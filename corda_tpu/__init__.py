@@ -1,0 +1,20 @@
+"""corda_tpu — a TPU-native distributed-ledger framework.
+
+A ground-up re-design of the capabilities of the reference platform
+(peterarmstrong/corda, JVM) for TPU hosts: the consensus-critical
+transaction-verification hot path (batched EC signature verification,
+Merkle hashing) runs as vectorised JAX/XLA programs on TPU, sharded
+across chips with `jax.sharding`; node logic is asyncio Python; the
+inter-node transport is gRPC over DCN.
+
+Layer map (mirrors SURVEY.md §1 of the reference):
+  crypto/   — L0 kernel: batched field/EC arithmetic, schemes, Merkle
+  core/     — L0/L1: data model, transactions, canonical serialization
+  flows/    — L3: flow framework (resumable state machines)
+  node/     — L2/L4/L5/L6: messaging, services, notaries, node assembly
+  parallel/ — mesh/sharding helpers (ICI data-parallel batch verify)
+  finance/  — L8: financial contracts and flows
+  testing/  — MockNetwork, ledger DSL, generators
+"""
+
+__version__ = "0.1.0"

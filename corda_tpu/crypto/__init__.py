@@ -1,0 +1,9 @@
+"""Crypto kernel: batched big-integer / elliptic-curve arithmetic on TPU.
+
+This package is the TPU-native replacement for the reference's JCA/
+BouncyCastle crypto stack (reference: core/src/main/kotlin/net/corda/core/
+crypto/Crypto.kt:73-605). The hot path — EC signature verification — is
+implemented as batch-oriented JAX programs over int32 limb vectors; the
+host side provides canonical encodings, hashing, DER parsing and a pure-
+Python bit-exact reference implementation.
+"""

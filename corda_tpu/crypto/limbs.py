@@ -1,0 +1,60 @@
+"""Limb representation for batched 256-bit integers on TPU.
+
+A big integer is a vector of NLIMB radix-2^12 digits stored in int32.
+On device a *batch* of B integers is a single `[NLIMB, B]` int32 array:
+the batch dimension is minor so each limb row is a contiguous [B] vector
+that maps onto the 8x128 VPU lanes.
+
+Why 12-bit limbs: TPU has no native 64-bit multiply, so schoolbook
+products must fit int32. With 12-bit digits a partial product is <= 24
+bits and a full column sum of 22 partials stays < 2^28.5 — comfortable
+int32 headroom, no simulated wide arithmetic anywhere.
+
+22 limbs * 12 bits = 264 bits >= 256-bit field elements with slack for
+Montgomery R = 2^264.
+
+(Reference semantics being replaced: JCA BigInteger/BouncyCastle inside
+core/.../crypto/Crypto.kt:439-503 — scalar, one-at-a-time.)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+LIMB_BITS = 12
+LIMB_MASK = (1 << LIMB_BITS) - 1
+NLIMB = 22                      # 264 bits
+RADIX = 1 << LIMB_BITS
+R_BITS = NLIMB * LIMB_BITS      # Montgomery R = 2**R_BITS
+
+
+def int_to_limbs(x: int, nlimb: int = NLIMB) -> np.ndarray:
+    """Host: python int -> [nlimb] int32 little-endian radix-2^12 digits."""
+    if x < 0:
+        raise ValueError("negative")
+    out = np.zeros(nlimb, dtype=np.int32)
+    for i in range(nlimb):
+        out[i] = x & LIMB_MASK
+        x >>= LIMB_BITS
+    if x:
+        raise ValueError(f"integer does not fit in {nlimb} limbs")
+    return out
+
+
+def limbs_to_int(limbs) -> int:
+    """Host: [nlimb] digit array (any int dtype, possibly non-canonical) -> python int."""
+    x = 0
+    for i, d in enumerate(np.asarray(limbs).tolist()):
+        x += int(d) << (LIMB_BITS * i)
+    return x
+
+
+def ints_to_batch(xs, nlimb: int = NLIMB) -> np.ndarray:
+    """Host: list of B python ints -> [nlimb, B] int32 batch."""
+    return np.stack([int_to_limbs(x, nlimb) for x in xs], axis=1)
+
+
+def batch_to_ints(arr) -> list[int]:
+    """Host: [nlimb, B] batch -> list of B python ints."""
+    a = np.asarray(arr)
+    return [limbs_to_int(a[:, j]) for j in range(a.shape[1])]

@@ -1,0 +1,323 @@
+"""Batched Montgomery modular arithmetic over [NLIMB, B] int32 limb arrays.
+
+All device functions are shape-polymorphic in the batch dimension B and
+contain no data-dependent control flow — everything is branchless selects
+so the whole signature-verification program jits into one XLA computation.
+
+Design (TPU-first):
+  * A field element batch is a [22, B] int32 array of radix-2^12 digits,
+    batch minor so each limb row vectorises across the 8x128 VPU lanes.
+  * Schoolbook products are ONE broadcast multiply [22,22,B] plus a
+    diagonal-sum: pad rows to length 45, reflatten as [22,44,B] and
+    reduce over axis 0 (45 = 1 mod 44, so flat columns align with i+j).
+    ~8 XLA ops per 264x264-bit multiply — both compile-time and VPU
+    friendly (the reference does one BigInteger multiply per signature
+    on the JVM instead: core/.../crypto/Crypto.kt:439-503).
+  * Carries are *parallel rounds* (shift-mask-add over the whole limb
+    axis). Three rounds bound non-negative limbs by 4096; no sequential
+    44-step chains in the hot path.
+  * Lazy reduction: Montgomery outputs live in [0, 2p) — there is no
+    conditional subtract inside the field ops. Subtraction adds a
+    precomputed 8p offset whose limbs are all >= 4096, keeping every
+    intermediate limb non-negative. Canonical form (< p, 12-bit digits)
+    is restored only at domain boundaries (`canon2p`, `from_mont`).
+
+Bound discipline (checked in comments where used):
+  * "bounded" limbs: in [0, 4200); product columns then stay < 2^29.
+  * mont_mul accepts values < 12p and returns a value < 2p with bounded
+    limbs: U/R < 144 p^2/R + (1+2^-11) p < 1.6 p  (p < 2^256, R = 2^264).
+  * add_mod: value < sum of inputs; sub_mod: value < a + 8p. The EC
+    formulas in ec.py keep every mul operand under 12p.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .limbs import LIMB_BITS, LIMB_MASK, NLIMB, R_BITS, int_to_limbs
+
+# ---------------------------------------------------------------------------
+# host-side context
+
+
+def _saturated_digits(value: int) -> tuple[int, ...] | None:
+    """Decompose value (= 8p) into 22 digits with digits[0..20] >= 4104.
+
+    Used as the subtraction offset: every low digit dominates any bounded
+    limb (<= 4100 after carry rounds), and the top digit (~ 8p >> 252)
+    dominates the top limb of any subtrahend < 4p, so a - b + offset has
+    non-negative limbs everywhere. Returns None when the top digit can't
+    dominate (scalar-order fields ~2^252 — they never subtract; see
+    sub_mod).
+    """
+    digits = []
+    v = value
+    for _ in range(NLIMB - 1):
+        r = v % 4096
+        d = r + 4096 if r >= 8 else r + 8192
+        digits.append(d)
+        v = (v - d) >> LIMB_BITS
+    if not (40 <= v < (1 << 30)):
+        return None
+    digits.append(v)
+    return tuple(digits)
+
+
+@dataclass(frozen=True)
+class MontCtx:
+    """Per-modulus constants, precomputed on host with python ints."""
+
+    p: int
+    p_limbs: tuple[int, ...]
+    pinv_limbs: tuple[int, ...]    # (-p)^-1 mod R
+    r2_limbs: tuple[int, ...]      # R^2 mod p
+    r_mod_p: int                   # R mod p  (Montgomery form of 1)
+    sub_offset: tuple[int, ...] | None   # 8p as saturated digits
+    inv_exp_bits: tuple[int, ...]  # bits of p-2, MSB first (Fermat inverse)
+
+    @staticmethod
+    def make(p: int) -> "MontCtx":
+        R = 1 << R_BITS
+        pinv = (-pow(p, -1, R)) % R
+        e = p - 2
+        bits = tuple((e >> i) & 1 for i in range(e.bit_length() - 1, -1, -1))
+        return MontCtx(
+            p=p,
+            p_limbs=tuple(int(v) for v in int_to_limbs(p)),
+            pinv_limbs=tuple(int(v) for v in int_to_limbs(pinv)),
+            r2_limbs=tuple(int(v) for v in int_to_limbs((R * R) % p)),
+            r_mod_p=R % p,
+            sub_offset=_saturated_digits(8 * p),
+            inv_exp_bits=bits,
+        )
+
+
+def _const_col(limbs: tuple[int, ...]):
+    """[N, 1] int32 device constant from a limb tuple."""
+    return jnp.asarray(np.array(limbs, dtype=np.int32))[:, None]
+
+
+# ---------------------------------------------------------------------------
+# carry rounds and products
+
+
+def _rounds(x, n: int):
+    """n parallel carry rounds on non-negative columns [K, B].
+
+    Returns (bounded_limbs, carry_out_sum): carries leaving the top limb
+    are summed (units of 2^(12K)) — callers either know they are zero or
+    use them for exact division by R. Three rounds take columns < 2^30
+    down to limbs <= 4096.
+    """
+    out = jnp.zeros_like(x[0])
+    for _ in range(n):
+        low = x & LIMB_MASK
+        c = x >> LIMB_BITS
+        x = low + jnp.concatenate([jnp.zeros_like(c[:1]), c[:-1]], axis=0)
+        out = out + c[-1]
+    return x, out
+
+
+def _diag_mul(a, b):
+    """Raw schoolbook column sums: [22,B] x [22,B] -> [44,B].
+
+    Inputs must have bounded limbs (< 4200) so columns stay < 2^29.
+    """
+    batch = a.shape[1]
+    prods = a[:, None, :] * b[None, :, :]                  # [22, 22, B]
+    padded = jnp.pad(prods, ((0, 0), (0, NLIMB + 1), (0, 0)))   # [22, 45, B]
+    flat = padded.reshape(NLIMB * (2 * NLIMB + 1), batch)
+    flat = flat[: NLIMB * 2 * NLIMB]
+    # 45 = 1 mod 44: flat column index == i + j for every product (i, j)
+    return flat.reshape(NLIMB, 2 * NLIMB, batch).sum(axis=0)
+
+
+def _diag_mul_const(a, const_limbs: tuple[int, ...]):
+    """Schoolbook columns against a host-constant second operand."""
+    batch = a.shape[1]
+    c = _const_col(const_limbs)                            # [22, 1]
+    prods = a[:, None, :] * c[None, :, :]                  # [22, 22, B]
+    padded = jnp.pad(prods, ((0, 0), (0, NLIMB + 1), (0, 0)))
+    flat = padded.reshape(NLIMB * (2 * NLIMB + 1), batch)
+    flat = flat[: NLIMB * 2 * NLIMB]
+    return flat.reshape(NLIMB, 2 * NLIMB, batch).sum(axis=0)
+
+
+def _mont_reduce(ctx: MontCtx, t_cols):
+    """Montgomery reduction of raw columns T (< 144 p^2) -> T/R mod p.
+
+    t_cols: [K, B] raw column sums, K <= 44, non-negative, < 2^30.
+    Output: value < 2p, bounded limbs.
+    """
+    batch = t_cols.shape[1]
+    if t_cols.shape[0] < 2 * NLIMB:
+        t_cols = jnp.pad(t_cols, ((0, 2 * NLIMB - t_cols.shape[0]), (0, 0)))
+    # m = (T mod R) * pinv mod R — dropping columns/carries >= R is free
+    t_lo_b, _ = _rounds(t_cols[:NLIMB], 3)
+    m, _ = _rounds(_diag_mul_const(t_lo_b, ctx.pinv_limbs)[:NLIMB], 3)
+    # U = T + m*p == 0 (mod R); divide exactly by R
+    u = t_cols + _diag_mul_const(m, ctx.p_limbs)
+    lo, t_drop = _rounds(u[:NLIMB], 3)
+    # remaining low value is a multiple of R in [0, 1.001*R) => 0 or R
+    t = t_drop + jnp.any(lo != 0, axis=0).astype(jnp.int32)
+    hi = u[NLIMB:].at[0].add(t)
+    out, top = _rounds(hi, 3)
+    del top  # value < 2p < 2^258 fits 22 limbs; top carries are zero
+    return out
+
+
+# ---------------------------------------------------------------------------
+# public batched ops (stacked [NLIMB, B] int32)
+
+
+def mont_mul(ctx: MontCtx, a, b):
+    """(a*b*R^-1) mod p for Montgomery-domain a, b (values < 12p each)."""
+    return _mont_reduce(ctx, _diag_mul(a, b))
+
+
+def mont_sqr(ctx: MontCtx, a):
+    return mont_mul(ctx, a, a)
+
+
+def mont_mul_const(ctx: MontCtx, a, const_limbs: tuple[int, ...]):
+    """a * const * R^-1 mod p, const given as canonical limb tuple."""
+    return _mont_reduce(ctx, _diag_mul_const(a, const_limbs))
+
+
+def add_mod(ctx: MontCtx, a, b):
+    """a+b (no reduction — lazy; value grows, limbs rebounded)."""
+    s, _ = _rounds(a + b, 1)
+    return s
+
+
+def sub_mod(ctx: MontCtx, a, b):
+    """a-b+8p: congruent to a-b mod p, non-negative limbs throughout.
+
+    Contract (satisfied by every call in ec.py): b is a mul or add
+    output with value < 4p and bounded limbs, so offset digits dominate
+    b limb-wise. Only curve fields (p ~ 2^255+) support subtraction;
+    scalar-order fields never need it.
+    """
+    if ctx.sub_offset is None:
+        raise ValueError("sub_mod unsupported for this modulus (no offset)")
+    s, _ = _rounds(a - b + _const_col(ctx.sub_offset), 1)
+    return s
+
+
+def neg_mod(ctx: MontCtx, a):
+    """8p - a, congruent to -a mod p (a < 4p, bounded limbs)."""
+    if ctx.sub_offset is None:
+        raise ValueError("neg_mod unsupported for this modulus (no offset)")
+    s, _ = _rounds(_const_col(ctx.sub_offset) - a, 1)
+    return s
+
+
+def _lex_ge(rows, b_limbs: tuple[int, ...]):
+    """[B] bool: value(rows) >= b, canonical non-negative digits."""
+    ge = jnp.ones_like(rows[0], dtype=jnp.bool_)
+    for k in range(len(rows)):
+        bk = b_limbs[k] if k < len(b_limbs) else 0
+        ge = (rows[k] > bk) | ((rows[k] == bk) & ge)
+    return ge
+
+
+def canon2p(ctx: MontCtx, x):
+    """Exact canonical form (< p, 12-bit digits) of a value < 2p."""
+    rows = [x[i] for i in range(NLIMB)]
+    for k in range(NLIMB - 1):            # exact sequential carry
+        c = rows[k] >> LIMB_BITS
+        rows[k] = rows[k] - (c << LIMB_BITS)
+        rows[k + 1] = rows[k + 1] + c
+    ge = _lex_ge(rows, ctx.p_limbs)
+    d = [rows[k] - ctx.p_limbs[k] for k in range(NLIMB)]
+    for k in range(NLIMB - 1):
+        c = d[k] >> LIMB_BITS
+        d[k] = d[k] - (c << LIMB_BITS)
+        d[k + 1] = d[k + 1] + c
+    return jnp.stack(
+        [jnp.where(ge, d[k], rows[k]) for k in range(NLIMB)], axis=0
+    )
+
+
+def to_mont(ctx: MontCtx, x):
+    """Standard -> Montgomery domain. Accepts any value < R (mods by p)."""
+    return mont_mul_const(ctx, x, ctx.r2_limbs)
+
+
+def from_mont(ctx: MontCtx, x):
+    """Montgomery -> standard domain, exact canonical output (< p)."""
+    return canon2p(ctx, _mont_reduce(ctx, x))
+
+
+def mont_canon(ctx: MontCtx, x):
+    """Canonical representative of a Montgomery-domain value < 2p.
+
+    Montgomery form is a bijection, so equality of Montgomery values is
+    equality of field elements once canonicalised.
+    """
+    return canon2p(ctx, x)
+
+
+def mont_pow_const(ctx: MontCtx, a, exp_bits: tuple[int, ...]):
+    """a^e for host-constant exponent (MSB-first bits), Montgomery domain.
+
+    Branchless square-and-multiply via lax.scan — 2 muls per bit.
+    """
+    bits = jnp.asarray(np.array(exp_bits, dtype=np.bool_))
+    one = mont_one(ctx, a.shape[1])
+
+    def body(acc, bit):
+        acc = mont_mul(ctx, acc, acc)
+        acc2 = mont_mul(ctx, acc, a)
+        return jnp.where(bit, acc2, acc), None
+
+    out, _ = lax.scan(body, one, bits)
+    return out
+
+
+def mont_inv(ctx: MontCtx, a):
+    """a^-1 mod p in Montgomery domain (Fermat; p must be prime)."""
+    return mont_pow_const(ctx, a, ctx.inv_exp_bits)
+
+
+def mont_one(ctx: MontCtx, batch: int):
+    """Montgomery form of 1, broadcast to [NLIMB, batch]."""
+    return const_batch(ctx.r_mod_p, batch)
+
+
+def const_batch(value: int, batch: int):
+    """Broadcast a host integer to a canonical [NLIMB, batch] limb array."""
+    limbs = int_to_limbs(value)
+    return jnp.broadcast_to(
+        jnp.asarray(limbs, dtype=jnp.int32)[:, None], (NLIMB, batch)
+    ).astype(jnp.int32)
+
+
+def is_zero(a) -> jnp.ndarray:
+    """[B] bool: canonical value == 0 (canonicalise first if lazy)."""
+    return jnp.all(a == 0, axis=0)
+
+
+def eq(a, b) -> jnp.ndarray:
+    """[B] bool: canonical values equal (limb-wise)."""
+    return jnp.all(a == b, axis=0)
+
+
+def select(mask, a, b):
+    """Per-batch-element select: mask [B] -> where(mask, a, b) on [NLIMB,B]."""
+    return jnp.where(mask[None, :], a, b)
+
+
+def get_bit(x, i):
+    """Bit i of canonical standard-domain limb array x: [B] int32 in {0,1}.
+
+    i may be a traced scalar (used inside scalar-mult fori_loops).
+    """
+    limb_idx = i // LIMB_BITS
+    shift = i % LIMB_BITS
+    row = lax.dynamic_index_in_dim(x, limb_idx, axis=0, keepdims=False)
+    return (row >> shift) & 1

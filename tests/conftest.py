@@ -1,0 +1,21 @@
+"""Test configuration: force an 8-virtual-device CPU mesh.
+
+The container's sitecustomize registers the remote-TPU (axon) PJRT
+plugin and pins jax_platforms at interpreter start, so plain env-var
+setdefault is too late — we must override the live jax config before
+any backend initialises. Tests never touch real TPU hardware; multi-
+chip sharding paths run on the virtual CPU mesh (the driver separately
+dry-runs the multi-chip path via __graft_entry__.dryrun_multichip).
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,131 @@
+"""Differential tests: batched limb arithmetic vs python ints."""
+
+import random
+from functools import partial
+
+import jax
+import numpy as np
+import pytest
+
+from corda_tpu.crypto import limbs as L
+from corda_tpu.crypto import modmath as M
+from corda_tpu.crypto.curves import ED25519, SECP256K1, SECP256R1
+
+# jit with the MontCtx static: the limb ops are built to run inside one
+# fused XLA computation — eager per-op dispatch is pathologically slow.
+jmul = partial(jax.jit, static_argnums=0)
+
+
+@jmul
+def _ops(ctx, ax, ay):
+    out = [
+        M.from_mont(ctx, M.mont_mul(ctx, ax, ay)),
+        M.from_mont(ctx, M.add_mod(ctx, ax, ay)),
+    ]
+    if ctx.sub_offset is not None:  # scalar-order fields never subtract
+        out.append(M.from_mont(ctx, M.sub_mod(ctx, ax, ay)))
+        out.append(M.from_mont(ctx, M.neg_mod(ctx, ax)))
+    return out
+
+
+@jmul
+def _to_from(ctx, a):
+    return M.from_mont(ctx, M.to_mont(ctx, a))
+
+
+@jmul
+def _inv(ctx, ax):
+    return M.from_mont(ctx, M.mont_inv(ctx, ax))
+
+
+@jmul
+def _tm(ctx, a):
+    return M.to_mont(ctx, a)
+
+MODULI = {
+    "p256": SECP256R1.p,
+    "n256": SECP256R1.n,
+    "k1": SECP256K1.p,
+    "nk1": SECP256K1.n,
+    "p25519": ED25519.p,
+    "L25519": ED25519.L,
+}
+
+
+def rand_elems(rng, p, b):
+    special = [0, 1, 2, p - 1, p - 2, p // 2]
+    xs = special[:b] + [rng.randrange(p) for _ in range(max(0, b - len(special)))]
+    return xs[:b]
+
+
+@pytest.mark.parametrize("mod_name", list(MODULI))
+def test_limb_roundtrip(mod_name):
+    p = MODULI[mod_name]
+    rng = random.Random(1)
+    xs = rand_elems(rng, p, 8)
+    assert L.batch_to_ints(L.ints_to_batch(xs)) == xs
+
+
+@pytest.mark.parametrize("mod_name", list(MODULI))
+def test_mont_mul_add_sub(mod_name):
+    p = MODULI[mod_name]
+    ctx = M.MontCtx.make(p)
+    rng = random.Random(2)
+    B = 8
+    xs = rand_elems(rng, p, B)
+    ys = list(reversed(rand_elems(rng, p, B)))
+    ax = _tm(ctx, L.ints_to_batch(xs))
+    ay = _tm(ctx, L.ints_to_batch(ys))
+
+    got = [L.batch_to_ints(o) for o in _ops(ctx, ax, ay)]
+    assert got[0] == [(x * y) % p for x, y in zip(xs, ys)]
+    assert got[1] == [(x + y) % p for x, y in zip(xs, ys)]
+    if ctx.sub_offset is not None:
+        assert got[2] == [(x - y) % p for x, y in zip(xs, ys)]
+        assert got[3] == [(-x) % p for x in xs]
+
+
+@pytest.mark.parametrize("mod_name", ["p256", "n256", "p25519"])
+def test_mont_roundtrip_and_one(mod_name):
+    p = MODULI[mod_name]
+    ctx = M.MontCtx.make(p)
+    rng = random.Random(3)
+    xs = rand_elems(rng, p, 8)
+    a = L.ints_to_batch(xs)
+    assert L.batch_to_ints(_to_from(ctx, a)) == xs
+    # to_mont accepts non-canonical inputs (values >= p, < R)
+    big = [p + 5, 2 * p + 7] + xs[:6]
+    assert L.batch_to_ints(_to_from(ctx, L.ints_to_batch(big))) == [v % p for v in big]
+    one = M.mont_one(ctx, 8)
+    assert L.batch_to_ints(jmul(M.from_mont)(ctx, one)) == [1] * 8
+
+
+@pytest.mark.parametrize("mod_name", ["p256", "n256", "k1", "p25519", "L25519"])
+def test_mont_inv(mod_name):
+    p = MODULI[mod_name]
+    ctx = M.MontCtx.make(p)
+    rng = random.Random(4)
+    xs = [rng.randrange(1, p) for _ in range(4)] + [1, p - 1, 2, p - 2]
+    ax = _tm(ctx, L.ints_to_batch(xs))
+    got = L.batch_to_ints(_inv(ctx, ax))
+    assert got == [pow(x, -1, p) for x in xs]
+
+
+def test_get_bit():
+    xs = [0b1011, 1 << 255, (1 << 256) - 1]
+    a = L.ints_to_batch(xs)
+    for i in [0, 1, 2, 3, 11, 12, 100, 255]:
+        got = np.asarray(M.get_bit(a, i)).tolist()
+        assert got == [(x >> i) & 1 for x in xs], f"bit {i}"
+
+
+def test_eq_iszero_select():
+    import jax.numpy as jnp
+
+    xs = [0, 5, 7]
+    a = L.ints_to_batch(xs)
+    b = L.ints_to_batch([0, 5, 8])
+    assert np.asarray(M.is_zero(a)).tolist() == [True, False, False]
+    assert np.asarray(M.eq(a, b)).tolist() == [True, True, False]
+    m = jnp.asarray([True, False, True])
+    assert L.batch_to_ints(M.select(m, a, b)) == [0, 5, 7]
