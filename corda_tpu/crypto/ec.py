@@ -118,7 +118,8 @@ def wei_select(mask, P, Q):
 
 
 def wei_is_infinity(ctx: MontCtx, P):
-    return is_zero(mont_canon(ctx, P[2]))
+    # Z can be an add-of-muls output, value < 4p
+    return is_zero(mont_canon(ctx, P[2], bound_mul=4))
 
 
 def wei_double_scalar_mul(curve: WeierstrassCurve, u1, u2, Q, nbits: int = 256):

@@ -225,22 +225,22 @@ def _lex_ge(rows, b_limbs: tuple[int, ...]):
     return ge
 
 
-def canon2p(ctx: MontCtx, x):
-    """Exact canonical form (< p, 12-bit digits) of a value < 2p."""
+def canon(ctx: MontCtx, x, bound_mul: int = 2):
+    """Exact canonical form (< p, 12-bit digits) of a value < bound_mul*p."""
     rows = [x[i] for i in range(NLIMB)]
     for k in range(NLIMB - 1):            # exact sequential carry
         c = rows[k] >> LIMB_BITS
         rows[k] = rows[k] - (c << LIMB_BITS)
         rows[k + 1] = rows[k + 1] + c
-    ge = _lex_ge(rows, ctx.p_limbs)
-    d = [rows[k] - ctx.p_limbs[k] for k in range(NLIMB)]
-    for k in range(NLIMB - 1):
-        c = d[k] >> LIMB_BITS
-        d[k] = d[k] - (c << LIMB_BITS)
-        d[k + 1] = d[k + 1] + c
-    return jnp.stack(
-        [jnp.where(ge, d[k], rows[k]) for k in range(NLIMB)], axis=0
-    )
+    for _ in range(bound_mul - 1):        # conditional subtracts of p
+        ge = _lex_ge(rows, ctx.p_limbs)
+        d = [rows[k] - ctx.p_limbs[k] for k in range(NLIMB)]
+        for k in range(NLIMB - 1):
+            c = d[k] >> LIMB_BITS
+            d[k] = d[k] - (c << LIMB_BITS)
+            d[k + 1] = d[k + 1] + c
+        rows = [jnp.where(ge, d[k], rows[k]) for k in range(NLIMB)]
+    return jnp.stack(rows, axis=0)
 
 
 def to_mont(ctx: MontCtx, x):
@@ -250,16 +250,16 @@ def to_mont(ctx: MontCtx, x):
 
 def from_mont(ctx: MontCtx, x):
     """Montgomery -> standard domain, exact canonical output (< p)."""
-    return canon2p(ctx, _mont_reduce(ctx, x))
+    return canon(ctx, _mont_reduce(ctx, x))
 
 
-def mont_canon(ctx: MontCtx, x):
-    """Canonical representative of a Montgomery-domain value < 2p.
+def mont_canon(ctx: MontCtx, x, bound_mul: int = 2):
+    """Canonical representative of a Montgomery-domain value < bound_mul*p.
 
     Montgomery form is a bijection, so equality of Montgomery values is
     equality of field elements once canonicalised.
     """
-    return canon2p(ctx, x)
+    return canon(ctx, x, bound_mul)
 
 
 def mont_pow_const(ctx: MontCtx, a, exp_bits: tuple[int, ...]):

@@ -1,0 +1,176 @@
+"""Differential tests: batched EC point ops vs pure-python reference."""
+
+import random
+from functools import partial
+
+import jax
+import numpy as np
+import pytest
+
+from corda_tpu.crypto import ec, refmath
+from corda_tpu.crypto import limbs as L
+from corda_tpu.crypto import modmath as M
+from corda_tpu.crypto.curves import ED25519, SECP256K1, SECP256R1
+
+WCURVES = {"p256": SECP256R1, "k1": SECP256K1}
+
+
+def wpoints_to_batch(curve, pts):
+    """Affine python points (None = infinity) -> projective Montgomery batch."""
+    ctx = curve.fp
+    xs = [0 if p is None else p[0] for p in pts]
+    ys = [1 if p is None else p[1] for p in pts]
+    zs = [0 if p is None else 1 for p in pts]
+    tm = jax.jit(M.to_mont, static_argnums=0)
+    return (
+        tm(ctx, L.ints_to_batch(xs)),
+        tm(ctx, L.ints_to_batch(ys)),
+        tm(ctx, L.ints_to_batch(zs)),
+    )
+
+
+@partial(jax.jit, static_argnums=0)
+def _wei_add_affine(curve, P, Q):
+    R = ec.wei_add(curve, P, Q)
+    ctx = curve.fp
+    x, y = ec.wei_proj_to_affine(ctx, R)
+    return (
+        M.from_mont(ctx, x),
+        M.from_mont(ctx, y),
+        ec.wei_is_infinity(ctx, R),
+    )
+
+
+@pytest.mark.parametrize("name", list(WCURVES))
+def test_wei_add_complete(name):
+    """Complete addition: generic, doubling, inverse, infinity cases."""
+    c = WCURVES[name]
+    rng = random.Random(10)
+    G = (c.gx, c.gy)
+    P1 = refmath.wei_mul(c, rng.randrange(1, c.n), G)
+    P2 = refmath.wei_mul(c, rng.randrange(1, c.n), G)
+    neg1 = (P1[0], c.p - P1[1])
+    cases = [
+        (P1, P2),          # generic
+        (P1, P1),          # doubling via the same formula
+        (P1, neg1),        # P + (-P) = infinity
+        (None, P1),        # inf + P
+        (P1, None),        # P + inf
+        (None, None),      # inf + inf
+        (G, G),
+        (P2, P1),
+    ]
+    A = wpoints_to_batch(c, [a for a, _ in cases])
+    B = wpoints_to_batch(c, [b for _, b in cases])
+    gx, gy, ginf = _wei_add_affine(c, A, B)
+    gx, gy = L.batch_to_ints(gx), L.batch_to_ints(gy)
+    ginf = np.asarray(ginf).tolist()
+    for i, (a, b) in enumerate(cases):
+        want = refmath.wei_add(c, a, b)
+        if want is None:
+            assert ginf[i], f"case {i}: expected infinity"
+        else:
+            assert not ginf[i], f"case {i}: unexpected infinity"
+            assert (gx[i], gy[i]) == want, f"case {i}"
+
+
+@partial(jax.jit, static_argnums=(0, 4))
+def _wei_dsm(curve, u1, u2, Q, nbits):
+    R = ec.wei_double_scalar_mul(curve, u1, u2, Q, nbits)
+    ctx = curve.fp
+    x, y = ec.wei_proj_to_affine(ctx, R)
+    return M.from_mont(ctx, x), M.from_mont(ctx, y), ec.wei_is_infinity(ctx, R)
+
+
+@pytest.mark.parametrize("name", list(WCURVES))
+def test_wei_double_scalar_mul(name):
+    c = WCURVES[name]
+    rng = random.Random(11)
+    G = (c.gx, c.gy)
+    B = 8
+    u1s = [rng.randrange(c.n) for _ in range(B - 3)] + [0, 1, c.n - 1]
+    u2s = [rng.randrange(c.n) for _ in range(B - 3)] + [0, 0, c.n - 1]
+    qs = [refmath.wei_mul(c, rng.randrange(1, c.n), G) for _ in range(B)]
+    Q = wpoints_to_batch(c, qs)
+    gx, gy, ginf = _wei_dsm(
+        c, L.ints_to_batch(u1s), L.ints_to_batch(u2s), Q, 256
+    )
+    gx, gy = L.batch_to_ints(gx), L.batch_to_ints(gy)
+    ginf = np.asarray(ginf).tolist()
+    for i in range(B):
+        want = refmath.wei_add(
+            c,
+            refmath.wei_mul(c, u1s[i], G),
+            refmath.wei_mul(c, u2s[i], qs[i]),
+        )
+        if want is None:
+            assert ginf[i], f"case {i}"
+        else:
+            assert (gx[i], gy[i]) == want, f"case {i}"
+
+
+# ---------------------------------------------------------------------------
+# Edwards
+
+
+def epoints_to_batch(pts):
+    ctx = ED25519.fp
+    tm = jax.jit(M.to_mont, static_argnums=0)
+    xm = tm(ctx, L.ints_to_batch([p[0] for p in pts]))
+    ym = tm(ctx, L.ints_to_batch([p[1] for p in pts]))
+    return jax.jit(ec.ed_affine_to_ext, static_argnums=0)(ctx, xm, ym)
+
+
+@partial(jax.jit, static_argnums=0)
+def _ed_add_affine(curve, P, Q):
+    R = ec.ed_add(curve, P, Q)
+    ctx = curve.fp
+    x, y = ec.ed_ext_to_affine(ctx, R)
+    return M.from_mont(ctx, x), M.from_mont(ctx, y)
+
+
+def test_ed_add_complete():
+    c = ED25519
+    rng = random.Random(12)
+    Bpt = (c.gx, c.gy)
+    P1 = refmath.ed_mul(c, rng.randrange(1, c.L), Bpt)
+    P2 = refmath.ed_mul(c, rng.randrange(1, c.L), Bpt)
+    neg1 = ((c.p - P1[0]) % c.p, P1[1])
+    ident = (0, 1)
+    cases = [(P1, P2), (P1, P1), (P1, neg1), (ident, P1), (P1, ident),
+             (ident, ident), (Bpt, Bpt), (P2, P1)]
+    A = epoints_to_batch([a for a, _ in cases])
+    B = epoints_to_batch([b for _, b in cases])
+    gx, gy = _ed_add_affine(c, A, B)
+    gx, gy = L.batch_to_ints(gx), L.batch_to_ints(gy)
+    for i, (a, b) in enumerate(cases):
+        want = refmath.ed_add(c, a, b)
+        assert (gx[i], gy[i]) == want, f"case {i}"
+
+
+@partial(jax.jit, static_argnums=(0, 4))
+def _ed_dsm(curve, s, k, A, nbits):
+    R = ec.ed_double_scalar_mul(curve, s, k, A, nbits)
+    ctx = curve.fp
+    x, y = ec.ed_ext_to_affine(ctx, R)
+    return M.from_mont(ctx, x), M.from_mont(ctx, y)
+
+
+def test_ed_double_scalar_mul():
+    c = ED25519
+    rng = random.Random(13)
+    Bpt = (c.gx, c.gy)
+    B = 8
+    ss = [rng.randrange(1 << 256) for _ in range(B - 3)] + [0, 1, c.L - 1]
+    ks = [rng.randrange(c.L) for _ in range(B - 3)] + [0, 0, c.L - 1]
+    apts = [refmath.ed_mul(c, rng.randrange(1, c.L), Bpt) for _ in range(B)]
+    A = epoints_to_batch(apts)
+    gx, gy = _ed_dsm(c, L.ints_to_batch(ss), L.ints_to_batch(ks), A, 256)
+    gx, gy = L.batch_to_ints(gx), L.batch_to_ints(gy)
+    for i in range(B):
+        want = refmath.ed_add(
+            c,
+            refmath.ed_mul(c, ss[i], Bpt),
+            refmath.ed_mul(c, ks[i], apts[i]),
+        )
+        assert (gx[i], gy[i]) == want, f"case {i}"
