@@ -1,0 +1,172 @@
+"""BatchSignatureVerifier SPI — the north-star verification seam.
+
+The reference verifies signatures one at a time on the JVM inside
+`SignedTransaction.verifyRegularTransaction` -> `Crypto.doVerify`
+(core/.../transactions/SignedTransaction.kt:143-149, crypto/Crypto.kt:
+439-503), and only offloads *contract* execution through its
+`TransactionVerifierService` SPI. Here the signature check itself is the
+SPI: callers accumulate (key, signature, message) triples and drain them
+through `verify_batch`, which the TPU implementation pads into fixed
+batch shapes and dispatches as one jitted XLA program per scheme —
+optionally sharded over a device mesh (ICI data parallelism).
+
+Implementations:
+  * CpuBatchVerifier  — pure-python reference semantics (bit-exactness
+    anchor; also the fallback for non-batchable schemes).
+  * TpuBatchVerifier  — jitted limb kernels, per-scheme bucketing,
+    power-of-two padding, optional jax.sharding mesh.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..parallel import mesh as meshlib
+from . import encodings, schemes
+from .curves import SECP256K1, SECP256R1
+from .ecdsa import ecdsa_verify_batch
+from .eddsa import ed25519_verify_batch
+
+
+@dataclass(frozen=True)
+class VerificationRequest:
+    """One signature check: does `signature` by `key` cover `message`?"""
+
+    key: schemes.PublicKey
+    signature: bytes
+    message: bytes
+
+
+class BatchSignatureVerifier:
+    """SPI: verify a batch of signature requests, preserving order."""
+
+    def verify_batch(self, requests: Sequence[VerificationRequest]) -> list[bool]:
+        raise NotImplementedError
+
+
+class CpuBatchVerifier(BatchSignatureVerifier):
+    """Reference semantics, one at a time on the host."""
+
+    def verify_batch(self, requests: Sequence[VerificationRequest]) -> list[bool]:
+        return [
+            schemes.verify_one(r.key, r.signature, r.message) for r in requests
+        ]
+
+
+class TpuBatchVerifier(BatchSignatureVerifier):
+    """Batched JAX/TPU verification with per-scheme bucketing.
+
+    Requests are grouped by scheme, padded up to the next configured
+    batch size (so jit caches stay warm across calls), verified on
+    device, and scattered back into request order. Schemes without a
+    batch kernel (RSA, SPHINCS placeholder) fall back to the CPU path.
+    """
+
+    def __init__(
+        self,
+        batch_sizes: tuple[int, ...] = (128, 1024, 4096),
+        mesh: Optional[object] = None,
+        donate: bool = True,
+    ):
+        self.batch_sizes = tuple(sorted(batch_sizes))
+        self.mesh = mesh
+        self._cpu = CpuBatchVerifier()
+        self._kernels = {}
+        del donate  # reserved
+
+    # -- kernel plumbing ----------------------------------------------------
+
+    def _kernel(self, scheme_id: int, batch: int):
+        key = (scheme_id, batch)
+        if key not in self._kernels:
+            if scheme_id == schemes.EDDSA_ED25519_SHA512:
+                fn = jax.jit(ed25519_verify_batch)
+            else:
+                curve = {
+                    schemes.ECDSA_SECP256K1_SHA256: SECP256K1,
+                    schemes.ECDSA_SECP256R1_SHA256: SECP256R1,
+                }[scheme_id]
+                fn = jax.jit(partial(ecdsa_verify_batch, curve))
+            self._kernels[key] = fn
+        return self._kernels[key]
+
+    def _pick_batch(self, n: int) -> int:
+        for b in self.batch_sizes:
+            if n <= b:
+                return b
+        return self.batch_sizes[-1]
+
+    def _dispatch(self, scheme_id: int, items: list, out, idxs) -> None:
+        """Verify one scheme bucket, chunking at the largest batch size."""
+        max_b = self.batch_sizes[-1]
+        for off in range(0, len(items), max_b):
+            chunk = items[off : off + max_b]
+            batch = self._pick_batch(len(chunk))
+            if scheme_id == schemes.EDDSA_ED25519_SHA512:
+                staged = encodings.stage_ed25519_batch(chunk, batch)
+            else:
+                curve = {
+                    schemes.ECDSA_SECP256K1_SHA256: SECP256K1,
+                    schemes.ECDSA_SECP256R1_SHA256: SECP256R1,
+                }[scheme_id]
+                staged = encodings.stage_ecdsa_batch(curve, chunk, batch)
+            if self.mesh is not None:
+                staged = {
+                    k: meshlib.shard_operand(self.mesh, v)
+                    for k, v in staged.items()
+                }
+            res = np.asarray(self._kernel(scheme_id, batch)(**staged))
+            for j, ok in enumerate(res[: len(chunk)].tolist()):
+                out[idxs[off + j]] = bool(ok)
+
+    # -- SPI ---------------------------------------------------------------
+
+    def verify_batch(self, requests: Sequence[VerificationRequest]) -> list[bool]:
+        out: list[Optional[bool]] = [None] * len(requests)
+        buckets: dict[int, tuple[list, list]] = {}
+        cpu_idx: list[int] = []
+        for i, req in enumerate(requests):
+            sid = req.key.scheme_id
+            if sid in SCHEME_KERNELS:
+                items, idxs = buckets.setdefault(sid, ([], []))
+                items.append((req.key.data, req.signature, req.message))
+                idxs.append(i)
+            else:
+                cpu_idx.append(i)
+        for sid, (items, idxs) in buckets.items():
+            self._dispatch(sid, items, out, idxs)
+        if cpu_idx:
+            cpu_res = self._cpu.verify_batch([requests[i] for i in cpu_idx])
+            for i, ok in zip(cpu_idx, cpu_res):
+                out[i] = ok
+        return [bool(v) for v in out]
+
+
+SCHEME_KERNELS = frozenset(
+    {
+        schemes.ECDSA_SECP256K1_SHA256,
+        schemes.ECDSA_SECP256R1_SHA256,
+        schemes.EDDSA_ED25519_SHA512,
+    }
+)
+
+
+_default: Optional[BatchSignatureVerifier] = None
+
+
+def default_verifier() -> BatchSignatureVerifier:
+    """Process-wide verifier: TPU-backed, constructed on first use."""
+    global _default
+    if _default is None:
+        _default = TpuBatchVerifier()
+    return _default
+
+
+def set_default_verifier(v: BatchSignatureVerifier) -> None:
+    global _default
+    _default = v

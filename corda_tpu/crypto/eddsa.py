@@ -1,0 +1,40 @@
+"""Batched ed25519 (EdDSA) verification kernel.
+
+Replaces the reference's default signature scheme — EDDSA_ED25519_SHA512
+via the i2p EdDSAEngine (core/.../crypto/Crypto.kt:171) — with a batch
+TPU program. Semantics are the cofactorless check with encoded-point
+comparison: accept iff encode(s*B - k*A) == R_bytes.
+
+Host side (encodings.py) decompresses and negates the public key A,
+computes k = SHA512(R || A || M) mod L, and splits the signature's R
+into (y value, sign bit); the device computes R' = s*B + k*(-A), maps
+to affine, and compares canonical y and the parity of x.
+"""
+
+from __future__ import annotations
+
+from .curves import ED25519
+from .ec import ed_affine_to_ext, ed_double_scalar_mul, ed_ext_to_affine
+from .modmath import eq, from_mont, to_mont
+
+
+def ed25519_verify_batch(
+    s,            # [22,B] signature scalar (raw 256-bit little-endian int)
+    k,            # [22,B] SHA512(R||A||M) mod L
+    nax,          # [22,B] canonical affine x of -A (host decompressed)
+    nay,          # [22,B] canonical affine y of -A
+    exp_y,        # [22,B] y value from signature R bytes (may be >= p)
+    exp_sign,     # [B] int32 sign bit from signature R bytes
+    valid_in,     # [B] bool host prefilter (decoding succeeded etc.)
+):
+    """[B] bool: cofactorless ed25519 verification."""
+    fp = ED25519.fp
+    A = ed_affine_to_ext(fp, to_mont(fp, nax), to_mont(fp, nay))
+    R = ed_double_scalar_mul(ED25519, s, k, A, nbits=256)
+    xm, ym = ed_ext_to_affine(fp, R)
+    x_std = from_mont(fp, xm)
+    y_std = from_mont(fp, ym)
+    sign = x_std[0] & 1
+    # canonical y' vs raw y-from-bytes: non-canonical encodings (y >= p)
+    # can never equal a canonical y', matching encode-and-compare.
+    return valid_in & eq(y_std, exp_y) & (sign == exp_sign)

@@ -1,0 +1,299 @@
+"""Host-side wire encodings and batch staging for the TPU kernels.
+
+Everything consensus-critical about *parsing* signatures lives here, on
+the host: strict DER for ECDSA, SEC1 points, RFC8032 ed25519 encodings.
+Malformed inputs are rejected before device dispatch (the "reject on
+host pre-filter" rule from SURVEY.md §7) — the device kernels only see
+well-formed field elements plus a validity mask.
+
+Also provides numpy-vectorised int <-> limb staging so host prep is not
+the bottleneck at 50k+ signatures/sec.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Optional
+
+import numpy as np
+
+from .curves import ED25519, WeierstrassCurve
+from .limbs import LIMB_BITS, NLIMB
+from . import refmath
+
+_LIMB_BYTES = NLIMB * LIMB_BITS // 8  # 33
+
+
+def ints_to_limbs_np(xs: list[int]) -> np.ndarray:
+    """[22, B] int32 limb batch from python ints (< 2^264), vectorised.
+
+    Byte-level 12-bit digit extraction: limb 2t spans bytes [3t, 3t+1],
+    limb 2t+1 spans bytes [3t+1, 3t+2].
+    """
+    buf = b"".join(x.to_bytes(_LIMB_BYTES, "little") for x in xs)
+    a = np.frombuffer(buf, dtype=np.uint8).reshape(len(xs), _LIMB_BYTES)
+    a = a.astype(np.int32)
+    out = np.zeros((len(xs), NLIMB), dtype=np.int32)
+    t = np.arange(NLIMB // 2)
+    out[:, 0::2] = a[:, 3 * t] | ((a[:, 3 * t + 1] & 0xF) << 8)
+    out[:, 1::2] = (a[:, 3 * t + 1] >> 4) | (a[:, 3 * t + 2] << 4)
+    return np.ascontiguousarray(out.T)
+
+
+# ---------------------------------------------------------------------------
+# ECDSA: strict DER signatures (r, s) and SEC1 public points
+
+
+def parse_der_ecdsa(sig: bytes) -> Optional[tuple[int, int]]:
+    """Strict DER SEQUENCE of two INTEGERs -> (r, s), None if malformed.
+
+    Matches the strict parsing of modern JCA/BouncyCastle providers:
+    definite lengths, minimal-length integers, no trailing bytes.
+    """
+    def read_len(b: bytes, i: int) -> Optional[tuple[int, int]]:
+        if i >= len(b):
+            return None
+        first = b[i]
+        if first < 0x80:
+            return first, i + 1
+        nlen = first & 0x7F
+        if nlen == 0 or nlen > 2 or i + 1 + nlen > len(b):
+            return None
+        val = int.from_bytes(b[i + 1 : i + 1 + nlen], "big")
+        if val < 0x80 or (nlen == 2 and val < 0x100):
+            return None  # non-minimal length encoding
+        return val, i + 1 + nlen
+
+    def read_int(b: bytes, i: int) -> Optional[tuple[int, int]]:
+        if i >= len(b) or b[i] != 0x02:
+            return None
+        ln = read_len(b, i + 1)
+        if ln is None:
+            return None
+        n, j = ln
+        if n == 0 or j + n > len(b):
+            return None
+        body = b[j : j + n]
+        if body[0] & 0x80:
+            return None  # negative
+        if n > 1 and body[0] == 0 and not (body[1] & 0x80):
+            return None  # non-minimal integer
+        return int.from_bytes(body, "big"), j + n
+
+    if len(sig) < 2 or sig[0] != 0x30:
+        return None
+    ln = read_len(sig, 1)
+    if ln is None:
+        return None
+    total, i = ln
+    if i + total != len(sig):
+        return None
+    ri = read_int(sig, i)
+    if ri is None:
+        return None
+    r, i = ri
+    si = read_int(sig, i)
+    if si is None:
+        return None
+    s, i = si
+    if i != len(sig):
+        return None
+    return r, s
+
+
+def encode_der_ecdsa(r: int, s: int) -> bytes:
+    """Minimal DER encoding of an (r, s) ECDSA signature."""
+    def enc_int(v: int) -> bytes:
+        body = v.to_bytes((v.bit_length() + 8) // 8 or 1, "big")
+        return b"\x02" + _der_len(len(body)) + body
+
+    body = enc_int(r) + enc_int(s)
+    return b"\x30" + _der_len(len(body)) + body
+
+
+def _der_len(n: int) -> bytes:
+    if n < 0x80:
+        return bytes([n])
+    if n < 0x100:
+        return bytes([0x81, n])
+    return bytes([0x82, n >> 8, n & 0xFF])
+
+
+def parse_sec1_point(
+    curve: WeierstrassCurve, data: bytes
+) -> Optional[tuple[int, int]]:
+    """SEC1 point bytes -> affine (x, y), with full on-curve validation.
+
+    Accepts uncompressed (0x04) and compressed (0x02/0x03) forms;
+    rejects the point at infinity and off-curve/out-of-range points.
+    """
+    p = curve.p
+    if len(data) == 65 and data[0] == 0x04:
+        x = int.from_bytes(data[1:33], "big")
+        y = int.from_bytes(data[33:], "big")
+        if x >= p or y >= p:
+            return None
+        if not refmath.wei_on_curve(curve, (x, y)):
+            return None
+        return (x, y)
+    if len(data) == 33 and data[0] in (0x02, 0x03):
+        x = int.from_bytes(data[1:], "big")
+        if x >= p:
+            return None
+        rhs = (x * x * x + curve.a * x + curve.b) % p
+        y = _sqrt_mod(rhs, p)
+        if y is None:
+            return None
+        if (y & 1) != (data[0] & 1):
+            y = p - y
+        return (x, y)
+    return None
+
+
+def encode_sec1_point(x: int, y: int) -> bytes:
+    return b"\x04" + x.to_bytes(32, "big") + y.to_bytes(32, "big")
+
+
+def _sqrt_mod(a: int, p: int) -> Optional[int]:
+    """Square root mod an odd prime (p = 3 mod 4 fast path, else T-S)."""
+    a %= p
+    if a == 0:
+        return 0
+    if pow(a, (p - 1) // 2, p) != 1:
+        return None
+    if p % 4 == 3:
+        return pow(a, (p + 1) // 4, p)
+    # Tonelli-Shanks (secp curves are 3 mod 4; kept for generality)
+    q, s = p - 1, 0
+    while q % 2 == 0:
+        q //= 2
+        s += 1
+    z = 2
+    while pow(z, (p - 1) // 2, p) != p - 1:
+        z += 1
+    m, c, t, r = s, pow(z, q, p), pow(a, q, p), pow(a, (q + 1) // 2, p)
+    while t != 1:
+        i, t2 = 0, t
+        while t2 != 1:
+            t2 = t2 * t2 % p
+            i += 1
+        b = pow(c, 1 << (m - i - 1), p)
+        m, c, t, r = i, b * b % p, t * b * b % p, r * b % p
+    return r
+
+
+# ---------------------------------------------------------------------------
+# staging: python signature tuples -> kernel input batches
+
+
+def stage_ecdsa_batch(
+    curve: WeierstrassCurve,
+    items: list[tuple[bytes, bytes, bytes]],  # (pubkey_sec1, der_sig, message)
+    batch: int,
+):
+    """Host prefilter + limb staging for ecdsa_verify_batch.
+
+    Returns dict of numpy arrays padded to `batch` rows; padding rows are
+    valid_in=False with benign values (s=1 invertible, Q=G).
+    """
+    n_items = len(items)
+    assert n_items <= batch
+    zs, rs, ss, qxs, qys, c1s = [], [], [], [], [], []
+    c1_ok = np.zeros(batch, dtype=bool)
+    valid = np.zeros(batch, dtype=bool)
+    for i, (pub, sig, msg) in enumerate(items):
+        ok = True
+        rs_pair = parse_der_ecdsa(sig)
+        pt = parse_sec1_point(curve, pub)
+        if rs_pair is None or pt is None:
+            ok = False
+            r = s = 1
+            pt = (curve.gx, curve.gy)
+        else:
+            r, s = rs_pair
+            if not (1 <= r < curve.n and 1 <= s < curve.n):
+                ok = False
+                r = s = 1
+        z = int.from_bytes(hashlib.sha256(msg).digest(), "big")
+        zs.append(z)
+        rs.append(r)
+        ss.append(s)
+        qxs.append(pt[0])
+        qys.append(pt[1])
+        c1s.append(r + curve.n)
+        c1_ok[i] = (r + curve.n) < curve.p
+        valid[i] = ok
+    pad = batch - n_items
+    if pad:
+        zs += [0] * pad
+        rs += [1] * pad
+        ss += [1] * pad
+        qxs += [curve.gx] * pad
+        qys += [curve.gy] * pad
+        c1s += [1 + curve.n] * pad
+    return dict(
+        z=ints_to_limbs_np(zs),
+        r=ints_to_limbs_np(rs),
+        s=ints_to_limbs_np(ss),
+        qx=ints_to_limbs_np(qxs),
+        qy=ints_to_limbs_np(qys),
+        c1=ints_to_limbs_np(c1s),
+        c1_ok=c1_ok,
+        valid_in=valid,
+    )
+
+
+def stage_ed25519_batch(
+    items: list[tuple[bytes, bytes, bytes]],  # (pubkey32, sig64, message)
+    batch: int,
+):
+    """Host prefilter + limb staging for ed25519_verify_batch."""
+    c = ED25519
+    n_items = len(items)
+    assert n_items <= batch
+    ss, ks, naxs, nays, eys = [], [], [], [], []
+    signs = np.zeros(batch, dtype=np.int32)
+    valid = np.zeros(batch, dtype=bool)
+    for i, (pub, sig, msg) in enumerate(items):
+        ok = len(sig) == 64 and len(pub) == 32
+        A = refmath.ed_decompress(c, pub) if ok else None
+        if A is None:
+            ok = False
+            A = (c.gx, c.gy)
+            s = 0
+            k = 0
+            ey, sign = 1, 0
+        else:
+            s = int.from_bytes(sig[32:], "little")
+            k = (
+                int.from_bytes(
+                    hashlib.sha512(sig[:32] + pub + msg).digest(), "little"
+                )
+                % c.L
+            )
+            renc = int.from_bytes(sig[:32], "little")
+            ey = renc & ((1 << 255) - 1)
+            sign = (renc >> 255) & 1
+        ss.append(s)
+        ks.append(k)
+        naxs.append((c.p - A[0]) % c.p)
+        nays.append(A[1])
+        eys.append(ey)
+        signs[i] = sign
+        valid[i] = ok
+    pad = batch - n_items
+    if pad:
+        ss += [0] * pad
+        ks += [0] * pad
+        naxs += [(c.p - c.gx) % c.p] * pad
+        nays += [c.gy] * pad
+        eys += [1] * pad
+    return dict(
+        s=ints_to_limbs_np(ss),
+        k=ints_to_limbs_np(ks),
+        nax=ints_to_limbs_np(naxs),
+        nay=ints_to_limbs_np(nays),
+        exp_y=ints_to_limbs_np(eys),
+        exp_sign=signs,
+        valid_in=valid,
+    )

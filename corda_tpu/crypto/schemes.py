@@ -1,0 +1,196 @@
+"""Signature scheme registry, keys, and host-side signing.
+
+Mirrors the reference's scheme table (core/.../crypto/Crypto.kt:78-184):
+
+  id  code name                 notes
+  1   RSA_SHA256                host-only (no batch kernel; RSA is not
+                                a ledger hot path)
+  2   ECDSA_SECP256K1_SHA256    TPU batch kernel (ecdsa.py)
+  3   ECDSA_SECP256R1_SHA256    TPU batch kernel (ecdsa.py)
+  4   EDDSA_ED25519_SHA512      default scheme (Crypto.kt:171); TPU
+                                batch kernel (eddsa.py)
+  5   SPHINCS256_SHA256         post-quantum hash-based; descoped this
+                                round (raises UnsupportedScheme)
+  6   COMPOSITE                 threshold key trees (composite.py)
+
+Signing happens on the host (nodes sign one transaction at a time — it
+is verification that fans out to batches). The `cryptography` (OpenSSL)
+library backs RSA/ECDSA/Ed25519 signing and keygen; deterministic
+from-seed key derivation is provided for tests, mirroring the
+reference's entropyToKeyPair (test-utils/.../TestConstants.kt).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from typing import Optional
+
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec as cec
+from cryptography.hazmat.primitives.asymmetric import ed25519 as ced
+from cryptography.hazmat.primitives.asymmetric import padding as cpad
+from cryptography.hazmat.primitives.asymmetric import rsa as crsa
+from cryptography.hazmat.primitives.asymmetric.utils import decode_dss_signature
+
+from . import encodings, refmath
+from .curves import ED25519, SECP256K1, SECP256R1
+
+RSA_SHA256 = 1
+ECDSA_SECP256K1_SHA256 = 2
+ECDSA_SECP256R1_SHA256 = 3
+EDDSA_ED25519_SHA512 = 4
+SPHINCS256_SHA256 = 5
+COMPOSITE_KEY = 6
+
+DEFAULT_SCHEME = EDDSA_ED25519_SHA512
+
+
+@dataclass(frozen=True)
+class SignatureScheme:
+    scheme_id: int
+    code_name: str
+    batchable: bool       # has a TPU batch kernel
+
+
+SCHEMES: dict[int, SignatureScheme] = {
+    RSA_SHA256: SignatureScheme(RSA_SHA256, "RSA_SHA256", False),
+    ECDSA_SECP256K1_SHA256: SignatureScheme(
+        ECDSA_SECP256K1_SHA256, "ECDSA_SECP256K1_SHA256", True
+    ),
+    ECDSA_SECP256R1_SHA256: SignatureScheme(
+        ECDSA_SECP256R1_SHA256, "ECDSA_SECP256R1_SHA256", True
+    ),
+    EDDSA_ED25519_SHA512: SignatureScheme(
+        EDDSA_ED25519_SHA512, "EDDSA_ED25519_SHA512", True
+    ),
+    SPHINCS256_SHA256: SignatureScheme(
+        SPHINCS256_SHA256, "SPHINCS256_SHA256", False
+    ),
+    COMPOSITE_KEY: SignatureScheme(COMPOSITE_KEY, "COMPOSITE", False),
+}
+
+_WCURVE = {ECDSA_SECP256K1_SHA256: SECP256K1, ECDSA_SECP256R1_SHA256: SECP256R1}
+_CCURVE = {ECDSA_SECP256K1_SHA256: cec.SECP256K1(), ECDSA_SECP256R1_SHA256: cec.SECP256R1()}
+
+
+class UnsupportedScheme(Exception):
+    pass
+
+
+@dataclass(frozen=True)
+class PublicKey:
+    """Scheme-tagged public key; `data` is the scheme-native encoding.
+
+    ECDSA: SEC1 uncompressed point (65 bytes); Ed25519: RFC8032 32-byte
+    compressed point; RSA: DER SubjectPublicKeyInfo.
+    """
+
+    scheme_id: int
+    data: bytes
+
+    def fingerprint(self) -> bytes:
+        return hashlib.sha256(bytes([self.scheme_id]) + self.data).digest()
+
+    def __repr__(self) -> str:
+        return f"PublicKey({SCHEMES[self.scheme_id].code_name}, {self.data.hex()[:16]}…)"
+
+
+@dataclass(frozen=True)
+class PrivateKey:
+    scheme_id: int
+    data: bytes            # scheme-native private encoding (see keygen)
+    public: PublicKey
+
+    def sign(self, message: bytes) -> bytes:
+        return sign(self, message)
+
+
+@dataclass(frozen=True)
+class KeyPair:
+    private: PrivateKey
+    public: PublicKey
+
+
+def generate_keypair(scheme_id: int = DEFAULT_SCHEME, seed: Optional[int] = None) -> KeyPair:
+    """Generate (or deterministically derive, given seed) a key pair."""
+    if scheme_id in _WCURVE:
+        curve = _WCURVE[scheme_id]
+        if seed is not None:
+            d = (seed % (curve.n - 1)) + 1
+        else:
+            d = cec.generate_private_key(_CCURVE[scheme_id]).private_numbers().private_value
+        pt = refmath.wei_mul(curve, d, (curve.gx, curve.gy))
+        pub = PublicKey(scheme_id, encodings.encode_sec1_point(*pt))
+        priv = PrivateKey(scheme_id, d.to_bytes(32, "big"), pub)
+        return KeyPair(priv, pub)
+    if scheme_id == EDDSA_ED25519_SHA512:
+        if seed is not None:
+            sk_bytes = hashlib.sha256(b"ed25519-seed" + seed.to_bytes(32, "big")).digest()
+        else:
+            sk_bytes = ced.Ed25519PrivateKey.generate().private_bytes_raw()
+        sk = ced.Ed25519PrivateKey.from_private_bytes(sk_bytes)
+        pub = PublicKey(scheme_id, sk.public_key().public_bytes_raw())
+        priv = PrivateKey(scheme_id, sk_bytes, pub)
+        return KeyPair(priv, pub)
+    if scheme_id == RSA_SHA256:
+        if seed is not None:
+            raise UnsupportedScheme("deterministic RSA keygen not supported")
+        sk = crsa.generate_private_key(public_exponent=65537, key_size=2048)
+        pub_der = sk.public_key().public_bytes(
+            serialization.Encoding.DER, serialization.PublicFormat.SubjectPublicKeyInfo
+        )
+        sk_der = sk.private_bytes(
+            serialization.Encoding.DER,
+            serialization.PrivateFormat.PKCS8,
+            serialization.NoEncryption(),
+        )
+        pub = PublicKey(scheme_id, pub_der)
+        return KeyPair(PrivateKey(scheme_id, sk_der, pub), pub)
+    raise UnsupportedScheme(f"scheme {scheme_id}")
+
+
+def sign(priv: PrivateKey, message: bytes) -> bytes:
+    """Host-side signing; signature formats match the verify kernels."""
+    sid = priv.scheme_id
+    if sid in _WCURVE:
+        d = int.from_bytes(priv.data, "big")
+        sk = cec.derive_private_key(d, _CCURVE[sid])
+        der = sk.sign(message, cec.ECDSA(hashes.SHA256()))
+        r, s = decode_dss_signature(der)
+        return encodings.encode_der_ecdsa(r, s)
+    if sid == EDDSA_ED25519_SHA512:
+        sk = ced.Ed25519PrivateKey.from_private_bytes(priv.data)
+        return sk.sign(message)
+    if sid == RSA_SHA256:
+        sk = serialization.load_der_private_key(priv.data, password=None)
+        return sk.sign(message, cpad.PKCS1v15(), hashes.SHA256())
+    raise UnsupportedScheme(f"scheme {sid}")
+
+
+def verify_one(pub: PublicKey, signature: bytes, message: bytes) -> bool:
+    """Host (CPU reference) verification of a single signature.
+
+    This is the bit-exactness anchor: pure-python refmath for the EC
+    schemes (the same semantics the batch kernels implement), OpenSSL
+    for RSA.
+    """
+    sid = pub.scheme_id
+    if sid in _WCURVE:
+        curve = _WCURVE[sid]
+        rs = encodings.parse_der_ecdsa(signature)
+        pt = encodings.parse_sec1_point(curve, pub.data)
+        if rs is None or pt is None:
+            return False
+        z = int.from_bytes(hashlib.sha256(message).digest(), "big")
+        return refmath.ecdsa_verify(curve, pt, z, rs[0], rs[1])
+    if sid == EDDSA_ED25519_SHA512:
+        return refmath.ed25519_verify(pub.data, message, signature)
+    if sid == RSA_SHA256:
+        try:
+            pk = serialization.load_der_public_key(pub.data)
+            pk.verify(signature, message, cpad.PKCS1v15(), hashes.SHA256())
+            return True
+        except Exception:
+            return False
+    raise UnsupportedScheme(f"scheme {sid}")

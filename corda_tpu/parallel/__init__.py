@@ -1,0 +1,8 @@
+"""Device-mesh parallelism helpers (ICI data-parallel batch sharding).
+
+The reference scales verification with worker thread pools and
+horizontally-scaled verifier processes (SURVEY.md §2.5); the TPU-native
+equivalent shards signature batches across chips over ICI with
+`jax.sharding` — embarrassingly data-parallel, no collectives in the
+hot loop.
+"""
