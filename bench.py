@@ -1,0 +1,73 @@
+"""Headline benchmark: ECDSA secp256r1 verifies/sec through the SPI.
+
+North star (BASELINE.md): >= 50,000 ECDSA-p256 verifies/sec on one TPU
+v5e chip, batch-1024 through the BatchSignatureVerifier SPI, bit-exact
+accept/reject vs the CPU reference semantics.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import os
+import random
+import sys
+import time
+
+BASELINE = 50_000.0  # verifies/sec target per BASELINE.json
+
+
+def main() -> None:
+    batch = int(os.environ.get("BENCH_BATCH", "4096"))
+    iters = int(os.environ.get("BENCH_ITERS", "5"))
+
+    from corda_tpu.crypto import schemes
+    from corda_tpu.crypto.batch_verifier import (
+        CpuBatchVerifier,
+        TpuBatchVerifier,
+        VerificationRequest,
+    )
+
+    rng = random.Random(2026)
+    keys = [
+        schemes.generate_keypair(
+            schemes.ECDSA_SECP256R1_SHA256, seed=rng.getrandbits(128)
+        )
+        for _ in range(32)
+    ]
+    reqs = []
+    for i in range(batch):
+        kp = keys[i % len(keys)]
+        msg = rng.randbytes(64)
+        sig = kp.private.sign(msg)
+        if i % 7 == 3:  # mix in rejects so accept/reject is exercised
+            msg = msg + b"x"
+        reqs.append(VerificationRequest(kp.public, sig, msg))
+
+    verifier = TpuBatchVerifier(batch_sizes=(batch,))
+
+    got = verifier.verify_batch(reqs)  # warm-up: compile + correctness
+    spot = random.Random(1).sample(range(batch), 32)
+    cpu = CpuBatchVerifier().verify_batch([reqs[i] for i in spot])
+    assert [got[i] for i in spot] == cpu, "TPU/CPU mismatch — bench aborted"
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        verifier.verify_batch(reqs)
+    dt = time.perf_counter() - t0
+
+    rate = batch * iters / dt
+    print(
+        json.dumps(
+            {
+                "metric": "ecdsa_p256_verifies_per_sec_via_spi",
+                "value": round(rate, 1),
+                "unit": "verifies/s",
+                "vs_baseline": round(rate / BASELINE, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    main()

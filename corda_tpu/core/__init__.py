@@ -1,0 +1,9 @@
+"""Core data model: states, transactions, identities, canonical encoding.
+
+The TPU-native re-design of the reference's L0/L1 layers
+(core/src/main/kotlin/net/corda/core/{contracts,transactions,identity},
+SURVEY.md §2.1/§2.3): pure-python immutable value types whose canonical
+byte encoding (serialization.py) is consensus-critical — transaction ids
+are Merkle roots over encoded components, and signatures cover encoded
+SignableData payloads.
+"""

@@ -1,0 +1,254 @@
+"""Canonical deterministic serialization (consensus-critical).
+
+The reference runs two schemes (whitelisting Kryo and an emerging
+schema-carrying AMQP: core/.../serialization/Kryo.kt, amqp/
+SerializerFactory.kt) behind per-use-case contexts
+(node-api/.../SerializationScheme.kt:31-58). This framework uses ONE
+deterministic, self-describing binary format ("CTS") for every context
+— P2P, storage, checkpoints, RPC — because the tx-id preimage and the
+signed payload must be bit-stable across hosts and rounds.
+
+Format (byte-tagged, big-endian lengths):
+  N           0x00                      None
+  T/F         0x01/0x02                 booleans
+  I+ / I-     0x03 varint / 0x04 varint unsigned/negated integers
+  B           0x05 varint payload       bytes
+  S           0x06 varint utf8          str
+  L           0x07 varint count items   list/tuple
+  M           0x08 varint count k,v*    dict, keys sorted by encoding
+  O           0x09 tag-str field-map    registered object
+
+Determinism rules: map keys sorted by their encoded bytes; registered
+objects encode as (tag, {field: value}) with fields in declaration
+order; integers are minimal-length varints; no floats (ledger amounts
+are fixed-point ints — floats are not deterministic across platforms).
+
+Objects register with @serializable (dataclasses) or via register();
+decoding is whitelist-only, mirroring the reference's class-whitelist
+stance (CordaClassResolver.kt) — unknown tags raise.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+_REGISTRY_BY_TAG: dict[str, type] = {}
+_REGISTRY_BY_TYPE: dict[type, str] = {}
+_CUSTOM_ENC: dict[type, Callable[[Any], Any]] = {}
+_CUSTOM_DEC: dict[str, Callable[[Any], Any]] = {}
+
+
+class SerializationError(Exception):
+    pass
+
+
+def serializable(cls=None, *, tag: Optional[str] = None):
+    """Register a (data)class for canonical object encoding."""
+
+    def wrap(c):
+        t = tag or c.__name__
+        if t in _REGISTRY_BY_TAG and _REGISTRY_BY_TAG[t] is not c:
+            raise SerializationError(f"duplicate serialization tag {t!r}")
+        _REGISTRY_BY_TAG[t] = c
+        _REGISTRY_BY_TYPE[c] = t
+        return c
+
+    return wrap(cls) if cls is not None else wrap
+
+
+def register_custom(cls: type, tag: str, enc, dec) -> None:
+    """Register a non-dataclass type with explicit encode/decode fns.
+
+    enc: obj -> encodable value; dec: value -> obj.
+    """
+    _REGISTRY_BY_TAG[tag] = cls
+    _REGISTRY_BY_TYPE[cls] = tag
+    _CUSTOM_ENC[cls] = enc
+    _CUSTOM_DEC[tag] = dec
+
+
+def _varint(n: int) -> bytes:
+    if n < 0:
+        raise SerializationError("varint must be non-negative")
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _read_varint(buf: bytes, i: int) -> tuple[int, int]:
+    shift = 0
+    val = 0
+    while True:
+        if i >= len(buf):
+            raise SerializationError("truncated varint")
+        b = buf[i]
+        i += 1
+        val |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            if b == 0 and shift:
+                raise SerializationError("non-minimal varint")
+            return val, i
+        shift += 7
+        if shift > 640:
+            raise SerializationError("varint too long")
+
+
+def encode(obj: Any) -> bytes:
+    out = bytearray()
+    _enc(obj, out)
+    return bytes(out)
+
+
+def _enc(obj: Any, out: bytearray) -> None:
+    if obj is None:
+        out.append(0x00)
+    elif obj is True:
+        out.append(0x01)
+    elif obj is False:
+        out.append(0x02)
+    elif isinstance(obj, int):
+        if obj >= 0:
+            out.append(0x03)
+            out += _varint(obj)
+        else:
+            out.append(0x04)
+            out += _varint(-obj)
+    elif isinstance(obj, (bytes, bytearray)):
+        out.append(0x05)
+        out += _varint(len(obj))
+        out += bytes(obj)
+    elif isinstance(obj, str):
+        b = obj.encode("utf-8")
+        out.append(0x06)
+        out += _varint(len(b))
+        out += b
+    elif isinstance(obj, (list, tuple)):
+        out.append(0x07)
+        out += _varint(len(obj))
+        for item in obj:
+            _enc(item, out)
+    elif isinstance(obj, (dict,)):
+        out.append(0x08)
+        out += _varint(len(obj))
+        entries = sorted((encode(k), encode(v)) for k, v in obj.items())
+        for ek, ev in entries:
+            out += ek
+            out += ev
+    elif isinstance(obj, frozenset):
+        # deterministic: encode as sorted list under a map-like rule
+        out.append(0x07)
+        items = sorted(encode(i) for i in obj)
+        out += _varint(len(items))
+        for e in items:
+            out += e
+    elif type(obj) in _REGISTRY_BY_TYPE:
+        tag = _REGISTRY_BY_TYPE[type(obj)]
+        out.append(0x09)
+        tb = tag.encode("utf-8")
+        out += _varint(len(tb))
+        out += tb
+        if type(obj) in _CUSTOM_ENC:
+            _enc(_CUSTOM_ENC[type(obj)](obj), out)
+        else:
+            fields = [
+                (f.name, getattr(obj, f.name))
+                for f in dataclasses.fields(obj)
+                if f.metadata.get("serialize", True)
+            ]
+            out += _varint(len(fields))
+            for name, value in fields:
+                _enc(name, out)
+                _enc(value, out)
+    else:
+        raise SerializationError(
+            f"type {type(obj).__name__} is not canonically serializable"
+        )
+
+
+def decode(buf: bytes) -> Any:
+    val, i = _dec(buf, 0)
+    if i != len(buf):
+        raise SerializationError("trailing bytes")
+    return val
+
+
+def _dec(buf: bytes, i: int) -> tuple[Any, int]:
+    if i >= len(buf):
+        raise SerializationError("truncated")
+    tag = buf[i]
+    i += 1
+    if tag == 0x00:
+        return None, i
+    if tag == 0x01:
+        return True, i
+    if tag == 0x02:
+        return False, i
+    if tag == 0x03:
+        return _read_varint(buf, i)
+    if tag == 0x04:
+        v, i = _read_varint(buf, i)
+        return -v, i
+    if tag == 0x05:
+        n, i = _read_varint(buf, i)
+        if i + n > len(buf):
+            raise SerializationError("truncated bytes")
+        return bytes(buf[i : i + n]), i + n
+    if tag == 0x06:
+        n, i = _read_varint(buf, i)
+        if i + n > len(buf):
+            raise SerializationError("truncated str")
+        return buf[i : i + n].decode("utf-8"), i + n
+    if tag == 0x07:
+        n, i = _read_varint(buf, i)
+        out = []
+        for _ in range(n):
+            v, i = _dec(buf, i)
+            out.append(v)
+        return out, i
+    if tag == 0x08:
+        n, i = _read_varint(buf, i)
+        d = {}
+        for _ in range(n):
+            k, i = _dec(buf, i)
+            v, i = _dec(buf, i)
+            d[k] = v
+        return d, i
+    if tag == 0x09:
+        n, i = _read_varint(buf, i)
+        tname = buf[i : i + n].decode("utf-8")
+        i += n
+        cls = _REGISTRY_BY_TAG.get(tname)
+        if cls is None:
+            raise SerializationError(f"unknown object tag {tname!r}")
+        if tname in _CUSTOM_DEC:
+            payload, i = _dec(buf, i)
+            return _CUSTOM_DEC[tname](payload), i
+        nf, i = _read_varint(buf, i)
+        kwargs = {}
+        for _ in range(nf):
+            name, i = _dec(buf, i)
+            value, i = _dec(buf, i)
+            kwargs[name] = value
+        return _decode_dataclass(cls, kwargs), i
+    raise SerializationError(f"unknown tag byte {tag:#x}")
+
+
+def _tuplify(v):
+    """Frozen dataclasses use tuple fields; sequences decode as tuples."""
+    if isinstance(v, list):
+        return tuple(_tuplify(i) for i in v)
+    return v
+
+
+def _decode_dataclass(cls, kwargs):
+    try:
+        return cls(**{k: _tuplify(v) for k, v in kwargs.items()})
+    except TypeError as e:
+        raise SerializationError(f"cannot reconstruct {cls.__name__}: {e}")

@@ -1,0 +1,129 @@
+"""Merkle trees and partial (inclusion-proof) Merkle trees.
+
+Reference semantics: core/.../crypto/MerkleTree.kt:14-60 (SHA-256
+binary tree, leaf list zero-padded to the next power of two) and
+PartialMerkleTree.kt:45 (tear-off inclusion proofs used by notaries and
+oracles so they see only the components they need — MerkleTransaction.kt).
+
+The tree hash is consensus-critical: a transaction's id is the root
+over its component hashes (transactions.py). Hashing runs on host
+(hashlib, C speed); trees are small (#components), while the *batch*
+dimension (many transactions) is where TPU parallelism lives.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from typing import Optional
+
+from ..core import serialization as ser
+from .hashes import SecureHash
+
+
+def _pad_leaves(leaves: list[SecureHash]) -> list[SecureHash]:
+    if not leaves:
+        raise ValueError("cannot build a Merkle tree with no leaves")
+    n = 1
+    while n < len(leaves):
+        n *= 2
+    return leaves + [SecureHash.zero()] * (n - len(leaves))
+
+
+def merkle_root(leaves: list[SecureHash]) -> SecureHash:
+    """Root of the zero-padded binary SHA-256 tree."""
+    level = _pad_leaves(leaves)
+    while len(level) > 1:
+        level = [
+            level[i].hash_concat(level[i + 1]) for i in range(0, len(level), 2)
+        ]
+    return level[0]
+
+
+def merkle_levels(leaves: list[SecureHash]) -> list[list[SecureHash]]:
+    """All levels bottom-up (levels[0] = padded leaves, levels[-1] = [root])."""
+    level = _pad_leaves(leaves)
+    levels = [level]
+    while len(level) > 1:
+        level = [
+            level[i].hash_concat(level[i + 1]) for i in range(0, len(level), 2)
+        ]
+        levels.append(level)
+    return levels
+
+
+@ser.serializable
+@dataclass(frozen=True)
+class PartialMerkleTree:
+    """Inclusion proof for a subset of leaves.
+
+    Encoding: the set of proven leaf indices (in the padded tree), the
+    padded tree size, and the sibling hashes needed to recompute the
+    root, in deterministic bottom-up, left-to-right order.
+    """
+
+    tree_size: int
+    included_indices: tuple[int, ...]
+    hashes: tuple[SecureHash, ...]
+
+    @staticmethod
+    def build(
+        all_leaves: list[SecureHash], included: list[SecureHash]
+    ) -> "PartialMerkleTree":
+        levels = merkle_levels(all_leaves)
+        padded = levels[0]
+        want = set()
+        incl_set = {h.bytes_ for h in included}
+        for i, leaf in enumerate(padded):
+            if leaf.bytes_ in incl_set:
+                want.add(i)
+        if len({h.bytes_ for h in included} - {padded[i].bytes_ for i in want}):
+            raise ValueError("included leaf not present in tree")
+        # walk up: record sibling hashes not derivable from included leaves
+        proof: list[SecureHash] = []
+        needed = want
+        for level in levels[:-1]:
+            next_needed = set()
+            for i in sorted(needed):
+                sib = i ^ 1
+                if sib not in needed:
+                    proof.append(level[sib])
+                next_needed.add(i // 2)
+            needed = next_needed
+        return PartialMerkleTree(len(padded), tuple(sorted(want)), tuple(proof))
+
+    def verify(self, root: SecureHash, leaves: list[SecureHash]) -> bool:
+        """Check `leaves` (in index order) hash up to `root`."""
+        try:
+            return self._root_for(leaves) == root
+        except (ValueError, IndexError):
+            return False
+
+    def _root_for(self, leaves: list[SecureHash]) -> SecureHash:
+        if len(leaves) != len(self.included_indices):
+            raise ValueError("leaf count mismatch")
+        if self.tree_size & (self.tree_size - 1) or self.tree_size <= 0:
+            raise ValueError("tree size not a power of two")
+        known: dict[int, SecureHash] = dict(zip(self.included_indices, leaves))
+        if any(i >= self.tree_size or i < 0 for i in known):
+            raise ValueError("leaf index out of range")
+        proof = list(self.hashes)
+        size = self.tree_size
+        while size > 1:
+            nxt: dict[int, SecureHash] = {}
+            for i in sorted(known):
+                sib = i ^ 1
+                if sib in known:
+                    if i < sib:
+                        nxt[i // 2] = known[i].hash_concat(known[sib])
+                else:
+                    if not proof:
+                        raise ValueError("proof exhausted")
+                    sh = proof.pop(0)
+                    pair = (known[i], sh) if i % 2 == 0 else (sh, known[i])
+                    nxt[i // 2] = pair[0].hash_concat(pair[1])
+            known = nxt
+            size //= 2
+        if proof:
+            raise ValueError("unused proof hashes")
+        return known[0]

@@ -1,0 +1,71 @@
+"""Transaction signatures: metadata-bound signatures over tx ids.
+
+Reference semantics: crypto/TransactionSignature.kt:14, SignableData.kt:
+13, SignatureMetadata.kt:15 — the signed payload is NOT the raw tx id
+but the canonical encoding of SignableData(txId, metadata), binding the
+platform version and scheme id into every signature.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..core import serialization as ser
+from .hashes import SecureHash
+from .schemes import PrivateKey, PublicKey
+
+PLATFORM_VERSION = 1
+
+
+@ser.serializable
+@dataclass(frozen=True)
+class SignatureMetadata:
+    platform_version: int
+    scheme_id: int
+
+
+@ser.serializable
+@dataclass(frozen=True)
+class SignableData:
+    """The canonical signed payload: (tx id, signature metadata)."""
+
+    tx_id: SecureHash
+    metadata: SignatureMetadata
+
+    def to_bytes(self) -> bytes:
+        return ser.encode(self)
+
+
+@ser.serializable
+@dataclass(frozen=True)
+class TransactionSignature:
+    """Signature bytes + signer key + metadata."""
+
+    signature: bytes
+    by: PublicKey
+    metadata: SignatureMetadata
+
+    def signable_payload(self, tx_id: SecureHash) -> bytes:
+        return SignableData(tx_id, self.metadata).to_bytes()
+
+    def is_valid(self, tx_id: SecureHash) -> bool:
+        """Host-path single verification (CPU reference semantics)."""
+        from .schemes import verify_one
+
+        return verify_one(self.by, self.signature, self.signable_payload(tx_id))
+
+    def verify(self, tx_id: SecureHash) -> None:
+        if not self.is_valid(tx_id):
+            raise InvalidSignature(
+                f"signature by {self.by} over {tx_id} is invalid"
+            )
+
+
+class InvalidSignature(Exception):
+    pass
+
+
+def sign_tx_id(private: PrivateKey, tx_id: SecureHash) -> TransactionSignature:
+    meta = SignatureMetadata(PLATFORM_VERSION, private.scheme_id)
+    payload = SignableData(tx_id, meta).to_bytes()
+    return TransactionSignature(private.sign(payload), private.public, meta)

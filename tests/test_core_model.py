@@ -1,0 +1,257 @@
+"""Ring-1 unit tests: serialization, Merkle, composite keys, transactions."""
+
+import pytest
+
+from corda_tpu.core import serialization as ser
+from corda_tpu.core.contracts import (
+    Amount,
+    Command,
+    StateAndRef,
+    StateRef,
+    TimeWindow,
+    TransactionState,
+)
+from corda_tpu.core.identity import Party, PartyAndReference
+from corda_tpu.core.transactions import (
+    FilteredTransaction,
+    G_INPUTS,
+    SignaturesMissingError,
+    TransactionBuilder,
+    WireTransaction,
+)
+from corda_tpu.crypto import schemes
+from corda_tpu.crypto.composite import CompositeKey
+from corda_tpu.crypto.hashes import SecureHash
+from corda_tpu.crypto.merkle import PartialMerkleTree, merkle_root
+from corda_tpu.crypto.tx_signature import InvalidSignature, sign_tx_id
+
+
+def kp(seed):
+    return schemes.generate_keypair(schemes.EDDSA_ED25519_SHA512, seed=seed)
+
+
+ALICE_KP = kp(1)
+BOB_KP = kp(2)
+NOTARY_KP = kp(3)
+ALICE = Party("O=Alice,L=London,C=GB", ALICE_KP.public)
+BOB = Party("O=Bob,L=NewYork,C=US", BOB_KP.public)
+NOTARY = Party("O=Notary,L=Zurich,C=CH", NOTARY_KP.public)
+
+
+# ---------------------------------------------------------------------------
+# serialization
+
+
+def test_serialization_roundtrip_primitives():
+    cases = [
+        None, True, False, 0, 1, -1, 2**300, -(2**300), b"", b"abc",
+        "", "hello é中", [], [1, [2, 3], {"a": b"b"}],
+        {"k": 1, "z": [None, True]},
+    ]
+    for c in cases:
+        assert ser.decode(ser.encode(c)) == c
+
+
+def test_serialization_deterministic_maps():
+    a = ser.encode({"x": 1, "y": 2})
+    b = ser.encode({"y": 2, "x": 1})
+    assert a == b
+
+
+def test_serialization_objects():
+    p = Party("O=X", ALICE_KP.public)
+    out = ser.decode(ser.encode(p))
+    assert out == p
+    h = SecureHash.sha256(b"data")
+    assert ser.decode(ser.encode(h)) == h
+
+
+def test_serialization_rejects_unknown():
+    class Foo:
+        pass
+
+    with pytest.raises(ser.SerializationError):
+        ser.encode(Foo())
+    with pytest.raises(ser.SerializationError):
+        ser.encode(1.5)  # floats are banned (non-deterministic)
+
+
+def test_varint_minimality_enforced():
+    # crafted non-minimal varint: 0x80 0x00 for value 0
+    bad = bytes([0x03, 0x80, 0x00])
+    with pytest.raises(ser.SerializationError):
+        ser.decode(bad)
+
+
+# ---------------------------------------------------------------------------
+# merkle
+
+
+def test_merkle_root_padding():
+    leaves = [SecureHash.sha256(bytes([i])) for i in range(5)]
+    root = merkle_root(leaves)
+    # 5 leaves pad to 8 with zero hashes
+    l8 = leaves + [SecureHash.zero()] * 3
+    lvl = l8
+    while len(lvl) > 1:
+        lvl = [lvl[i].hash_concat(lvl[i + 1]) for i in range(0, len(lvl), 2)]
+    assert root == lvl[0]
+
+
+@pytest.mark.parametrize("n,pick", [(1, [0]), (4, [1, 2]), (7, [0, 6]), (8, [3])])
+def test_partial_merkle_proofs(n, pick):
+    leaves = [SecureHash.sha256(bytes([i, 7])) for i in range(n)]
+    root = merkle_root(leaves)
+    included = [leaves[i] for i in pick]
+    pmt = PartialMerkleTree.build(leaves, included)
+    assert pmt.verify(root, included)
+    # tamper: wrong leaf
+    wrong = [SecureHash.sha256(b"evil")] + included[1:]
+    assert not pmt.verify(root, wrong)
+    # tamper: wrong root
+    assert not pmt.verify(SecureHash.zero(), included)
+
+
+# ---------------------------------------------------------------------------
+# composite keys
+
+
+def test_composite_threshold():
+    k1, k2, k3 = kp(11).public, kp(12).public, kp(13).public
+    ck = CompositeKey.build([k1, k2, k3], threshold=2)
+    assert not ck.is_fulfilled_by([k1])
+    assert ck.is_fulfilled_by([k1, k3])
+    nested = CompositeKey.build([ck, kp(14).public], threshold=1)
+    assert nested.is_fulfilled_by([k2, k3])
+    assert nested.is_fulfilled_by([kp(14).public])
+    assert not nested.is_fulfilled_by([k1])
+
+
+def test_composite_validation():
+    k1, k2 = kp(21).public, kp(22).public
+    with pytest.raises(ValueError):
+        CompositeKey.build([k1, k2], threshold=3)  # unreachable
+    with pytest.raises(ValueError):
+        CompositeKey.build([k1, k1], threshold=1)  # duplicate leaves
+    with pytest.raises(ValueError):
+        CompositeKey.build([k1], weights=[0], threshold=1)
+
+
+# ---------------------------------------------------------------------------
+# transactions
+
+
+from dataclasses import dataclass  # noqa: E402
+
+
+@ser.serializable
+@dataclass(frozen=True)
+class DummyState:
+    owner: schemes.PublicKey
+    magic: int
+
+    @property
+    def participants(self):
+        return (self.owner,)
+
+
+@ser.serializable
+@dataclass(frozen=True)
+class DummyCmd:
+    pass
+
+
+def build_tx():
+    b = TransactionBuilder(notary=NOTARY)
+    b.add_output_state(DummyState(ALICE_KP.public, 42), "dummy")
+    b.add_command(DummyCmd(), ALICE_KP.public)
+    b.set_time_window(TimeWindow.between(0, 10**18))
+    return b
+
+
+def test_wire_tx_id_stable_and_sensitive():
+    tx1 = build_tx().to_wire_transaction()
+    tx2 = build_tx().to_wire_transaction()
+    assert tx1.id == tx2.id
+    b3 = build_tx()
+    b3.add_command(DummyCmd(), BOB_KP.public)
+    assert b3.to_wire_transaction().id != tx1.id
+
+
+def test_signed_tx_signature_checks():
+    stx = build_tx().sign_initial_transaction(ALICE_KP.private)
+    stx.check_signatures_are_valid()
+    stx.verify_required_signatures()
+
+    # tampered signature fails crypto check
+    bad_sig = stx.sigs[0]
+    tampered = bad_sig.__class__(
+        signature=bad_sig.signature[:-1] + bytes([bad_sig.signature[-1] ^ 1]),
+        by=bad_sig.by,
+        metadata=bad_sig.metadata,
+    )
+    from corda_tpu.core.transactions import SignedTransaction
+
+    stx_bad = SignedTransaction(stx.wtx, (tampered,))
+    with pytest.raises(InvalidSignature):
+        stx_bad.check_signatures_are_valid()
+
+    # missing signer detected
+    stx_none = SignedTransaction(stx.wtx, ())
+    with pytest.raises(SignaturesMissingError):
+        stx_none.verify_required_signatures()
+
+
+def test_notary_signature_required_when_inputs_present():
+    consumed = build_tx().to_wire_transaction()
+    b = TransactionBuilder(notary=NOTARY)
+    b.add_input_state(
+        StateAndRef(consumed.outputs[0], consumed.out_ref(0))
+    )
+    b.add_output_state(DummyState(BOB_KP.public, 43), "dummy")
+    b.add_command(DummyCmd(), ALICE_KP.public)
+    stx = b.sign_initial_transaction(ALICE_KP.private)
+    missing = stx.missing_signing_keys()
+    assert NOTARY_KP.public in missing
+    stx2 = stx.with_additional_signature(
+        sign_tx_id(NOTARY_KP.private, stx.id)
+    )
+    stx2.verify_required_signatures()
+
+
+def test_filtered_transaction_tear_off():
+    consumed = build_tx().to_wire_transaction()
+    b = build_tx()
+    b.add_input_state(StateAndRef(consumed.outputs[0], consumed.out_ref(0)))
+    wtx = b.to_wire_transaction()
+
+    ftx = wtx.build_filtered_transaction(
+        lambda c: isinstance(c, (StateRef, TimeWindow, Party))
+    )
+    ftx.verify()
+    assert ftx.inputs == [consumed.out_ref(0)]
+    assert ftx.notary == NOTARY
+    assert ftx.time_window is not None
+    # outputs are NOT visible
+    assert all(g in (G_INPUTS, 4, 5) for g, _, _ in ftx.components)
+
+    # tampering with a revealed component breaks the proof
+    bad = FilteredTransaction(
+        id=ftx.id,
+        components=tuple(
+            [(g, i, StateRef(SecureHash.zero(), 9)) if g == G_INPUTS else (g, i, c)
+             for g, i, c in ftx.components]
+        ),
+        proof=ftx.proof,
+    )
+    import pytest as _pt
+
+    with _pt.raises(Exception):
+        bad.verify()
+
+
+def test_serialization_roundtrip_wire_tx():
+    wtx = build_tx().to_wire_transaction()
+    out = ser.decode(ser.encode(wtx))
+    assert out == wtx
+    assert out.id == wtx.id
