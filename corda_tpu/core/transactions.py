@@ -52,6 +52,13 @@ from .identity import Party
 
 # component group ordinals (stable — part of the id preimage)
 G_INPUTS, G_OUTPUTS, G_COMMANDS, G_ATTACHMENTS, G_NOTARY, G_TIMEWINDOW = range(6)
+# meta group: a single always-revealed leaf carrying the per-group
+# component counts, so a FilteredTransaction can prove COMPLETENESS of
+# a revealed group (a partial Merkle proof alone proves inclusion, not
+# that nothing was hidden — without this a tear-off could conceal an
+# input from a non-validating notary and double-spend it)
+G_META = 6
+N_GROUPS = 6
 
 
 class TransactionVerificationError(Exception):
@@ -84,8 +91,19 @@ class WireTransaction:
 
     # -- identity ----------------------------------------------------------
 
+    def group_counts(self) -> list[int]:
+        return [
+            len(self.inputs),
+            len(self.outputs),
+            len(self.commands),
+            len(self.attachments),
+            1 if self.notary else 0,
+            1 if self.time_window else 0,
+        ]
+
     def component_leaves(self) -> list[tuple[int, int, Any]]:
-        """(group, index, component) triples in canonical order."""
+        """(group, index, component) triples in canonical order; the
+        trailing G_META leaf commits to every group's size."""
         out: list[tuple[int, int, Any]] = []
         for g, items in (
             (G_INPUTS, self.inputs),
@@ -97,6 +115,7 @@ class WireTransaction:
         ):
             for i, item in enumerate(items):
                 out.append((g, i, item))
+        out.append((G_META, 0, self.group_counts()))
         return out
 
     def leaf_hashes(self) -> list[SecureHash]:
@@ -135,7 +154,7 @@ class WireTransaction:
         included = [
             (g, i, c)
             for (g, i, c), h in zip(leaves, hashes)
-            if predicate(c)
+            if g == G_META or predicate(c)   # meta is always revealed
         ]
         included_hashes = [
             component_hash(g, i, c) for g, i, c in included
@@ -173,6 +192,27 @@ class FilteredTransaction:
             raise TransactionVerificationError(
                 f"filtered transaction proof failed for {self.id}"
             )
+        metas = self.components_in_group(G_META)
+        if len(metas) != 1 or len(metas[0]) != N_GROUPS:
+            raise TransactionVerificationError(
+                "filtered transaction lacks the group-counts meta leaf"
+            )
+        counts = metas[0]
+        for g in range(N_GROUPS):
+            revealed = len(self.components_in_group(g))
+            if revealed > counts[g]:
+                raise TransactionVerificationError(
+                    f"group {g} reveals more components than committed"
+                )
+
+    def group_count(self, group: int) -> int:
+        """Committed total size of a group (from the meta leaf)."""
+        return self.components_in_group(G_META)[0][group]
+
+    def all_revealed(self, group: int) -> bool:
+        """True iff every component of `group` is present — the
+        completeness check a non-validating notary needs on inputs."""
+        return len(self.components_in_group(group)) == self.group_count(group)
 
     def components_in_group(self, group: int) -> list[Any]:
         return [c for g, _, c in self.components if g == group]

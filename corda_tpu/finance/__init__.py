@@ -1,0 +1,17 @@
+"""Financial contracts & flows (reference: finance/ module)."""
+
+from .cash import (
+    Cash,
+    CashExitFlow,
+    CashIssueFlow,
+    CashPaymentFlow,
+    CashState,
+)
+
+__all__ = [
+    "Cash",
+    "CashExitFlow",
+    "CashIssueFlow",
+    "CashPaymentFlow",
+    "CashState",
+]

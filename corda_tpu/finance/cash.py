@@ -1,0 +1,298 @@
+"""Cash: fungible issued currency — the canonical contract.
+
+Reference: finance/src/main/kotlin/net/corda/contracts/asset/Cash.kt
+(state + clause-based contract + Issue/Move/Exit commands) and the
+flows CashIssueFlow / CashPaymentFlow / CashExitFlow
+(finance/.../flows/, SURVEY §2.10).
+
+The contract groups states by issued token (issuer+currency) and
+checks conservation per group — pure integer arithmetic on Amount.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from ..core import serialization as ser
+from ..core.contracts import (
+    Amount,
+    Issued,
+    StateAndRef,
+    register_contract,
+    require_that,
+)
+from ..core.identity import Party, PartyAndReference
+from ..core.transactions import LedgerTransaction, TransactionBuilder
+from ..crypto.composite import AnyKey, leaves_of
+from ..flows.api import FlowException, FlowLogic, initiating_flow
+from ..flows.core_flows import FinalityFlow
+from ..node.services import InsufficientBalanceError
+
+CASH_CONTRACT = "corda_tpu.finance.Cash"
+
+
+@ser.serializable
+@dataclass(frozen=True)
+class CashState:
+    """An amount of issued currency owned by a key
+    (Cash.State: finance/.../asset/Cash.kt)."""
+
+    amount: Amount              # token is an Issued(issuer_ref, currency)
+    owner: AnyKey
+
+    @property
+    def participants(self):
+        return (self.owner,)
+
+    def with_owner(self, new_owner: AnyKey) -> "CashState":
+        return CashState(self.amount, new_owner)
+
+    @property
+    def issuer(self) -> Party:
+        return self.amount.token.issuer.party
+
+
+# commands
+
+
+@ser.serializable
+@dataclass(frozen=True)
+class CashIssue:
+    nonce: int = 0
+
+
+@ser.serializable
+@dataclass(frozen=True)
+class CashMove:
+    pass
+
+
+@ser.serializable
+@dataclass(frozen=True)
+class CashExit:
+    amount: Amount
+
+
+class Cash:
+    """The contract: verify() is the deterministic rule set
+    (Cash.kt clause stack → flat checks here)."""
+
+    def verify(self, ltx: LedgerTransaction) -> None:
+        groups = ltx.group_states(CashState, lambda s: s.amount.token)
+        cmds = [
+            c for c in ltx.commands
+            if isinstance(c.value, (CashIssue, CashMove, CashExit))
+        ]
+        require_that("a Cash command is present", len(cmds) >= 1)
+        all_signers = {k for c in cmds for k in c.signers}
+        for group in groups:
+            token = group.key
+            issuer_key = token.issuer.party.owning_key
+            in_sum = sum(s.amount.quantity for s in group.inputs)
+            out_sum = sum(s.amount.quantity for s in group.outputs)
+            require_that(
+                "output amounts are positive",
+                all(s.amount.quantity > 0 for s in group.outputs),
+            )
+            issue = [c for c in cmds if isinstance(c.value, CashIssue)]
+            # exits apply per token group, not globally — an exit of
+            # token A must not constrain a simultaneous move of token B
+            group_exits = [
+                c for c in cmds
+                if isinstance(c.value, CashExit)
+                and c.value.amount.token == token
+            ]
+            if issue and not group.inputs:
+                require_that("issued amount is positive", out_sum > 0)
+                require_that(
+                    "issue is signed by the issuer",
+                    _signed_by(issuer_key, all_signers),
+                )
+                continue
+            if group_exits:
+                exited = sum(
+                    c.value.amount.quantity for c in group_exits
+                )
+                require_that(
+                    "exit conserves value", in_sum - out_sum == exited
+                )
+                require_that(
+                    "exit signed by issuer",
+                    _signed_by(
+                        issuer_key,
+                        {k for c in group_exits for k in c.signers},
+                    ),
+                )
+            else:
+                require_that(
+                    "cash is conserved (inputs == outputs)",
+                    in_sum == out_sum and in_sum > 0,
+                )
+            for owner in {s.owner for s in group.inputs}:
+                require_that(
+                    "move/exit is signed by every input owner",
+                    _signed_by(owner, all_signers),
+                )
+
+
+def _signed_by(key, signers) -> bool:
+    """Composite-aware: `key` is satisfied when it (or, for composite
+    keys, a fulfilling set of its leaves) appears among the command
+    signers' leaves."""
+    leaf_pool = set()
+    for s in signers:
+        leaf_pool.update(leaves_of(s))
+        leaf_pool.add(s)
+    from ..crypto.composite import is_fulfilled_by
+
+    return key in leaf_pool or is_fulfilled_by(key, leaf_pool)
+
+
+register_contract(CASH_CONTRACT, Cash())
+
+
+# ---------------------------------------------------------------------------
+# flows
+
+
+@initiating_flow
+class CashIssueFlow(FlowLogic):
+    """Issue cash to a recipient (finance/.../flows/CashIssueFlow.kt).
+    Issuance has no inputs, so no notarisation round-trip is needed —
+    FinalityFlow records + broadcasts."""
+
+    def __init__(
+        self,
+        quantity: int,
+        currency: str,
+        recipient: Party,
+        notary: Party,
+        issuer_ref: bytes = b"\x01",
+        nonce: int = 0,
+    ):
+        self.quantity = quantity
+        self.currency = currency
+        self.recipient = recipient
+        self.notary = notary
+        self.issuer_ref = issuer_ref
+        self.nonce = nonce
+
+    def call(self):
+        us = self.our_identity
+        token = Issued(PartyAndReference(us, self.issuer_ref), self.currency)
+        state = CashState(
+            Amount(self.quantity, token), self.recipient.owning_key
+        )
+        builder = TransactionBuilder(self.notary)
+        builder.add_output_state(state, CASH_CONTRACT)
+        builder.add_command(CashIssue(self.nonce), us.owning_key)
+        stx = self.services.sign_initial_transaction(builder)
+        result = yield from self.sub_flow(FinalityFlow(stx))
+        return result
+
+
+@initiating_flow
+class CashPaymentFlow(FlowLogic):
+    """Pay cash to a recipient: coin-select, move, change back to us
+    (finance/.../flows/CashPaymentFlow.kt)."""
+
+    def __init__(self, quantity: int, currency: str, recipient: Party):
+        self.quantity = quantity
+        self.currency = currency
+        self.recipient = recipient
+
+    def call(self):
+        builder, _ = yield from generate_spend(
+            self, self.quantity, self.currency, self.recipient.owning_key
+        )
+        stx = self.services.sign_initial_transaction(builder)
+        result = yield from self.sub_flow(FinalityFlow(stx))
+        return result
+
+
+@initiating_flow
+class CashExitFlow(FlowLogic):
+    """Redeem (destroy) our cash back to the issuer
+    (finance/.../flows/CashExitFlow.kt). Only the issuer runs this over
+    states it issued and owns."""
+
+    def __init__(self, quantity: int, currency: str, issuer_ref: bytes = b"\x01"):
+        self.quantity = quantity
+        self.currency = currency
+        self.issuer_ref = issuer_ref
+
+    def call(self):
+        us = self.our_identity
+        token = Issued(PartyAndReference(us, self.issuer_ref), self.currency)
+        lock_id = yield from self.record(
+            lambda: self.services.key_management.fresh_key().fingerprint()
+        )
+        coins = self.services.vault.unconsumed_states_for_spending(
+            self.quantity,
+            lock_id,
+            cls=CashState,
+            predicate=lambda ts: ts.data.amount.token == token,
+        )
+        total = sum(sar.state.data.amount.quantity for sar in coins)
+        builder = TransactionBuilder()
+        for sar in coins:
+            builder.add_input_state(sar)
+        change = total - self.quantity
+        if change > 0:
+            builder.add_output_state(
+                CashState(Amount(change, token), us.owning_key),
+                CASH_CONTRACT,
+            )
+        builder.add_command(
+            CashExit(Amount(self.quantity, token)), us.owning_key
+        )
+        stx = self.services.sign_initial_transaction(builder)
+        result = yield from self.sub_flow(FinalityFlow(stx))
+        return result
+
+
+def generate_spend(flow: FlowLogic, quantity: int, currency: str, to_key):
+    """Shared spend builder (Cash.generateSpend, Cash.kt): greedy coin
+    selection over every issuer's tokens in `currency`, outputs to
+    `to_key` grouped per token + change to us. Generator (journals the
+    soft-lock id)."""
+    services = flow.services
+    us = flow.our_identity
+    lock_id = yield from flow.record(
+        lambda: services.key_management.fresh_key().fingerprint()
+    )
+    try:
+        coins = services.vault.unconsumed_states_for_spending(
+            quantity,
+            lock_id,
+            cls=CashState,
+            predicate=lambda ts: ts.data.amount.token.product == currency,
+        )
+    except InsufficientBalanceError as e:
+        raise FlowException(
+            f"insufficient {currency}: short {e.shortfall}"
+        ) from e
+    builder = TransactionBuilder()
+    by_token: dict = {}
+    for sar in coins:
+        builder.add_input_state(sar)
+        t = sar.state.data.amount.token
+        by_token[t] = by_token.get(t, 0) + sar.state.data.amount.quantity
+    remaining = quantity
+    for token in sorted(by_token, key=lambda t: ser.encode(t)):
+        available = by_token[token]
+        pay = min(available, remaining)
+        if pay > 0:
+            builder.add_output_state(
+                CashState(Amount(pay, token), to_key), CASH_CONTRACT
+            )
+        change = available - pay
+        if change > 0:
+            builder.add_output_state(
+                CashState(Amount(change, token), us.owning_key),
+                CASH_CONTRACT,
+            )
+        remaining -= pay
+    builder.add_command(CashMove(), us.owning_key)
+    return builder, coins

@@ -1,0 +1,27 @@
+"""Flow framework: durable, resumable multi-party protocols.
+
+Reference: core/.../flows/FlowLogic.kt + node/.../statemachine/ (SURVEY
+§2.4). Flows here are Python generators driven by a StateMachineManager;
+durability comes from event-sourced checkpoints (journal of absorbed
+nondeterminism) instead of Quasar fiber-stack serialization.
+"""
+
+from .api import (
+    FlowException,
+    FlowLogic,
+    FlowSessionException,
+    ProgressTracker,
+    initiated_by,
+    initiating_flow,
+)
+from .statemachine import StateMachineManager
+
+__all__ = [
+    "FlowException",
+    "FlowLogic",
+    "FlowSessionException",
+    "ProgressTracker",
+    "initiated_by",
+    "initiating_flow",
+    "StateMachineManager",
+]

@@ -1,0 +1,1 @@
+"""Node runtime: messaging fabric, services, notaries, assembly."""

@@ -1,0 +1,180 @@
+"""Messaging fabric: topic/peer addressed, durable-queue semantics.
+
+Reference: the `MessagingService` API (node/.../services/messaging/
+Messaging.kt — send, addMessageHandler(topic), createMessage) backed in
+production by an embedded Artemis broker with per-peer store-and-forward
+queues and TLS bridges (ArtemisMessagingServer.kt:90,300-401), and in
+Ring-3 tests by `InMemoryMessagingNetwork` with manually-pumped
+deterministic delivery (test-utils/.../InMemoryMessagingNetwork.kt:47).
+
+This module provides the API plus the in-memory fabric; the DCN (TCP)
+fabric with durable queues lives in `corda_tpu.node.fabric`. Delivery
+guarantees match Artemis semantics: per-(sender, target) FIFO, at-least-
+once upstream with exactly-once to handlers via (sender, unique_id)
+dedupe. Payloads are canonical-serialized bytes — even in-memory
+delivery round-trips through the wire encoding so serialization gaps
+surface in Ring-3 tests, not in production.
+"""
+
+from __future__ import annotations
+
+import random
+from collections import deque
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+TOPIC_SESSION = "platform.session"
+TOPIC_NETWORK_MAP = "platform.network_map"
+TOPIC_RPC = "rpc.requests"
+TOPIC_VERIFIER_REQ = "verifier.requests"
+TOPIC_VERIFIER_RES = "verifier.responses"
+
+
+@dataclass(frozen=True)
+class Message:
+    topic: str
+    payload: bytes          # canonical-serialized body
+    sender: str             # peer name of origin
+    unique_id: int          # per-sender unique id (dedupe key)
+
+
+Handler = Callable[[Message], None]
+
+
+class MessagingService:
+    """Send/handle interface every node component talks through."""
+
+    def send(
+        self,
+        topic: str,
+        payload: bytes,
+        target: str,
+        unique_id: Optional[int] = None,
+    ) -> None:
+        raise NotImplementedError
+
+    def add_handler(self, topic: str, handler: Handler) -> None:
+        raise NotImplementedError
+
+    @property
+    def my_address(self) -> str:
+        raise NotImplementedError
+
+
+class InMemoryMessagingNetwork:
+    """Shared fabric for Ring-3 tests: deterministic, manually pumped.
+
+    One FIFO queue per (sender, target) pair — the in-memory analogue of
+    Artemis per-peer bridges. `pump(1)` delivers exactly one message in
+    global send order; `run(seed)` delivers until quiescent, with a seed
+    interleaving *between* pair-queues (never reordering within one) to
+    surface cross-peer races deterministically — the reference's
+    pumpSend/pumpReceive + runNetwork loop.
+    """
+
+    def __init__(self):
+        self._queues: dict[tuple[str, str], deque[Message]] = {}
+        self._order: deque[tuple[str, str]] = deque()
+        self._endpoints: dict[str, "InMemoryMessaging"] = {}
+        self._dropped: list[Message] = []
+        self.sent_count = 0
+
+    def endpoint(self, name: str) -> "InMemoryMessaging":
+        if name not in self._endpoints:
+            self._endpoints[name] = InMemoryMessaging(self, name)
+        return self._endpoints[name]
+
+    def _enqueue(self, msg: Message, target: str) -> None:
+        self.sent_count += 1
+        pair = (msg.sender, target)
+        self._queues.setdefault(pair, deque()).append(msg)
+        self._order.append(pair)
+
+    def pump(self, n: int = 1, rng: Optional[random.Random] = None) -> int:
+        """Deliver up to n messages; returns how many were delivered."""
+        delivered = 0
+        while self._order and delivered < n:
+            if rng is None:
+                pair = self._order.popleft()
+            else:
+                live = [p for p, q in self._queues.items() if q]
+                pair = live[rng.randrange(len(live))]
+                self._order.remove(pair)   # earliest occurrence
+            msg = self._queues[pair].popleft()
+            ep = self._endpoints.get(pair[1])
+            if ep is None or not ep.running:
+                self._dropped.append(msg)
+            else:
+                ep._deliver(msg)
+            delivered += 1
+        return delivered
+
+    def run(self, seed: Optional[int] = None) -> int:
+        """Pump until quiescent. Returns total messages delivered."""
+        rng = random.Random(seed) if seed is not None else None
+        total = 0
+        while self._order:
+            total += self.pump(1, rng)
+        return total
+
+    @property
+    def pending(self) -> int:
+        return len(self._order)
+
+
+class InMemoryMessaging(MessagingService):
+    """One node's endpoint on the in-memory fabric."""
+
+    def __init__(self, network: InMemoryMessagingNetwork, name: str):
+        self._network = network
+        self._name = name
+        self._handlers: dict[str, list[Handler]] = {}
+        self._next_id = 0
+        self._seen: set[tuple[str, int]] = set()
+        self._undelivered: deque[Message] = deque()
+        self.running = True
+
+    @property
+    def my_address(self) -> str:
+        return self._name
+
+    def send(
+        self,
+        topic: str,
+        payload: bytes,
+        target: str,
+        unique_id: Optional[int] = None,
+    ) -> None:
+        """Explicit unique_id lets flows use deterministic ids so that
+        replayed sends after checkpoint restore dedupe at the receiver
+        (statemachine.py); counter ids stay below 2**63, hashed flow ids
+        set the top bit, so the namespaces never collide."""
+        if unique_id is None:
+            unique_id = self._next_id
+            self._next_id += 1
+        msg = Message(topic, payload, self._name, unique_id)
+        self._network._enqueue(msg, target)
+
+    def add_handler(self, topic: str, handler: Handler) -> None:
+        self._handlers.setdefault(topic, []).append(handler)
+        parked = [m for m in self._undelivered if m.topic == topic]
+        for m in parked:
+            self._undelivered.remove(m)
+            self._deliver(m)
+
+    def remove_handler(self, topic: str, handler: Handler) -> None:
+        handlers = self._handlers.get(topic, [])
+        if handler in handlers:
+            handlers.remove(handler)
+
+    def _deliver(self, msg: Message) -> None:
+        key = (msg.sender, msg.unique_id)
+        if key in self._seen:
+            return  # at-least-once upstream, exactly-once to handlers
+        handlers = self._handlers.get(msg.topic)
+        if not handlers:
+            self._undelivered.append(msg)
+            return
+        self._seen.add(key)
+        for h in list(handlers):
+            h(msg)

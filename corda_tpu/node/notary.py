@@ -1,0 +1,229 @@
+"""Notary services: uniqueness (double-spend prevention) + signing.
+
+Reference: node/.../services/transactions/ (SURVEY §2.7) —
+SimpleNotaryService / ValidatingNotaryService over a
+PersistentUniquenessProvider (locked stateRef->consumingTx map,
+PersistentUniquenessProvider.kt:20, commit :63+), TimeWindowChecker
+(core/.../node/services/TimeWindowChecker.kt), and the NotaryFlow
+service side (core/.../flows/NotaryFlow.kt:107-130).
+
+TPU-first: the notary is the batch seam. `NotaryService.process_batch`
+drains every queued request through ONE BatchSignatureVerifier dispatch
+(signature checks across all pending transactions in a single padded
+XLA program) before committing inputs — the serving path the reference
+approximates with horizontally-scaled verifier processes (SURVEY §2.5).
+The flow-level server handles one request per session; the Phase-4
+batching notary enqueues requests and answers them from the batch loop.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from ..core import serialization as ser
+from ..core.contracts import StateRef, TimeWindow
+from ..core.identity import Party
+from ..core.transactions import (
+    FilteredTransaction,
+    SignedTransaction,
+    TransactionVerificationError,
+)
+from ..crypto.hashes import SecureHash
+from ..crypto.tx_signature import TransactionSignature
+from .services import ServiceHub
+
+# -- errors (wire-serializable: sent back to the requesting flow) ------------
+
+
+@ser.serializable
+@dataclass(frozen=True)
+class NotaryError:
+    """Base marker for notarisation failures (reference:
+    core/.../flows/NotaryError.kt)."""
+
+    kind: str
+    message: str
+    conflict: Any = None    # {state_ref: consuming_tx_id} for conflicts
+
+
+class NotaryException(Exception):
+    def __init__(self, error: NotaryError):
+        self.error = error
+        super().__init__(f"notarisation failed: {error.kind}: {error.message}")
+
+
+class UniquenessConflict(Exception):
+    def __init__(self, conflict: dict):
+        self.conflict = conflict   # StateRef -> consuming tx id
+        super().__init__(f"{len(conflict)} input(s) already consumed")
+
+
+# -- uniqueness providers ----------------------------------------------------
+
+
+class UniquenessProvider:
+    """stateRef -> consuming-tx registry; the core consensus primitive."""
+
+    def commit(
+        self, states: list[StateRef], tx_id: SecureHash, requester: Party
+    ) -> None:
+        raise NotImplementedError
+
+
+class InMemoryUniquenessProvider(UniquenessProvider):
+    """Single-node map (reference: PersistentUniquenessProvider
+    semantics, minus the JDBC persistence — see persistence.py for the
+    sqlite-backed version). Commit is all-or-nothing: on any conflict
+    nothing is recorded and the full conflict set is reported."""
+
+    def __init__(self):
+        self.committed: dict[StateRef, SecureHash] = {}
+
+    def commit(self, states, tx_id, requester) -> None:
+        conflict = {
+            ref: self.committed[ref]
+            for ref in states
+            if ref in self.committed and self.committed[ref] != tx_id
+        }
+        if conflict:
+            raise UniquenessConflict(conflict)
+        for ref in states:
+            self.committed[ref] = tx_id
+
+
+# -- time window -------------------------------------------------------------
+
+
+class TimeWindowChecker:
+    """Clock-tolerance validation (TimeWindowChecker.kt): the notary
+    accepts a window iff `now` (± tolerance) intersects it."""
+
+    def __init__(self, clock, tolerance_micros: int = 30_000_000):
+        self.clock = clock
+        self.tolerance = tolerance_micros
+
+    def is_valid(self, tw: Optional[TimeWindow]) -> bool:
+        if tw is None:
+            return True
+        now = self.clock.now_micros()
+        if tw.until_time is not None and now - self.tolerance >= tw.until_time:
+            return False
+        if tw.from_time is not None and now + self.tolerance < tw.from_time:
+            return False
+        return True
+
+
+# -- the services ------------------------------------------------------------
+
+
+class NotaryService:
+    """Common commit-and-sign core shared by every notary flavour."""
+
+    validating = False
+
+    def __init__(
+        self,
+        services: ServiceHub,
+        uniqueness: Optional[UniquenessProvider] = None,
+        tolerance_micros: int = 30_000_000,
+    ):
+        self.services = services
+        self.uniqueness = uniqueness or InMemoryUniquenessProvider()
+        self.time_window_checker = TimeWindowChecker(
+            services.clock, tolerance_micros
+        )
+
+    @property
+    def identity(self) -> Party:
+        return self.services.my_info.notary_identity
+
+    def commit_and_sign(
+        self,
+        tx_id: SecureHash,
+        inputs: list[StateRef],
+        time_window: Optional[TimeWindow],
+        requester: Party,
+    ):
+        """validate time window -> commit inputs -> sign tx id
+        (NotaryFlow.Service.call, NotaryFlow.kt:110-130). Returns a
+        TransactionSignature or a NotaryError."""
+        if not self.time_window_checker.is_valid(time_window):
+            return NotaryError(
+                "time-window-invalid",
+                f"window {time_window} outside notary clock tolerance",
+            )
+        try:
+            self.uniqueness.commit(inputs, tx_id, requester)
+        except UniquenessConflict as e:
+            return NotaryError(
+                "conflict",
+                str(e),
+                conflict={str(r): h for r, h in e.conflict.items()},
+            )
+        sig = self.services.key_management.sign(
+            tx_id, self.identity.owning_key
+        )
+        return sig
+
+
+class SimpleNotaryService(NotaryService):
+    """Non-validating: sees only a Merkle tear-off of (inputs, notary,
+    time window) — privacy-preserving, trusts the requester for contract
+    validity (SimpleNotaryService.kt)."""
+
+    def process(self, ftx: FilteredTransaction, requester: Party):
+        try:
+            ftx.verify()
+        except TransactionVerificationError as e:
+            return NotaryError("invalid-proof", str(e))
+        # completeness: a tear-off hiding an input (or the time window /
+        # notary) would let the requester double-spend the hidden state
+        from ..core.transactions import G_INPUTS, G_NOTARY, G_TIMEWINDOW
+
+        for g, what in (
+            (G_INPUTS, "inputs"),
+            (G_NOTARY, "notary"),
+            (G_TIMEWINDOW, "time window"),
+        ):
+            if not ftx.all_revealed(g):
+                return NotaryError(
+                    "incomplete-tearoff",
+                    f"tear-off hides {what} components",
+                )
+        if ftx.notary != self.identity:
+            return NotaryError(
+                "wrong-notary", f"tx names notary {ftx.notary}, I am "
+                f"{self.identity}"
+            )
+        return self.commit_and_sign(
+            ftx.id, list(ftx.inputs), ftx.time_window, requester
+        )
+
+
+class ValidatingNotaryService(NotaryService):
+    """Validating: fully resolves and verifies the transaction —
+    signatures through the TPU batch SPI, then contracts — before
+    committing (ValidatingNotaryFlow.kt:17-46). Backchain resolution
+    happens in the service *flow* (it needs sessions); this class does
+    the post-resolution work."""
+
+    validating = True
+
+    def process(self, stx: SignedTransaction, requester: Party):
+        if stx.wtx.notary != self.identity:
+            return NotaryError(
+                "wrong-notary", f"tx names notary {stx.wtx.notary}, I am "
+                f"{self.identity}"
+            )
+        try:
+            stx.verify(
+                self.services,
+                check_sufficient_signatures=False,   # ours is still missing
+                verifier=self.services.batch_verifier,
+            )
+        except Exception as e:
+            return NotaryError("invalid-transaction", str(e))
+        return self.commit_and_sign(
+            stx.id, list(stx.wtx.inputs), stx.wtx.time_window, requester
+        )

@@ -1,0 +1,561 @@
+"""ServiceHub and the in-memory node services.
+
+Reference: the `ServiceHub` facade (core/.../node/ServiceHub.kt:45-60 —
+vault, keyManagement, identity, attachments, validatedTransactions,
+transactionVerifierService, clock, networkMapCache) and its node-side
+implementations (SURVEY §2.8). These in-memory implementations are the
+Ring-2/Ring-3 substrate (reference: testing/node/MockServices.kt) and
+double as the storage interface the sqlite-backed Phase-3 services
+implement.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable, Optional
+
+from ..core.contracts import (
+    Attachment,
+    CommandWithParties,
+    StateAndRef,
+    StateRef,
+    TransactionState,
+)
+from ..core.identity import AnonymousParty, Party
+from ..core.transactions import (
+    LedgerTransaction,
+    SignedTransaction,
+    TransactionVerificationError,
+    WireTransaction,
+)
+from ..crypto import composite as comp
+from ..crypto import schemes
+from ..crypto.batch_verifier import (
+    BatchSignatureVerifier,
+    default_verifier,
+)
+from ..crypto.hashes import SecureHash
+from ..crypto.tx_signature import TransactionSignature, sign_tx_id
+
+
+# ---------------------------------------------------------------------------
+# clock
+
+
+class Clock:
+    """Integer-microsecond clock (determinism: no floats on consensus
+    paths; reference TimeWindow uses Instants)."""
+
+    def now_micros(self) -> int:
+        import time
+
+        return time.time_ns() // 1_000
+
+
+class TestClock(Clock):
+    """Settable clock for Ring-2/3 tests (reference: TestClock.kt)."""
+
+    def __init__(self, start_micros: int = 1_700_000_000_000_000):
+        self._now = start_micros
+
+    def now_micros(self) -> int:
+        return self._now
+
+    def advance(self, micros: int) -> None:
+        self._now += micros
+
+    def set(self, micros: int) -> None:
+        self._now = micros
+
+
+# ---------------------------------------------------------------------------
+# storage services
+
+
+class TransactionStorage:
+    """Validated-transaction store (reference: DBTransactionStorage).
+    Observers fire on first record — the SMM's waitForLedgerCommit and
+    the vault hang off this."""
+
+    def __init__(self):
+        self._txs: dict[SecureHash, SignedTransaction] = {}
+        self.observers: list[Callable[[SignedTransaction], None]] = []
+
+    def get(self, tx_id: SecureHash) -> Optional[SignedTransaction]:
+        return self._txs.get(tx_id)
+
+    def add(self, stx: SignedTransaction) -> bool:
+        """Returns True if newly added (idempotent on re-record)."""
+        if stx.id in self._txs:
+            return False
+        self._txs[stx.id] = stx
+        for cb in list(self.observers):
+            cb(stx)
+        return True
+
+    def __contains__(self, tx_id: SecureHash) -> bool:
+        return tx_id in self._txs
+
+    def all(self) -> list[SignedTransaction]:
+        return list(self._txs.values())
+
+
+class AttachmentStorage:
+    """Content-addressed blob store (reference: NodeAttachmentService)."""
+
+    def __init__(self):
+        self._blobs: dict[SecureHash, bytes] = {}
+
+    def import_attachment(self, data: bytes) -> SecureHash:
+        att = Attachment.of(data)
+        self._blobs.setdefault(att.id, data)
+        return att.id
+
+    def open_attachment(self, att_id: SecureHash) -> Optional[Attachment]:
+        data = self._blobs.get(att_id)
+        return None if data is None else Attachment(att_id, data)
+
+    def __contains__(self, att_id: SecureHash) -> bool:
+        return att_id in self._blobs
+
+
+class CheckpointStorage:
+    """Flow checkpoint store (reference: DBCheckpointStorage.kt:18)."""
+
+    def __init__(self):
+        self._checkpoints: dict[bytes, bytes] = {}
+
+    def add(self, flow_id: bytes, record: bytes) -> None:
+        self._checkpoints[flow_id] = record
+
+    def remove(self, flow_id: bytes) -> None:
+        self._checkpoints.pop(flow_id, None)
+
+    def all(self) -> list[tuple[bytes, bytes]]:
+        return sorted(self._checkpoints.items())
+
+
+# ---------------------------------------------------------------------------
+# key management & identity
+
+
+class KeyManagementService:
+    """Holds this node's signing keys; mints fresh (anonymous) keys
+    (reference: node/.../services/keys/PersistentKeyManagementService)."""
+
+    def __init__(self, *initial_keys: schemes.KeyPair, rng=None):
+        import random as _random
+
+        self._keys: dict[schemes.PublicKey, schemes.PrivateKey] = {
+            kp.public: kp.private for kp in initial_keys
+        }
+        self._rng = rng or _random.Random()
+
+    @property
+    def keys(self) -> set[schemes.PublicKey]:
+        return set(self._keys)
+
+    def fresh_key(
+        self, scheme_id: int = schemes.DEFAULT_SCHEME
+    ) -> schemes.PublicKey:
+        kp = schemes.generate_keypair(
+            scheme_id, seed=self._rng.getrandbits(256)
+        )
+        self._keys[kp.public] = kp.private
+        return kp.public
+
+    def sign(self, tx_id: SecureHash, key: schemes.PublicKey) -> TransactionSignature:
+        priv = self._keys.get(key)
+        if priv is None:
+            raise KeyError(f"no private key for {key}")
+        return sign_tx_id(priv, tx_id)
+
+    def our_first_key_for(self, candidates: Iterable) -> Optional[schemes.PublicKey]:
+        """First leaf of any candidate key that we control."""
+        for k in candidates:
+            for leaf in comp.leaves_of(k):
+                if leaf in self._keys:
+                    return leaf
+        return None
+
+
+class IdentityService:
+    """party <-> key registry (reference: InMemoryIdentityService)."""
+
+    def __init__(self, *parties: Party):
+        self._by_key: dict[bytes, Party] = {}
+        self._by_name: dict[str, Party] = {}
+        for p in parties:
+            self.register(p)
+
+    def register(self, party: Party) -> None:
+        self._by_key[_key_fp(party.owning_key)] = party
+        self._by_name[party.name] = party
+
+    def party_from_key(self, key) -> Optional[Party]:
+        return self._by_key.get(_key_fp(key))
+
+    def party_from_name(self, name: str) -> Optional[Party]:
+        return self._by_name.get(name)
+
+    def well_known_party(self, party) -> Optional[Party]:
+        """Resolve an AnonymousParty/Party to its well-known identity."""
+        if isinstance(party, Party):
+            return party
+        if isinstance(party, AnonymousParty):
+            return self.party_from_key(party.owning_key)
+        return None
+
+    def all_parties(self) -> list[Party]:
+        return list(self._by_name.values())
+
+
+def _key_fp(key) -> bytes:
+    return key.fingerprint()
+
+
+# ---------------------------------------------------------------------------
+# network map cache
+
+
+@dataclass(frozen=True)
+class NodeInfo:
+    """A node's advertised identity + address (reference:
+    core/.../node/NodeInfo.kt)."""
+
+    address: str
+    legal_identity: Party
+    advertised_services: tuple[str, ...] = ()
+
+    @property
+    def notary_identity(self) -> Party:
+        return self.legal_identity
+
+
+SERVICE_NOTARY = "corda.notary.simple"
+SERVICE_NOTARY_VALIDATING = "corda.notary.validating"
+SERVICE_NETWORK_MAP = "corda.network_map"
+
+
+class NetworkMapCache:
+    """Peer directory (reference: InMemoryNetworkMapCache). The Phase-3
+    network-map *service* feeds this over the fabric; Ring-3 tests fill
+    it directly."""
+
+    def __init__(self):
+        self._nodes: dict[str, NodeInfo] = {}
+        self.observers: list[Callable[[NodeInfo], None]] = []
+
+    def add_node(self, info: NodeInfo) -> None:
+        self._nodes[info.legal_identity.name] = info
+        for cb in list(self.observers):
+            cb(info)
+
+    def address_of(self, party: Party) -> Optional[str]:
+        info = self._nodes.get(party.name)
+        return info.address if info else None
+
+    def node_of(self, party: Party) -> Optional[NodeInfo]:
+        return self._nodes.get(party.name)
+
+    def notary_identities(self) -> list[Party]:
+        return [
+            n.legal_identity
+            for n in self._nodes.values()
+            if any(s.startswith("corda.notary") for s in n.advertised_services)
+        ]
+
+    def is_validating_notary(self, party: Party) -> bool:
+        info = self._nodes.get(party.name)
+        return bool(
+            info and SERVICE_NOTARY_VALIDATING in info.advertised_services
+        )
+
+    def all_nodes(self) -> list[NodeInfo]:
+        return list(self._nodes.values())
+
+
+# ---------------------------------------------------------------------------
+# vault
+
+
+@dataclass
+class VaultUpdate:
+    """One ledger delta seen by this node (reference: Vault.Update)."""
+
+    consumed: list[StateAndRef]
+    produced: list[StateAndRef]
+
+
+class VaultService:
+    """Tracks our unconsumed states; streams updates; soft-locks states
+    for in-flight spends (reference: NodeVaultService.kt +
+    VaultSoftLockManager)."""
+
+    def __init__(self, services: "ServiceHub"):
+        self._services = services
+        self._unconsumed: dict[StateRef, TransactionState] = {}
+        self._consumed: dict[StateRef, TransactionState] = {}
+        self._soft_locks: dict[StateRef, bytes] = {}   # ref -> lock id
+        self.updates: list[Callable[[VaultUpdate], None]] = []
+
+    # -- ingestion ----------------------------------------------------------
+
+    def notify(self, wtx: WireTransaction) -> None:
+        """Apply a recorded transaction: consume our inputs, add our
+        relevant outputs (NodeVaultService.notifyAll)."""
+        consumed = []
+        for ref in wtx.inputs:
+            ts = self._unconsumed.pop(ref, None)
+            if ts is not None:
+                self._consumed[ref] = ts
+                self._soft_locks.pop(ref, None)
+                consumed.append(StateAndRef(ts, ref))
+        produced = []
+        my_keys = self._services.key_management.keys
+        for i, ts in enumerate(wtx.outputs):
+            if self._is_relevant(ts, my_keys):
+                ref = StateRef(wtx.id, i)
+                self._unconsumed[ref] = ts
+                produced.append(StateAndRef(ts, ref))
+        if consumed or produced:
+            update = VaultUpdate(consumed, produced)
+            for cb in list(self.updates):
+                cb(update)
+
+    @staticmethod
+    def _is_relevant(ts: TransactionState, my_keys: set) -> bool:
+        for participant in ts.data.participants:
+            for leaf in comp.leaves_of(_owning_key_of(participant)):
+                if leaf in my_keys:
+                    return True
+        return False
+
+    # -- queries ------------------------------------------------------------
+
+    def unconsumed_states(self, cls=None) -> list[StateAndRef]:
+        out = []
+        for ref, ts in self._unconsumed.items():
+            if cls is None or isinstance(ts.data, cls):
+                out.append(StateAndRef(ts, ref))
+        return out
+
+    def consumed_states(self, cls=None) -> list[StateAndRef]:
+        return [
+            StateAndRef(ts, ref)
+            for ref, ts in self._consumed.items()
+            if cls is None or isinstance(ts.data, cls)
+        ]
+
+    # -- coin selection -----------------------------------------------------
+
+    def unconsumed_states_for_spending(
+        self,
+        amount_quantity: int,
+        lock_id: bytes,
+        cls=None,
+        predicate: Callable[[TransactionState], bool] = lambda ts: True,
+        quantity_of: Callable[[TransactionState], int] = None,
+    ) -> list[StateAndRef]:
+        """Greedy coin selection with soft-locking (reference:
+        NodeVaultService.unconsumedStatesForSpending)."""
+        if quantity_of is None:
+            quantity_of = lambda ts: ts.data.amount.quantity  # noqa: E731
+        picked, total = [], 0
+        for ref, ts in sorted(
+            self._unconsumed.items(), key=lambda kv: str(kv[0])
+        ):
+            if cls is not None and not isinstance(ts.data, cls):
+                continue
+            lock = self._soft_locks.get(ref)
+            if lock is not None and lock != lock_id:
+                continue
+            if not predicate(ts):
+                continue
+            picked.append(StateAndRef(ts, ref))
+            total += quantity_of(ts)
+            if total >= amount_quantity:
+                break
+        if total < amount_quantity:
+            self.release_soft_locks(lock_id)
+            raise InsufficientBalanceError(amount_quantity - total)
+        for sar in picked:
+            self._soft_locks[sar.ref] = lock_id
+        return picked
+
+    def release_soft_locks(self, lock_id: bytes) -> None:
+        self._soft_locks = {
+            r: l for r, l in self._soft_locks.items() if l != lock_id
+        }
+
+
+class InsufficientBalanceError(Exception):
+    def __init__(self, shortfall: int):
+        self.shortfall = shortfall
+        super().__init__(f"short {shortfall} units")
+
+
+def _owning_key_of(participant):
+    """Participants may be keys or parties."""
+    return getattr(participant, "owning_key", participant)
+
+
+# ---------------------------------------------------------------------------
+# transaction verifier service (the offload seam)
+
+
+class _Future:
+    """Tiny synchronous future (the SPI is future-shaped so the out-of-
+    process pool in Phase 4 can slot in: OutOfProcessTransaction-
+    VerifierService.kt:19-73)."""
+
+    def __init__(self):
+        self._done = False
+        self._exc: Optional[BaseException] = None
+
+    def set_result(self) -> None:
+        self._done = True
+
+    def set_exception(self, exc: BaseException) -> None:
+        self._done = True
+        self._exc = exc
+
+    def result(self) -> None:
+        if not self._done:
+            raise RuntimeError("verification still pending")
+        if self._exc is not None:
+            raise self._exc
+
+
+class TransactionVerifierService:
+    """SPI: verify(ltx) -> future (reference: core/.../node/services/
+    TransactionVerifierService.kt:9-15)."""
+
+    def verify(self, ltx: LedgerTransaction) -> _Future:
+        raise NotImplementedError
+
+
+class InMemoryTransactionVerifierService(TransactionVerifierService):
+    """Runs contract verification inline (reference: InMemoryTransaction-
+    VerifierService.kt:10-14 — thread pool there; synchronous here, the
+    fabric pump provides concurrency)."""
+
+    def verify(self, ltx: LedgerTransaction) -> _Future:
+        f = _Future()
+        try:
+            ltx.verify()
+            f.set_result()
+        except Exception as e:
+            f.set_exception(e)
+        return f
+
+
+# ---------------------------------------------------------------------------
+# the hub
+
+
+class ServiceHub:
+    """Facade over every node service (ServiceHub.kt:45-60)."""
+
+    def __init__(
+        self,
+        my_info: NodeInfo,
+        key_management: KeyManagementService,
+        identity: IdentityService,
+        network_map_cache: Optional[NetworkMapCache] = None,
+        clock: Optional[Clock] = None,
+        batch_verifier: Optional[BatchSignatureVerifier] = None,
+    ):
+        self.my_info = my_info
+        self.key_management = key_management
+        self.identity = identity
+        self.network_map_cache = network_map_cache or NetworkMapCache()
+        self.clock = clock or Clock()
+        self.validated_transactions = TransactionStorage()
+        self.attachments = AttachmentStorage()
+        self.checkpoint_storage = CheckpointStorage()
+        self.vault = VaultService(self)
+        self.transaction_verifier = InMemoryTransactionVerifierService()
+        self._batch_verifier = batch_verifier
+
+    @property
+    def batch_verifier(self) -> BatchSignatureVerifier:
+        """The TPU signature-verification SPI for this node."""
+        return self._batch_verifier or default_verifier()
+
+    # -- recording ----------------------------------------------------------
+
+    def record_transactions(self, stxs: Iterable[SignedTransaction]) -> None:
+        """Store validated transactions + notify the vault (reference:
+        ServiceHub.recordTransactions -> NodeVaultService.notifyAll)."""
+        for stx in stxs:
+            if self.validated_transactions.add(stx):
+                self.vault.notify(stx.wtx)
+
+    # -- resolution ---------------------------------------------------------
+
+    def resolve_transaction(self, wtx: WireTransaction) -> LedgerTransaction:
+        """WireTransaction -> LedgerTransaction: resolve input refs from
+        storage, signers to parties, attachment ids to blobs
+        (WireTransaction.toLedgerTransaction, WireTransaction.kt:60)."""
+        inputs = []
+        for ref in wtx.inputs:
+            stx = self.validated_transactions.get(ref.txhash)
+            if stx is None:
+                raise TransactionResolutionError(ref.txhash)
+            if ref.index >= len(stx.wtx.outputs):
+                raise TransactionResolutionError(ref.txhash)
+            inputs.append(StateAndRef(stx.wtx.outputs[ref.index], ref))
+        commands = []
+        for cmd in wtx.commands:
+            parties = []
+            for k in cmd.signers:
+                p = self.identity.party_from_key(k)
+                if p is not None:
+                    parties.append(p)
+            commands.append(
+                CommandWithParties(cmd.signers, tuple(parties), cmd.value)
+            )
+        attachments = []
+        for att_id in wtx.attachments:
+            att = self.attachments.open_attachment(att_id)
+            if att is None:
+                raise AttachmentResolutionError(att_id)
+            attachments.append(att)
+        return LedgerTransaction(
+            inputs=tuple(inputs),
+            outputs=wtx.outputs,
+            commands=tuple(commands),
+            attachments=tuple(attachments),
+            notary=wtx.notary,
+            time_window=wtx.time_window,
+            id=wtx.id,
+        )
+
+    # -- signing ------------------------------------------------------------
+
+    def sign_initial_transaction(self, builder, *keys) -> SignedTransaction:
+        """Build + sign with our keys (default: legal identity key)."""
+        wtx = builder.to_wire_transaction()
+        use = list(keys) or [self.my_info.legal_identity.owning_key]
+        sigs = tuple(self.key_management.sign(wtx.id, k) for k in use)
+        return SignedTransaction(wtx, sigs)
+
+    def add_signature(self, stx: SignedTransaction, key=None) -> SignedTransaction:
+        k = key or self.my_info.legal_identity.owning_key
+        return stx.with_additional_signature(
+            self.key_management.sign(stx.id, k)
+        )
+
+
+class TransactionResolutionError(TransactionVerificationError):
+    def __init__(self, tx_id):
+        self.tx_id = tx_id
+        super().__init__(f"cannot resolve {tx_id}")
+
+
+class AttachmentResolutionError(TransactionVerificationError):
+    def __init__(self, att_id):
+        self.att_id = att_id
+        super().__init__(f"missing attachment {att_id}")

@@ -1,0 +1,5 @@
+"""Test kit: MockNetwork (Ring 3), test identities, ledger DSL."""
+
+from .mock_network import MockNetwork, MockNode
+
+__all__ = ["MockNetwork", "MockNode"]

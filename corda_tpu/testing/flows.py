@@ -1,0 +1,78 @@
+"""Tiny flows used by Ring-3 tests and demos (ping/pong, echo).
+
+Module-level so checkpoint restore can re-import them
+(statemachine._reconstruct_logic).
+"""
+
+from __future__ import annotations
+
+from ..core.identity import Party
+from ..flows.api import FlowLogic, initiated_by, initiating_flow
+
+
+@initiating_flow
+class PingFlow(FlowLogic):
+    """Send `count` pings, expect incremented replies."""
+
+    def __init__(self, other: Party, count: int = 1):
+        self.other = other
+        self.count = count
+
+    def call(self):
+        total = 0
+        for i in range(self.count):
+            reply = yield from self.send_and_receive(self.other, i, int)
+            if reply != i + 1:
+                raise AssertionError(f"bad pong {reply} for ping {i}")
+            total += reply
+        return total
+
+
+@initiated_by(PingFlow)
+class PongFlow(FlowLogic):
+    def __init__(self, other: Party):
+        self.other = other
+
+    def call(self):
+        while True:
+            try:
+                n = yield from self.receive(self.other, int)
+            except Exception:
+                return None   # session ended
+            yield from self.send(self.other, n + 1)
+
+
+@initiating_flow
+class OneShotPingFlow(FlowLogic):
+    """Single round-trip (responder ends after one reply)."""
+
+    def __init__(self, other: Party, value: int = 7):
+        self.other = other
+        self.value = value
+
+    def call(self):
+        reply = yield from self.send_and_receive(self.other, self.value, int)
+        return reply
+
+
+@initiated_by(OneShotPingFlow)
+class OneShotPongFlow(FlowLogic):
+    def __init__(self, other: Party):
+        self.other = other
+
+    def call(self):
+        n = yield from self.receive(self.other, int)
+        yield from self.send(self.other, n * 2)
+        return n
+
+
+@initiating_flow
+class NoResponderFlow(FlowLogic):
+    """No @initiated_by counterpart: used to test SessionReject."""
+
+    def __init__(self, other: Party):
+        self.other = other
+
+    def call(self):
+        reply = yield from self.send_and_receive(self.other, 1, int)
+        return reply

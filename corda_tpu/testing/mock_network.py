@@ -1,0 +1,162 @@
+"""MockNetwork: N in-process nodes over the manually-pumped fabric.
+
+Reference: test-utils/.../testing/node/MockNode.kt:58 — N AbstractNode
+instances in one JVM over an InMemoryMessagingNetwork with deterministic
+manual delivery, deterministic identities from seeds
+(TestConstants.kt entropyToKeyPair), in-memory persistence, and an
+InMemoryTransactionVerifierService. `run()` loops until quiescent
+(MockNode runNetwork).
+
+Signature verification uses the CPU reference verifier by default so
+Ring-3 tests stay fast; pass a TpuBatchVerifier to exercise the jitted
+kernels end-to-end (done once in tests/test_e2e_tpu.py).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Optional
+
+from ..core.identity import Party
+from ..crypto import schemes
+from ..crypto.batch_verifier import BatchSignatureVerifier, CpuBatchVerifier
+from ..flows.api import FlowLogic
+from ..flows.statemachine import FlowStateMachine, StateMachineManager
+from ..node import messaging as msglib
+from ..node.notary import (
+    InMemoryUniquenessProvider,
+    SimpleNotaryService,
+    ValidatingNotaryService,
+)
+from ..node.services import (
+    IdentityService,
+    KeyManagementService,
+    NodeInfo,
+    NetworkMapCache,
+    SERVICE_NOTARY,
+    SERVICE_NOTARY_VALIDATING,
+    ServiceHub,
+    TestClock,
+)
+
+
+class MockNode:
+    """One in-process node: ServiceHub + SMM + fabric endpoint."""
+
+    def __init__(
+        self,
+        network: "MockNetwork",
+        name: str,
+        *,
+        notary: Optional[str] = None,     # None | "simple" | "validating"
+        scheme_id: int = schemes.DEFAULT_SCHEME,
+    ):
+        self.network = network
+        self.name = name
+        seed = network.rng.getrandbits(256)
+        self.keypair = schemes.generate_keypair(scheme_id, seed=seed)
+        self.party = Party(name, self.keypair.public)
+        advertised: tuple[str, ...] = ()
+        if notary == "simple":
+            advertised = (SERVICE_NOTARY,)
+        elif notary == "validating":
+            advertised = (SERVICE_NOTARY_VALIDATING,)
+        elif notary is not None:
+            raise ValueError(f"unknown notary type {notary!r}")
+        self.info = NodeInfo(name, self.party, advertised)
+        self.services = ServiceHub(
+            my_info=self.info,
+            key_management=KeyManagementService(
+                self.keypair, rng=random.Random(network.rng.getrandbits(64))
+            ),
+            identity=IdentityService(self.party),
+            network_map_cache=NetworkMapCache(),
+            clock=network.clock,
+            batch_verifier=network.batch_verifier,
+        )
+        self.messaging = network.fabric.endpoint(name)
+        self.smm = StateMachineManager(
+            self.services,
+            self.messaging,
+            rng=random.Random(network.rng.getrandbits(64)),
+        )
+        if notary == "simple":
+            self.services.notary_service = SimpleNotaryService(
+                self.services, InMemoryUniquenessProvider()
+            )
+        elif notary == "validating":
+            self.services.notary_service = ValidatingNotaryService(
+                self.services, InMemoryUniquenessProvider()
+            )
+
+    # -- conveniences -------------------------------------------------------
+
+    def start_flow(self, logic: FlowLogic) -> FlowStateMachine:
+        return self.smm.start_flow(logic)
+
+    def run_flow(self, logic: FlowLogic):
+        """start + pump the whole network + return the result."""
+        fsm = self.start_flow(logic)
+        self.network.run()
+        return fsm.result_or_throw()
+
+    @property
+    def vault(self):
+        return self.services.vault
+
+    def __repr__(self) -> str:
+        return f"<MockNode {self.name}>"
+
+
+class MockNetwork:
+    """Deterministic multi-node harness (MockNode.kt:58)."""
+
+    def __init__(
+        self,
+        seed: int = 42,
+        batch_verifier: Optional[BatchSignatureVerifier] = None,
+        shuffle_delivery: bool = False,
+    ):
+        self.rng = random.Random(seed)
+        self.fabric = msglib.InMemoryMessagingNetwork()
+        self.clock = TestClock()
+        self.batch_verifier = batch_verifier or CpuBatchVerifier()
+        self.nodes: list[MockNode] = []
+        self._shuffle_seed = (
+            self.rng.getrandbits(32) if shuffle_delivery else None
+        )
+
+    def create_node(self, name: Optional[str] = None, **kw) -> MockNode:
+        node = MockNode(
+            self, name or f"Node{len(self.nodes)}", **kw
+        )
+        self.nodes.append(node)
+        self._sync_directories()
+        return node
+
+    def create_notary(self, name: str = "Notary", validating: bool = False):
+        return self.create_node(
+            name, notary="validating" if validating else "simple"
+        )
+
+    def _sync_directories(self) -> None:
+        """Every node learns every node (the reference's network-map
+        registration round, instant here)."""
+        for node in self.nodes:
+            for other in self.nodes:
+                node.services.network_map_cache.add_node(other.info)
+                node.services.identity.register(other.party)
+
+    def run(self, pump_limit: int = 100_000) -> int:
+        """Deliver messages until quiescent; returns count delivered."""
+        rng = (
+            random.Random(self._shuffle_seed)
+            if self._shuffle_seed is not None
+            else None
+        )
+        total = 0
+        while self.fabric.pending:
+            total += self.fabric.pump(1, rng)
+            if total > pump_limit:
+                raise RuntimeError("network did not quiesce (livelock?)")
+        return total

@@ -232,8 +232,11 @@ def test_filtered_transaction_tear_off():
     assert ftx.inputs == [consumed.out_ref(0)]
     assert ftx.notary == NOTARY
     assert ftx.time_window is not None
-    # outputs are NOT visible
-    assert all(g in (G_INPUTS, 4, 5) for g, _, _ in ftx.components)
+    # outputs are NOT visible (meta/group-counts leaf always is)
+    from corda_tpu.core.transactions import G_META
+
+    assert all(g in (G_INPUTS, 4, 5, G_META) for g, _, _ in ftx.components)
+    assert ftx.all_revealed(G_INPUTS)
 
     # tampering with a revealed component breaks the proof
     bad = FilteredTransaction(
@@ -255,3 +258,27 @@ def test_serialization_roundtrip_wire_tx():
     out = ser.decode(ser.encode(wtx))
     assert out == wtx
     assert out.id == wtx.id
+
+
+def test_tear_off_cannot_hide_inputs():
+    """A tear-off revealing only a subset of inputs must be detectable:
+    the always-revealed meta leaf commits to group sizes (defence for
+    the non-validating notary double-spend vector)."""
+    c1 = build_tx().to_wire_transaction()
+    c2 = build_tx().to_wire_transaction()
+    b = build_tx()
+    b.add_input_state(StateAndRef(c1.outputs[0], c1.out_ref(0)))
+    b.add_input_state(StateAndRef(c2.outputs[0], c2.out_ref(0)))
+    wtx = b.to_wire_transaction()
+
+    hidden = wtx.inputs[1]
+    ftx = wtx.build_filtered_transaction(
+        lambda c: isinstance(c, (StateRef, TimeWindow, Party)) and c != hidden
+    )
+    ftx.verify()  # inclusion proof is still valid...
+    assert not ftx.all_revealed(G_INPUTS)   # ...but incompleteness shows
+
+    full = wtx.build_filtered_transaction(
+        lambda c: isinstance(c, (StateRef, TimeWindow, Party))
+    )
+    assert full.all_revealed(G_INPUTS)
