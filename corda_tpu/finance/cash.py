@@ -228,12 +228,15 @@ class CashExitFlow(FlowLogic):
         lock_id = yield from self.record(
             lambda: self.services.key_management.fresh_key().fingerprint()
         )
-        coins = self.services.vault.unconsumed_states_for_spending(
-            self.quantity,
-            lock_id,
-            cls=CashState,
-            predicate=lambda ts: ts.data.amount.token == token,
+        coins = yield from self.record(
+            lambda: self.services.vault.unconsumed_states_for_spending(
+                self.quantity,
+                lock_id,
+                cls=CashState,
+                predicate=lambda ts: ts.data.amount.token == token,
+            )
         )
+        self.services.vault.soft_lock([sar.ref for sar in coins], lock_id)
         total = sum(sar.state.data.amount.quantity for sar in coins)
         builder = TransactionBuilder()
         for sar in coins:
@@ -262,17 +265,24 @@ def generate_spend(flow: FlowLogic, quantity: int, currency: str, to_key):
     lock_id = yield from flow.record(
         lambda: services.key_management.fresh_key().fingerprint()
     )
+    # The selection is journaled: on checkpoint replay the recorded
+    # coins are reused verbatim (never re-selected against a vault that
+    # may have changed), so the rebuilt tx id matches the journaled
+    # notary conversation. Locks are then re-asserted for this run.
     try:
-        coins = services.vault.unconsumed_states_for_spending(
-            quantity,
-            lock_id,
-            cls=CashState,
-            predicate=lambda ts: ts.data.amount.token.product == currency,
+        coins = yield from flow.record(
+            lambda: services.vault.unconsumed_states_for_spending(
+                quantity,
+                lock_id,
+                cls=CashState,
+                predicate=lambda ts: ts.data.amount.token.product == currency,
+            )
         )
     except InsufficientBalanceError as e:
         raise FlowException(
             f"insufficient {currency}: short {e.shortfall}"
         ) from e
+    services.vault.soft_lock([sar.ref for sar in coins], lock_id)
     builder = TransactionBuilder()
     by_token: dict = {}
     for sar in coins:

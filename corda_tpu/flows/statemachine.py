@@ -334,9 +334,38 @@ class StateMachineManager:
                 continue
             if isinstance(req, _Record):
                 if fsm.replaying:
-                    _, value = self._journal_next(fsm, "rec")
+                    kind, value = self._journal_next(
+                        fsm, ("rec", "rec_err", "rec_err_opaque")
+                    )
+                    if kind == "rec_err":
+                        fsm.throw_exc = value   # CTS round-tripped exception
+                        continue
+                    if kind == "rec_err_opaque":
+                        tag, message = value
+                        fsm.throw_exc = FlowException(f"{tag}: {message}")
+                        continue
                 else:
-                    value = req.fn()
+                    try:
+                        value = req.fn()
+                    except Exception as e:
+                        # Journal the failure so a replay deterministically
+                        # re-raises instead of re-running the side effect.
+                        # Exception types registered with the canonical
+                        # codec replay faithfully (attributes intact);
+                        # anything else replays as an opaque FlowException.
+                        try:
+                            ser.encode(e)
+                            _journal_add(fsm, ["rec_err", e])
+                        except ser.SerializationError:
+                            _journal_add(
+                                fsm,
+                                [
+                                    "rec_err_opaque",
+                                    [_class_tag(type(e)), str(e)],
+                                ],
+                            )
+                        fsm.throw_exc = e
+                        continue
                     _journal_add(fsm, ["rec", value])
                 fsm.resume_value = value
                 continue

@@ -1,0 +1,488 @@
+"""SQLite-backed node persistence.
+
+Reference: the node's JDBC/H2 storage layer — `DBTransactionStorage`,
+`NodeAttachmentService`, `DBCheckpointStorage` (node/.../services/
+persistence/), `PersistentUniquenessProvider` (node/.../services/
+transactions/PersistentUniquenessProvider.kt:20), the `JDBCHashMap`
+KV-on-SQL primitive (node/.../utilities/JDBCHashMap.kt) and
+`CordaPersistence` transaction management (node/.../utilities/
+CordaPersistence.kt). H2-behind-ORMs becomes one sqlite database per
+node in WAL mode; every store is a write-through cache over its table so
+read paths stay as fast as the in-memory Ring-3 services they subclass.
+
+The vault table carries denormalised query columns (contract tag,
+fungible quantity/token, linear id, participant fingerprints) — the
+analogue of the reference's `MappedSchema` ORM projection
+(core/.../schemas/PersistentTypes.kt, node/.../vault/VaultSchema.kt) —
+so the QueryCriteria parser (vault_query.py) can compile to SQL the way
+HibernateQueryCriteriaParser does.
+"""
+
+from __future__ import annotations
+
+import sqlite3
+import threading
+from typing import Optional
+
+from ..core import serialization as ser
+from ..core.contracts import StateRef
+from ..core.identity import Party
+from ..core.transactions import SignedTransaction
+from ..crypto import composite as comp
+from ..crypto import schemes
+from ..crypto.hashes import SecureHash
+from .notary import UniquenessConflict, UniquenessProvider
+from .services import (
+    AttachmentStorage,
+    CheckpointStorage,
+    KeyManagementService,
+    TransactionStorage,
+    VaultService,
+    _owning_key_of,
+)
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS transactions (
+    tx_id BLOB PRIMARY KEY,
+    data  BLOB NOT NULL
+);
+CREATE TABLE IF NOT EXISTS attachments (
+    att_id BLOB PRIMARY KEY,
+    data   BLOB NOT NULL
+);
+CREATE TABLE IF NOT EXISTS checkpoints (
+    flow_id BLOB PRIMARY KEY,
+    record  BLOB NOT NULL
+);
+CREATE TABLE IF NOT EXISTS notary_commits (
+    ref_tx    BLOB NOT NULL,
+    ref_index INTEGER NOT NULL,
+    consumer  BLOB NOT NULL,
+    requester TEXT NOT NULL,
+    PRIMARY KEY (ref_tx, ref_index)
+);
+CREATE TABLE IF NOT EXISTS our_keys (
+    fingerprint BLOB PRIMARY KEY,
+    scheme_id   INTEGER NOT NULL,
+    public_key  BLOB NOT NULL,
+    private_key BLOB NOT NULL
+);
+CREATE TABLE IF NOT EXISTS vault_states (
+    ref_tx       BLOB NOT NULL,
+    ref_index    INTEGER NOT NULL,
+    state        BLOB NOT NULL,
+    contract_tag TEXT NOT NULL,
+    status       INTEGER NOT NULL,          -- 0 unconsumed, 1 consumed
+    notary       TEXT,
+    quantity     INTEGER,                    -- fungible states
+    token        TEXT,                       -- fungible token descriptor
+    issuer       TEXT,                       -- fungible issuer party name
+    linear_id    BLOB,                       -- linear states
+    recorded_at  INTEGER NOT NULL,
+    consumed_at  INTEGER,
+    PRIMARY KEY (ref_tx, ref_index)
+);
+CREATE INDEX IF NOT EXISTS vault_status_idx
+    ON vault_states (status, contract_tag);
+CREATE TABLE IF NOT EXISTS vault_parts (
+    ref_tx      BLOB NOT NULL,
+    ref_index   INTEGER NOT NULL,
+    fingerprint BLOB NOT NULL
+);
+CREATE INDEX IF NOT EXISTS vault_parts_idx ON vault_parts (fingerprint);
+CREATE TABLE IF NOT EXISTS kv (
+    space TEXT NOT NULL,
+    k     BLOB NOT NULL,
+    v     BLOB NOT NULL,
+    PRIMARY KEY (space, k)
+);
+CREATE TABLE IF NOT EXISTS queue_journal (
+    seq      INTEGER PRIMARY KEY AUTOINCREMENT,
+    peer     TEXT NOT NULL,
+    topic    TEXT NOT NULL,
+    payload  BLOB NOT NULL,
+    uid      INTEGER NOT NULL,
+    acked    INTEGER NOT NULL DEFAULT 0
+);
+CREATE INDEX IF NOT EXISTS queue_peer_idx ON queue_journal (peer, acked);
+CREATE TABLE IF NOT EXISTS dedupe (
+    sender TEXT NOT NULL,
+    uid    INTEGER NOT NULL,
+    PRIMARY KEY (sender, uid)
+);
+"""
+
+
+class NodeDatabase:
+    """One sqlite database per node (reference: CordaPersistence over
+    H2). A single serialized connection shared by every store; callers
+    batch related writes inside `transaction()` the way the reference
+    wraps service mutations in `database.transaction {}`."""
+
+    def __init__(self, path: str = ":memory:"):
+        self.path = path
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.RLock()
+        self._tx_depth = 0
+        with self._lock:
+            if path != ":memory:":
+                self._conn.execute("PRAGMA journal_mode=WAL")
+                self._conn.execute("PRAGMA synchronous=NORMAL")
+            self._conn.executescript(_SCHEMA)
+            self._conn.commit()
+
+    def execute(self, sql: str, params: tuple = ()) -> sqlite3.Cursor:
+        with self._lock:
+            cur = self._conn.execute(sql, params)
+            if self._tx_depth == 0:
+                self._conn.commit()
+            return cur
+
+    def query(self, sql: str, params: tuple = ()) -> list[tuple]:
+        with self._lock:
+            return self._conn.execute(sql, params).fetchall()
+
+    def transaction(self):
+        """Context manager: batched atomic writes. Nests — inner blocks
+        (and bare execute() calls) join the outermost transaction, which
+        alone commits, so a multi-store mutation like
+        record_transactions is all-or-nothing across a crash."""
+        return _DbTx(self)
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.commit()
+            self._conn.close()
+
+
+class _DbTx:
+    def __init__(self, db: NodeDatabase):
+        self._db = db
+
+    def __enter__(self):
+        self._db._lock.acquire()
+        self._db._tx_depth += 1
+        return self._db._conn
+
+    def __exit__(self, exc_type, exc, tb):
+        try:
+            self._db._tx_depth = max(0, self._db._tx_depth - 1)
+            if exc_type is None:
+                if self._db._tx_depth == 0:
+                    self._db._conn.commit()
+            else:
+                # any failure aborts the whole outermost transaction
+                self._db._tx_depth = 0
+                self._db._conn.rollback()
+        finally:
+            self._db._lock.release()
+        return False
+
+
+class PersistentKVStore:
+    """Namespaced KV map on SQL — the JDBCHashMap primitive the
+    reference builds ad-hoc node state on (JDBCHashMap.kt)."""
+
+    def __init__(self, db: NodeDatabase, space: str):
+        self._db = db
+        self._space = space
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        rows = self._db.query(
+            "SELECT v FROM kv WHERE space=? AND k=?", (self._space, key)
+        )
+        return rows[0][0] if rows else None
+
+    def put(self, key: bytes, value: bytes) -> None:
+        self._db.execute(
+            "INSERT OR REPLACE INTO kv (space, k, v) VALUES (?,?,?)",
+            (self._space, key, value),
+        )
+
+    def delete(self, key: bytes) -> None:
+        self._db.execute(
+            "DELETE FROM kv WHERE space=? AND k=?", (self._space, key)
+        )
+
+    def items(self) -> list[tuple[bytes, bytes]]:
+        return self._db.query(
+            "SELECT k, v FROM kv WHERE space=? ORDER BY k", (self._space,)
+        )
+
+
+# ---------------------------------------------------------------------------
+# stores
+
+
+class PersistentTransactionStorage(TransactionStorage):
+    """DBTransactionStorage: canonical-serialized SignedTransactions
+    keyed by id, write-through over the in-memory map."""
+
+    def __init__(self, db: NodeDatabase):
+        super().__init__()
+        self._db = db
+        for (tx_id, data) in db.query("SELECT tx_id, data FROM transactions"):
+            stx = ser.decode(data)
+            self._txs[SecureHash(bytes(tx_id))] = stx
+
+    def add(self, stx: SignedTransaction) -> bool:
+        added = super().add(stx)
+        if added:
+            self._db.execute(
+                "INSERT OR IGNORE INTO transactions (tx_id, data) VALUES (?,?)",
+                (stx.id.bytes_, ser.encode(stx)),
+            )
+        return added
+
+
+class PersistentAttachmentStorage(AttachmentStorage):
+    """NodeAttachmentService: SHA-256-addressed blobs in the DB."""
+
+    def __init__(self, db: NodeDatabase):
+        super().__init__()
+        self._db = db
+        for (att_id, data) in db.query("SELECT att_id, data FROM attachments"):
+            self._blobs[SecureHash(bytes(att_id))] = bytes(data)
+
+    def import_attachment(self, data: bytes) -> SecureHash:
+        att_id = super().import_attachment(data)
+        self._db.execute(
+            "INSERT OR IGNORE INTO attachments (att_id, data) VALUES (?,?)",
+            (att_id.bytes_, data),
+        )
+        return att_id
+
+
+class PersistentCheckpointStorage(CheckpointStorage):
+    """DBCheckpointStorage.kt:18 — flow checkpoints survive restarts;
+    StateMachineManager.restore_checkpoints reads them back."""
+
+    def __init__(self, db: NodeDatabase):
+        super().__init__()
+        self._db = db
+        for (flow_id, record) in db.query(
+            "SELECT flow_id, record FROM checkpoints"
+        ):
+            self._checkpoints[bytes(flow_id)] = bytes(record)
+
+    def add(self, flow_id: bytes, record: bytes) -> None:
+        super().add(flow_id, record)
+        self._db.execute(
+            "INSERT OR REPLACE INTO checkpoints (flow_id, record) VALUES (?,?)",
+            (flow_id, record),
+        )
+
+    def remove(self, flow_id: bytes) -> None:
+        super().remove(flow_id)
+        self._db.execute(
+            "DELETE FROM checkpoints WHERE flow_id=?", (flow_id,)
+        )
+
+
+class PersistentUniquenessProvider(UniquenessProvider):
+    """The notary's committed-state registry on SQL (reference:
+    PersistentUniquenessProvider.kt:20, commit at :63+). All-or-nothing:
+    the conflict check and the inserts share one DB transaction."""
+
+    def __init__(self, db: NodeDatabase):
+        self._db = db
+
+    def commit(
+        self, states: list[StateRef], tx_id: SecureHash, requester: Party
+    ) -> None:
+        with self._db.transaction() as conn:
+            conflict = {}
+            for ref in states:
+                row = conn.execute(
+                    "SELECT consumer FROM notary_commits"
+                    " WHERE ref_tx=? AND ref_index=?",
+                    (ref.txhash.bytes_, ref.index),
+                ).fetchone()
+                if row is not None and bytes(row[0]) != tx_id.bytes_:
+                    conflict[ref] = SecureHash(bytes(row[0]))
+            if conflict:
+                raise UniquenessConflict(conflict)
+            for ref in states:
+                conn.execute(
+                    "INSERT OR IGNORE INTO notary_commits"
+                    " (ref_tx, ref_index, consumer, requester)"
+                    " VALUES (?,?,?,?)",
+                    (
+                        ref.txhash.bytes_,
+                        ref.index,
+                        tx_id.bytes_,
+                        requester.name,
+                    ),
+                )
+
+    @property
+    def committed_count(self) -> int:
+        return self._db.query("SELECT COUNT(*) FROM notary_commits")[0][0]
+
+
+class PersistentKeyManagementService(KeyManagementService):
+    """PersistentKeyManagementService: fresh (anonymous) keys persist so
+    confidential identities survive a node restart."""
+
+    def __init__(self, db: NodeDatabase, *initial_keys: schemes.KeyPair, rng=None):
+        super().__init__(*initial_keys, rng=rng)
+        self._db = db
+        # Key material is stored as raw columns, NOT via the canonical
+        # codec: registering a PrivateKey serializer would silently make
+        # private keys wire-encodable anywhere (checkpoints, session
+        # payloads), defeating the encode-time guard in serialization.py.
+        for (fp, scheme_id, pub, priv) in db.query(
+            "SELECT fingerprint, scheme_id, public_key, private_key"
+            " FROM our_keys"
+        ):
+            public = schemes.PublicKey(scheme_id, bytes(pub))
+            self._keys[public] = schemes.PrivateKey(
+                scheme_id, bytes(priv), public
+            )
+        for kp in initial_keys:
+            self._persist(kp.public, kp.private)
+
+    def _persist(self, public, private) -> None:
+        self._db.execute(
+            "INSERT OR IGNORE INTO our_keys"
+            " (fingerprint, scheme_id, public_key, private_key)"
+            " VALUES (?,?,?,?)",
+            (public.fingerprint(), public.scheme_id, public.data, private.data),
+        )
+
+    def fresh_key(self, scheme_id: int = schemes.DEFAULT_SCHEME):
+        public = super().fresh_key(scheme_id)
+        self._persist(public, self._keys[public])
+        return public
+
+
+# ---------------------------------------------------------------------------
+# vault
+
+
+def _fungible_columns(data) -> tuple[Optional[int], Optional[str], Optional[str]]:
+    """(quantity, token, issuer) for fungible states: any state exposing
+    `amount` of an `Issued` token projects into the fungible schema
+    (reference: CashSchemaV1 / VaultSchema fungible rows)."""
+    amount = getattr(data, "amount", None)
+    if amount is None:
+        return None, None, None
+    quantity = getattr(amount, "quantity", None)
+    token = getattr(amount, "token", None)
+    issuer = None
+    product = token
+    if token is not None and hasattr(token, "issuer"):
+        issuer = token.issuer.party.name
+        product = token.product
+    return quantity, (None if product is None else str(product)), issuer
+
+
+def _linear_id_of(data) -> Optional[bytes]:
+    lid = getattr(data, "linear_id", None)
+    if lid is None:
+        return None
+    return lid if isinstance(lid, bytes) else ser.encode(lid)
+
+
+class PersistentVaultService(VaultService):
+    """NodeVaultService over sqlite: the in-memory maps stay (hot path
+    for flows/coin-selection), every delta also lands in `vault_states`
+    with denormalised query columns for vault_query.py. Soft-locks are
+    deliberately NOT persisted: in-flight spends die with the process
+    and their flows resume from checkpoints, which re-lock."""
+
+    def __init__(self, services):
+        super().__init__(services)
+        self._db: NodeDatabase = services.db
+        for row in self._db.query(
+            "SELECT ref_tx, ref_index, state, status FROM vault_states"
+        ):
+            ref = StateRef(SecureHash(bytes(row[0])), row[1])
+            ts = ser.decode(bytes(row[2]))
+            (self._unconsumed if row[3] == 0 else self._consumed)[ref] = ts
+        # Persist each delta as the base class computes it — O(tx size),
+        # not O(vault size). Registered first so rows are on disk before
+        # any other update subscriber observes them.
+        self.updates.insert(0, self._persist_update)
+
+    def _persist_update(self, update) -> None:
+        now = self._services.clock.now_micros()
+        with self._db.transaction() as conn:
+            for sar in update.consumed:
+                conn.execute(
+                    "UPDATE vault_states SET status=1, consumed_at=?"
+                    " WHERE ref_tx=? AND ref_index=?",
+                    (now, sar.ref.txhash.bytes_, sar.ref.index),
+                )
+            for sar in update.produced:
+                ref, ts = sar.ref, sar.state
+                quantity, token, issuer = _fungible_columns(ts.data)
+                conn.execute(
+                    "INSERT OR REPLACE INTO vault_states"
+                    " (ref_tx, ref_index, state, contract_tag, status,"
+                    "  notary, quantity, token, issuer, linear_id,"
+                    "  recorded_at, consumed_at)"
+                    " VALUES (?,?,?,?,0,?,?,?,?,?,?,NULL)",
+                    (
+                        ref.txhash.bytes_,
+                        ref.index,
+                        ser.encode(ts),
+                        type(ts.data).__name__,
+                        ts.notary.name if ts.notary else None,
+                        quantity,
+                        token,
+                        issuer,
+                        _linear_id_of(ts.data),
+                        now,
+                    ),
+                )
+                for participant in ts.data.participants:
+                    for leaf in comp.leaves_of(_owning_key_of(participant)):
+                        conn.execute(
+                            "INSERT INTO vault_parts"
+                            " (ref_tx, ref_index, fingerprint) VALUES (?,?,?)",
+                            (ref.txhash.bytes_, ref.index, leaf.fingerprint()),
+                        )
+
+
+# ---------------------------------------------------------------------------
+# assembly
+
+
+class PersistentServiceHub:
+    """Builds a ServiceHub whose stores are all sqlite-backed — the
+    Phase-3 node's storage plane (reference: AbstractNode.
+    initialiseDatabasePersistence + makeServices, AbstractNode.kt:
+    412-423,538). Constructed via `open()` so callers get the same
+    ServiceHub type flows already talk to."""
+
+    @staticmethod
+    def open(
+        path: str,
+        my_info,
+        identity,
+        *initial_keys: schemes.KeyPair,
+        network_map_cache=None,
+        clock=None,
+        batch_verifier=None,
+        rng=None,
+    ):
+        from .services import ServiceHub
+
+        db = NodeDatabase(path)
+        key_management = PersistentKeyManagementService(
+            db, *initial_keys, rng=rng
+        )
+        return ServiceHub(
+            my_info,
+            key_management,
+            identity,
+            network_map_cache=network_map_cache,
+            clock=clock,
+            batch_verifier=batch_verifier,
+            db=db,
+            validated_transactions=PersistentTransactionStorage(db),
+            attachments=PersistentAttachmentStorage(db),
+            checkpoint_storage=PersistentCheckpointStorage(db),
+            vault_factory=PersistentVaultService,
+        )

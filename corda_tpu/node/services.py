@@ -15,6 +15,7 @@ from collections import defaultdict
 from dataclasses import dataclass, field
 from typing import Any, Callable, Iterable, Optional
 
+from ..core import serialization as ser
 from ..core.contracts import (
     Attachment,
     CommandWithParties,
@@ -389,11 +390,30 @@ class VaultService:
             r: l for r, l in self._soft_locks.items() if l != lock_id
         }
 
+    def soft_lock(self, refs: Iterable[StateRef], lock_id: bytes) -> None:
+        """Re-assert locks over a journaled coin selection after a
+        checkpoint replay (locks are process-local; the selection itself
+        is journaled so replay never re-runs it — see finance/cash.py)."""
+        for ref in refs:
+            if ref in self._unconsumed:
+                self._soft_locks[ref] = lock_id
+
 
 class InsufficientBalanceError(Exception):
     def __init__(self, shortfall: int):
         self.shortfall = shortfall
         super().__init__(f"short {shortfall} units")
+
+
+# Registered with the canonical codec so a journaled selection failure
+# replays after restart with its attributes intact (statemachine.py
+# record() error journaling).
+ser.register_custom(
+    InsufficientBalanceError,
+    "InsufficientBalanceError",
+    lambda e: e.shortfall,
+    lambda v: InsufficientBalanceError(v),
+)
 
 
 def _owning_key_of(participant):
@@ -466,16 +486,24 @@ class ServiceHub:
         network_map_cache: Optional[NetworkMapCache] = None,
         clock: Optional[Clock] = None,
         batch_verifier: Optional[BatchSignatureVerifier] = None,
+        db=None,
+        validated_transactions: Optional[TransactionStorage] = None,
+        attachments: Optional[AttachmentStorage] = None,
+        checkpoint_storage: Optional[CheckpointStorage] = None,
+        vault_factory: Optional[Callable[["ServiceHub"], VaultService]] = None,
     ):
         self.my_info = my_info
         self.key_management = key_management
         self.identity = identity
         self.network_map_cache = network_map_cache or NetworkMapCache()
         self.clock = clock or Clock()
-        self.validated_transactions = TransactionStorage()
-        self.attachments = AttachmentStorage()
-        self.checkpoint_storage = CheckpointStorage()
-        self.vault = VaultService(self)
+        self.db = db   # NodeDatabase for persistent hubs, else None
+        self.validated_transactions = (
+            validated_transactions or TransactionStorage()
+        )
+        self.attachments = attachments or AttachmentStorage()
+        self.checkpoint_storage = checkpoint_storage or CheckpointStorage()
+        self.vault = (vault_factory or VaultService)(self)
         self.transaction_verifier = InMemoryTransactionVerifierService()
         self._batch_verifier = batch_verifier
 
@@ -488,10 +516,18 @@ class ServiceHub:
 
     def record_transactions(self, stxs: Iterable[SignedTransaction]) -> None:
         """Store validated transactions + notify the vault (reference:
-        ServiceHub.recordTransactions -> NodeVaultService.notifyAll)."""
-        for stx in stxs:
-            if self.validated_transactions.add(stx):
-                self.vault.notify(stx.wtx)
+        ServiceHub.recordTransactions -> NodeVaultService.notifyAll).
+        On a persistent hub the whole record — tx rows, vault rows, and
+        any checkpoints written by observers resuming waiting flows —
+        lands in ONE database transaction, so a crash can never leave a
+        stored tx whose vault effects are missing."""
+        import contextlib
+
+        ctx = self.db.transaction() if self.db else contextlib.nullcontext()
+        with ctx:
+            for stx in stxs:
+                if self.validated_transactions.add(stx):
+                    self.vault.notify(stx.wtx)
 
     # -- resolution ---------------------------------------------------------
 

@@ -50,12 +50,15 @@ class MockNode:
         *,
         notary: Optional[str] = None,     # None | "simple" | "validating"
         scheme_id: int = schemes.DEFAULT_SCHEME,
+        keypair: Optional[schemes.KeyPair] = None,
     ):
         self.network = network
         self.name = name
+        self.scheme_id = scheme_id
         seed = network.rng.getrandbits(256)
-        self.keypair = schemes.generate_keypair(scheme_id, seed=seed)
+        self.keypair = keypair or schemes.generate_keypair(scheme_id, seed=seed)
         self.party = Party(name, self.keypair.public)
+        self.notary_kind = notary
         advertised: tuple[str, ...] = ()
         if notary == "simple":
             advertised = (SERVICE_NOTARY,)
@@ -64,16 +67,36 @@ class MockNode:
         elif notary is not None:
             raise ValueError(f"unknown notary type {notary!r}")
         self.info = NodeInfo(name, self.party, advertised)
-        self.services = ServiceHub(
-            my_info=self.info,
-            key_management=KeyManagementService(
-                self.keypair, rng=random.Random(network.rng.getrandbits(64))
-            ),
-            identity=IdentityService(self.party),
-            network_map_cache=NetworkMapCache(),
-            clock=network.clock,
-            batch_verifier=network.batch_verifier,
-        )
+        kms_rng = random.Random(network.rng.getrandbits(64))
+        if network.db_dir is not None:
+            from ..node.persistence import (
+                PersistentServiceHub,
+                PersistentUniquenessProvider,
+            )
+
+            self.services = PersistentServiceHub.open(
+                f"{network.db_dir}/{name}.db",
+                self.info,
+                IdentityService(self.party),
+                self.keypair,
+                network_map_cache=NetworkMapCache(),
+                clock=network.clock,
+                batch_verifier=network.batch_verifier,
+                rng=kms_rng,
+            )
+            uniqueness = lambda: PersistentUniquenessProvider(  # noqa: E731
+                self.services.db
+            )
+        else:
+            self.services = ServiceHub(
+                my_info=self.info,
+                key_management=KeyManagementService(self.keypair, rng=kms_rng),
+                identity=IdentityService(self.party),
+                network_map_cache=NetworkMapCache(),
+                clock=network.clock,
+                batch_verifier=network.batch_verifier,
+            )
+            uniqueness = InMemoryUniquenessProvider
         self.messaging = network.fabric.endpoint(name)
         self.smm = StateMachineManager(
             self.services,
@@ -82,11 +105,11 @@ class MockNode:
         )
         if notary == "simple":
             self.services.notary_service = SimpleNotaryService(
-                self.services, InMemoryUniquenessProvider()
+                self.services, uniqueness()
             )
         elif notary == "validating":
             self.services.notary_service = ValidatingNotaryService(
-                self.services, InMemoryUniquenessProvider()
+                self.services, uniqueness()
             )
 
     # -- conveniences -------------------------------------------------------
@@ -116,7 +139,9 @@ class MockNetwork:
         seed: int = 42,
         batch_verifier: Optional[BatchSignatureVerifier] = None,
         shuffle_delivery: bool = False,
+        db_dir: Optional[str] = None,
     ):
+        self.db_dir = db_dir
         self.rng = random.Random(seed)
         self.fabric = msglib.InMemoryMessagingNetwork()
         self.clock = TestClock()
@@ -138,6 +163,34 @@ class MockNetwork:
         return self.create_node(
             name, notary="validating" if validating else "simple"
         )
+
+    def restart_node(self, node: MockNode) -> MockNode:
+        """Kill a node and boot a replacement from its database — the
+        reference's crash-recovery test move (StateMachineManager
+        restoreFibersFromCheckpoints, MockNode restart). Requires
+        db_dir. The new node re-registers, restores checkpoints, and
+        resumes flows on the next pump. The replacement reuses the same
+        fabric endpoint object, so the receiver-side dedupe set and id
+        counter survive — the in-memory stand-in for the durable
+        fabric's persisted dedupe table."""
+        if self.db_dir is None:
+            raise RuntimeError("restart_node requires MockNetwork(db_dir=...)")
+        node.smm.stop()
+        node.services.db.close()
+        node.messaging.running = False
+        self.nodes.remove(node)
+        replacement = MockNode(
+            self,
+            node.name,
+            notary=node.notary_kind,
+            scheme_id=node.scheme_id,
+            keypair=node.keypair,
+        )
+        self.nodes.append(replacement)
+        self._sync_directories()
+        replacement.messaging.running = True
+        replacement.smm.restore_checkpoints()
+        return replacement
 
     def _sync_directories(self) -> None:
         """Every node learns every node (the reference's network-map
