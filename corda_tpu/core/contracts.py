@@ -79,6 +79,64 @@ class Amount:
 # states
 
 
+@ser.serializable
+@dataclass(frozen=True, order=True)
+class UniqueIdentifier:
+    """Identity of a LinearState thread across its evolution
+    (reference: contracts/Structures.kt UniqueIdentifier — external id
+    plus UUID; here the internal id is 16 opaque bytes minted via the
+    flow-journaled randomness so replays are stable)."""
+
+    id_bytes: bytes
+    external_id: Optional[str] = None
+
+    @staticmethod
+    def fresh(rng=None) -> "UniqueIdentifier":
+        import secrets
+
+        data = (
+            rng.getrandbits(128).to_bytes(16, "big")
+            if rng is not None
+            else secrets.token_bytes(16)
+        )
+        return UniqueIdentifier(data)
+
+    def __str__(self) -> str:
+        prefix = f"{self.external_id}_" if self.external_id else ""
+        return prefix + self.id_bytes.hex()
+
+
+@runtime_checkable
+class LinearState(Protocol):
+    """A state thread evolving through time, tracked by linear_id
+    (reference: Structures.kt LinearState). Contracts must verify that
+    a linear id never appears in more than one output."""
+
+    @property
+    def linear_id(self) -> UniqueIdentifier: ...
+
+
+@dataclass(frozen=True)
+class ScheduledActivity:
+    """A flow to run at a time (reference: Structures.kt
+    ScheduledActivity): flow logic tag + constructor args + micros."""
+
+    flow_tag: str
+    flow_args: tuple
+    scheduled_at: int
+
+
+@runtime_checkable
+class SchedulableState(Protocol):
+    """A state that requests future activity; the scheduler service
+    watches vault outputs for these (Structures.kt SchedulableState,
+    node/.../events/NodeSchedulerService.kt)."""
+
+    def next_scheduled_activity(
+        self, this_state_ref: "StateRef"
+    ) -> Optional[ScheduledActivity]: ...
+
+
 @runtime_checkable
 class ContractState(Protocol):
     """Anything stored on ledger. Implementations are frozen dataclasses

@@ -28,7 +28,6 @@ from ..core import serialization as ser
 from ..core.contracts import StateRef
 from ..core.identity import Party
 from ..core.transactions import SignedTransaction
-from ..crypto import composite as comp
 from ..crypto import schemes
 from ..crypto.hashes import SecureHash
 from .notary import UniquenessConflict, UniquenessProvider
@@ -38,7 +37,6 @@ from .services import (
     KeyManagementService,
     TransactionStorage,
     VaultService,
-    _owning_key_of,
 )
 
 _SCHEMA = """
@@ -96,20 +94,6 @@ CREATE TABLE IF NOT EXISTS kv (
     v     BLOB NOT NULL,
     PRIMARY KEY (space, k)
 );
-CREATE TABLE IF NOT EXISTS queue_journal (
-    seq      INTEGER PRIMARY KEY AUTOINCREMENT,
-    peer     TEXT NOT NULL,
-    topic    TEXT NOT NULL,
-    payload  BLOB NOT NULL,
-    uid      INTEGER NOT NULL,
-    acked    INTEGER NOT NULL DEFAULT 0
-);
-CREATE INDEX IF NOT EXISTS queue_peer_idx ON queue_journal (peer, acked);
-CREATE TABLE IF NOT EXISTS dedupe (
-    sender TEXT NOT NULL,
-    uid    INTEGER NOT NULL,
-    PRIMARY KEY (sender, uid)
-);
 """
 
 
@@ -156,24 +140,37 @@ class NodeDatabase:
 
 
 class _DbTx:
+    """Nested blocks are sqlite SAVEPOINTs: an inner failure that the
+    caller catches (e.g. UniquenessConflict inside a notary commit)
+    rolls back only the inner writes — the outer transaction's prior
+    writes survive and its own exit still decides commit vs rollback."""
+
     def __init__(self, db: NodeDatabase):
         self._db = db
+        self._savepoint: Optional[str] = None
 
     def __enter__(self):
         self._db._lock.acquire()
+        if self._db._tx_depth > 0:
+            self._savepoint = f"sp{self._db._tx_depth}"
+            self._db._conn.execute(f"SAVEPOINT {self._savepoint}")
         self._db._tx_depth += 1
         return self._db._conn
 
     def __exit__(self, exc_type, exc, tb):
         try:
-            self._db._tx_depth = max(0, self._db._tx_depth - 1)
-            if exc_type is None:
-                if self._db._tx_depth == 0:
-                    self._db._conn.commit()
+            self._db._tx_depth -= 1
+            if self._savepoint is not None:
+                if exc_type is not None:
+                    self._db._conn.execute(
+                        f"ROLLBACK TO {self._savepoint}"
+                    )
+                self._db._conn.execute(f"RELEASE {self._savepoint}")
             else:
-                # any failure aborts the whole outermost transaction
-                self._db._tx_depth = 0
-                self._db._conn.rollback()
+                if exc_type is None:
+                    self._db._conn.commit()
+                else:
+                    self._db._conn.rollback()
         finally:
             self._db._lock.release()
         return False
@@ -360,30 +357,6 @@ class PersistentKeyManagementService(KeyManagementService):
 # vault
 
 
-def _fungible_columns(data) -> tuple[Optional[int], Optional[str], Optional[str]]:
-    """(quantity, token, issuer) for fungible states: any state exposing
-    `amount` of an `Issued` token projects into the fungible schema
-    (reference: CashSchemaV1 / VaultSchema fungible rows)."""
-    amount = getattr(data, "amount", None)
-    if amount is None:
-        return None, None, None
-    quantity = getattr(amount, "quantity", None)
-    token = getattr(amount, "token", None)
-    issuer = None
-    product = token
-    if token is not None and hasattr(token, "issuer"):
-        issuer = token.issuer.party.name
-        product = token.product
-    return quantity, (None if product is None else str(product)), issuer
-
-
-def _linear_id_of(data) -> Optional[bytes]:
-    lid = getattr(data, "linear_id", None)
-    if lid is None:
-        return None
-    return lid if isinstance(lid, bytes) else ser.encode(lid)
-
-
 class PersistentVaultService(VaultService):
     """NodeVaultService over sqlite: the in-memory maps stay (hot path
     for flows/coin-selection), every delta also lands in `vault_states`
@@ -400,12 +373,22 @@ class PersistentVaultService(VaultService):
             ref = StateRef(SecureHash(bytes(row[0])), row[1])
             ts = ser.decode(bytes(row[2]))
             (self._unconsumed if row[3] == 0 else self._consumed)[ref] = ts
-        # Persist each delta as the base class computes it — O(tx size),
-        # not O(vault size). Registered first so rows are on disk before
-        # any other update subscriber observes them.
-        self.updates.insert(0, self._persist_update)
+    def query_by(self, criteria, paging=None, sorting=None):
+        """Same criteria AST as the in-memory vault, compiled to SQL
+        over vault_states (the HibernateQueryCriteriaParser role)."""
+        from .vault_query import PageSpecification, Sort, run_sql
 
-    def _persist_update(self, update) -> None:
+        return run_sql(
+            self._db,
+            criteria,
+            paging or PageSpecification(),
+            sorting or Sort(),
+        )
+
+    def _on_delta(self, update) -> None:
+        """Persist one vault delta — O(tx size), not O(vault size). Runs
+        before observers (base notify) so rows are on disk first; a
+        failure here aborts the surrounding record transaction."""
         now = self._services.clock.now_micros()
         with self._db.transaction() as conn:
             for sar in update.consumed:
@@ -415,8 +398,13 @@ class PersistentVaultService(VaultService):
                     (now, sar.ref.txhash.bytes_, sar.ref.index),
                 )
             for sar in update.produced:
+                # single source of truth for the schema projection:
+                # vault_query.row_of — the in-memory query path uses the
+                # same function, so both backends answer identically
+                from .vault_query import UNCONSUMED, row_of
+
+                row = row_of(sar, UNCONSUMED, now)
                 ref, ts = sar.ref, sar.state
-                quantity, token, issuer = _fungible_columns(ts.data)
                 conn.execute(
                     "INSERT OR REPLACE INTO vault_states"
                     " (ref_tx, ref_index, state, contract_tag, status,"
@@ -427,22 +415,21 @@ class PersistentVaultService(VaultService):
                         ref.txhash.bytes_,
                         ref.index,
                         ser.encode(ts),
-                        type(ts.data).__name__,
-                        ts.notary.name if ts.notary else None,
-                        quantity,
-                        token,
-                        issuer,
-                        _linear_id_of(ts.data),
+                        row.contract_tag,
+                        row.notary_name,
+                        row.quantity,
+                        row.product,
+                        row.issuer_name,
+                        row.linear_id,
                         now,
                     ),
                 )
-                for participant in ts.data.participants:
-                    for leaf in comp.leaves_of(_owning_key_of(participant)):
-                        conn.execute(
-                            "INSERT INTO vault_parts"
-                            " (ref_tx, ref_index, fingerprint) VALUES (?,?,?)",
-                            (ref.txhash.bytes_, ref.index, leaf.fingerprint()),
-                        )
+                for fp in row.participant_fps:
+                    conn.execute(
+                        "INSERT INTO vault_parts"
+                        " (ref_tx, ref_index, fingerprint) VALUES (?,?,?)",
+                        (ref.txhash.bytes_, ref.index, fp),
+                    )
 
 
 # ---------------------------------------------------------------------------

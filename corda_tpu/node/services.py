@@ -70,6 +70,22 @@ class TestClock(Clock):
         self._now = micros
 
 
+def _safe_notify(cb, item) -> None:
+    """Observer failures must not abort ledger recording: a subscriber
+    bug aborting record_transactions would roll back the DB rows while
+    the in-memory caches keep them — permanent memory/disk divergence.
+    Matches the reference's Rx semantics (onNext errors don't undo the
+    vault write)."""
+    import logging
+
+    try:
+        cb(item)
+    except Exception:
+        logging.getLogger("corda_tpu.vault").exception(
+            "ledger observer raised; continuing"
+        )
+
+
 # ---------------------------------------------------------------------------
 # storage services
 
@@ -92,7 +108,7 @@ class TransactionStorage:
             return False
         self._txs[stx.id] = stx
         for cb in list(self.observers):
-            cb(stx)
+            _safe_notify(cb, stx)
         return True
 
     def __contains__(self, tx_id: SecureHash) -> bool:
@@ -289,6 +305,42 @@ class VaultUpdate:
     produced: list[StateAndRef]
 
 
+class Observable:
+    """Minimal push stream (the Rx Observable role in DataFeed —
+    reference returns rx.Observable from trackBy/CordaRPCOps feeds)."""
+
+    def __init__(self):
+        self._subscribers: list[Callable[[Any], None]] = []
+
+    def subscribe(self, cb: Callable[[Any], None]) -> Callable[[], None]:
+        self._subscribers.append(cb)
+
+        def unsubscribe():
+            if cb in self._subscribers:
+                self._subscribers.remove(cb)
+
+        return unsubscribe
+
+    def emit(self, item: Any) -> None:
+        for cb in list(self._subscribers):
+            cb(item)
+
+
+@dataclass
+class DataFeed:
+    """Snapshot + updates stream (core/.../messaging/DataFeed).
+    `dispose()` detaches the feed from its source (the reference leans
+    on Rx unsubscribe + GC reaping, RPCClientProxyHandler.kt:37-68)."""
+
+    snapshot: Any
+    updates: Observable
+    dispose: Optional[Callable[[], None]] = None
+
+    def close(self) -> None:
+        if self.dispose is not None:
+            self.dispose()
+
+
 class VaultService:
     """Tracks our unconsumed states; streams updates; soft-locks states
     for in-flight spends (reference: NodeVaultService.kt +
@@ -299,6 +351,7 @@ class VaultService:
         self._unconsumed: dict[StateRef, TransactionState] = {}
         self._consumed: dict[StateRef, TransactionState] = {}
         self._soft_locks: dict[StateRef, bytes] = {}   # ref -> lock id
+        self._recorded_at: dict[StateRef, int] = {}
         self.updates: list[Callable[[VaultUpdate], None]] = []
 
     # -- ingestion ----------------------------------------------------------
@@ -315,15 +368,23 @@ class VaultService:
                 consumed.append(StateAndRef(ts, ref))
         produced = []
         my_keys = self._services.key_management.keys
+        now = self._services.clock.now_micros()
         for i, ts in enumerate(wtx.outputs):
             if self._is_relevant(ts, my_keys):
                 ref = StateRef(wtx.id, i)
                 self._unconsumed[ref] = ts
+                self._recorded_at[ref] = now
                 produced.append(StateAndRef(ts, ref))
         if consumed or produced:
             update = VaultUpdate(consumed, produced)
+            # persistence hook first and NOT error-shielded: a failed
+            # disk write must abort the record, unlike observer bugs
+            self._on_delta(update)
             for cb in list(self.updates):
-                cb(update)
+                _safe_notify(cb, update)
+
+    def _on_delta(self, update: VaultUpdate) -> None:
+        """Subclass hook: persist one vault delta (no-op in memory)."""
 
     @staticmethod
     def _is_relevant(ts: TransactionState, my_keys: set) -> bool:
@@ -348,6 +409,81 @@ class VaultService:
             for ref, ts in self._consumed.items()
             if cls is None or isinstance(ts.data, cls)
         ]
+
+    # -- query DSL ----------------------------------------------------------
+
+    def _query_rows(self):
+        from .vault_query import CONSUMED, UNCONSUMED, row_of
+
+        rows = []
+        for ref, ts in self._unconsumed.items():
+            rows.append(
+                row_of(
+                    StateAndRef(ts, ref),
+                    UNCONSUMED,
+                    self._recorded_at.get(ref, 0),
+                )
+            )
+        for ref, ts in self._consumed.items():
+            rows.append(
+                row_of(
+                    StateAndRef(ts, ref),
+                    CONSUMED,
+                    self._recorded_at.get(ref, 0),
+                )
+            )
+        return rows
+
+    def query_by(self, criteria, paging=None, sorting=None):
+        """VaultService.queryBy (VaultService.kt:157): criteria AST →
+        Page. The in-memory vault evaluates criteria as predicates; the
+        persistent vault compiles the same AST to SQL."""
+        from .vault_query import PageSpecification, Sort, run_in_memory
+
+        return run_in_memory(
+            self._query_rows(),
+            criteria,
+            paging or PageSpecification(),
+            sorting or Sort(),
+        )
+
+    def track_by(self, criteria, paging=None, sorting=None) -> "DataFeed":
+        """VaultService.trackBy: consistent snapshot + stream of future
+        updates whose states match the criteria."""
+        snapshot = self.query_by(criteria, paging, sorting)
+        feed = Observable()
+
+        def on_update(update: VaultUpdate) -> None:
+            from .vault_query import UNCONSUMED, row_of
+
+            now = self._services.clock.now_micros()
+            # Consumed states are matched as if still live: the feed
+            # reports consumption of states that were IN the tracked
+            # set (reference trackBy semantics) — projecting them as
+            # CONSUMED would always fail status=UNCONSUMED criteria.
+            consumed = [
+                s
+                for s in update.consumed
+                if criteria.matches(row_of(s, UNCONSUMED, now))
+            ]
+            produced = [
+                s
+                for s in update.produced
+                if criteria.matches(row_of(s, UNCONSUMED, now))
+            ]
+            if consumed or produced:
+                feed.emit(VaultUpdate(consumed, produced))
+
+        self.updates.append(on_update)
+        return DataFeed(
+            snapshot,
+            feed,
+            dispose=lambda: (
+                self.updates.remove(on_update)
+                if on_update in self.updates
+                else None
+            ),
+        )
 
     # -- coin selection -----------------------------------------------------
 

@@ -66,6 +66,51 @@ class OneShotPongFlow(FlowLogic):
         return n
 
 
+from dataclasses import dataclass
+
+from ..core import serialization as ser
+from ..core.contracts import UniqueIdentifier
+from ..core.transactions import TransactionBuilder
+
+
+@ser.serializable
+@dataclass(frozen=True)
+class DummyLinearState:
+    """Minimal LinearState for vault/scheduler tests (reference:
+    test-utils DummyLinearContract.State)."""
+
+    linear_id: UniqueIdentifier
+    info: str
+    owner: object   # PublicKey
+
+    @property
+    def participants(self):
+        return (self.owner,)
+
+
+class _DummyLinearContract:
+    def verify(self, ltx) -> None:
+        pass
+
+
+DUMMY_LINEAR_CONTRACT = "test.DummyLinear"
+
+
+def make_linear_state_tx(node, notary: Party, linear_id, info: str):
+    """Build, self-sign and record a tx issuing one DummyLinearState."""
+    from ..core.contracts import register_contract
+
+    register_contract(DUMMY_LINEAR_CONTRACT, _DummyLinearContract())
+    b = TransactionBuilder(notary=notary)
+    b.add_output_state(
+        DummyLinearState(linear_id, info, node.party.owning_key),
+        DUMMY_LINEAR_CONTRACT,
+    )
+    stx = node.services.sign_initial_transaction(b)
+    node.services.record_transactions([stx])
+    return stx
+
+
 @initiating_flow
 class NoResponderFlow(FlowLogic):
     """No @initiated_by counterpart: used to test SessionReject."""

@@ -94,6 +94,44 @@ def test_conflict_is_all_or_nothing(tmp_path):
     up.commit([fresh], SecureHash.sha256(b"third"), party)
 
 
+def test_nested_transaction_failure_preserves_outer_writes(tmp_path):
+    """A caught inner-transaction failure (savepoint rollback) must not
+    roll back the outer transaction's earlier writes nor leak its later
+    writes outside the outer commit/rollback decision."""
+    db = NodeDatabase(str(tmp_path / "tx.db"))
+    kv = PersistentKVStore(db, "s")
+
+    with db.transaction():
+        kv.put(b"before", b"1")
+        try:
+            with db.transaction():
+                kv.put(b"inner", b"x")
+                raise RuntimeError("inner fails")
+        except RuntimeError:
+            pass
+        kv.put(b"after", b"2")
+    assert kv.get(b"before") == b"1"      # survived the inner rollback
+    assert kv.get(b"inner") is None       # inner write rolled back
+    assert kv.get(b"after") == b"2"
+
+    # outer failure still reverts everything, including post-inner writes
+    try:
+        with db.transaction():
+            kv.put(b"doomed", b"3")
+            try:
+                with db.transaction():
+                    raise RuntimeError("inner")
+            except RuntimeError:
+                pass
+            kv.put(b"doomed2", b"4")
+            raise RuntimeError("outer fails")
+    except RuntimeError:
+        pass
+    assert kv.get(b"doomed") is None
+    assert kv.get(b"doomed2") is None
+    db.close()
+
+
 def test_ledger_survives_node_restart(tmp_path):
     net, notary, alice, bob = make_net(tmp_path)
     alice.run_flow(CashIssueFlow(1000, "USD", alice.party, notary.party))

@@ -1,0 +1,229 @@
+"""Vault query DSL: in-memory and SQL paths must answer identically.
+
+Reference test model: VaultQueryTests (node/src/test/.../vault/) — the
+criteria coverage matrix: status, state type, fungible comparisons,
+linear ids, And/Or composition, paging, sorting, trackBy feeds.
+"""
+
+import pytest
+
+from corda_tpu.core.contracts import UniqueIdentifier
+from corda_tpu.finance import CashIssueFlow, CashPaymentFlow, CashState
+from corda_tpu.node.vault_query import (
+    ALL,
+    CONSUMED,
+    UNCONSUMED,
+    ColumnPredicate,
+    FungibleAssetQueryCriteria,
+    LinearStateQueryCriteria,
+    PageSpecification,
+    Sort,
+    VaultQueryCriteria,
+)
+from corda_tpu.testing import MockNetwork
+
+
+@pytest.fixture(params=["memory", "sqlite"])
+def ledger(request, tmp_path):
+    """A small ledger on both vault backends: alice issued 3 coins of
+    USD (100, 250, 400) + 1 GBP (70), paid bob 150 USD."""
+    kw = {"db_dir": str(tmp_path)} if request.param == "sqlite" else {}
+    net = MockNetwork(seed=13, **kw)
+    notary = net.create_notary()
+    alice = net.create_node("Alice")
+    bob = net.create_node("Bob")
+    for q in (100, 250, 400):
+        alice.run_flow(CashIssueFlow(q, "USD", alice.party, notary.party))
+    alice.run_flow(CashIssueFlow(70, "GBP", alice.party, notary.party))
+    alice.run_flow(CashPaymentFlow(150, "USD", bob.party))
+    return net, notary, alice, bob
+
+
+def quantities(page):
+    return sorted(s.state.data.amount.quantity for s in page.states)
+
+
+def test_unconsumed_by_default(ledger):
+    _, _, alice, bob = ledger
+    page = alice.vault.query_by(VaultQueryCriteria())
+    # alice: 70 GBP + unconsumed USD coins summing to 600
+    assert sum(quantities(page)) == 70 + 600
+    assert page.total_states_available == len(page.states)
+    bob_page = bob.vault.query_by(VaultQueryCriteria())
+    assert quantities(bob_page) == [150]
+
+
+def test_consumed_and_all(ledger):
+    _, _, alice, _ = ledger
+    consumed = alice.vault.query_by(VaultQueryCriteria(status=CONSUMED))
+    assert consumed.total_states_available >= 1   # the coins spent to bob
+    everything = alice.vault.query_by(VaultQueryCriteria(status=ALL))
+    assert (
+        everything.total_states_available
+        == consumed.total_states_available
+        + alice.vault.query_by(VaultQueryCriteria()).total_states_available
+    )
+
+
+def test_state_type_filter(ledger):
+    _, _, alice, _ = ledger
+    page = alice.vault.query_by(
+        VaultQueryCriteria(contract_state_types=(CashState,))
+    )
+    assert page.total_states_available > 0
+    none = alice.vault.query_by(
+        VaultQueryCriteria(contract_state_types=("NoSuchState",))
+    )
+    assert none.total_states_available == 0
+
+
+def test_fungible_quantity_comparison(ledger):
+    _, _, alice, _ = ledger
+    big = alice.vault.query_by(
+        FungibleAssetQueryCriteria(
+            quantity=ColumnPredicate(">=", 200), product="USD"
+        )
+    )
+    assert all(
+        s.state.data.amount.quantity >= 200
+        and s.state.data.amount.token.product == "USD"
+        for s in big.states
+    )
+    assert big.total_states_available >= 1
+
+
+def test_fungible_product_and_issuer(ledger):
+    _, _, alice, _ = ledger
+    gbp = alice.vault.query_by(FungibleAssetQueryCriteria(product="GBP"))
+    assert quantities(gbp) == [70]
+    by_issuer = alice.vault.query_by(
+        FungibleAssetQueryCriteria(issuer_names=("Alice",))
+    )
+    assert by_issuer.total_states_available >= 4 - 1  # all issued by alice
+    none = alice.vault.query_by(
+        FungibleAssetQueryCriteria(issuer_names=("Eve",))
+    )
+    assert none.total_states_available == 0
+
+
+def test_participant_criteria(ledger):
+    _, _, alice, bob = ledger
+    mine = alice.vault.query_by(
+        FungibleAssetQueryCriteria(participant_key=alice.party.owning_key)
+    )
+    # every unconsumed state in alice's vault is cash she participates in
+    everything = alice.vault.query_by(VaultQueryCriteria())
+    assert mine.total_states_available == everything.total_states_available
+    theirs = alice.vault.query_by(
+        FungibleAssetQueryCriteria(participant_key=bob.party.owning_key)
+    )
+    assert theirs.total_states_available == 0  # bob's coin lives in HIS vault
+
+
+def test_and_or_composition(ledger):
+    _, _, alice, _ = ledger
+    c = FungibleAssetQueryCriteria(product="GBP") | FungibleAssetQueryCriteria(
+        quantity=ColumnPredicate(">", 300)
+    )
+    page = alice.vault.query_by(c)
+    got = quantities(page)
+    assert 70 in got and all(q == 70 or q > 300 for q in got)
+
+    both = FungibleAssetQueryCriteria(product="USD") & FungibleAssetQueryCriteria(
+        quantity=ColumnPredicate("<", 200)
+    )
+    page2 = alice.vault.query_by(both)
+    assert all(
+        s.state.data.amount.token.product == "USD"
+        and s.state.data.amount.quantity < 200
+        for s in page2.states
+    )
+
+
+def test_paging_and_sorting(ledger):
+    _, _, alice, _ = ledger
+    asc = alice.vault.query_by(
+        VaultQueryCriteria(),
+        paging=PageSpecification(1, 2),
+        sorting=Sort("quantity"),
+    )
+    assert len(asc.states) == 2
+    total = asc.total_states_available
+    qs = [s.state.data.amount.quantity for s in asc.states]
+    assert qs == sorted(qs)
+
+    desc = alice.vault.query_by(
+        VaultQueryCriteria(),
+        paging=PageSpecification(1, 2),
+        sorting=Sort("quantity", descending=True),
+    )
+    dqs = [s.state.data.amount.quantity for s in desc.states]
+    assert dqs == sorted(dqs, reverse=True)
+
+    # walk every page: union == total, no overlaps
+    seen = []
+    n = 1
+    while True:
+        page = alice.vault.query_by(
+            VaultQueryCriteria(),
+            paging=PageSpecification(n, 2),
+            sorting=Sort("quantity"),
+        )
+        if not page.states:
+            break
+        seen += [s.ref for s in page.states]
+        n += 1
+    assert len(seen) == len(set(seen)) == total
+
+
+def test_track_by_streams_matching_updates(ledger):
+    net, notary, alice, bob = ledger
+    feed = bob.vault.track_by(FungibleAssetQueryCriteria(product="USD"))
+    assert quantities(feed.snapshot) == [150]
+    got = []
+    feed.updates.subscribe(got.append)
+    alice.run_flow(CashPaymentFlow(100, "USD", bob.party))
+    assert len(got) == 1
+    assert [s.state.data.amount.quantity for s in got[0].produced] == [100]
+    # non-matching currency doesn't reach the feed
+    alice.run_flow(CashPaymentFlow(70, "GBP", bob.party))
+    assert len(got) == 1
+
+
+def test_track_by_reports_consumption_and_close(ledger):
+    net, notary, alice, bob = ledger
+    feed = alice.vault.track_by(FungibleAssetQueryCriteria(product="USD"))
+    got = []
+    feed.updates.subscribe(got.append)
+    alice.run_flow(CashPaymentFlow(50, "USD", bob.party))
+    # spending emits BOTH the consumed tracked coins and any change
+    assert len(got) == 1
+    assert len(got[0].consumed) >= 1
+    feed.close()
+    alice.run_flow(CashPaymentFlow(25, "USD", bob.party))
+    assert len(got) == 1  # closed feed receives nothing
+
+
+def test_linear_state_criteria(tmp_path):
+    from corda_tpu.core.contracts import Amount
+    from corda_tpu.testing.flows import make_linear_state_tx
+
+    net = MockNetwork(seed=5, db_dir=str(tmp_path))
+    notary = net.create_notary()
+    alice = net.create_node("Alice")
+    lid_a = UniqueIdentifier(b"\x01" * 16, external_id="deal-A")
+    lid_b = UniqueIdentifier(b"\x02" * 16, external_id="deal-B")
+    make_linear_state_tx(alice, notary.party, lid_a, "hello")
+    make_linear_state_tx(alice, notary.party, lid_b, "world")
+
+    one = alice.vault.query_by(
+        LinearStateQueryCriteria(linear_ids=(lid_a,))
+    )
+    assert one.total_states_available == 1
+    assert one.states[0].state.data.linear_id == lid_a
+
+    by_ext = alice.vault.query_by(
+        LinearStateQueryCriteria(external_ids=("deal-B",))
+    )
+    assert by_ext.total_states_available == 1
+    assert by_ext.states[0].state.data.info == "world"
