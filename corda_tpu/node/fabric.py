@@ -1,0 +1,613 @@
+"""DCN message fabric: durable, ordered, authenticated P2P queues.
+
+Reference: the Artemis messaging layer — an embedded broker per node
+with store-and-forward queues and per-peer TLS bridges deployed on
+demand (node/.../messaging/ArtemisMessagingServer.kt:90,300-401,
+cert-pinning connector :471), consumed through the `MessagingService`
+API (Messaging.kt) by `NodeMessagingClient` (NodeMessagingClient.kt:71)
+with JDBC-backed redelivery (`messagesToRedeliver` :110) and dedupe.
+
+TPU-native redesign (SURVEY §2.5): not a broker translation — an
+asyncio TCP fabric over DCN where each node owns
+  * an outbound journal (sqlite): per-peer FIFO, survives restarts,
+    drained by one bridge task per peer with exponential-backoff
+    reconnects; rows delete only on peer ack (at-least-once),
+  * an inbound journal: frames land durably BEFORE they are acked,
+    dedup by (sender, uid) primary key, and are dispatched to handlers
+    exactly once — handler effects and the processed-flag update share
+    one database transaction (the reference's bufferUntilDatabaseCommit
+    discipline),
+  * channel security: optional TLS with certificate pinning by SHA-256
+    fingerprint (the VerifyingNettyConnectorFactory move) plus
+    application-layer mutual authentication — each side signs the
+    other's nonce with its node identity key, so trust roots in ledger
+    identities rather than a CA hierarchy (X509Utilities' role).
+
+ICI stays out of this layer: chips parallelise *inside* the crypto
+kernels (shard_map over signature batches); DCN moves ledger data
+between hosts. The wire envelope is canonical CTS bytes; uids are
+stable across restarts so replayed sends dedupe at the receiver.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import ssl
+import threading
+from collections import deque
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from ..core import serialization as ser
+from ..crypto import schemes
+from .messaging import Handler, Message, MessagingService
+
+_FABRIC_SCHEMA = """
+CREATE TABLE IF NOT EXISTS fabric_out (
+    seq     INTEGER PRIMARY KEY AUTOINCREMENT,
+    peer    TEXT NOT NULL,
+    topic   TEXT NOT NULL,
+    payload BLOB NOT NULL,
+    uid     INTEGER NOT NULL,
+    UNIQUE (peer, uid) ON CONFLICT IGNORE
+);
+CREATE INDEX IF NOT EXISTS fabric_out_peer ON fabric_out (peer, seq);
+CREATE TABLE IF NOT EXISTS fabric_in (
+    sender    TEXT NOT NULL,
+    uid       INTEGER NOT NULL,
+    arrival   INTEGER NOT NULL,
+    topic     TEXT NOT NULL,
+    payload   BLOB NOT NULL,
+    processed INTEGER NOT NULL DEFAULT 0,
+    PRIMARY KEY (sender, uid)
+);
+CREATE INDEX IF NOT EXISTS fabric_in_pending ON fabric_in (processed, arrival);
+CREATE TABLE IF NOT EXISTS fabric_meta (
+    k TEXT PRIMARY KEY,
+    v INTEGER NOT NULL
+);
+"""
+
+
+def _to_db_uid(uid: int) -> int:
+    """Message uids are unsigned 64-bit (the SMM's hashed ids set the
+    top bit); sqlite INTEGER is signed 64-bit — map through two's
+    complement at the storage boundary."""
+    return uid - 2**64 if uid >= 2**63 else uid
+
+
+def _from_db_uid(uid: int) -> int:
+    return uid + 2**64 if uid < 0 else uid
+
+
+# ---------------------------------------------------------------------------
+# framing
+
+
+async def _read_frame(reader: asyncio.StreamReader) -> list:
+    try:
+        header = await reader.readexactly(4)
+        length = int.from_bytes(header, "big")
+        if length > 64 * 1024 * 1024:
+            raise ConnectionError("frame too large")
+        body = await reader.readexactly(length)
+    except asyncio.IncompleteReadError as e:
+        raise ConnectionError("peer closed mid-frame") from e
+    try:
+        frame = ser.decode(body)
+    except ser.SerializationError as e:
+        raise ConnectionError(f"undecodable frame: {e}") from e
+    if not isinstance(frame, list) or not frame:
+        raise ConnectionError("malformed frame")
+    return frame
+
+
+def _write_frame(writer: asyncio.StreamWriter, frame: list) -> None:
+    body = ser.encode(frame)
+    writer.write(len(body).to_bytes(4, "big") + body)
+
+
+# ---------------------------------------------------------------------------
+# transport security
+
+
+@dataclass
+class PeerAddress:
+    host: str
+    port: int
+    tls_fingerprint: Optional[bytes] = None   # pinned server-cert sha256
+
+
+class TlsIdentity:
+    """Self-signed TLS material for one node. Peers authenticate the
+    *channel* by pinning this cert's SHA-256 fingerprint (advertised
+    through the network map, like the reference's cert-pinning bridge)
+    — node *identity* is proven separately by the key-signed nonce
+    handshake, so the cert needs no chain."""
+
+    def __init__(self, cert_pem: bytes, key_pem: bytes):
+        self.cert_pem = cert_pem
+        self.key_pem = key_pem
+        self.fingerprint = _cert_fingerprint(cert_pem)
+
+    @staticmethod
+    def generate(common_name: str) -> "TlsIdentity":
+        from cryptography import x509
+        from cryptography.hazmat.primitives import hashes as chashes
+        from cryptography.hazmat.primitives import serialization as cser
+        from cryptography.hazmat.primitives.asymmetric import ec
+        from cryptography.x509.oid import NameOID
+        import datetime
+
+        key = ec.generate_private_key(ec.SECP256R1())
+        name = x509.Name(
+            [x509.NameAttribute(NameOID.COMMON_NAME, common_name)]
+        )
+        now = datetime.datetime(2020, 1, 1)
+        cert = (
+            x509.CertificateBuilder()
+            .subject_name(name)
+            .issuer_name(name)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now)
+            .not_valid_after(now + datetime.timedelta(days=365 * 30))
+            .sign(key, chashes.SHA256())
+        )
+        return TlsIdentity(
+            cert.public_bytes(cser.Encoding.PEM),
+            key.private_bytes(
+                cser.Encoding.PEM,
+                cser.PrivateFormat.PKCS8,
+                cser.NoEncryption(),
+            ),
+        )
+
+    def server_context(self) -> ssl.SSLContext:
+        import tempfile
+
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        with tempfile.NamedTemporaryFile(suffix=".pem") as f:
+            f.write(self.cert_pem + self.key_pem)
+            f.flush()
+            ctx.load_cert_chain(f.name)
+        ctx.minimum_version = ssl.TLSVersion.TLSv1_2
+        return ctx
+
+
+def client_context() -> ssl.SSLContext:
+    """Chain validation is OFF — trust is the pinned fingerprint checked
+    after the handshake (self-signed certs have no chain to validate)."""
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    ctx.check_hostname = False
+    ctx.verify_mode = ssl.CERT_NONE
+    ctx.minimum_version = ssl.TLSVersion.TLSv1_2
+    return ctx
+
+
+def _cert_fingerprint(cert_pem: bytes) -> bytes:
+    der = ssl.PEM_cert_to_DER_cert(cert_pem.decode())
+    return hashlib.sha256(der).digest()
+
+
+# ---------------------------------------------------------------------------
+# the endpoint
+
+
+class FabricEndpoint(MessagingService):
+    """One node's fabric endpoint: server + per-peer bridges + journals.
+
+    Threading model: asyncio IO runs on a dedicated loop thread; handler
+    dispatch happens on whichever thread calls `pump()` — the node's
+    single "server thread" (AffinityExecutor.kt role), keeping the SMM
+    single-threaded. `send()` is safe from the pump thread.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        keypair: schemes.KeyPair,
+        db,                                    # NodeDatabase
+        resolve: Callable[[str], Optional[PeerAddress]],
+        host: str = "127.0.0.1",
+        port: int = 0,
+        tls: Optional[TlsIdentity] = None,
+    ):
+        self._name = name
+        self._keypair = keypair
+        self._db = db
+        self._resolve = resolve
+        self._host = host
+        self._port = port
+        self._tls = tls
+        db.execute_script(_FABRIC_SCHEMA)
+        self._handlers: dict[str, list[Handler]] = {}
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._bridges: dict[str, asyncio.Event] = {}
+        self._pump_wake = threading.Event()
+        self._parked: deque = deque()   # undispatchable (no handler yet)
+        self.running = False
+        self._arrival_counter = self._load_arrival_counter()
+
+    # -- MessagingService ---------------------------------------------------
+
+    @property
+    def my_address(self) -> str:
+        return self._name
+
+    @property
+    def listen_port(self) -> int:
+        return self._port
+
+    def send(
+        self,
+        topic: str,
+        payload: bytes,
+        target: str,
+        unique_id: Optional[int] = None,
+    ) -> None:
+        """Durably journal, then wake the peer's bridge. uid None mints
+        an id from a persistent monotonic counter — NEVER reused, even
+        after rows ack away, because the receiver's dedupe key
+        (sender, uid) lives forever: a recycled uid would be silently
+        swallowed as a duplicate."""
+        with self._db.transaction():
+            if unique_id is None:
+                unique_id = self._next_uid()
+            self._db.execute(
+                "INSERT INTO fabric_out (peer, topic, payload, uid)"
+                " VALUES (?,?,?,?)",
+                (target, topic, payload, _to_db_uid(unique_id)),
+            )
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._wake_bridge, target)
+
+    def _next_uid(self) -> int:
+        row = self._db.query(
+            "SELECT v FROM fabric_meta WHERE k='next_uid'"
+        )
+        nxt = row[0][0] if row else 1
+        self._db.execute(
+            "INSERT OR REPLACE INTO fabric_meta (k, v) VALUES ('next_uid', ?)",
+            (nxt + 1,),
+        )
+        return nxt
+
+    def add_handler(self, topic: str, handler: Handler) -> None:
+        self._handlers.setdefault(topic, []).append(handler)
+        self._pump_wake.set()   # parked messages may now be deliverable
+
+    def remove_handler(self, topic: str, handler: Handler) -> None:
+        handlers = self._handlers.get(topic, [])
+        if handler in handlers:
+            handlers.remove(handler)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        started = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run_loop, args=(started,), daemon=True,
+            name=f"fabric-{self._name}",
+        )
+        self.running = True
+        self._thread.start()
+        started.wait(timeout=10)
+        if self._loop is None or self._server is None:
+            self.running = False
+            raise RuntimeError("fabric loop failed to start")
+        # wake bridges for any journal left over from a previous run
+        for (peer,) in self._db.query(
+            "SELECT DISTINCT peer FROM fabric_out"
+        ):
+            self._loop.call_soon_threadsafe(self._wake_bridge, peer)
+
+    def stop(self) -> None:
+        self.running = False
+        if self._loop is not None:
+            loop = self._loop
+
+            def _shutdown():
+                for task in asyncio.all_tasks(loop):
+                    task.cancel()
+
+            loop.call_soon_threadsafe(_shutdown)
+            self._thread.join(timeout=5)
+            self._loop = None
+
+    def _run_loop(self, started: threading.Event) -> None:
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        self._loop = loop
+
+        async def main():
+            ssl_ctx = self._tls.server_context() if self._tls else None
+            self._server = await asyncio.start_server(
+                self._on_connection, self._host, self._port, ssl=ssl_ctx
+            )
+            self._port = self._server.sockets[0].getsockname()[1]
+            started.set()
+            try:
+                await self._server.serve_forever()
+            except asyncio.CancelledError:
+                pass
+
+        try:
+            loop.run_until_complete(main())
+        except Exception:
+            started.set()
+        finally:
+            try:
+                pending = asyncio.all_tasks(loop)
+                for t in pending:
+                    t.cancel()
+                loop.run_until_complete(
+                    asyncio.gather(*pending, return_exceptions=True)
+                )
+            except Exception:
+                pass
+            loop.close()
+
+    # -- outbound bridges ---------------------------------------------------
+
+    def _wake_bridge(self, peer: str) -> None:
+        ev = self._bridges.get(peer)
+        if ev is None:
+            ev = asyncio.Event()
+            self._bridges[peer] = ev
+            asyncio.ensure_future(self._bridge_task(peer, ev))
+        ev.set()
+
+    async def _bridge_task(self, peer: str, wake: asyncio.Event) -> None:
+        """Drain the peer's outbound journal over one long-lived
+        connection (re-auth only on reconnect); exponential backoff on
+        failure (ArtemisMessagingServer deployBridge +
+        messagesToRedeliver semantics)."""
+        backoff = 0.05
+        while self.running:
+            if not self._db.query(
+                "SELECT 1 FROM fabric_out WHERE peer=? LIMIT 1", (peer,)
+            ):
+                wake.clear()
+                try:
+                    await asyncio.wait_for(wake.wait(), timeout=30)
+                except asyncio.TimeoutError:
+                    continue
+            addr = self._resolve(peer)
+            if addr is None:
+                await asyncio.sleep(min(backoff, 5.0))
+                backoff = min(backoff * 2, 5.0)
+                continue
+            try:
+                reader, writer = await self._connect(addr)
+                try:
+                    await self._auth_client(reader, writer, addr)
+                    backoff = 0.05
+                    await self._drain_loop(peer, wake, reader, writer)
+                finally:
+                    writer.close()
+                    try:
+                        await writer.wait_closed()
+                    except Exception:
+                        pass
+            except (OSError, ConnectionError, asyncio.TimeoutError, ssl.SSLError):
+                await asyncio.sleep(min(backoff, 5.0))
+                backoff = min(backoff * 2, 5.0)
+
+    async def _drain_loop(self, peer, wake, reader, writer) -> None:
+        """Pump batches over one authenticated connection until idle
+        for 30s (then close to free the socket) or an error."""
+        while self.running:
+            rows = self._db.query(
+                "SELECT seq, topic, payload, uid FROM fabric_out"
+                " WHERE peer=? ORDER BY seq LIMIT 256",
+                (peer,),
+            )
+            if not rows:
+                wake.clear()
+                try:
+                    await asyncio.wait_for(wake.wait(), timeout=30)
+                    continue
+                except asyncio.TimeoutError:
+                    return   # idle: close connection, journal is empty
+            for seq, topic, payload, uid in rows:
+                _write_frame(
+                    writer,
+                    ["msg", seq, topic, bytes(payload), _from_db_uid(uid)],
+                )
+            await writer.drain()
+            for _ in rows:
+                frame = await asyncio.wait_for(_read_frame(reader), timeout=30)
+                if frame[0] != "ack":
+                    raise ConnectionError(f"expected ack, got {frame[0]!r}")
+                self._db.execute(
+                    "DELETE FROM fabric_out WHERE seq=? AND peer=?",
+                    (frame[1], peer),
+                )
+
+    async def _connect(self, addr: PeerAddress):
+        ctx = None
+        if addr.tls_fingerprint is not None:
+            ctx = client_context()
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(addr.host, addr.port, ssl=ctx),
+            timeout=10,
+        )
+        if addr.tls_fingerprint is not None:
+            der = writer.get_extra_info("ssl_object").getpeercert(
+                binary_form=True
+            )
+            if hashlib.sha256(der).digest() != addr.tls_fingerprint:
+                writer.close()
+                raise ConnectionError("TLS certificate fingerprint mismatch")
+        return reader, writer
+
+    async def _auth_client(self, reader, writer, addr: PeerAddress) -> None:
+        """Mutual nonce-signing handshake (client side): prove we hold
+        our identity key; no secrets on the wire."""
+        hello = await asyncio.wait_for(_read_frame(reader), timeout=10)
+        if hello[0] != "challenge":
+            raise ConnectionError("bad handshake")
+        nonce = bytes(hello[1])
+        sig = self._keypair.private.sign(b"fabric-auth" + nonce)
+        _write_frame(
+            writer,
+            [
+                "auth",
+                self._name,
+                self._keypair.public.scheme_id,
+                self._keypair.public.data,
+                sig,
+            ],
+        )
+        await writer.drain()
+        ok = await asyncio.wait_for(_read_frame(reader), timeout=10)
+        if ok[0] != "ok":
+            raise ConnectionError(f"auth rejected: {ok!r}")
+
+    # -- inbound ------------------------------------------------------------
+
+    async def _on_connection(self, reader, writer) -> None:
+        try:
+            sender = await self._auth_server(reader, writer)
+            while True:
+                frame = await _read_frame(reader)
+                if frame[0] != "msg":
+                    raise ConnectionError(f"unexpected frame {frame[0]!r}")
+                _, seq, topic, payload, uid = frame
+                self._ingest(sender, topic, bytes(payload), uid)
+                _write_frame(writer, ["ack", seq])
+                await writer.drain()
+        except (
+            OSError,
+            ConnectionError,
+            asyncio.IncompleteReadError,
+            asyncio.TimeoutError,
+            ser.SerializationError,
+        ):
+            pass
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    async def _auth_server(self, reader, writer) -> str:
+        """Server side of the nonce handshake: challenge, verify the
+        signature against the sender's claimed identity key, and check
+        that key against the network map (resolve) so a peer cannot
+        impersonate another name."""
+        import os
+
+        nonce = os.urandom(32)
+        _write_frame(writer, ["challenge", nonce])
+        await writer.drain()
+        frame = await asyncio.wait_for(_read_frame(reader), timeout=10)
+        if frame[0] != "auth" or len(frame) != 5:
+            raise ConnectionError("bad auth frame")
+        _, name, scheme_id, key_data, sig = frame
+        pub = schemes.PublicKey(scheme_id, bytes(key_data))
+        if not schemes.verify_one(pub, bytes(sig), b"fabric-auth" + nonce):
+            _write_frame(writer, ["reject", "bad signature"])
+            raise ConnectionError("auth signature invalid")
+        expected = self._expected_key(name)
+        if expected is not None and expected != pub:
+            _write_frame(writer, ["reject", "identity key mismatch"])
+            raise ConnectionError("auth key does not match network map")
+        _write_frame(writer, ["ok"])
+        await writer.drain()
+        return name
+
+    def _expected_key(self, peer_name: str) -> Optional[schemes.PublicKey]:
+        """Hook: subclass/NodeFabric wires this to the network map. A
+        None result admits the peer on signature alone (pre-registration
+        window, like the reference's network-map bootstrap)."""
+        resolver = getattr(self, "expected_identity_key", None)
+        return resolver(peer_name) if resolver else None
+
+    def _load_arrival_counter(self) -> int:
+        row = self._db.query("SELECT MAX(arrival) FROM fabric_in")
+        return (row[0][0] or 0) + 1
+
+    def _ingest(self, sender: str, topic: str, payload: bytes, uid: int) -> None:
+        """Durable + deduped BEFORE ack: the PRIMARY KEY swallows
+        duplicates so redelivered frames ack without re-dispatch."""
+        self._arrival_counter += 1
+        self._db.execute(
+            "INSERT OR IGNORE INTO fabric_in"
+            " (sender, uid, arrival, topic, payload) VALUES (?,?,?,?,?)",
+            (sender, _to_db_uid(uid), self._arrival_counter, topic, payload),
+        )
+        self._pump_wake.set()
+
+    # -- dispatch (server thread) -------------------------------------------
+
+    def pump(self, block: bool = False, timeout: float = 1.0) -> int:
+        """Deliver unprocessed inbound messages to handlers on the
+        calling thread. Handler effects + the processed flag share one
+        DB transaction; a handler exception dead-letters the message
+        (processed=2) rather than wedging the queue. Messages for
+        topics with no handler yet stay parked (processed=0) without
+        blocking other topics. Returns count delivered."""
+        if block and not self._pending_rows():
+            self._pump_wake.wait(timeout)
+        self._pump_wake.clear()
+        delivered = 0
+        while True:
+            rows = self._pending_rows()
+            if not rows:
+                break
+            for sender, uid, topic, payload in rows:
+                msg = Message(topic, bytes(payload), sender, _from_db_uid(uid))
+                try:
+                    with self._db.transaction():
+                        for h in list(self._handlers.get(topic, ())):
+                            h(msg)
+                        self._db.execute(
+                            "UPDATE fabric_in SET processed=1"
+                            " WHERE sender=? AND uid=?",
+                            (sender, uid),
+                        )
+                except Exception:
+                    import logging
+
+                    logging.getLogger("corda_tpu.fabric").exception(
+                        "handler failed; dead-lettering %s from %s",
+                        topic,
+                        sender,
+                    )
+                    self._db.execute(
+                        "UPDATE fabric_in SET processed=2"
+                        " WHERE sender=? AND uid=?",
+                        (sender, uid),
+                    )
+                delivered += 1
+        return delivered
+
+    def _pending_rows(self):
+        """Unprocessed rows for topics we can dispatch right now —
+        parked topics never head-of-line-block handled ones."""
+        topics = [t for t, hs in self._handlers.items() if hs]
+        if not topics:
+            return []
+        placeholders = ",".join("?" * len(topics))
+        return self._db.query(
+            "SELECT sender, uid, topic, payload FROM fabric_in"
+            f" WHERE processed=0 AND topic IN ({placeholders})"
+            " ORDER BY arrival LIMIT 64",
+            tuple(topics),
+        )
+
+    @property
+    def pending_inbound(self) -> int:
+        return self._db.query(
+            "SELECT COUNT(*) FROM fabric_in WHERE processed=0"
+        )[0][0]
+
+    @property
+    def pending_outbound(self) -> int:
+        return self._db.query("SELECT COUNT(*) FROM fabric_out")[0][0]

@@ -126,6 +126,20 @@ class NodeDatabase:
         with self._lock:
             return self._conn.execute(sql, params).fetchall()
 
+    def execute_script(self, script: str) -> None:
+        """DDL for subsystem-owned tables (e.g. the fabric journals).
+        Refused inside an open transaction: sqlite's executescript
+        implicitly COMMITs pending writes, which would break the
+        all-or-nothing guarantee of the surrounding block."""
+        with self._lock:
+            if self._tx_depth > 0:
+                raise RuntimeError(
+                    "execute_script inside an open transaction would "
+                    "implicitly commit it; run DDL at startup instead"
+                )
+            self._conn.executescript(script)
+            self._conn.commit()
+
     def transaction(self):
         """Context manager: batched atomic writes. Nests — inner blocks
         (and bare execute() calls) join the outermost transaction, which
@@ -151,10 +165,14 @@ class _DbTx:
 
     def __enter__(self):
         self._db._lock.acquire()
-        if self._db._tx_depth > 0:
-            self._savepoint = f"sp{self._db._tx_depth}"
-            self._db._conn.execute(f"SAVEPOINT {self._savepoint}")
-        self._db._tx_depth += 1
+        try:
+            if self._db._tx_depth > 0:
+                self._savepoint = f"sp{self._db._tx_depth}"
+                self._db._conn.execute(f"SAVEPOINT {self._savepoint}")
+            self._db._tx_depth += 1
+        except BaseException:
+            self._db._lock.release()   # __exit__ will never run
+            raise
         return self._db._conn
 
     def __exit__(self, exc_type, exc, tb):
@@ -222,8 +240,8 @@ class PersistentTransactionStorage(TransactionStorage):
             stx = ser.decode(data)
             self._txs[SecureHash(bytes(tx_id))] = stx
 
-    def add(self, stx: SignedTransaction) -> bool:
-        added = super().add(stx)
+    def add_quiet(self, stx: SignedTransaction) -> bool:
+        added = super().add_quiet(stx)
         if added:
             self._db.execute(
                 "INSERT OR IGNORE INTO transactions (tx_id, data) VALUES (?,?)",

@@ -104,12 +104,27 @@ class TransactionStorage:
 
     def add(self, stx: SignedTransaction) -> bool:
         """Returns True if newly added (idempotent on re-record)."""
+        if not self.add_quiet(stx):
+            return False
+        self.fire_observers(stx)
+        return True
+
+    def add_quiet(self, stx: SignedTransaction) -> bool:
+        """Store without firing observers — record_transactions defers
+        observer side effects until the vault has fully persisted, so a
+        disk failure can unwind with no observer having seen the tx."""
         if stx.id in self._txs:
             return False
         self._txs[stx.id] = stx
+        return True
+
+    def fire_observers(self, stx: SignedTransaction) -> None:
         for cb in list(self.observers):
             _safe_notify(cb, stx)
-        return True
+
+    def _forget(self, tx_id: SecureHash) -> None:
+        """Undo of add_quiet when a later step of the record fails."""
+        self._txs.pop(tx_id, None)
 
     def __contains__(self, tx_id: SecureHash) -> bool:
         return tx_id in self._txs
@@ -323,7 +338,7 @@ class Observable:
 
     def emit(self, item: Any) -> None:
         for cb in list(self._subscribers):
-            cb(item)
+            _safe_notify(cb, item)   # one bad subscriber can't starve the rest
 
 
 @dataclass
@@ -378,8 +393,19 @@ class VaultService:
         if consumed or produced:
             update = VaultUpdate(consumed, produced)
             # persistence hook first and NOT error-shielded: a failed
-            # disk write must abort the record, unlike observer bugs
-            self._on_delta(update)
+            # disk write must abort the record — and unwind the map
+            # mutations above so memory never runs ahead of disk and a
+            # retry of record_transactions isn't silently a no-op
+            try:
+                self._on_delta(update)
+            except BaseException:
+                for sar in consumed:
+                    self._unconsumed[sar.ref] = sar.state
+                    self._consumed.pop(sar.ref, None)
+                for sar in produced:
+                    self._unconsumed.pop(sar.ref, None)
+                    self._recorded_at.pop(sar.ref, None)
+                raise
             for cb in list(self.updates):
                 _safe_notify(cb, update)
 
@@ -662,8 +688,15 @@ class ServiceHub:
         ctx = self.db.transaction() if self.db else contextlib.nullcontext()
         with ctx:
             for stx in stxs:
-                if self.validated_transactions.add(stx):
-                    self.vault.notify(stx.wtx)
+                if self.validated_transactions.add_quiet(stx):
+                    try:
+                        self.vault.notify(stx.wtx)
+                    except BaseException:
+                        # disk failure: unwind memory too, so a retry
+                        # re-runs the whole record instead of no-opping
+                        self.validated_transactions._forget(stx.id)
+                        raise
+                    self.validated_transactions.fire_observers(stx)
 
     # -- resolution ---------------------------------------------------------
 

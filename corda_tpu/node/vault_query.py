@@ -91,10 +91,11 @@ def row_of(sar: StateAndRef, status: str, recorded_at: int) -> VaultRow:
     lid_b = None
     if lid is not None:
         lid_b = lid if isinstance(lid, bytes) else ser.encode(lid)
+    from .services import _owning_key_of
+
     fps = []
     for p in data.participants:
-        key = getattr(p, "owning_key", p)
-        for leaf in comp.leaves_of(key):
+        for leaf in comp.leaves_of(_owning_key_of(p)):
             fps.append(leaf.fingerprint())
     return VaultRow(
         state_and_ref=sar,
