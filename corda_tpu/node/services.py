@@ -429,6 +429,11 @@ class VaultService:
                 out.append(StateAndRef(ts, ref))
         return out
 
+    def state_and_ref(self, ref: StateRef) -> Optional[StateAndRef]:
+        """Look up one unconsumed state by ref (None if spent/unknown)."""
+        ts = self._unconsumed.get(ref)
+        return StateAndRef(ts, ref) if ts is not None else None
+
     def consumed_states(self, cls=None) -> list[StateAndRef]:
         return [
             StateAndRef(ts, ref)

@@ -111,6 +111,93 @@ def make_linear_state_tx(node, notary: Party, linear_id, info: str):
     return stx
 
 
+@ser.serializable
+@dataclass(frozen=True)
+class HeartbeatState:
+    """SchedulableState test fixture: beats `count` up to `target`, one
+    beat every `period_micros` (reference: NodeSchedulerServiceTest's
+    TestState + ScheduledFlow in samples/irs-demo fixing logic)."""
+
+    owner: object                  # PublicKey
+    count: int
+    target: int
+    due_micros: int
+    period_micros: int
+
+    @property
+    def participants(self):
+        return (self.owner,)
+
+    def next_scheduled_activity(self, this_state_ref):
+        if self.count >= self.target:
+            return None
+        from ..core.contracts import ScheduledActivity
+
+        return ScheduledActivity(
+            "corda_tpu.testing.flows.HeartbeatFlow",
+            (this_state_ref,),
+            self.due_micros,
+        )
+
+
+class _HeartbeatContract:
+    def verify(self, ltx) -> None:
+        pass
+
+
+HEARTBEAT_CONTRACT = "test.Heartbeat"
+
+
+def make_heartbeat_tx(node, notary: Party, *, target: int, period: int):
+    """Issue a HeartbeatState due `period` micros from now."""
+    from ..core.contracts import register_contract
+
+    register_contract(HEARTBEAT_CONTRACT, _HeartbeatContract())
+    now = node.services.clock.now_micros()
+    b = TransactionBuilder(notary=notary)
+    b.add_output_state(
+        HeartbeatState(node.party.owning_key, 0, target, now + period, period),
+        HEARTBEAT_CONTRACT,
+    )
+    stx = node.services.sign_initial_transaction(b)
+    node.services.record_transactions([stx])
+    return stx
+
+
+@initiating_flow
+class HeartbeatFlow(FlowLogic):
+    """Scheduler-launched: consume the heartbeat state, emit the next
+    beat (count+1) due one period later. Constructor args = (StateRef,)
+    per the FlowLogicRef discipline."""
+
+    def __init__(self, ref):
+        self.ref = ref
+
+    def call(self):
+        from ..flows.core_flows import FinalityFlow
+
+        sar = self.services.vault.state_and_ref(self.ref)
+        if sar is None:
+            return None   # already consumed (double-fire guard)
+        beat: HeartbeatState = sar.state.data
+        now = self.services.clock.now_micros()
+        b = TransactionBuilder(notary=sar.state.notary)
+        b.add_input_state(sar)
+        b.add_output_state(
+            HeartbeatState(
+                beat.owner,
+                beat.count + 1,
+                beat.target,
+                now + beat.period_micros,
+                beat.period_micros,
+            ),
+            HEARTBEAT_CONTRACT,
+        )
+        stx = self.services.sign_initial_transaction(b)
+        stx = yield from self.sub_flow(FinalityFlow(stx))
+        return stx.id
+
+
 @initiating_flow
 class NoResponderFlow(FlowLogic):
     """No @initiated_by counterpart: used to test SessionReject."""

@@ -28,6 +28,7 @@ from ..node.notary import (
     SimpleNotaryService,
     ValidatingNotaryService,
 )
+from ..node.scheduler import NodeSchedulerService
 from ..node.services import (
     IdentityService,
     KeyManagementService,
@@ -111,6 +112,9 @@ class MockNode:
             self.services.notary_service = ValidatingNotaryService(
                 self.services, uniqueness()
             )
+        self.scheduler = NodeSchedulerService(
+            self.services, self.smm.start_flow
+        )
 
     # -- conveniences -------------------------------------------------------
 
@@ -175,6 +179,7 @@ class MockNetwork:
         fabric's persisted dedupe table."""
         if self.db_dir is None:
             raise RuntimeError("restart_node requires MockNetwork(db_dir=...)")
+        node.scheduler.stop()
         node.smm.stop()
         node.services.db.close()
         node.messaging.running = False
@@ -208,8 +213,20 @@ class MockNetwork:
             else None
         )
         total = 0
-        while self.fabric.pending:
-            total += self.fabric.pump(1, rng)
-            if total > pump_limit:
-                raise RuntimeError("network did not quiesce (livelock?)")
-        return total
+        rounds = 0
+        while True:
+            while self.fabric.pending:
+                total += self.fabric.pump(1, rng)
+                if total > pump_limit:
+                    raise RuntimeError("network did not quiesce (livelock?)")
+            # quiescent on messages: fire any due scheduled activities
+            # (the reference's scheduler thread wakes on its own; in
+            # Ring 3 the pump is the only driver, so ticks interleave
+            # deterministically with delivery)
+            if not sum(n.scheduler.tick() for n in self.nodes):
+                return total
+            rounds += 1
+            if rounds > pump_limit:
+                # scheduled flows that keep producing immediately-due
+                # activities without any messaging never quiesce either
+                raise RuntimeError("scheduler did not quiesce (livelock?)")

@@ -91,10 +91,12 @@ def test_send_receive_ordered(net):
     b.add_handler("t", lambda m: got.append((m.sender, m.payload)))
     for i in range(20):
         a.send("t", f"m{i}".encode(), "B")
-    assert wait_for(lambda: b.pump() or len(got) == 20)
-    wait_for(lambda: len(got) == 20 or not b.pump())
-    while b.pump():
-        pass
+    def drained():
+        while b.pump():
+            pass
+        return len(got) == 20
+
+    assert wait_for(drained)
     assert got == [("A", f"m{i}".encode()) for i in range(20)]
     assert wait_for(lambda: a.pending_outbound == 0)
 

@@ -1,0 +1,96 @@
+"""Scheduler service: SchedulableState outputs trigger flows on time.
+
+Reference behavior: node/.../services/events/NodeSchedulerService.kt +
+ScheduledActivityObserver — earliest activity wakes the service, which
+launches the flow named by the state's ScheduledActivity; consuming a
+state cancels its activity; the schedule survives restart (here: it is
+re-derived from the vault).
+"""
+
+from corda_tpu.node.scheduler import NodeSchedulerService
+from corda_tpu.testing.flows import (
+    HeartbeatState,
+    make_heartbeat_tx,
+)
+from corda_tpu.testing.mock_network import MockNetwork
+
+PERIOD = 1_000_000  # 1s in micros
+
+
+def make_net():
+    net = MockNetwork(seed=42)
+    notary = net.create_notary("Notary")
+    alice = net.create_node("Alice")
+    return net, notary, alice
+
+
+def beats(node):
+    return sorted(
+        s.state.data.count
+        for s in node.vault.unconsumed_states(HeartbeatState)
+    )
+
+
+def test_not_due_does_not_fire():
+    net, notary, alice = make_net()
+    make_heartbeat_tx(alice, notary.party, target=3, period=PERIOD)
+    net.run()
+    assert beats(alice) == [0]
+    assert alice.scheduler.pending_count() == 1
+    assert (
+        alice.scheduler.next_wakeup_micros()
+        == net.clock.now_micros() + PERIOD
+    )
+
+
+def test_fires_when_due_and_chains():
+    net, notary, alice = make_net()
+    make_heartbeat_tx(alice, notary.party, target=3, period=PERIOD)
+    net.run()
+    net.clock.advance(PERIOD)
+    net.run()   # beat 0 -> 1
+    assert beats(alice) == [1]
+    # advancing far enough fires each subsequent beat as it becomes due
+    net.clock.advance(PERIOD)
+    net.run()
+    net.clock.advance(PERIOD)
+    net.run()
+    assert beats(alice) == [3]
+    # target reached: state no longer schedules anything
+    assert alice.scheduler.pending_count() == 0
+    net.clock.advance(10 * PERIOD)
+    assert net.run() == 0
+
+
+def test_consumed_state_cancels_activity():
+    net, notary, alice = make_net()
+    stx = make_heartbeat_tx(alice, notary.party, target=3, period=PERIOD)
+    net.run()
+    # spend the heartbeat out-of-band before it fires
+    from corda_tpu.core.contracts import StateRef
+    from corda_tpu.core.transactions import TransactionBuilder
+    from corda_tpu.flows.core_flows import FinalityFlow
+
+    sar = alice.vault.state_and_ref(StateRef(stx.id, 0))
+    b = TransactionBuilder(notary=notary.party)
+    b.add_input_state(sar)
+    kill = alice.services.sign_initial_transaction(b)
+    alice.run_flow(FinalityFlow(kill))
+    assert alice.scheduler.pending_count() == 0
+    net.clock.advance(5 * PERIOD)
+    assert alice.scheduler.tick() == 0
+
+
+def test_schedule_rederived_from_vault():
+    net, notary, alice = make_net()
+    make_heartbeat_tx(alice, notary.party, target=3, period=PERIOD)
+    net.run()
+    # a fresh scheduler over the same services rebuilds the schedule
+    # (the crash-recovery story: the vault IS the persistent schedule)
+    alice.scheduler.stop()
+    fresh = NodeSchedulerService(alice.services, alice.smm.start_flow)
+    assert fresh.pending_count() == 1
+    alice.scheduler = fresh
+    net.clock.advance(PERIOD)
+    net.run()
+    assert beats(alice) == [1]
