@@ -255,6 +255,7 @@ class TimeWindow:
 # attachments
 
 
+@ser.serializable
 @dataclass(frozen=True)
 class Attachment:
     """Content-addressed blob (contract code / data) referenced by hash.

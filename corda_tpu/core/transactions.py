@@ -273,7 +273,13 @@ class SignedTransaction:
         """All attached signatures must be cryptographically valid
         (reference: TransactionWithSignatures.checkSignaturesAreValid:58)."""
         v = verifier or default_verifier()
-        results = v.verify_batch(self.signature_requests())
+        self.raise_on_invalid(v.verify_batch(self.signature_requests()))
+
+    def raise_on_invalid(self, results: Sequence[bool]) -> None:
+        """Map per-signature batch results back to signers; raise
+        InvalidSignature naming the bad ones. Shared by the in-process
+        check above and the out-of-process verifier worker, which stages
+        many transactions' signatures into one batch dispatch."""
         bad = [s for s, ok in zip(self.sigs, results) if not ok]
         if bad:
             raise InvalidSignature(
@@ -333,9 +339,14 @@ class SignedTransaction:
         services.transaction_verifier.verify(ltx).result()
 
 
+@ser.serializable
 @dataclass(frozen=True)
 class LedgerTransaction:
-    """Fully resolved transaction: ready for contract execution."""
+    """Fully resolved transaction: ready for contract execution.
+
+    Serializable because the out-of-process verifier pool ships resolved
+    transactions to workers (reference: VerifierApi.kt VerificationRequest
+    carries the LedgerTransaction bytes)."""
 
     inputs: tuple[StateAndRef, ...]
     outputs: tuple[TransactionState, ...]

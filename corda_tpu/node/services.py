@@ -608,6 +608,10 @@ class _Future:
         self._done = True
         self._exc = exc
 
+    @property
+    def done(self) -> bool:
+        return self._done
+
     def result(self) -> None:
         if not self._done:
             raise RuntimeError("verification still pending")

@@ -1,0 +1,54 @@
+"""Metrics registry unit tests (reference role: dropwizard MetricRegistry
+held by MonitoringService, node/.../services/api/MonitoringService.kt)."""
+
+import pytest
+
+from corda_tpu.utils.metrics import MetricRegistry
+
+
+def test_counter_and_gauge():
+    reg = MetricRegistry()
+    c = reg.counter("a.b")
+    c.inc()
+    c.inc(4)
+    c.dec()
+    assert c.count == 4
+    reg.gauge("g", lambda: 2.5)
+    assert "g 2.5" in reg.to_prometheus()
+
+
+def test_timer_records_durations():
+    reg = MetricRegistry()
+    t = reg.timer("op")
+    with t.time():
+        pass
+    t.update(0.5)
+    assert t.count == 2
+    assert t.histogram.max >= 0.5
+    assert t.histogram.min >= 0.0
+
+
+def test_histogram_quantiles():
+    reg = MetricRegistry()
+    h = reg.histogram("h")
+    for i in range(100):
+        h.update(float(i))
+    assert h.count == 100
+    assert h.quantile(0.5) == pytest.approx(50, abs=2)
+    assert h.quantile(0.99) == pytest.approx(99, abs=2)
+    assert h.mean == pytest.approx(49.5)
+
+
+def test_same_name_same_instance_and_type_conflicts():
+    reg = MetricRegistry()
+    assert reg.counter("x") is reg.counter("x")
+    with pytest.raises(TypeError):
+        reg.meter("x")
+
+
+def test_meter_rates():
+    reg = MetricRegistry()
+    m = reg.meter("ev")
+    m.mark(10)
+    assert m.count == 10
+    assert m.mean_rate > 0
