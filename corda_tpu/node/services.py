@@ -251,6 +251,7 @@ def _key_fp(key) -> bytes:
 # network map cache
 
 
+@ser.serializable
 @dataclass(frozen=True)
 class NodeInfo:
     """A node's advertised identity + address (reference:
@@ -284,12 +285,18 @@ class NetworkMapCache:
         for cb in list(self.observers):
             cb(info)
 
+    def remove_node(self, info: NodeInfo) -> None:
+        self._nodes.pop(info.legal_identity.name, None)
+
     def address_of(self, party: Party) -> Optional[str]:
         info = self._nodes.get(party.name)
         return info.address if info else None
 
     def node_of(self, party: Party) -> Optional[NodeInfo]:
         return self._nodes.get(party.name)
+
+    def node_by_name(self, name: str) -> Optional[NodeInfo]:
+        return self._nodes.get(name)
 
     def notary_identities(self) -> list[Party]:
         return [

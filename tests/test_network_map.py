@@ -1,0 +1,325 @@
+"""Network map service: register/fetch/subscribe/push protocol.
+
+Reference behaviours under test: NetworkMapService.kt:62 (signed
+registrations, serial replay protection, expiry), subscriber push with
+ack-based eviction, persistent registration reload.
+"""
+
+import pytest
+
+from corda_tpu.core.identity import Party
+from corda_tpu.crypto import schemes
+from corda_tpu.node import network_map as nm
+from corda_tpu.node.messaging import InMemoryMessagingNetwork
+from corda_tpu.node.services import (
+    IdentityService,
+    KeyManagementService,
+    NodeInfo,
+    ServiceHub,
+    TestClock,
+)
+
+
+def make_node(fabric, clock, name, scheme=schemes.EDDSA_ED25519_SHA512, seed=None):
+    kp = schemes.generate_keypair(scheme, seed=seed or hash(name) % 2**63)
+    party = Party(name, kp.public)
+    hub = ServiceHub(
+        my_info=NodeInfo(name, party),
+        key_management=KeyManagementService(kp),
+        identity=IdentityService(party),
+        clock=clock,
+    )
+    return hub, fabric.endpoint(name), kp
+
+
+@pytest.fixture
+def net():
+    fabric = InMemoryMessagingNetwork()
+    clock = TestClock()
+    map_hub, map_ep, _ = make_node(fabric, clock, "MapService")
+    service = nm.NetworkMapService(map_ep, clock)
+    return fabric, clock, service
+
+
+def make_client(fabric, clock, name, **kw):
+    hub, ep, kp = make_node(fabric, clock, name, **kw)
+    client = nm.NetworkMapClient(hub, ep, "MapService", kp.private)
+    return hub, client
+
+
+def test_register_fetch_populates_cache(net):
+    fabric, clock, service = net
+    hub_a, client_a = make_client(fabric, clock, "Alice")
+    hub_b, client_b = make_client(fabric, clock, "Bob")
+
+    client_a.register()
+    client_b.register()
+    fabric.run()
+    assert client_a.registered and client_b.registered
+    assert service.registered_names() == ["Alice", "Bob"]
+
+    hub_c, client_c = make_client(fabric, clock, "Carol")
+    client_c.fetch(subscribe=False)
+    fabric.run()
+    cache = hub_c.network_map_cache
+    assert cache.address_of(hub_a.my_info.legal_identity) == "Alice"
+    assert cache.address_of(hub_b.my_info.legal_identity) == "Bob"
+    # identities learned too
+    assert hub_c.identity.party_from_name("Bob") is not None
+
+
+def test_subscription_receives_pushes(net):
+    fabric, clock, service = net
+    hub_a, client_a = make_client(fabric, clock, "Alice")
+    client_a.fetch(subscribe=True)
+    fabric.run()
+
+    hub_b, client_b = make_client(fabric, clock, "Bob")
+    client_b.register()
+    fabric.run()
+    # Alice saw Bob's arrival via push (and acked it)
+    assert hub_a.network_map_cache.address_of(hub_b.my_info.legal_identity) == "Bob"
+    assert service.subscriber_count() == 1
+
+
+def test_unchanged_fetch_sends_no_registrations(net):
+    fabric, clock, service = net
+    hub_a, client_a = make_client(fabric, clock, "Alice")
+    client_a.register()
+    client_a.fetch(subscribe=False)
+    fabric.run()
+    v = client_a.map_version
+    assert v == service.version
+    client_a.fetch(subscribe=False)   # if_changed_since == current version
+    fabric.run()
+    assert client_a.map_version == v
+
+
+def test_serial_replay_rejected(net):
+    fabric, clock, service = net
+    hub_a, client_a = make_client(fabric, clock, "Alice")
+    client_a.register()
+    fabric.run()
+    # same clock instant -> same serial -> rejected
+    client_a.register()
+    with pytest.raises(ValueError, match="not newer"):
+        fabric.run()
+    # later serial accepted
+    clock.advance(1_000)
+    client_a.register()
+    fabric.run()
+
+
+def test_expired_registration_rejected(net):
+    fabric, clock, service = net
+    hub_a, ep = make_node(fabric, clock, "Alice")[0:2]
+    kp = schemes.generate_keypair(seed=99)
+    party = Party("Eve", kp.public)
+    reg = nm.NodeRegistration(
+        info=NodeInfo("Eve", party),
+        serial=clock.now_micros(),
+        op=nm.ADD,
+        expires_micros=clock.now_micros() - 1,
+    )
+    wire = nm.sign_registration(reg, kp.private)
+    with pytest.raises(ValueError, match="expired"):
+        service._process_registration(wire)
+
+
+def test_tampered_registration_rejected(net):
+    fabric, clock, service = net
+    kp = schemes.generate_keypair(seed=7)
+    party = Party("Mallory", kp.public)
+    reg = nm.NodeRegistration(
+        info=NodeInfo("Mallory", party),
+        serial=clock.now_micros(),
+        op=nm.ADD,
+        expires_micros=clock.now_micros() + 10**9,
+    )
+    wire = nm.sign_registration(reg, kp.private)
+    forged = nm.WireNodeRegistration(wire.raw + b"", bytes(len(wire.signature)))
+    with pytest.raises(ValueError, match="signature"):
+        service._process_registration(forged)
+
+
+def test_remove_op(net):
+    fabric, clock, service = net
+    hub_a, client_a = make_client(fabric, clock, "Alice")
+    hub_b, client_b = make_client(fabric, clock, "Bob")
+    client_a.register()
+    client_b.register()
+    client_b.fetch(subscribe=True)
+    fabric.run()
+    clock.advance(1_000)
+    client_a.deregister()
+    fabric.run()
+    assert service.registered_names() == ["Bob"]
+    # Bob's cache saw the removal push
+    assert hub_b.network_map_cache.address_of(hub_a.my_info.legal_identity) is None
+
+
+def test_slow_subscriber_evicted(net):
+    fabric, clock, service = net
+    hub_a, client_a = make_client(fabric, clock, "Alice")
+    client_a.fetch(subscribe=True)
+    fabric.run()
+    # Stop Alice acking, then exceed the un-acked budget.
+    ep = fabric.endpoint("Alice")
+    ep._handlers.pop(nm.TOPIC_NM_PUSH, None)
+    for i in range(nm.MAX_UNACKED_UPDATES + 2):
+        clock.advance(1_000)
+        hub, client = make_client(fabric, clock, f"Peer{i}")
+        client.register()
+        fabric.run()
+    assert service.subscriber_count() == 0
+
+
+def test_name_hijack_rejected(net):
+    """First registration binds name->key; a different key signing for
+    the same name is rejected (and never reaches subscribers)."""
+    fabric, clock, service = net
+    hub_a, client_a = make_client(fabric, clock, "Alice")
+    client_a.register()
+    fabric.run()
+
+    mallory_kp = schemes.generate_keypair(seed=666)
+    hijack = nm.NodeRegistration(
+        info=NodeInfo("Mallory-addr", Party("Alice", mallory_kp.public)),
+        serial=2**60,   # beats any clock serial
+        op=nm.ADD,
+        expires_micros=clock.now_micros() + 10**9,
+    )
+    wire = nm.sign_registration(hijack, mallory_kp.private)
+    with pytest.raises(ValueError, match="key mismatch"):
+        service._process_registration(wire)
+    # Alice's entry is untouched
+    reg = service._registry["Alice"].verified()
+    assert reg.info.address == "Alice"
+
+
+def test_client_ignores_pushes_from_strangers(net):
+    """Only the configured map service may push updates; a peer sending
+    TOPIC_NM_PUSH directly cannot poison the cache."""
+    fabric, clock, service = net
+    hub_a, client_a = make_client(fabric, clock, "Alice")
+    client_a.fetch(subscribe=True)
+    fabric.run()
+
+    mallory_kp = schemes.generate_keypair(seed=667)
+    fake = nm.NodeRegistration(
+        info=NodeInfo("Evil-addr", Party("Bob", mallory_kp.public)),
+        serial=1,
+        op=nm.ADD,
+        expires_micros=clock.now_micros() + 10**9,
+    )
+    wire = nm.sign_registration(fake, mallory_kp.private)
+    from corda_tpu.core import serialization as ser
+
+    mallory_ep = fabric.endpoint("Mallory")
+    mallory_ep.send(
+        nm.TOPIC_NM_PUSH, ser.encode(nm.MapUpdate(wire, 99)), "Alice"
+    )
+    fabric.run()
+    assert hub_a.network_map_cache.node_by_name("Bob") is None
+
+
+def test_full_fetch_reconciles_removed_nodes(net):
+    """A non-subscribed client that re-fetches after a peer deregisters
+    drops the stale entry (fetch responses carry no tombstones; the full
+    set is authoritative)."""
+    fabric, clock, service = net
+    hub_a, client_a = make_client(fabric, clock, "Alice")
+    hub_b, client_b = make_client(fabric, clock, "Bob")
+    client_a.register()
+    client_b.register()
+    fabric.run()
+    hub_c, client_c = make_client(fabric, clock, "Carol")
+    client_c.fetch(subscribe=False)
+    fabric.run()
+    assert hub_c.network_map_cache.node_by_name("Alice") is not None
+
+    clock.advance(1_000)
+    client_a.deregister()
+    fabric.run()
+    client_c.fetch(subscribe=False)
+    fabric.run()
+    assert hub_c.network_map_cache.node_by_name("Alice") is None
+    assert hub_c.network_map_cache.node_by_name("Bob") is not None
+
+
+def test_persistent_service_reloads_registrations(tmp_path):
+    from corda_tpu.node.persistence import NodeDatabase
+
+    fabric = InMemoryMessagingNetwork()
+    clock = TestClock()
+    db = NodeDatabase(str(tmp_path / "map.db"))
+    map_ep = fabric.endpoint("MapService")
+    service = nm.NetworkMapService(map_ep, clock, db=db)
+
+    hub_a, client_a = make_client(fabric, clock, "Alice")
+    client_a.register()
+    fabric.run()
+    assert service.registered_names() == ["Alice"]
+    version_before = service.version
+    db.close()
+
+    # restart the service over the same database
+    db2 = NodeDatabase(str(tmp_path / "map.db"))
+    fabric2 = InMemoryMessagingNetwork()
+    service2 = nm.NetworkMapService(fabric2.endpoint("MapService"), clock, db=db2)
+    assert service2.registered_names() == ["Alice"]
+    assert service2.version == version_before
+    # replay protection survives the restart: re-sending Alice's original
+    # registration (same serial) is rejected
+    reg = nm.NodeRegistration(
+        info=hub_a.my_info,
+        serial=service2._serials["Alice"],
+        op=nm.ADD,
+        expires_micros=clock.now_micros() + 10**9,
+    )
+    kp_priv = client_a._priv
+    with pytest.raises(ValueError, match="not newer"):
+        service2._process_registration(nm.sign_registration(reg, kp_priv))
+    db2.close()
+
+
+def test_remove_tombstone_survives_restart(tmp_path):
+    """After deregistration + service restart, replaying the old signed
+    ADD cannot resurrect the node (the REMOVE persists as a tombstone
+    carrying the serial high-water mark)."""
+    from corda_tpu.node.persistence import NodeDatabase
+
+    fabric = InMemoryMessagingNetwork()
+    clock = TestClock()
+    db = NodeDatabase(str(tmp_path / "map.db"))
+    service = nm.NetworkMapService(fabric.endpoint("MapService"), clock, db=db)
+
+    hub_a, client_a = make_client(fabric, clock, "Alice")
+    # capture the original signed ADD as an attacker would
+    add_reg = nm.NodeRegistration(
+        info=hub_a.my_info,
+        serial=clock.now_micros(),
+        op=nm.ADD,
+        expires_micros=clock.now_micros() + 10**9,
+    )
+    captured_add = nm.sign_registration(add_reg, client_a._priv)
+    service._process_registration(captured_add)
+    clock.advance(1_000)
+    remove_reg = nm.NodeRegistration(
+        info=hub_a.my_info,
+        serial=clock.now_micros(),
+        op=nm.REMOVE,
+        expires_micros=clock.now_micros() + 10**9,
+    )
+    service._process_registration(nm.sign_registration(remove_reg, client_a._priv))
+    assert service.registered_names() == []
+    db.close()
+
+    db2 = NodeDatabase(str(tmp_path / "map.db"))
+    service2 = nm.NetworkMapService(
+        InMemoryMessagingNetwork().endpoint("MapService"), clock, db=db2
+    )
+    assert service2.registered_names() == []
+    with pytest.raises(ValueError, match="not newer"):
+        service2._process_registration(captured_add)
+    db2.close()
