@@ -187,6 +187,10 @@ class StateMachineManager:
         self.tx_waiters: dict[Any, list[FlowStateMachine]] = {}
         self.initiated_factories: dict[str, Callable] = {}
         self.changes: list[Callable[[FlowStateMachine, str], None]] = []
+        # lifecycle observers: cb(kind, fsm) with kind "added"/"removed"
+        # (the CordaRPCOps.stateMachinesFeed source — RPCServer hangs
+        # flow-result streaming off "removed")
+        self.lifecycle: list[Callable[[str, FlowStateMachine], None]] = []
         self.stopped = False
         messaging.add_handler(msglib.TOPIC_SESSION, self._on_session_message)
         tx_store = getattr(services, "validated_transactions", None)
@@ -230,8 +234,20 @@ class StateMachineManager:
         self._bind(fsm)
         self.flows[flow_id] = fsm
         self._checkpoint(fsm)      # initial checkpoint (reference: smm.add)
+        self._notify_lifecycle("added", fsm)
         self._run(fsm)
         return fsm
+
+    def _notify_lifecycle(self, kind: str, fsm: FlowStateMachine) -> None:
+        for cb in list(self.lifecycle):
+            try:
+                cb(kind, fsm)
+            except Exception:
+                import logging
+
+                logging.getLogger("corda_tpu.smm").exception(
+                    "lifecycle observer raised; continuing"
+                )
 
     def restore_checkpoints(self) -> int:
         """Re-animate every checkpointed flow (StateMachineManager.kt:
@@ -241,6 +257,7 @@ class StateMachineManager:
             fsm = self._restore_one(flow_id, ser.decode(record))
             self.flows[flow_id] = fsm
             restored.append(fsm)
+            self._notify_lifecycle("added", fsm)
         for fsm in restored:
             if not fsm.done:
                 self._run(fsm)
@@ -524,6 +541,7 @@ class StateMachineManager:
                 self._emit(fsm, SessionEnd(sess.id, error_text), sess.party)
             self.sessions_by_id.pop(sess.id, None)
         self.services.checkpoint_storage.remove(fsm.id)
+        self._notify_lifecycle("removed", fsm)
 
     # -- inbound ------------------------------------------------------------
 
@@ -583,6 +601,7 @@ class StateMachineManager:
         self._bind(fsm)
         self.flows[flow_id] = fsm
         self._checkpoint(fsm)
+        self._notify_lifecycle("added", fsm)
         self._run(fsm)
 
     def _notify_tx_recorded(self, stx) -> None:

@@ -157,10 +157,10 @@ class NetworkMapService:
             self._store = PersistentKVStore(db, "network_map")
             self._meta = PersistentKVStore(db, "network_map_meta")
             for key, blob in self._store.items():
-                wire = ser.decode(blob)
                 try:
+                    wire = ser.decode(blob)
                     reg = wire.verified()
-                except ValueError:
+                except (ValueError, ser.SerializationError):
                     continue
                 name = reg.info.legal_identity.name
                 self._serials[name] = reg.serial
@@ -179,12 +179,25 @@ class NetworkMapService:
 
     # -- request processing --------------------------------------------------
 
+    @staticmethod
+    def _decoded(msg: Message, expected: type):
+        """Decode a request, dropping malformed payloads instead of
+        letting them crash the message pump (an unauthenticated peer
+        must not be able to DoS the directory with garbage bytes)."""
+        try:
+            req = ser.decode(msg.payload)
+        except ser.SerializationError:
+            return None
+        return req if isinstance(req, expected) else None
+
     def _on_register(self, msg: Message) -> None:
-        req = ser.decode(msg.payload)
+        req = self._decoded(msg, RegistrationRequest)
+        if req is None:
+            return
         error = None
         try:
             self._process_registration(req.wire)
-        except ValueError as e:
+        except (ValueError, ser.SerializationError) as e:
             error = str(e)
         self._reply(msg.sender, RegistrationResponse(req.req_id, error))
 
@@ -237,7 +250,9 @@ class NetworkMapService:
             self._messaging.send(TOPIC_NM_PUSH, update, address)
 
     def _on_fetch(self, msg: Message) -> None:
-        req = ser.decode(msg.payload)
+        req = self._decoded(msg, FetchMapRequest)
+        if req is None:
+            return
         if req.subscribe:
             self._subscribers[msg.sender] = 0
         unchanged = (
@@ -290,6 +305,7 @@ class NetworkMapClient:
         self._priv = identity_priv
         self._next_req = 0
         self._pending: dict[int, Callable] = {}
+        self.registration_error: Optional[str] = None
         # mirror of the service's replay/continuity guards, so a stale or
         # forged push can't roll this client's view backwards:
         self._serials: dict[str, int] = {}
@@ -302,9 +318,17 @@ class NetworkMapClient:
 
     # -- outbound ------------------------------------------------------------
 
-    def register(self, op: str = ADD, on_done: Optional[Callable] = None) -> None:
+    def register(
+        self,
+        op: str = ADD,
+        on_done: Optional[Callable] = None,
+        on_error: Optional[Callable[[str], None]] = None,
+    ) -> None:
         """Publish our own NodeInfo (serial = clock micros: monotone
-        across restarts, the reference uses Instant serials)."""
+        across restarts, the reference uses Instant serials). Rejection
+        is reported via `on_error`/`registration_error`, never raised —
+        the reply handler runs inside the message pump, and a throw
+        there would abort delivery of unrelated traffic."""
         reg = NodeRegistration(
             info=self._services.my_info,
             serial=self._services.clock.now_micros(),
@@ -316,7 +340,17 @@ class NetworkMapClient:
 
         def handle(resp: RegistrationResponse):
             if resp.error is not None:
-                raise ValueError(f"network map rejected registration: {resp.error}")
+                self.registration_error = resp.error
+                if on_error is not None:
+                    on_error(resp.error)
+                else:
+                    import logging
+
+                    logging.getLogger("corda_tpu.network_map").warning(
+                        "network map rejected registration: %s", resp.error
+                    )
+                return
+            self.registration_error = None
             self.registered = True
             if on_done is not None:
                 on_done(resp)
@@ -346,7 +380,10 @@ class NetworkMapClient:
     def _on_reply(self, msg: Message) -> None:
         if msg.sender != self._map_address:
             return   # replies are only trusted from our map service
-        resp = ser.decode(msg.payload)
+        try:
+            resp = ser.decode(msg.payload)
+        except ser.SerializationError:
+            return
         handler = self._pending.pop(resp.req_id, None)
         if handler is not None:
             handler(resp)
@@ -373,7 +410,10 @@ class NetworkMapClient:
     def _on_push(self, msg: Message) -> None:
         if msg.sender != self._map_address:
             return   # only the map service may push to us
-        update = ser.decode(msg.payload)
+        try:
+            update = ser.decode(msg.payload)
+        except ser.SerializationError:
+            return
         self._apply_wire(update.wire)
         self.map_version = update.version
         self._messaging.send(

@@ -271,22 +271,38 @@ SERVICE_NOTARY_VALIDATING = "corda.notary.validating"
 SERVICE_NETWORK_MAP = "corda.network_map"
 
 
+@dataclass(frozen=True)
+class MapChange:
+    """One network-map delta (reference: NetworkMapCache.MapChange —
+    Added/Removed/Modified)."""
+
+    kind: str                 # "added" | "removed"
+    info: NodeInfo
+
+
+ser.serializable(MapChange)
+
+
 class NetworkMapCache:
     """Peer directory (reference: InMemoryNetworkMapCache). The Phase-3
     network-map *service* feeds this over the fabric; Ring-3 tests fill
-    it directly."""
+    it directly. Observers receive MapChange deltas — removals too, or
+    feed consumers would route to dead addresses forever."""
 
     def __init__(self):
         self._nodes: dict[str, NodeInfo] = {}
-        self.observers: list[Callable[[NodeInfo], None]] = []
+        self.observers: list[Callable[[MapChange], None]] = []
 
     def add_node(self, info: NodeInfo) -> None:
         self._nodes[info.legal_identity.name] = info
         for cb in list(self.observers):
-            cb(info)
+            _safe_notify(cb, MapChange("added", info))
 
     def remove_node(self, info: NodeInfo) -> None:
-        self._nodes.pop(info.legal_identity.name, None)
+        removed = self._nodes.pop(info.legal_identity.name, None)
+        if removed is not None:
+            for cb in list(self.observers):
+                _safe_notify(cb, MapChange("removed", removed))
 
     def address_of(self, party: Party) -> Optional[str]:
         info = self._nodes.get(party.name)
@@ -325,6 +341,16 @@ class VaultUpdate:
 
     consumed: list[StateAndRef]
     produced: list[StateAndRef]
+
+
+# Vault updates stream over RPC feeds (CordaRPCOps.vaultTrackBy), so
+# they need a wire form; mutable lists round-trip as lists.
+ser.register_custom(
+    VaultUpdate,
+    "VaultUpdate",
+    lambda u: [list(u.consumed), list(u.produced)],
+    lambda v: VaultUpdate(list(v[0]), list(v[1])),
+)
 
 
 class Observable:

@@ -505,3 +505,41 @@ def _vault_row_of(r, fps_map: dict) -> VaultRow:
         participant_fps=tuple(fps_map.get((bytes(r[0]), r[1]), ())),
         recorded_at=r[10],
     )
+
+
+# ---------------------------------------------------------------------------
+# wire registration — criteria travel over RPC (CordaRPCOps.vaultQueryBy
+# takes the criteria AST from the client; the reference serializes the
+# QueryCriteria object graph over Kryo/AMQP)
+
+for _cls in (
+    ColumnPredicate,
+    FungibleAssetQueryCriteria,
+    LinearStateQueryCriteria,
+    And,
+    Or,
+    PageSpecification,
+    Sort,
+    Page,
+):
+    ser.serializable(_cls)
+
+# VaultQueryCriteria may hold Python classes in contract_state_types;
+# they normalise to tag strings on the wire (the SQL compiler and
+# matcher treat both identically).
+ser.register_custom(
+    VaultQueryCriteria,
+    "VaultQueryCriteria",
+    lambda c: [
+        c.status,
+        None if c.contract_state_types is None else c._tags(),
+        None if c.notary_names is None else list(c.notary_names),
+        None if c.recorded_between is None else list(c.recorded_between),
+    ],
+    lambda v: VaultQueryCriteria(
+        v[0],
+        None if v[1] is None else tuple(v[1]),
+        None if v[2] is None else tuple(v[2]),
+        None if v[3] is None else tuple(v[3]),
+    ),
+)

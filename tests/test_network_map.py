@@ -100,14 +100,18 @@ def test_serial_replay_rejected(net):
     hub_a, client_a = make_client(fabric, clock, "Alice")
     client_a.register()
     fabric.run()
-    # same clock instant -> same serial -> rejected
-    client_a.register()
-    with pytest.raises(ValueError, match="not newer"):
-        fabric.run()
+    # same clock instant -> same serial -> rejected (reported via the
+    # error channel, never thrown into the pump)
+    errors = []
+    client_a.register(on_error=errors.append)
+    fabric.run()
+    assert errors and "not newer" in errors[0]
+    assert client_a.registration_error is not None
     # later serial accepted
     clock.advance(1_000)
     client_a.register()
     fabric.run()
+    assert client_a.registration_error is None
 
 
 def test_expired_registration_rejected(net):
@@ -323,3 +327,28 @@ def test_remove_tombstone_survives_restart(tmp_path):
     with pytest.raises(ValueError, match="not newer"):
         service2._process_registration(captured_add)
     db2.close()
+
+
+def test_garbage_payloads_do_not_crash_service(net):
+    """Unauthenticated garbage on any directory topic is dropped, not a
+    pump-crashing DoS."""
+    from corda_tpu.core import serialization as ser
+
+    fabric, clock, service = net
+    mallory = fabric.endpoint("Mallory")
+    for topic in (nm.TOPIC_NM_REGISTER, nm.TOPIC_NM_FETCH):
+        mallory.send(topic, b"\xff\xff\xff", "MapService")
+    # corrupt raw inside a well-formed request envelope
+    mallory.send(
+        nm.TOPIC_NM_REGISTER,
+        ser.encode(
+            nm.RegistrationRequest(nm.WireNodeRegistration(b"\xff", b"sig"), 7)
+        ),
+        "MapService",
+    )
+    fabric.run()   # must not raise
+    # and the service still works afterwards
+    hub_a, client_a = make_client(fabric, clock, "Alice")
+    client_a.register()
+    fabric.run()
+    assert service.registered_names() == ["Alice"]

@@ -1,0 +1,282 @@
+"""RPC layer: proxy calls, permissions, streaming feeds, flow handles.
+
+Reference behaviours under test: CordaRPCOps surface (CordaRPCOps.kt:
+38-284), Observables-as-results (RPCClientProxyHandler.kt:37-68), flow
+start permissioning (RPCUserService), subscription reaping.
+"""
+
+import pytest
+
+from corda_tpu.finance.cash import CashIssueFlow, CashPaymentFlow, CashState
+from corda_tpu.node import rpc
+from corda_tpu.node.services import DataFeed
+from corda_tpu.node.vault_query import (
+    FungibleAssetQueryCriteria,
+    VaultQueryCriteria,
+)
+from corda_tpu.testing.mock_network import MockNetwork
+
+
+@pytest.fixture
+def net():
+    net = MockNetwork(seed=11)
+    notary = net.create_notary("Notary")
+    alice = net.create_node("Alice")
+    bob = net.create_node("Bob")
+    return net, notary, alice, bob
+
+
+def rpc_pair(net, node, client_name, users=None, username="admin", password="pw"):
+    """Wire an RPCServer on `node` and a client endpoint on the fabric."""
+    user_service = rpc.RPCUserService(
+        *(users or [rpc.RpcUser("admin", "pw", ("ALL",))])
+    )
+    ops = rpc.CordaRPCOpsImpl(node.services, node.smm)
+    server = rpc.RPCServer(ops, node.messaging, user_service)
+    client_ep = net.fabric.endpoint(client_name)
+    client = rpc.RPCClient(client_ep, node.name, username, password)
+    return server, client
+
+
+def test_simple_calls(net):
+    network, notary, alice, bob = net
+    server, client = rpc_pair(network, alice, "cli")
+
+    fut = client.node_identity()
+    fut2 = client.current_node_time()
+    fut3 = client.notary_identities()
+    network.run()
+    assert fut.get().legal_identity == alice.party
+    assert fut2.get() == network.clock.now_micros()
+    assert list(fut3.get()) == [notary.party]
+
+
+def test_bad_credentials_rejected(net):
+    network, notary, alice, bob = net
+    server, client = rpc_pair(network, alice, "cli", password="wrong")
+    fut = client.node_identity()
+    network.run()
+    with pytest.raises(rpc.RpcError, match="bad password"):
+        fut.get()
+
+
+def test_unknown_method_rejected(net):
+    network, notary, alice, bob = net
+    server, client = rpc_pair(network, alice, "cli")
+    fut = client.call("record_transactions", ())
+    network.run()
+    with pytest.raises(rpc.RpcError, match="no such RPC method"):
+        fut.get()
+
+
+def test_start_flow_and_result(net):
+    network, notary, alice, bob = net
+    server, client = rpc_pair(network, alice, "cli")
+
+    fut = client.start_flow(CashIssueFlow(500, "USD", alice.party, notary.party))
+    network.run()
+    handle = fut.get()
+    assert isinstance(handle, rpc.FlowHandle)
+    stx = handle.result.get()
+    assert stx is not None
+    # the cash landed
+    q = client.vault_query_by(VaultQueryCriteria())
+    network.run()
+    page = q.get()
+    assert page.total_states_available == 1
+
+
+def test_start_flow_permission_denied(net):
+    network, notary, alice, bob = net
+    users = [rpc.RpcUser("limited", "pw", ())]   # no StartFlow permission
+    server, client = rpc_pair(
+        network, alice, "cli", users=users, username="limited"
+    )
+    fut = client.start_flow(CashIssueFlow(500, "USD", alice.party, notary.party))
+    network.run()
+    with pytest.raises(rpc.RpcError, match="may not start"):
+        fut.get()
+
+
+def test_start_flow_named_permission(net):
+    network, notary, alice, bob = net
+    users = [
+        rpc.RpcUser(
+            "issuer", "pw", (rpc.start_flow_permission(CashIssueFlow),)
+        )
+    ]
+    server, client = rpc_pair(network, alice, "cli", users=users, username="issuer")
+    fut = client.start_flow(CashIssueFlow(100, "USD", alice.party, notary.party))
+    network.run()
+    assert fut.get().result.get() is not None
+    # but payment flow is not permitted
+    fut2 = client.start_flow(CashPaymentFlow(50, "USD", bob.party))
+    network.run()
+    with pytest.raises(rpc.RpcError, match="may not start"):
+        fut2.get()
+
+
+def test_vault_track_feed_streams_updates(net):
+    network, notary, alice, bob = net
+    server, client = rpc_pair(network, alice, "cli")
+
+    feed_fut = client.vault_track_by(
+        FungibleAssetQueryCriteria(product="USD")
+    )
+    network.run()
+    feed = feed_fut.get()
+    assert isinstance(feed, DataFeed)
+    assert feed.snapshot.total_states_available == 0
+
+    seen = []
+    feed.updates.subscribe(seen.append)
+    client.start_flow(CashIssueFlow(750, "USD", alice.party, notary.party))
+    network.run()
+    assert len(seen) == 1
+    update = seen[0]
+    assert update.produced[0].state.data.amount.quantity == 750
+
+    # unsubscribe stops the stream
+    feed.close()
+    client.start_flow(CashIssueFlow(10, "USD", alice.party, notary.party))
+    network.run()
+    assert len(seen) == 1
+    assert server.subscription_count == 0
+
+
+def test_state_machines_feed(net):
+    network, notary, alice, bob = net
+    server, client = rpc_pair(network, alice, "cli")
+    feed_fut = client.state_machines_feed()
+    network.run()
+    feed = feed_fut.get()
+    events = []
+    feed.updates.subscribe(events.append)
+    client.start_flow(CashIssueFlow(100, "USD", alice.party, notary.party))
+    network.run()
+    kinds = [e.kind for e in events]
+    assert "added" in kinds and "removed" in kinds
+    tags = {e.info.flow_tag for e in events}
+    assert any("CashIssueFlow" in t for t in tags)
+
+
+def test_network_map_feed(net):
+    network, notary, alice, bob = net
+    server, client = rpc_pair(network, alice, "cli")
+    snap_fut = client.network_map_snapshot()
+    feed_fut = client.network_map_feed()
+    network.run()
+    assert {n.legal_identity.name for n in snap_fut.get()} == {
+        "Notary", "Alice", "Bob",
+    }
+    feed = feed_fut.get()
+    changes = []
+    feed.updates.subscribe(changes.append)
+    carol = network.create_node("Carol")
+    network.run()
+    assert any(
+        c.kind == "added" and c.info.legal_identity.name == "Carol"
+        for c in changes
+    )
+    # removals stream too (or clients route to dead addresses forever)
+    alice.services.network_map_cache.remove_node(carol.info)
+    network.run()
+    assert any(
+        c.kind == "removed" and c.info.legal_identity.name == "Carol"
+        for c in changes
+    )
+
+
+def test_attachments_over_rpc(net):
+    network, notary, alice, bob = net
+    server, client = rpc_pair(network, alice, "cli")
+    data = b"jar bytes here"
+    up = client.upload_attachment(data)
+    network.run()
+    att_id = up.get()
+    ex = client.attachment_exists(att_id)
+    opened = client.open_attachment(att_id)
+    network.run()
+    assert ex.get() is True
+    assert opened.get() == data
+
+
+def test_flow_error_propagates(net):
+    network, notary, alice, bob = net
+    server, client = rpc_pair(network, alice, "cli")
+    # pay with an empty vault -> InsufficientBalanceError inside the flow
+    fut = client.start_flow(CashPaymentFlow(999, "USD", bob.party))
+    network.run()
+    handle = fut.get()
+    with pytest.raises(rpc.RpcError):
+        handle.result.get()
+
+
+def test_close_client_reaps_subscriptions(net):
+    network, notary, alice, bob = net
+    server, client = rpc_pair(network, alice, "cli")
+    f1 = client.vault_track_by(VaultQueryCriteria())
+    f2 = client.state_machines_feed()
+    network.run()
+    f1.get(), f2.get()
+    assert server.subscription_count == 2
+    server.close_client("cli")
+    assert server.subscription_count == 0
+    # vault updates no longer reach the dead client
+    assert alice.services.vault.updates == [] or all(
+        cb.__qualname__.find("forward") == -1
+        for cb in alice.services.vault.updates
+    )
+
+
+def test_stranger_replies_ignored(net):
+    """A peer spoofing rpc.replies cannot resolve a client's pending
+    call with forged data."""
+    from corda_tpu.core import serialization as ser
+
+    network, notary, alice, bob = net
+    server, client = rpc_pair(network, alice, "cli")
+    fut = client.node_identity()
+    mallory = network.fabric.endpoint("Mallory")
+    mallory.send(
+        rpc.TOPIC_RPC_REPLY,
+        ser.encode(rpc.RpcReply(1, True, "forged", None, None)),
+        "cli",
+    )
+    network.run()
+    # the genuine reply (from Alice) wins; the forged one was dropped
+    assert fut.get().legal_identity == alice.party
+
+
+def test_garbage_request_does_not_crash_server(net):
+    """Malformed rpc.requests payloads are dropped; later calls work."""
+    network, notary, alice, bob = net
+    server, client = rpc_pair(network, alice, "cli")
+    mallory = network.fabric.endpoint("m2")
+    mallory.send(rpc.TOPIC_RPC_REQUEST, b"\x99\x99", "Alice")
+    network.run()   # must not raise
+    fut = client.current_node_time()
+    network.run()
+    assert fut.get() > 0
+
+
+def test_invalid_argument_decode_does_not_crash_server(net):
+    """Args whose validation raises during decode (Sort.__post_init__)
+    drop the request instead of killing the pump."""
+    from corda_tpu.core import serialization as ser
+    from corda_tpu.node.vault_query import Sort, VaultQueryCriteria
+
+    network, notary, alice, bob = net
+    server, client = rpc_pair(network, alice, "cli")
+    # hand-craft a payload whose Sort column is invalid: encode a valid
+    # request, then corrupt the column string bytes
+    good = rpc.RpcRequest(
+        1, "admin", "pw", "vault_query_by",
+        (VaultQueryCriteria(), None, Sort("quantity")),
+    )
+    raw = ser.encode(good).replace(b"quantity", b"quantitX")
+    network.fabric.endpoint("m3").send(rpc.TOPIC_RPC_REQUEST, raw, "Alice")
+    network.run()   # must not raise
+    fut = client.current_node_time()
+    network.run()
+    assert fut.get() > 0
