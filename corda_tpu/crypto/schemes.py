@@ -150,6 +150,31 @@ def generate_keypair(scheme_id: int = DEFAULT_SCHEME, seed: Optional[int] = None
     raise UnsupportedScheme(f"scheme {scheme_id}")
 
 
+def keypair_from_private(scheme_id: int, data: bytes) -> KeyPair:
+    """Rebuild a KeyPair from its scheme-native private encoding (node
+    identity reload across restarts — the reference reads the node CA
+    keystore, KeyStoreUtilities.kt)."""
+    if scheme_id in _WCURVE:
+        curve = _WCURVE[scheme_id]
+        d = int.from_bytes(data, "big")
+        pt = refmath.wei_mul(curve, d, (curve.gx, curve.gy))
+        pub = PublicKey(scheme_id, encodings.encode_sec1_point(*pt))
+        return KeyPair(PrivateKey(scheme_id, data, pub), pub)
+    if scheme_id == EDDSA_ED25519_SHA512:
+        sk = ced.Ed25519PrivateKey.from_private_bytes(data)
+        pub = PublicKey(scheme_id, sk.public_key().public_bytes_raw())
+        return KeyPair(PrivateKey(scheme_id, data, pub), pub)
+    if scheme_id == RSA_SHA256:
+        sk = serialization.load_der_private_key(data, password=None)
+        pub_der = sk.public_key().public_bytes(
+            serialization.Encoding.DER,
+            serialization.PublicFormat.SubjectPublicKeyInfo,
+        )
+        pub = PublicKey(scheme_id, pub_der)
+        return KeyPair(PrivateKey(scheme_id, data, pub), pub)
+    raise UnsupportedScheme(f"scheme {scheme_id}")
+
+
 def sign(priv: PrivateKey, message: bytes) -> bytes:
     """Host-side signing; signature formats match the verify kernels."""
     sid = priv.scheme_id

@@ -1,0 +1,63 @@
+"""CLI entry point: `python -m corda_tpu.node --config node.toml`.
+
+Reference: NodeStartup.main (node/.../internal/NodeStartup.kt:44-99) —
+banner, config load, logging init, node.start() + run().
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+
+from .config import ConfigError, load_config
+from .node import Node, banner
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="corda_tpu.node", description="Run a corda_tpu node"
+    )
+    parser.add_argument("--config", required=True, help="path to node.toml")
+    parser.add_argument(
+        "--log-level", default="INFO",
+        choices=["DEBUG", "INFO", "WARNING", "ERROR"],
+    )
+    parser.add_argument(
+        "--print-port", action="store_true",
+        help="print the bound p2p port on stdout after start (driver handshake)",
+    )
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=args.log_level,
+        format="%(asctime)s %(levelname)-7s %(name)s - %(message)s",
+    )
+    try:
+        config = load_config(args.config)
+    except (ConfigError, OSError) as e:
+        print(f"bad config: {e}", file=sys.stderr)
+        return 1
+
+    print(banner(config))
+    node = Node(config).start()
+
+    def shutdown(signum, frame):
+        node.running = False
+
+    # handlers must be live before the port is announced: the driver
+    # may signal the instant it reads the line
+    signal.signal(signal.SIGTERM, shutdown)
+    signal.signal(signal.SIGINT, shutdown)
+    if args.print_port:
+        print(f"P2P_PORT={node.messaging.listen_port}", flush=True)
+    try:
+        node.run()
+    finally:
+        node.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,197 @@
+"""Node configuration: typed schema + TOML loading.
+
+Reference: `NodeConfiguration` (node/.../config/NodeConfiguration.kt:
+21-101) bound reflectively from HOCON files (node-api/.../config/
+ConfigUtilities.kt `parseAs`), with `reference.conf` defaults and
+per-node `node.conf`. Here the schema is a dataclass, the file format
+is TOML (stdlib tomllib — no HOCON in Python), and unknown keys are
+rejected the way the reference's strict binding is.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..crypto import schemes
+
+_SCHEME_NAMES = {
+    "rsa": schemes.RSA_SHA256,
+    "secp256k1": schemes.ECDSA_SECP256K1_SHA256,
+    "secp256r1": schemes.ECDSA_SECP256R1_SHA256,
+    "ed25519": schemes.EDDSA_ED25519_SHA512,
+}
+
+NOTARY_KINDS = ("", "simple", "validating", "raft", "raft-validating", "bft")
+VERIFIER_TYPES = ("in_memory", "out_of_process")
+
+
+class ConfigError(Exception):
+    pass
+
+
+@dataclass(frozen=True)
+class RpcUserConfig:
+    """One RPC login (NodeConfiguration.kt rpcUsers)."""
+
+    username: str
+    password: str
+    permissions: tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class NodeConfig:
+    """The full node configuration (NodeConfiguration.kt:21-101).
+
+    `name` doubles as the node's fabric peer name; `network_map_peer`
+    names the directory node (empty = this node hosts the map, the
+    reference's NetworkMapService advertisement); `notary` selects the
+    service flavour installed at boot (AbstractNode.kt:635-643).
+    """
+
+    name: str
+    base_dir: str
+    p2p_host: str = "127.0.0.1"
+    p2p_port: int = 0                       # 0 = ephemeral (dev/driver)
+    network_map_peer: str = ""
+    network_map_host: str = ""
+    network_map_port: int = 0
+    network_map_fingerprint: Optional[bytes] = None
+    notary: str = ""
+    verifier_type: str = "in_memory"
+    dev_mode: bool = True
+    key_seed: int = 0                       # dev: deterministic identity
+    scheme: str = "ed25519"
+    use_tls: bool = True
+    rpc_users: tuple[RpcUserConfig, ...] = field(default_factory=tuple)
+    # notary cluster membership (raft/bft): peer names of all members
+    cluster_peers: tuple[str, ...] = ()
+    # CorDapp modules imported at boot: registers contract/state classes
+    # with the codec and @initiated_by responders (the reference's
+    # CorDapp classpath scan, AbstractNode.kt:427)
+    cordapps: tuple[str, ...] = ("corda_tpu.finance.cash",)
+
+    def __post_init__(self):
+        if not self.name:
+            raise ConfigError("node.name is required")
+        if self.notary not in NOTARY_KINDS:
+            raise ConfigError(
+                f"unknown notary kind {self.notary!r}; one of {NOTARY_KINDS}"
+            )
+        if self.verifier_type not in VERIFIER_TYPES:
+            raise ConfigError(
+                f"unknown verifier_type {self.verifier_type!r}"
+            )
+        if self.scheme not in _SCHEME_NAMES:
+            raise ConfigError(
+                f"unknown scheme {self.scheme!r}; one of {sorted(_SCHEME_NAMES)}"
+            )
+
+    @property
+    def scheme_id(self) -> int:
+        return _SCHEME_NAMES[self.scheme]
+
+    @property
+    def is_network_map_host(self) -> bool:
+        return self.network_map_peer == ""
+
+
+def load_config(path: str) -> NodeConfig:
+    """Parse a TOML node config; strict about unknown keys (typos in a
+    config must fail loudly at boot, not silently default)."""
+    import tomllib
+
+    with open(path, "rb") as f:
+        raw = tomllib.load(f)
+    return config_from_dict(raw)
+
+
+def config_from_dict(raw: dict) -> NodeConfig:
+    node = dict(raw.get("node", {}))
+    rpc = dict(raw.get("rpc", {}))
+    extra_sections = set(raw) - {"node", "rpc"}
+    if extra_sections:
+        raise ConfigError(f"unknown config sections {sorted(extra_sections)}")
+
+    users = []
+    for u in rpc.pop("users", []):
+        unknown = set(u) - {"username", "password", "permissions"}
+        if unknown:
+            raise ConfigError(f"unknown rpc.users keys {sorted(unknown)}")
+        users.append(
+            RpcUserConfig(
+                u["username"], u["password"], tuple(u.get("permissions", ()))
+            )
+        )
+    if rpc:
+        raise ConfigError(f"unknown rpc keys {sorted(rpc)}")
+
+    fp = node.pop("network_map_fingerprint", None)
+    if isinstance(fp, str):
+        fp = bytes.fromhex(fp)
+    known = {f.name for f in dataclasses.fields(NodeConfig)} - {
+        "rpc_users", "network_map_fingerprint",
+    }
+    unknown = set(node) - known
+    if unknown:
+        raise ConfigError(f"unknown node keys {sorted(unknown)}")
+    for key in ("cluster_peers", "cordapps"):
+        if key in node:
+            node[key] = tuple(node[key])
+    try:
+        return NodeConfig(
+            rpc_users=tuple(users), network_map_fingerprint=fp, **node
+        )
+    except TypeError as e:
+        raise ConfigError(str(e))
+
+
+def write_config(cfg: NodeConfig, path: str) -> None:
+    """Emit a TOML file for `cfg` (the cordformation role: the driver
+    and demos generate per-node configs — Cordform.groovy)."""
+    import json
+
+    lines = ["[node]"]
+
+    def quote(s: str) -> str:
+        # JSON string escaping is valid TOML basic-string escaping
+        return json.dumps(str(s))
+
+    def emit(key, value):
+        if isinstance(value, bool):
+            lines.append(f"{key} = {'true' if value else 'false'}")
+        elif isinstance(value, int):
+            lines.append(f"{key} = {value}")
+        else:
+            lines.append(f"{key} = {quote(value)}")
+
+    emit("name", cfg.name)
+    emit("base_dir", cfg.base_dir)
+    emit("p2p_host", cfg.p2p_host)
+    emit("p2p_port", cfg.p2p_port)
+    emit("network_map_peer", cfg.network_map_peer)
+    emit("network_map_host", cfg.network_map_host)
+    emit("network_map_port", cfg.network_map_port)
+    if cfg.network_map_fingerprint is not None:
+        emit("network_map_fingerprint", cfg.network_map_fingerprint.hex())
+    emit("notary", cfg.notary)
+    emit("verifier_type", cfg.verifier_type)
+    emit("dev_mode", cfg.dev_mode)
+    emit("key_seed", cfg.key_seed)
+    emit("scheme", cfg.scheme)
+    emit("use_tls", cfg.use_tls)
+    if cfg.cluster_peers:
+        peers = ", ".join(quote(p) for p in cfg.cluster_peers)
+        lines.append(f"cluster_peers = [{peers}]")
+    apps = ", ".join(quote(a) for a in cfg.cordapps)
+    lines.append(f"cordapps = [{apps}]")
+    for u in cfg.rpc_users:
+        lines.append("")
+        lines.append("[[rpc.users]]")
+        emit("username", u.username)
+        emit("password", u.password)
+        perms = ", ".join(quote(p) for p in u.permissions)
+        lines.append(f"permissions = [{perms}]")
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")

@@ -213,6 +213,7 @@ class FabricEndpoint(MessagingService):
         host: str = "127.0.0.1",
         port: int = 0,
         tls: Optional[TlsIdentity] = None,
+        advertise_host: Optional[str] = None,
     ):
         self._name = name
         self._keypair = keypair
@@ -221,6 +222,9 @@ class FabricEndpoint(MessagingService):
         self._host = host
         self._port = port
         self._tls = tls
+        # the address peers should dial back (differs from the bind
+        # host behind NAT or when bound to 0.0.0.0)
+        self.advertise_host = advertise_host or host
         db.execute_script(_FABRIC_SCHEMA)
         self._handlers: dict[str, list[Handler]] = {}
         self._loop: Optional[asyncio.AbstractEventLoop] = None
@@ -230,6 +234,11 @@ class FabricEndpoint(MessagingService):
         self._pump_wake = threading.Event()
         self._parked: deque = deque()   # undispatchable (no handler yet)
         self.running = False
+        # peers that advertised their listen address at auth time
+        # (ad-hoc clients: RPC consoles, verifier workers); consulted
+        # after the injected resolver
+        self.learned_peers: dict[str, PeerAddress] = {}
+        self.advertise_listen_port = True
         self._arrival_counter = self._load_arrival_counter()
 
     # -- MessagingService ---------------------------------------------------
@@ -376,7 +385,7 @@ class FabricEndpoint(MessagingService):
                     await asyncio.wait_for(wake.wait(), timeout=30)
                 except asyncio.TimeoutError:
                     continue
-            addr = self._resolve(peer)
+            addr = self._resolve(peer) or self.learned_peers.get(peer)
             if addr is None:
                 await asyncio.sleep(min(backoff, 5.0))
                 backoff = min(backoff * 2, 5.0)
@@ -461,6 +470,9 @@ class FabricEndpoint(MessagingService):
                 self._keypair.public.scheme_id,
                 self._keypair.public.data,
                 sig,
+                self.advertise_host,
+                self._port if self.advertise_listen_port else 0,
+                self._tls.fingerprint if self._tls else b"",
             ],
         )
         await writer.drain()
@@ -507,9 +519,9 @@ class FabricEndpoint(MessagingService):
         _write_frame(writer, ["challenge", nonce])
         await writer.drain()
         frame = await asyncio.wait_for(_read_frame(reader), timeout=10)
-        if frame[0] != "auth" or len(frame) != 5:
+        if frame[0] != "auth" or len(frame) not in (5, 8):
             raise ConnectionError("bad auth frame")
-        _, name, scheme_id, key_data, sig = frame
+        name, scheme_id, key_data, sig = frame[1:5]
         pub = schemes.PublicKey(scheme_id, bytes(key_data))
         if not schemes.verify_one(pub, bytes(sig), b"fabric-auth" + nonce):
             _write_frame(writer, ["reject", "bad signature"])
@@ -518,6 +530,18 @@ class FabricEndpoint(MessagingService):
         if expected is not None and expected != pub:
             _write_frame(writer, ["reject", "identity key mismatch"])
             raise ConnectionError("auth key does not match network map")
+        if len(frame) == 8 and frame[6]:
+            # the peer advertised its own dial-back address + TLS pin
+            # (RPC consoles and verifier workers are reachable but not
+            # map-registered; the node learns the return route at auth
+            # time). Only honoured for names the map does not govern: a
+            # map-known name must route via its registered NodeInfo, or
+            # any key-holder could redirect it.
+            if expected is None:
+                fp = bytes(frame[7]) or None
+                self.learned_peers[name] = PeerAddress(
+                    str(frame[5]), int(frame[6]), fp
+                )
         _write_frame(writer, ["ok"])
         await writer.drain()
         return name

@@ -138,9 +138,14 @@ class NetworkMapService:
     PersistentNetworkMapService's JDBC-backed registration map.
     """
 
-    def __init__(self, messaging: MessagingService, clock, db=None):
+    def __init__(self, messaging: MessagingService, clock, db=None, services=None):
+        """`services`: the hosting node's ServiceHub — accepted
+        registrations mirror into its own NetworkMapCache/IdentityService
+        so the host can route back to registrants (the reference's map
+        node shares the node's cache the same way)."""
         self._messaging = messaging
         self._clock = clock
+        self._services = services
         self._registry: dict[str, WireNodeRegistration] = {}
         # Replay + hijack protection. The latest registration per name is
         # persisted even for REMOVE (a tombstone), so neither the serial
@@ -169,6 +174,7 @@ class NetworkMapService:
                 )
                 if reg.op == ADD:
                     self._registry[name] = wire
+                    self._mirror(reg)
             stored_version = self._meta.get(b"version")
             if stored_version is not None:
                 self._version = ser.decode(stored_version)
@@ -237,7 +243,18 @@ class NetworkMapService:
         self._version += 1
         if self._meta is not None:
             self._meta.put(b"version", ser.encode(self._version))
+        self._mirror(reg)
         self._push(wire)
+
+    def _mirror(self, reg: NodeRegistration) -> None:
+        """Reflect an accepted registration into the host's own cache."""
+        if self._services is None:
+            return
+        if reg.op == ADD:
+            self._services.network_map_cache.add_node(reg.info)
+            self._services.identity.register(reg.info.legal_identity)
+        else:
+            self._services.network_map_cache.remove_node(reg.info)
 
     def _push(self, wire: WireNodeRegistration) -> None:
         update = ser.encode(MapUpdate(wire, self._version))

@@ -1,0 +1,369 @@
+"""Node assembly & lifecycle: the real node over the DCN fabric.
+
+Reference: `AbstractNode.start()` boot ordering (node/.../internal/
+AbstractNode.kt:163-222 — database, services, messaging, notary, SMM,
+scheduler, network-map registration) and `Node` (Node.kt:125-344 —
+embedded broker, RPC server start, the message pump `run()` loop);
+CLI entry `NodeStartup` (NodeStartup.kt:44-99).
+
+TPU-first differences: the "broker" is the node's own durable fabric
+endpoint (fabric.py) — there is no separate broker process; signature
+verification drains into the TPU batch SPI (in-process or via the
+out-of-process verifier pool, NodeConfiguration.verifierType); the pump
+loop is the single server thread every service runs on
+(AffinityExecutor.kt role).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+from typing import Optional
+
+from ..crypto import schemes
+from ..crypto.batch_verifier import BatchSignatureVerifier
+from ..flows.statemachine import StateMachineManager
+from . import network_map as nm
+from . import rpc as rpclib
+from .config import NodeConfig
+from .fabric import FabricEndpoint, PeerAddress, TlsIdentity
+from .notary import (
+    InMemoryUniquenessProvider,
+    SimpleNotaryService,
+    ValidatingNotaryService,
+)
+from .persistence import (
+    NodeDatabase,
+    PersistentKVStore,
+    PersistentServiceHub,
+    PersistentUniquenessProvider,
+)
+from .scheduler import NodeSchedulerService
+from .services import (
+    Clock,
+    IdentityService,
+    NodeInfo,
+    SERVICE_NETWORK_MAP,
+    SERVICE_NOTARY,
+    SERVICE_NOTARY_VALIDATING,
+)
+
+
+class Node:
+    """One production node process (reference: Node.kt).
+
+    Lifecycle: `Node(config).start()` boots everything and registers
+    with the network map; `run()` enters the pump loop (blocks);
+    `stop()` shuts down. `rpc_client(...)` builds a loopback client for
+    embedded use (tests, the shell).
+    """
+
+    def __init__(
+        self,
+        config: NodeConfig,
+        clock: Optional[Clock] = None,
+        batch_verifier: Optional[BatchSignatureVerifier] = None,
+    ):
+        self.config = config
+        # CorDapps first: their import registers states/commands with
+        # the canonical codec (decoding a peer's transaction needs the
+        # classes) and @initiated_by responders with the flow registry
+        # (reference: CorDapp scan before SMM start, AbstractNode.kt:427)
+        import importlib
+
+        for module in config.cordapps:
+            importlib.import_module(module)
+        os.makedirs(config.base_dir, exist_ok=True)
+        self.db = NodeDatabase(os.path.join(config.base_dir, "node.db"))
+
+        # -- identity (persisted across restarts; AbstractNode obtains
+        # it from the node CA keystore, KeyStoreUtilities.kt) ---------
+        self.keypair = self._load_or_create_identity()
+        from ..core.identity import Party
+
+        self.party = Party(config.name, self.keypair.public)
+
+        # -- TLS channel identity (self-signed; pinned via network map)
+        self.tls = self._load_or_create_tls() if config.use_tls else None
+
+        advertised: tuple[str, ...] = ()
+        if config.notary in ("simple", "raft"):
+            advertised = (SERVICE_NOTARY,)
+        elif config.notary in ("validating", "raft-validating", "bft"):
+            advertised = (SERVICE_NOTARY_VALIDATING,)
+        if config.is_network_map_host:
+            advertised = advertised + (SERVICE_NETWORK_MAP,)
+
+        self.info = NodeInfo(
+            address=config.name,
+            legal_identity=self.party,
+            advertised_services=advertised,
+            host=config.p2p_host,
+            port=0,   # patched after the fabric binds (ephemeral ports)
+            tls_fingerprint=self.tls.fingerprint if self.tls else None,
+        )
+
+        # -- services over one shared database -------------------------
+        self.services = PersistentServiceHub.open(
+            "",   # path unused: db is shared
+            self.info,
+            IdentityService(self.party),
+            self.keypair,
+            clock=clock,
+            batch_verifier=batch_verifier,
+            rng=random.Random(self._dev_seed("kms")),
+            db=self.db,
+        )
+
+        # -- fabric endpoint -------------------------------------------
+        self.messaging = FabricEndpoint(
+            config.name,
+            self.keypair,
+            self.db,
+            resolve=self._resolve_peer,
+            host=config.p2p_host,
+            port=config.p2p_port,
+            tls=self.tls,
+        )
+        # inbound connections claiming a map-registered name must prove
+        # they hold that identity's key (fabric.py _auth_server); without
+        # this, any peer could claim "Bob" and inject session messages
+        self.messaging.expected_identity_key = self._expected_identity_key
+
+        # -- network map (host or client) ------------------------------
+        self.network_map_service: Optional[nm.NetworkMapService] = None
+        self.network_map_client: Optional[nm.NetworkMapClient] = None
+        if config.is_network_map_host:
+            self.network_map_service = nm.NetworkMapService(
+                self.messaging,
+                self.services.clock,
+                db=self.db,
+                services=self.services,
+            )
+        else:
+            self.network_map_client = nm.NetworkMapClient(
+                self.services,
+                self.messaging,
+                config.network_map_peer,
+                self.keypair.private,
+            )
+
+        # -- flows, notary, scheduler ----------------------------------
+        self.smm = StateMachineManager(
+            self.services, self.messaging,
+            rng=random.Random(self._dev_seed("smm")),
+        )
+        self._install_notary()
+        self.scheduler = NodeSchedulerService(self.services, self.smm.start_flow)
+
+        # -- verifier offload ------------------------------------------
+        self.verifier_service = None
+        if config.verifier_type == "out_of_process":
+            from ..utils.metrics import MetricRegistry
+            from .verifier import OutOfProcessTransactionVerifierService
+
+            self.metrics = MetricRegistry()
+            self.verifier_service = OutOfProcessTransactionVerifierService(
+                self.messaging,
+                metrics=self.metrics,
+                register_peer=self._register_worker_peer,
+            )
+            self.services.transaction_verifier = self.verifier_service
+
+        # -- RPC --------------------------------------------------------
+        users = [
+            rpclib.RpcUser(u.username, u.password, tuple(u.permissions))
+            for u in config.rpc_users
+        ]
+        self.rpc_ops = rpclib.CordaRPCOpsImpl(self.services, self.smm)
+        self.rpc_server = rpclib.RPCServer(
+            self.rpc_ops,
+            self.messaging,
+            rpclib.RPCUserService(*users),
+            client_backlog=self._peer_backlog,
+        )
+
+        self._worker_peers: dict[str, PeerAddress] = {}
+        self.running = False
+
+    def _dev_seed(self, purpose: str):
+        """Deterministic per-(node, purpose) RNG seed in dev mode, None
+        (OS entropy) otherwise. The node name is mixed in: two dev nodes
+        must never share a fresh-key stream, or each would hold the
+        other's 'anonymous' private keys."""
+        if not self.config.dev_mode:
+            return None
+        import hashlib
+
+        material = f"{self.config.name}:{self.config.key_seed}:{purpose}"
+        return int.from_bytes(
+            hashlib.sha256(material.encode()).digest()[:8], "big"
+        )
+
+    # -- identity persistence ------------------------------------------------
+
+    def _load_or_create_identity(self) -> schemes.KeyPair:
+        store = PersistentKVStore(self.db, "node_identity")
+        blob = store.get(b"private")
+        if blob is not None:
+            scheme_id = int.from_bytes(blob[:4], "big")
+            return schemes.keypair_from_private(scheme_id, blob[4:])
+        cfg = self.config
+        seed = self._dev_seed("identity") if cfg.key_seed else None
+        kp = schemes.generate_keypair(cfg.scheme_id, seed=seed)
+        store.put(
+            b"private",
+            kp.private.scheme_id.to_bytes(4, "big") + kp.private.data,
+        )
+        return kp
+
+    def _load_or_create_tls(self) -> TlsIdentity:
+        store = PersistentKVStore(self.db, "node_tls")
+        cert, key = store.get(b"cert"), store.get(b"key")
+        if cert is not None and key is not None:
+            return TlsIdentity(bytes(cert), bytes(key))
+        tls = TlsIdentity.generate(self.config.name)
+        store.put(b"cert", tls.cert_pem)
+        store.put(b"key", tls.key_pem)
+        return tls
+
+    # -- peer resolution -----------------------------------------------------
+
+    def _resolve_peer(self, peer: str) -> Optional[PeerAddress]:
+        """Fabric bridge target lookup: network map first (host, port,
+        pinned fingerprint travel in NodeInfo), then ad-hoc worker
+        registrations, then the statically-configured map host."""
+        info = self.services.network_map_cache.node_by_name(peer)
+        if info is not None and info.host is not None and info.port:
+            return PeerAddress(info.host, info.port, info.tls_fingerprint)
+        if peer in self._worker_peers:
+            return self._worker_peers[peer]
+        cfg = self.config
+        if peer == cfg.network_map_peer and cfg.network_map_host:
+            return PeerAddress(
+                cfg.network_map_host,
+                cfg.network_map_port,
+                cfg.network_map_fingerprint,
+            )
+        return None
+
+    def _register_worker_peer(self, name: str, host: str, port: int) -> None:
+        self._worker_peers[name] = PeerAddress(host, port)
+
+    def _expected_identity_key(self, peer: str):
+        info = self.services.network_map_cache.node_by_name(peer)
+        return None if info is None else info.legal_identity.owning_key
+
+    def _peer_backlog(self, peer: str) -> int:
+        """Outbound journal depth for one peer — the RPC server's
+        dead-client detector."""
+        rows = self.db.query(
+            "SELECT COUNT(*) FROM fabric_out WHERE peer=?", (peer,)
+        )
+        return rows[0][0]
+
+    # -- notary ---------------------------------------------------------------
+
+    def _install_notary(self) -> None:
+        kind = self.config.notary
+        if kind == "":
+            return
+        if kind in ("simple", "validating"):
+            uniqueness = PersistentUniquenessProvider(self.db)
+            cls = (
+                SimpleNotaryService if kind == "simple"
+                else ValidatingNotaryService
+            )
+            self.services.notary_service = cls(self.services, uniqueness)
+            return
+        raise NotImplementedError(
+            f"notary kind {kind!r} lands with the distributed notary phase"
+        )
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> "Node":
+        self.messaging.start()
+        # the fabric bound its listen port; advertise the real one
+        self.info = NodeInfo(
+            self.info.address,
+            self.info.legal_identity,
+            self.info.advertised_services,
+            host=self.info.host,
+            port=self.messaging.listen_port,
+            tls_fingerprint=self.info.tls_fingerprint,
+        )
+        self.services.my_info = self.info
+        self.services.network_map_cache.add_node(self.info)
+        self.services.identity.register(self.party)
+        if self.network_map_client is not None:
+            self.network_map_client.register()
+            self.network_map_client.fetch(subscribe=True)
+        if self.network_map_service is not None:
+            # the map host publishes its own NodeInfo so clients learn
+            # its identity (and, when it doubles as a notary, that too)
+            reg = nm.NodeRegistration(
+                info=self.info,
+                serial=self.services.clock.now_micros(),
+                op=nm.ADD,
+                expires_micros=self.services.clock.now_micros()
+                + nm.NetworkMapClient.DEFAULT_TTL_MICROS,
+            )
+            try:
+                self.network_map_service._process_registration(
+                    nm.sign_registration(reg, self.keypair.private)
+                )
+            except ValueError:
+                pass   # restart within one clock microsecond: already registered
+        restored = self.smm.restore_checkpoints()
+        if restored:
+            import logging
+
+            logging.getLogger("corda_tpu.node").info(
+                "restored %d checkpointed flows", restored
+            )
+        self.running = True
+        return self
+
+    def run(self) -> None:
+        """The pump loop — the single server thread (Node.kt:344)."""
+        while self.running:
+            self.messaging.pump(block=True, timeout=0.2)
+            self.scheduler.tick()
+
+    def pump(self, timeout: float = 0.0) -> int:
+        """One pump step (embedded/driver use)."""
+        n = self.messaging.pump(block=timeout > 0, timeout=timeout)
+        self.scheduler.tick()
+        return n
+
+    def stop(self) -> None:
+        if not self.running:
+            return
+        self.running = False
+        self.scheduler.stop()
+        self.smm.stop()
+        self.messaging.stop()
+        self.db.close()
+
+    # -- conveniences ---------------------------------------------------------
+
+    def rpc_client(self, username: str, password: str) -> rpclib.RPCClient:
+        """Loopback RPC client on this node's own endpoint (the shell's
+        connection — InteractiveShell talks to the node the same way a
+        remote client does)."""
+        return rpclib.RPCClient(
+            self.messaging, self.config.name, username, password
+        )
+
+
+def banner(config: NodeConfig) -> str:
+    return (
+        "\n   ______               __         ______ ___  __  __\n"
+        "  / ____/___  _________/ /___ _   /_  __// _ \\/ / / /\n"
+        " / /   / __ \\/ ___/ __  / __ `/    / /  / ___/ /_/ /\n"
+        "/ /___/ /_/ / /  / /_/ / /_/ /    / /  / /  / __  /\n"
+        "\\____/\\____/_/   \\__,_/\\__,_/    /_/  /_/  /_/ /_/\n\n"
+        f"  node: {config.name}   notary: {config.notary or 'none'}   "
+        f"map: {'host' if config.is_network_map_host else config.network_map_peer}\n"
+    )

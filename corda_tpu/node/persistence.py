@@ -149,8 +149,11 @@ class NodeDatabase:
 
     def close(self) -> None:
         with self._lock:
+            if self._conn is None:
+                return   # idempotent: teardown paths overlap
             self._conn.commit()
             self._conn.close()
+            self._conn = None
 
 
 class _DbTx:
@@ -471,10 +474,15 @@ class PersistentServiceHub:
         clock=None,
         batch_verifier=None,
         rng=None,
+        db=None,
     ):
+        """Pass `db` to share one NodeDatabase with other subsystems
+        (the fabric journals live in the same file, so one sqlite tx
+        can span a handler's effects and its message acks)."""
         from .services import ServiceHub
 
-        db = NodeDatabase(path)
+        if db is None:
+            db = NodeDatabase(path)
         key_management = PersistentKeyManagementService(
             db, *initial_keys, rng=rng
         )

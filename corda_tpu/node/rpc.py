@@ -323,19 +323,29 @@ class RPCServer:
     """Dispatches RpcRequests onto the ops object; captures returned
     feeds/flows and streams them as observations (RPCServer.kt:46-80)."""
 
+    # a client whose outbound journal backs up past this many frames is
+    # presumed dead and reaped (the Artemis-notification reaping role,
+    # RPCServer.kt:67-73 — our fabric has no disconnect signal, so
+    # backlog pressure is the detector)
+    MAX_CLIENT_BACKLOG = 10_000
+    _BACKLOG_PROBE_EVERY = 64
+
     def __init__(
         self,
         ops: CordaRPCOpsImpl,
         messaging: MessagingService,
         user_service: RPCUserService,
+        client_backlog: Optional[Callable[[str], int]] = None,
     ):
         self._ops = ops
         self._messaging = messaging
         self._users = user_service
+        self._backlog = client_backlog
         self._next_obs = 0
         # (client_address, observable_id) -> dispose fn
         self._subs: dict[tuple[str, int], Callable[[], None]] = {}
         self._deferred: list[Callable[[], None]] = []
+        self._obs_since_probe: dict[str, int] = {}
         messaging.add_handler(TOPIC_RPC_REQUEST, self._on_request)
         messaging.add_handler(TOPIC_RPC_UNSUBSCRIBE, self._on_unsubscribe)
 
@@ -396,10 +406,29 @@ class RPCServer:
         self._next_obs += 1
         return self._next_obs
 
+    def _client_backpressure(self, client: str) -> bool:
+        """True if the client's outbound queue says it stopped consuming
+        (probed every _BACKLOG_PROBE_EVERY observations)."""
+        if self._backlog is None:
+            return False
+        n = self._obs_since_probe.get(client, 0) + 1
+        self._obs_since_probe[client] = n
+        if n % self._BACKLOG_PROBE_EVERY:
+            return False
+        return self._backlog(client) > self.MAX_CLIENT_BACKLOG
+
     def _feed_handle(self, feed: DataFeed, client: str) -> FeedHandle:
         obs_id = self._fresh_obs_id()
 
         def forward(item: Any) -> None:
+            if self._client_backpressure(client):
+                import logging
+
+                logging.getLogger("corda_tpu.rpc").warning(
+                    "reaping subscriptions of backed-up client %s", client
+                )
+                self.close_client(client)
+                return
             self._messaging.send(
                 TOPIC_RPC_OBSERVATION,
                 ser.encode(RpcObservation(obs_id, item)),
@@ -452,7 +481,12 @@ class RPCServer:
     # -- unsubscription ------------------------------------------------------
 
     def _on_unsubscribe(self, msg: Message) -> None:
-        req = ser.decode(msg.payload)
+        try:
+            req = ser.decode(msg.payload)
+        except Exception:
+            return   # malformed: drop, never crash the pump
+        if not isinstance(req, RpcUnsubscribe):
+            return
         dispose = self._subs.pop((msg.sender, req.observable_id), None)
         if dispose is not None:
             dispose()

@@ -255,11 +255,18 @@ def _key_fp(key) -> bytes:
 @dataclass(frozen=True)
 class NodeInfo:
     """A node's advertised identity + address (reference:
-    core/.../node/NodeInfo.kt)."""
+    core/.../node/NodeInfo.kt). `address` is the peer's fabric address
+    (its unique peer name — message targets everywhere). On the DCN
+    fabric, `host`/`port`/`tls_fingerprint` tell bridges where to dial
+    and which self-signed TLS cert to pin; the network map is how they
+    are learned (the reference distributes cert chains the same way)."""
 
     address: str
     legal_identity: Party
     advertised_services: tuple[str, ...] = ()
+    host: Optional[str] = None
+    port: int = 0
+    tls_fingerprint: Optional[bytes] = None
 
     @property
     def notary_identity(self) -> Party:
