@@ -34,6 +34,12 @@ class FlowSessionException(FlowException):
     """The counterparty's flow ended, rejected, or errored."""
 
 
+class FlowTimeoutException(FlowException):
+    """A timed receive expired (the sendAndReceiveWithRetry mechanism,
+    FlowLogic.kt:108 — notary clients catch this and try another
+    cluster member)."""
+
+
 # ---------------------------------------------------------------------------
 # IO requests — the only values a flow generator may yield.
 # (Reference: node/.../statemachine/FlowIORequest.kt)
@@ -53,6 +59,7 @@ class _Receive:
     party: Party
     expected: type
     logic: Any
+    timeout_micros: Optional[int] = None
 
 
 @dataclass(frozen=True)
@@ -61,6 +68,7 @@ class _SendAndReceive:
     payload: Any
     expected: type
     logic: Any
+    timeout_micros: Optional[int] = None
 
 
 @dataclass(frozen=True)
@@ -74,6 +82,67 @@ class _Record:
 @dataclass(frozen=True)
 class _WaitLedgerCommit:
     tx_id: Any
+
+
+@dataclass(frozen=True)
+class _WaitFuture:
+    """Suspend until a FlowFuture resolves (the bridge from flows to
+    async services: Raft commits, the verifier pool). The result is
+    journaled, so a replayed flow returns the recorded value instead of
+    re-waiting — the submission side effect must be idempotent."""
+
+    future: "FlowFuture"
+
+
+class FlowFuture:
+    """Completable future resolved on the node's pump thread (services
+    that finish later — Raft quorum, worker pools — hand these to
+    flows; CordaFuture's role in the reference)."""
+
+    def __init__(self):
+        self.done = False
+        self._value: Any = None
+        self._exc: Optional[BaseException] = None
+        self._callbacks: list[Callable[["FlowFuture"], None]] = []
+
+    def set_result(self, value: Any) -> None:
+        if self.done:
+            return
+        self.done = True
+        self._value = value
+        self._fire()
+
+    def set_exception(self, exc: BaseException) -> None:
+        if self.done:
+            return
+        self.done = True
+        self._exc = exc
+        self._fire()
+
+    def result(self) -> Any:
+        if not self.done:
+            raise RuntimeError("future not resolved")
+        if self._exc is not None:
+            raise self._exc
+        return self._value
+
+    def add_done_callback(self, cb: Callable[["FlowFuture"], None]) -> None:
+        if self.done:
+            cb(self)
+        else:
+            self._callbacks.append(cb)
+
+    def _fire(self) -> None:
+        cbs, self._callbacks = self._callbacks, []
+        for cb in cbs:
+            cb(self)
+
+
+def wait_future(future: FlowFuture):
+    """`result = yield from wait_future(fut)` from inside any flow (or
+    generator the flow delegates to)."""
+    value = yield _WaitFuture(future)
+    return value
 
 
 @dataclass(frozen=True)
@@ -158,18 +227,30 @@ class FlowLogic:
         (FlowLogic.kt:131)."""
         yield _Send(party, payload, self)
 
-    def receive(self, party: Party, expected: type = object):
+    def receive(
+        self,
+        party: Party,
+        expected: type = object,
+        timeout_micros: Optional[int] = None,
+    ):
         """Wait for the next payload from the counterparty
         (FlowLogic.kt:89). The returned data is untrustworthy — the
-        type is checked, the contents are the peer's claim."""
-        data = yield _Receive(party, expected, self)
+        type is checked, the contents are the peer's claim. A timeout
+        raises FlowTimeoutException (journaled, so replay re-raises)."""
+        data = yield _Receive(party, expected, self, timeout_micros)
         return _checked(data, expected, party)
 
     def send_and_receive(
-        self, party: Party, payload: Any, expected: type = object
+        self,
+        party: Party,
+        payload: Any,
+        expected: type = object,
+        timeout_micros: Optional[int] = None,
     ):
         """Send then wait for the reply (FlowLogic.kt:159)."""
-        data = yield _SendAndReceive(party, payload, expected, self)
+        data = yield _SendAndReceive(
+            party, payload, expected, self, timeout_micros
+        )
         return _checked(data, expected, party)
 
     def sub_flow(self, logic: "FlowLogic"):

@@ -31,6 +31,7 @@ from .api import (
     FlowException,
     FlowLogic,
     FlowSessionException,
+    FlowTimeoutException,
     initiated_by,
     initiating_flow,
 )
@@ -341,9 +342,15 @@ class NotaryFlow(FlowLogic):
             payload = self.stx.wtx.build_filtered_transaction(
                 lambda c: isinstance(c, (StateRef, Party, TimeWindow))
             )
-        resp = yield from self.send_and_receive(
-            notary, payload, NotarisationResponse
-        )
+        members = self.services.network_map_cache.cluster_members(notary)
+        if members:
+            resp = yield from self._request_from_cluster(
+                members, payload
+            )
+        else:
+            resp = yield from self.send_and_receive(
+                notary, payload, NotarisationResponse
+            )
         if resp.error is not None:
             raise NotaryException(resp.error)
         sigs = resp.signatures
@@ -359,6 +366,37 @@ class NotaryFlow(FlowLogic):
         for s in sigs:
             s.verify(self.stx.id)
         return list(sigs)
+
+    # per-attempt timeout before trying the next cluster member
+    # (sendAndReceiveWithRetry, FlowLogic.kt:108 / NotaryFlow.kt:159)
+    retry_timeout_micros = 3_000_000
+
+    def _request_from_cluster(self, members, payload):
+        """Distributed notary: each attempt opens a session to a
+        DIFFERENT member (sessions key per member party, so a retry is
+        a fresh session, not a resend into a dead one). Commits are
+        idempotent cluster-side, so a slow member answering late is
+        harmless."""
+        last_exc = None
+        for member in members * 2:
+            member_party = member.legal_identity
+            try:
+                return (
+                    yield from self.send_and_receive(
+                        member_party,
+                        payload,
+                        NotarisationResponse,
+                        timeout_micros=self.retry_timeout_micros,
+                    )
+                )
+            except (FlowTimeoutException, FlowSessionException) as e:
+                last_exc = e
+        raise NotaryException(
+            NotaryError(
+                "unavailable",
+                f"no notary cluster member responded: {last_exc}",
+            )
+        )
 
 
 @initiated_by(NotaryFlow)
@@ -390,7 +428,7 @@ class NotaryServiceFlow(FlowLogic):
             )
         elif not isinstance(payload, FilteredTransaction):
             raise FlowException("non-validating notary takes a tear-off")
-        result = service.process(payload, self.other_party)
+        result = yield from service.process(payload, self.other_party)
         if isinstance(result, NotaryError):
             resp = NotarisationResponse((), result)
         else:

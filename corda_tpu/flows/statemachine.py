@@ -45,11 +45,13 @@ from .api import (
     FlowException,
     FlowLogic,
     FlowSessionException,
+    FlowTimeoutException,
     _Receive,
     _Record,
     _Send,
     _SendAndReceive,
     _TrackStep,
+    _WaitFuture,
     _WaitLedgerCommit,
     as_generator,
     initiating_tag_of,
@@ -346,7 +348,9 @@ class StateMachineManager:
                     if err is not None:
                         fsm.throw_exc = FlowSessionException(err)
                         continue
-                if not self._try_receive(fsm, req.party, req.logic):
+                if not self._try_receive(
+                    fsm, req.party, req.logic, req.timeout_micros
+                ):
                     return  # suspended (checkpointed inside)
                 continue
             if isinstance(req, _Record):
@@ -389,6 +393,10 @@ class StateMachineManager:
             if isinstance(req, _WaitLedgerCommit):
                 if not self._try_commit_wait(fsm, req.tx_id):
                     return
+                continue
+            if isinstance(req, _WaitFuture):
+                if not self._try_future_wait(fsm, req.future):
+                    return   # suspended until the future resolves
                 continue
             if isinstance(req, _TrackStep):
                 tracker = fsm.logic.progress_tracker
@@ -458,7 +466,7 @@ class StateMachineManager:
         _journal_add(fsm, ["sent"])
         return None
 
-    def _try_receive(self, fsm, party: Party, logic) -> bool:
+    def _try_receive(self, fsm, party: Party, logic, timeout_micros=None) -> bool:
         """Returns True if the flow got a value (or error) and should
         continue; False if it suspended."""
         if fsm.replaying:
@@ -468,9 +476,13 @@ class StateMachineManager:
             if fsm.journal[fsm.replay_pos][0] == "sent":
                 fsm.replay_pos += 1
         if fsm.replaying:
-            kind, value = self._journal_next(fsm, ("recv", "err"))
+            kind, value = self._journal_next(
+                fsm, ("recv", "err", "recv_timeout")
+            )
             if kind == "recv":
                 fsm.resume_value = value
+            elif kind == "recv_timeout":
+                fsm.throw_exc = FlowTimeoutException("receive timed out")
             else:
                 fsm.throw_exc = FlowSessionException(value)
             return True
@@ -478,9 +490,9 @@ class StateMachineManager:
         sess = self._session_for(fsm, party, logic, for_send=False)
         if self._open_if_needed(fsm, sess, False, None):
             _journal_add(fsm, ["sent"])
-        return self._try_receive_on(fsm, sess)
+        return self._try_receive_on(fsm, sess, timeout_micros)
 
-    def _try_receive_on(self, fsm, sess: SessionState) -> bool:
+    def _try_receive_on(self, fsm, sess: SessionState, timeout_micros=None) -> bool:
         """Receive on a known session (no tag resolution — also the
         resume path when a waited-for message arrives)."""
         if sess.buffer:
@@ -493,9 +505,37 @@ class StateMachineManager:
             _journal_add(fsm, ["err", err])
             fsm.throw_exc = FlowSessionException(err)
             return True
-        fsm.waiting = ("recv", sess.id)
+        deadline = (
+            None
+            if timeout_micros is None
+            else self.services.clock.now_micros() + timeout_micros
+        )
+        fsm.waiting = ("recv", sess.id, deadline)
         self._checkpoint(fsm)
         return False
+
+    def tick(self) -> int:
+        """Expire timed receives (driven from the node pump loop /
+        MockNetwork.run — the timer thread role of the reference's
+        fiber scheduler). Returns number of flows resumed."""
+        now = self.services.clock.now_micros()
+        fired = 0
+        for fsm in list(self.flows.values()):
+            w = fsm.waiting
+            if (
+                not fsm.done
+                and w is not None
+                and w[0] == "recv"
+                and len(w) > 2
+                and w[2] is not None
+                and now >= w[2]
+            ):
+                fsm.waiting = None
+                _journal_add(fsm, ["recv_timeout"])
+                fsm.throw_exc = FlowTimeoutException("receive timed out")
+                fired += 1
+                self._run(fsm)
+        return fired
 
     def _try_commit_wait(self, fsm, tx_id) -> bool:
         store = self.services.validated_transactions
@@ -512,6 +552,54 @@ class StateMachineManager:
         self.tx_waiters.setdefault(tx_id, []).append(fsm)
         self._checkpoint(fsm)
         return False
+
+    def _try_future_wait(self, fsm, future) -> bool:
+        """_WaitFuture: journal the outcome like _Record — a replayed
+        flow re-submits the (idempotent) operation only if the journal
+        has no recorded outcome yet."""
+        if fsm.replaying:
+            kind, value = self._journal_next(
+                fsm, ("fut", "fut_err", "fut_err_opaque")
+            )
+            if kind == "fut":
+                fsm.resume_value = value
+            elif kind == "fut_err":
+                fsm.throw_exc = value
+            else:
+                tag, message = value
+                fsm.throw_exc = FlowException(f"{tag}: {message}")
+            return True
+        if future.done:
+            self._settle_future(fsm, future)
+            return True
+        fsm.waiting = ("future",)
+        self._checkpoint(fsm)
+
+        def on_done(fut):
+            if fsm.done or self.stopped:
+                return
+            fsm.waiting = None
+            self._settle_future(fsm, fut)
+            self._run(fsm)
+
+        future.add_done_callback(on_done)
+        return False
+
+    def _settle_future(self, fsm, future) -> None:
+        try:
+            value = future.result()
+        except BaseException as e:
+            try:
+                ser.encode(e)
+                _journal_add(fsm, ["fut_err", e])
+            except ser.SerializationError:
+                _journal_add(
+                    fsm, ["fut_err_opaque", [_class_tag(type(e)), str(e)]]
+                )
+            fsm.throw_exc = e
+            return
+        _journal_add(fsm, ["fut", value])
+        fsm.resume_value = value
 
     def _journal_next(self, fsm, expect) -> tuple:
         entry = fsm.journal[fsm.replay_pos]

@@ -67,6 +67,11 @@ class NodeConfig:
     rpc_users: tuple[RpcUserConfig, ...] = field(default_factory=tuple)
     # notary cluster membership (raft/bft): peer names of all members
     cluster_peers: tuple[str, ...] = ()
+    # distributed notary service identity: cluster name + dev-mode key
+    # seed (every member configured alike derives the same shared
+    # service key; production would distribute it out of band)
+    cluster_name: str = "DistributedNotary"
+    cluster_key_seed: int = 1
     # CorDapp modules imported at boot: registers contract/state classes
     # with the codec and @initiated_by responders (the reference's
     # CorDapp classpath scan, AbstractNode.kt:427)
@@ -181,6 +186,8 @@ def write_config(cfg: NodeConfig, path: str) -> None:
     emit("key_seed", cfg.key_seed)
     emit("scheme", cfg.scheme)
     emit("use_tls", cfg.use_tls)
+    emit("cluster_name", cfg.cluster_name)
+    emit("cluster_key_seed", cfg.cluster_key_seed)
     if cfg.cluster_peers:
         peers = ", ".join(quote(p) for p in cfg.cluster_peers)
         lines.append(f"cluster_peers = [{peers}]")

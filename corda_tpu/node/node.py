@@ -94,6 +94,27 @@ class Node:
         if config.is_network_map_host:
             advertised = advertised + (SERVICE_NETWORK_MAP,)
 
+        # distributed notary members share one service identity derived
+        # from (cluster_name, cluster_key_seed); the key installs into
+        # key management so any member can sign for the cluster
+        self._cluster_identity = None
+        self._cluster_keypair = None
+        if config.notary in ("raft", "raft-validating", "bft"):
+            import hashlib
+
+            material = f"{config.cluster_name}:{config.cluster_key_seed}"
+            self._cluster_keypair = schemes.generate_keypair(
+                config.scheme_id,
+                seed=int.from_bytes(
+                    hashlib.sha256(material.encode()).digest()[:16], "big"
+                ),
+            )
+            from ..core.identity import Party as _Party
+
+            self._cluster_identity = _Party(
+                config.cluster_name, self._cluster_keypair.public
+            )
+
         self.info = NodeInfo(
             address=config.name,
             legal_identity=self.party,
@@ -101,6 +122,7 @@ class Node:
             host=config.p2p_host,
             port=0,   # patched after the fabric binds (ephemeral ports)
             tls_fingerprint=self.tls.fingerprint if self.tls else None,
+            cluster_identity=self._cluster_identity,
         )
 
         # -- services over one shared database -------------------------
@@ -266,6 +288,7 @@ class Node:
 
     def _install_notary(self) -> None:
         kind = self.config.notary
+        self.raft = None
         if kind == "":
             return
         if kind in ("simple", "validating"):
@@ -276,22 +299,55 @@ class Node:
             )
             self.services.notary_service = cls(self.services, uniqueness)
             return
+        if kind in ("raft", "raft-validating"):
+            from .config import ConfigError
+            from .raft import RaftNode, RaftUniquenessProvider
+
+            if self.config.name not in self.config.cluster_peers:
+                raise ConfigError(
+                    "raft notary needs cluster_peers including this node"
+                )
+            self.services.key_management.register_keypair(
+                self._cluster_keypair
+            )
+
+            def factory(apply_fn):
+                return RaftNode(
+                    self.config.name,
+                    list(self.config.cluster_peers),
+                    self.messaging,
+                    apply_fn,
+                    self.services.clock,
+                    cluster=self.config.cluster_name,
+                    db=self.db,
+                    rng=random.Random(self._dev_seed("raft")),
+                )
+
+            provider = RaftUniquenessProvider(factory)
+            self.raft = provider.raft
+            cls = (
+                SimpleNotaryService if kind == "raft"
+                else ValidatingNotaryService
+            )
+            self.services.notary_service = cls(
+                self.services,
+                provider,
+                service_identity=self._cluster_identity,
+            )
+            return
         raise NotImplementedError(
-            f"notary kind {kind!r} lands with the distributed notary phase"
+            f"notary kind {kind!r} lands with the BFT phase"
         )
 
     # -- lifecycle ------------------------------------------------------------
 
     def start(self) -> "Node":
+        import dataclasses
+
         self.messaging.start()
         # the fabric bound its listen port; advertise the real one
-        self.info = NodeInfo(
-            self.info.address,
-            self.info.legal_identity,
-            self.info.advertised_services,
-            host=self.info.host,
-            port=self.messaging.listen_port,
-            tls_fingerprint=self.info.tls_fingerprint,
+        self.info = dataclasses.replace(
+            self.info, port=self.messaging.listen_port
         )
         self.services.my_info = self.info
         self.services.network_map_cache.add_node(self.info)
@@ -330,11 +386,17 @@ class Node:
         while self.running:
             self.messaging.pump(block=True, timeout=0.2)
             self.scheduler.tick()
+            self.smm.tick()
+            if self.raft is not None:
+                self.raft.tick()
 
     def pump(self, timeout: float = 0.0) -> int:
         """One pump step (embedded/driver use)."""
         n = self.messaging.pump(block=timeout > 0, timeout=timeout)
         self.scheduler.tick()
+        self.smm.tick()
+        if self.raft is not None:
+            self.raft.tick()
         return n
 
     def stop(self) -> None:
@@ -343,10 +405,16 @@ class Node:
         self.running = False
         self.scheduler.stop()
         self.smm.stop()
+        if self.raft is not None:
+            self.raft.stop()
         self.messaging.stop()
         self.db.close()
 
     # -- conveniences ---------------------------------------------------------
+
+    @property
+    def vault(self):
+        return self.services.vault
 
     def rpc_client(self, username: str, password: str) -> rpclib.RPCClient:
         """Loopback RPC client on this node's own endpoint (the shell's

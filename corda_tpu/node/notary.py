@@ -59,6 +59,16 @@ class UniquenessConflict(Exception):
         super().__init__(f"{len(conflict)} input(s) already consumed")
 
 
+# journaled flow-future outcomes must round-trip the codec so a restored
+# notary flow replays the same conflict
+ser.register_custom(
+    UniquenessConflict,
+    "UniquenessConflict",
+    lambda e: e.conflict,
+    lambda v: UniquenessConflict(dict(v)),
+)
+
+
 # -- uniqueness providers ----------------------------------------------------
 
 
@@ -69,6 +79,22 @@ class UniquenessProvider:
         self, states: list[StateRef], tx_id: SecureHash, requester: Party
     ) -> None:
         raise NotImplementedError
+
+    def commit_async(
+        self, states: list[StateRef], tx_id: SecureHash, requester: Party
+    ):
+        """Future-shaped commit (what notary flows actually await):
+        local providers resolve immediately; distributed ones (Raft,
+        BFT) resolve when the cluster reaches consensus."""
+        from ..flows.api import FlowFuture
+
+        fut = FlowFuture()
+        try:
+            self.commit(states, tx_id, requester)
+            fut.set_result(None)
+        except Exception as e:
+            fut.set_exception(e)
+        return fut
 
 
 class InMemoryUniquenessProvider(UniquenessProvider):
@@ -127,15 +153,22 @@ class NotaryService:
         services: ServiceHub,
         uniqueness: Optional[UniquenessProvider] = None,
         tolerance_micros: int = 30_000_000,
+        service_identity: Optional[Party] = None,
     ):
+        """`service_identity`: the cluster-shared notary Party for
+        distributed notaries (each member holds the shared key and
+        answers for it); None = this node's own identity."""
         self.services = services
         self.uniqueness = uniqueness or InMemoryUniquenessProvider()
         self.time_window_checker = TimeWindowChecker(
             services.clock, tolerance_micros
         )
+        self.service_identity = service_identity
 
     @property
     def identity(self) -> Party:
+        if self.service_identity is not None:
+            return self.service_identity
         return self.services.my_info.notary_identity
 
     def commit_and_sign(
@@ -146,21 +179,30 @@ class NotaryService:
         requester: Party,
     ):
         """validate time window -> commit inputs -> sign tx id
-        (NotaryFlow.Service.call, NotaryFlow.kt:110-130). Returns a
+        (NotaryFlow.Service.call, NotaryFlow.kt:110-130). A generator
+        (`yield from` it inside a flow): the commit awaits the
+        uniqueness provider's future, which suspends the service flow
+        while a distributed provider reaches consensus. Returns a
         TransactionSignature or a NotaryError."""
+        from ..flows.api import wait_future
+
         if not self.time_window_checker.is_valid(time_window):
             return NotaryError(
                 "time-window-invalid",
                 f"window {time_window} outside notary clock tolerance",
             )
         try:
-            self.uniqueness.commit(inputs, tx_id, requester)
+            yield from wait_future(
+                self.uniqueness.commit_async(inputs, tx_id, requester)
+            )
         except UniquenessConflict as e:
             return NotaryError(
                 "conflict",
                 str(e),
                 conflict={str(r): h for r, h in e.conflict.items()},
             )
+        except Exception as e:
+            return NotaryError("commit-unavailable", str(e))
         sig = self.services.key_management.sign(
             tx_id, self.identity.owning_key
         )
@@ -196,8 +238,10 @@ class SimpleNotaryService(NotaryService):
                 "wrong-notary", f"tx names notary {ftx.notary}, I am "
                 f"{self.identity}"
             )
-        return self.commit_and_sign(
-            ftx.id, list(ftx.inputs), ftx.time_window, requester
+        return (
+            yield from self.commit_and_sign(
+                ftx.id, list(ftx.inputs), ftx.time_window, requester
+            )
         )
 
 
@@ -224,6 +268,8 @@ class ValidatingNotaryService(NotaryService):
             )
         except Exception as e:
             return NotaryError("invalid-transaction", str(e))
-        return self.commit_and_sign(
-            stx.id, list(stx.wtx.inputs), stx.wtx.time_window, requester
+        return (
+            yield from self.commit_and_sign(
+                stx.id, list(stx.wtx.inputs), stx.wtx.time_window, requester
+            )
         )

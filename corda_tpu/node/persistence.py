@@ -373,6 +373,10 @@ class PersistentKeyManagementService(KeyManagementService):
         self._persist(public, self._keys[public])
         return public
 
+    def register_keypair(self, kp: schemes.KeyPair) -> None:
+        super().register_keypair(kp)
+        self._persist(kp.public, kp.private)
+
 
 # ---------------------------------------------------------------------------
 # vault

@@ -1,0 +1,711 @@
+"""Raft consensus over the message fabric + the replicated uniqueness map.
+
+Reference: `RaftUniquenessProvider` (node/.../transactions/
+RaftUniquenessProvider.kt:41) — a Copycat-replicated
+`DistributedImmutableMap` (DistributedImmutableMap.kt) of
+stateRef→consumingTx, with the Raft transport running over its own
+Netty mesh (`:72-110`). The TPU build runs Raft over the same DCN
+fabric the rest of the node uses (one transport, SURVEY §2.5), and the
+notary awaits commits through the FlowFuture seam so the service flow
+suspends while the cluster replicates.
+
+The algorithm is standard Raft (election §5.2, replication §5.3, the
+current-term commit rule §5.4.2 — Ongaro & Ousterhout, "In Search of an
+Understandable Consensus Algorithm", public spec): persistent
+(term, votedFor, log) in the node database, randomized election
+timeouts driven by explicit `tick()` calls from the node's pump loop —
+deterministic under the Ring-3 manual pump, wall-clock on a real node.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+from ..core import serialization as ser
+from ..flows.api import FlowFuture
+from .messaging import Message, MessagingService
+
+TOPIC_RAFT = "raft"
+
+
+class RaftUnavailable(Exception):
+    """No leader reachable within the command deadline (the caller —
+    e.g. a notary client — retries, NotaryFlow.kt:159-162)."""
+
+
+ser.register_custom(
+    RaftUnavailable,
+    "RaftUnavailable",
+    lambda e: str(e),
+    lambda v: RaftUnavailable(v),
+)
+
+
+# -- wire messages (all peer-to-peer on the cluster topic) -------------------
+
+
+@dataclass(frozen=True)
+class RequestVote:
+    term: int
+    candidate: str
+    last_log_index: int
+    last_log_term: int
+
+
+@dataclass(frozen=True)
+class VoteReply:
+    term: int
+    granted: bool
+    voter: str
+
+
+@dataclass(frozen=True)
+class AppendEntries:
+    term: int
+    leader: str
+    prev_log_index: int
+    prev_log_term: int
+    entries: tuple          # of (term, command) pairs
+    leader_commit: int
+
+
+@dataclass(frozen=True)
+class AppendReply:
+    term: int
+    follower: str
+    success: bool
+    match_index: int
+
+
+@dataclass(frozen=True)
+class ClientCommand:
+    """A command forwarded to the (believed) leader by any member."""
+
+    cmd_id: int
+    origin: str
+    command: Any
+
+
+@dataclass(frozen=True)
+class ClientResult:
+    cmd_id: int
+    ok: bool
+    value: Any
+
+
+for _cls in (
+    RequestVote, VoteReply, AppendEntries, AppendReply,
+    ClientCommand, ClientResult,
+):
+    ser.serializable(_cls)
+
+
+# -- configuration -----------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class RaftConfig:
+    heartbeat_micros: int = 50_000
+    election_min_micros: int = 150_000
+    election_max_micros: int = 300_000
+    command_deadline_micros: int = 10_000_000
+
+
+_RAFT_SCHEMA = """
+CREATE TABLE IF NOT EXISTS raft_log (
+    cluster TEXT NOT NULL,
+    idx     INTEGER NOT NULL,
+    term    INTEGER NOT NULL,
+    command BLOB NOT NULL,
+    PRIMARY KEY (cluster, idx)
+);
+CREATE TABLE IF NOT EXISTS raft_meta (
+    cluster  TEXT PRIMARY KEY,
+    term     INTEGER NOT NULL,
+    voted_for TEXT
+);
+"""
+
+FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
+
+
+class RaftNode:
+    """One cluster member. The log is 1-indexed; `apply_fn(command)` is
+    the replicated state machine, invoked exactly once per committed
+    entry in log order on every member (DistributedImmutableMap's
+    role). `submit()` returns a FlowFuture resolved with apply_fn's
+    return value once the entry commits."""
+
+    def __init__(
+        self,
+        name: str,
+        peers: list[str],                  # all members, self included
+        messaging: MessagingService,
+        apply_fn: Callable[[Any], Any],
+        clock,
+        cluster: str = "notary",
+        db=None,
+        rng=None,
+        config: RaftConfig = RaftConfig(),
+    ):
+        import random as _random
+
+        assert name in peers, "peers must include this member"
+        self.name = name
+        self.peers = list(peers)
+        self.others = [p for p in peers if p != name]
+        self.messaging = messaging
+        self.apply_fn = apply_fn
+        self.clock = clock
+        self.cluster = cluster
+        self.config = config
+        self.rng = rng or _random.Random()
+        self._db = db
+        if db is not None:
+            db.execute_script(_RAFT_SCHEMA)
+
+        # persistent state (reloaded from db)
+        self.term = 0
+        self.voted_for: Optional[str] = None
+        self.log: list[tuple[int, Any]] = []   # [(term, command)]
+        self._load()
+
+        # volatile
+        self.role = FOLLOWER
+        self.leader: Optional[str] = None
+        self.commit_index = 0
+        self.last_applied = 0
+        self.next_index: dict[str, int] = {}
+        self.match_index: dict[str, int] = {}
+        self.votes: set[str] = set()
+        # leader: log index -> (term, future, deadline);
+        # everywhere: cmd_id -> (future, deadline)
+        self._index_futures: dict[int, tuple[int, FlowFuture, int]] = {}
+        self._client_futures: dict[int, tuple[FlowFuture, int]] = {}
+        # leader: log index -> (origin, cmd_id, term) for forwarded cmds
+        self._forwarded: dict[int, tuple[str, int, int]] = {}
+        # unresolved client commands awaiting a (possibly future) leader;
+        # re-flushed whenever leadership changes — commands MUST be
+        # idempotent (the uniqueness map is), because a leader change
+        # can replicate a command twice
+        self._pending_client: dict[int, Any] = {}
+        self._flushed_to: Optional[str] = None
+        self._next_cmd = 0
+        self._last_heartbeat_sent = 0
+        self._election_deadline = self._fresh_election_deadline()
+        self.applied_count = 0
+
+        self.topic = f"{TOPIC_RAFT}.{cluster}"
+        messaging.add_handler(self.topic, self._on_message)
+        self.stopped = False
+
+        # Re-apply the committed prefix? No: commit_index is volatile and
+        # rediscovered from the leader; the state machine must therefore
+        # be rebuilt by re-applying from the log — done lazily as
+        # commit_index advances past last_applied after restart, which
+        # re-runs apply_fn for every previously-committed entry. apply_fn
+        # must be deterministic AND rebuildable (the uniqueness provider
+        # rebuilds its map this way; reference: Copycat snapshot+replay).
+
+    # -- persistence ---------------------------------------------------------
+
+    def _load(self) -> None:
+        if self._db is None:
+            return
+        rows = self._db.query(
+            "SELECT term, voted_for FROM raft_meta WHERE cluster=?",
+            (self.cluster,),
+        )
+        if rows:
+            self.term, self.voted_for = rows[0][0], rows[0][1]
+        for idx, term, blob in self._db.query(
+            "SELECT idx, term, command FROM raft_log WHERE cluster=?"
+            " ORDER BY idx",
+            (self.cluster,),
+        ):
+            assert idx == len(self.log) + 1, "raft log has holes"
+            self.log.append((term, ser.decode(bytes(blob))))
+
+    def _persist_meta(self) -> None:
+        if self._db is None:
+            return
+        self._db.execute(
+            "INSERT OR REPLACE INTO raft_meta (cluster, term, voted_for)"
+            " VALUES (?,?,?)",
+            (self.cluster, self.term, self.voted_for),
+        )
+
+    def _persist_append(self, start_idx: int) -> None:
+        """Persist log[start_idx-1:] (1-indexed start)."""
+        if self._db is None:
+            return
+        with self._db.transaction():
+            self._db.execute(
+                "DELETE FROM raft_log WHERE cluster=? AND idx>=?",
+                (self.cluster, start_idx),
+            )
+            for i in range(start_idx, len(self.log) + 1):
+                term, command = self.log[i - 1]
+                self._db.execute(
+                    "INSERT INTO raft_log (cluster, idx, term, command)"
+                    " VALUES (?,?,?,?)",
+                    (self.cluster, i, term, ser.encode(command)),
+                )
+
+    # -- log helpers ---------------------------------------------------------
+
+    @property
+    def last_log_index(self) -> int:
+        return len(self.log)
+
+    @property
+    def last_log_term(self) -> int:
+        return self.log[-1][0] if self.log else 0
+
+    def _term_at(self, idx: int) -> int:
+        return self.log[idx - 1][0] if 1 <= idx <= len(self.log) else 0
+
+    # -- timers --------------------------------------------------------------
+
+    def _fresh_election_deadline(self) -> int:
+        span = (
+            self.config.election_max_micros - self.config.election_min_micros
+        )
+        return (
+            self.clock.now_micros()
+            + self.config.election_min_micros
+            + self.rng.randrange(span + 1)
+        )
+
+    def tick(self) -> int:
+        """Drive timers; returns number of messages sent (so pump loops
+        can detect quiescence)."""
+        if self.stopped:
+            return 0
+        now = self.clock.now_micros()
+        sent = 0
+        if self.role == LEADER:
+            if now - self._last_heartbeat_sent >= self.config.heartbeat_micros:
+                sent += self._broadcast_append()
+        elif now >= self._election_deadline:
+            sent += self._start_election()
+        sent += self._expire_client_futures(now)
+        return sent
+
+    def _expire_client_futures(self, now: int) -> int:
+        for cmd_id, (fut, deadline) in list(self._client_futures.items()):
+            if now >= deadline:
+                del self._client_futures[cmd_id]
+                self._pending_client.pop(cmd_id, None)
+                fut.set_exception(
+                    RaftUnavailable(
+                        f"no commit within deadline (leader={self.leader})"
+                    )
+                )
+        for idx, (term, fut, deadline) in list(self._index_futures.items()):
+            if now >= deadline:
+                del self._index_futures[idx]
+                fut.set_exception(
+                    RaftUnavailable("deposed before entry committed")
+                )
+        return 0
+
+    # -- elections -----------------------------------------------------------
+
+    def _start_election(self) -> int:
+        self.term += 1
+        self.role = CANDIDATE
+        self.voted_for = self.name
+        self.leader = None
+        self.votes = {self.name}
+        self._persist_meta()
+        self._election_deadline = self._fresh_election_deadline()
+        msg = RequestVote(
+            self.term, self.name, self.last_log_index, self.last_log_term
+        )
+        for peer in self.others:
+            self._send(peer, msg)
+        if self._quorum(len(self.votes)):   # single-member cluster
+            self._become_leader()
+        return len(self.others)
+
+    def _quorum(self, n: int) -> bool:
+        return n * 2 > len(self.peers)
+
+    def _become_leader(self) -> None:
+        self.role = LEADER
+        self.leader = self.name
+        self.next_index = {p: self.last_log_index + 1 for p in self.others}
+        self.match_index = {p: 0 for p in self.others}
+        # commit a no-op entry so prior-term entries can commit under
+        # the §5.4.2 current-term rule without waiting for client load
+        self.log.append((self.term, ["noop"]))
+        self._persist_append(self.last_log_index)
+        # commands awaiting a leader: we ARE the leader now
+        for cmd_id, command in list(self._pending_client.items()):
+            self.log.append((self.term, command))
+            idx = self.last_log_index
+            self._persist_append(idx)
+            self._forwarded[idx] = (self.name, cmd_id, self.term)
+        self._flushed_to = self.name
+        self._broadcast_append()
+        self._maybe_advance_commit()   # single-member cluster
+
+    def _maybe_step_down(self, term: int) -> None:
+        if term > self.term:
+            self.term = term
+            self.voted_for = None
+            self.role = FOLLOWER
+            self.leader = None   # stale pointers drop commands silently
+            self.votes = set()
+            self._persist_meta()
+
+    # -- replication ---------------------------------------------------------
+
+    def _broadcast_append(self) -> int:
+        self._last_heartbeat_sent = self.clock.now_micros()
+        for peer in self.others:
+            self._send_append(peer)
+        return len(self.others)
+
+    def _send_append(self, peer: str) -> None:
+        nxt = self.next_index.get(peer, self.last_log_index + 1)
+        prev = nxt - 1
+        entries = tuple(
+            (t, c) for t, c in self.log[prev : prev + 64]
+        )
+        self._send(
+            peer,
+            AppendEntries(
+                self.term, self.name, prev, self._term_at(prev),
+                entries, self.commit_index,
+            ),
+        )
+
+    def submit(self, command: Any) -> FlowFuture:
+        """Replicate one command; future resolves with apply_fn's return
+        once committed (leader) or via ClientResult (member/forwarded).
+        Submissions while leaderless wait in the client table and are
+        flushed to the leader when one emerges (deadline-bounded)."""
+        fut = FlowFuture()
+        deadline = (
+            self.clock.now_micros() + self.config.command_deadline_micros
+        )
+        if self.role == LEADER:
+            # register BEFORE appending: on a single-member cluster the
+            # append commits (and applies) inline
+            idx = self.last_log_index + 1
+            self._index_futures[idx] = (self.term, fut, deadline)
+            self._leader_append(command)
+            return fut
+        self._next_cmd += 1
+        cmd_id = self._next_cmd
+        self._client_futures[cmd_id] = (fut, deadline)
+        self._pending_client[cmd_id] = command
+        if self.leader is not None:
+            self._send(
+                self.leader, ClientCommand(cmd_id, self.name, command)
+            )
+        return fut
+
+    def _leader_append(self, command: Any) -> int:
+        self.log.append((self.term, command))
+        idx = self.last_log_index
+        self._persist_append(idx)
+        self._broadcast_append()
+        self._maybe_advance_commit()   # single-member clusters commit now
+        return idx
+
+    # -- message handling ----------------------------------------------------
+
+    def _on_message(self, msg: Message) -> None:
+        if self.stopped:
+            return
+        try:
+            m = ser.decode(msg.payload)
+        except ser.SerializationError:
+            return
+        if isinstance(m, RequestVote):
+            self._on_request_vote(m, msg.sender)
+        elif isinstance(m, VoteReply):
+            self._on_vote_reply(m)
+        elif isinstance(m, AppendEntries):
+            self._on_append(m, msg.sender)
+        elif isinstance(m, AppendReply):
+            self._on_append_reply(m)
+        elif isinstance(m, ClientCommand):
+            self._on_client_command(m)
+        elif isinstance(m, ClientResult):
+            self._on_client_result(m)
+
+    def _on_request_vote(self, m: RequestVote, sender: str) -> None:
+        if sender != m.candidate or m.candidate not in self.peers:
+            return   # a non-member (or spoofing member) gets no vote
+        self._maybe_step_down(m.term)
+        up_to_date = (m.last_log_term, m.last_log_index) >= (
+            self.last_log_term, self.last_log_index,
+        )
+        grant = (
+            m.term == self.term
+            and self.voted_for in (None, m.candidate)
+            and up_to_date
+        )
+        if grant:
+            self.voted_for = m.candidate
+            self._persist_meta()
+            self._election_deadline = self._fresh_election_deadline()
+        self._send(m.candidate, VoteReply(self.term, grant, self.name))
+
+    def _on_vote_reply(self, m: VoteReply) -> None:
+        self._maybe_step_down(m.term)
+        if self.role != CANDIDATE or m.term != self.term or not m.granted:
+            return
+        if m.voter not in self.peers:
+            return
+        self.votes.add(m.voter)
+        if self._quorum(len(self.votes)):
+            self._become_leader()
+
+    def _on_append(self, m: AppendEntries, sender: str) -> None:
+        if sender != m.leader or m.leader not in self.peers:
+            return
+        self._maybe_step_down(m.term)
+        if m.term < self.term:
+            self._send(
+                m.leader, AppendReply(self.term, self.name, False, 0)
+            )
+            return
+        # valid leader for this term
+        self.role = FOLLOWER
+        self.leader = m.leader
+        self.votes = set()
+        self._election_deadline = self._fresh_election_deadline()
+        self._flush_parked()
+        # log consistency check
+        if m.prev_log_index > self.last_log_index or (
+            m.prev_log_index >= 1
+            and self._term_at(m.prev_log_index) != m.prev_log_term
+        ):
+            self._send(
+                m.leader,
+                AppendReply(self.term, self.name, False, 0),
+            )
+            return
+        # append, truncating any conflicting suffix
+        insert_at = m.prev_log_index
+        changed_from = None
+        for i, (term, command) in enumerate(m.entries):
+            idx = insert_at + i + 1
+            if idx <= self.last_log_index:
+                if self._term_at(idx) == term:
+                    continue
+                del self.log[idx - 1 :]
+            self.log.append((term, list(command) if isinstance(command, tuple) else command))
+            if changed_from is None:
+                changed_from = idx
+        if changed_from is not None:
+            self._persist_append(changed_from)
+        if m.leader_commit > self.commit_index:
+            self.commit_index = min(m.leader_commit, self.last_log_index)
+            self._apply_committed()
+        self._send(
+            m.leader,
+            AppendReply(self.term, self.name, True, insert_at + len(m.entries)),
+        )
+
+    def _flush_parked(self) -> None:
+        """(Re)send unresolved client commands when leadership changes —
+        a command sent to a since-crashed leader would otherwise hang
+        until its deadline despite a healthy new leader."""
+        if self.leader is None or self._flushed_to == self.leader:
+            return
+        self._flushed_to = self.leader
+        for cmd_id, command in list(self._pending_client.items()):
+            self._send(
+                self.leader, ClientCommand(cmd_id, self.name, command)
+            )
+
+    def _on_append_reply(self, m: AppendReply) -> None:
+        self._maybe_step_down(m.term)
+        if self.role != LEADER or m.term != self.term:
+            return
+        if m.follower not in self.peers:
+            return
+        if m.success:
+            self.match_index[m.follower] = max(
+                self.match_index.get(m.follower, 0), m.match_index
+            )
+            self.next_index[m.follower] = self.match_index[m.follower] + 1
+            self._maybe_advance_commit()
+            if self.next_index[m.follower] <= self.last_log_index:
+                self._send_append(m.follower)   # more to stream
+        else:
+            self.next_index[m.follower] = max(
+                1, self.next_index.get(m.follower, 1) - 1
+            )
+            self._send_append(m.follower)
+
+    def _maybe_advance_commit(self) -> None:
+        for idx in range(self.last_log_index, self.commit_index, -1):
+            if self._term_at(idx) != self.term:
+                break   # §5.4.2: only current-term entries count directly
+            replicated = 1 + sum(
+                1 for p in self.others if self.match_index.get(p, 0) >= idx
+            )
+            if self._quorum(replicated):
+                self.commit_index = idx
+                self._apply_committed()
+                break
+
+    def _apply_committed(self) -> None:
+        while self.last_applied < self.commit_index:
+            self.last_applied += 1
+            term, command = self.log[self.last_applied - 1]
+            result = (
+                None if command == ["noop"] else self.apply_fn(command)
+            )
+            self.applied_count += 1
+            entry = self._index_futures.pop(self.last_applied, None)
+            if entry is not None:
+                fterm, fut, _deadline = entry
+                if fterm == term:
+                    fut.set_result(result)
+                else:
+                    fut.set_exception(
+                        RaftUnavailable("entry overwritten by new leader")
+                    )
+            fwd = self._forwarded.pop(self.last_applied, None)
+            if fwd is not None:
+                origin, cmd_id, fwd_term = fwd
+                if fwd_term != term:
+                    # a new leader overwrote this index with a DIFFERENT
+                    # entry: reporting success would hand the origin a
+                    # result for someone else's command (a double-spend
+                    # window at the notary)
+                    if origin != self.name:
+                        self._send(
+                            origin,
+                            ClientResult(
+                                cmd_id, False, "entry overwritten"
+                            ),
+                        )
+                elif origin == self.name:
+                    # a command parked here pre-election: resolve locally
+                    entry = self._client_futures.pop(cmd_id, None)
+                    if entry is not None:
+                        self._pending_client.pop(cmd_id, None)
+                        entry[0].set_result(result)
+                else:
+                    self._send(origin, ClientResult(cmd_id, True, result))
+        # a deposed leader's outstanding futures must not hang forever:
+        # indexes at/below commit that resolved above are gone; the rest
+        # expire via the client-deadline path or on overwrite
+
+    def _on_client_command(self, m: ClientCommand) -> None:
+        if m.origin not in self.peers:
+            return
+        if self.role != LEADER:
+            return   # origin re-flushes on leader discovery
+        idx = self.last_log_index + 1
+        self._forwarded[idx] = (m.origin, m.cmd_id, self.term)
+        self._leader_append(m.command)
+
+    def _on_client_result(self, m: ClientResult) -> None:
+        entry = self._client_futures.pop(m.cmd_id, None)
+        if entry is None:
+            return
+        self._pending_client.pop(m.cmd_id, None)
+        fut, _deadline = entry
+        if m.ok:
+            fut.set_result(m.value)
+        else:
+            fut.set_exception(RaftUnavailable(str(m.value)))
+
+    # -- plumbing ------------------------------------------------------------
+
+    def _send(self, peer: str, message) -> None:
+        self.messaging.send(self.topic, ser.encode(message), peer)
+
+    def stop(self) -> None:
+        self.stopped = True
+        remove = getattr(self.messaging, "remove_handler", None)
+        if remove is not None:
+            remove(self.topic, self._on_message)
+
+    def __repr__(self) -> str:
+        return (
+            f"<RaftNode {self.name} {self.role} term={self.term}"
+            f" log={self.last_log_index} commit={self.commit_index}>"
+        )
+
+
+# ---------------------------------------------------------------------------
+# the replicated uniqueness map
+
+
+class RaftUniquenessProvider:
+    """stateRef→consumingTx map replicated by Raft (reference:
+    RaftUniquenessProvider.kt:41 + DistributedImmutableMap.kt — put-all
+    is atomic: any conflict rejects the whole batch).
+
+    Every member applies the same deterministic conflict check, so the
+    map is identical cluster-wide; the submitting member's future
+    resolves with the conflict set (or None) once the entry commits.
+    """
+
+    def __init__(self, raft_factory: Callable[[Callable], RaftNode]):
+        """raft_factory(apply_fn) -> RaftNode — the provider owns the
+        state machine, the caller owns transport/cluster wiring."""
+        self.committed: dict = {}   # StateRef -> SecureHash
+        self.raft = raft_factory(self._apply)
+
+    # the replicated state machine ------------------------------------------
+
+    def _apply(self, command) -> Any:
+        from ..core.contracts import StateRef
+        from ..crypto.hashes import SecureHash
+
+        kind, tx_id_b, refs_b = command
+        assert kind == "commit", f"unknown raft command {kind!r}"
+        tx_id = SecureHash(bytes(tx_id_b))
+        refs = [ser.decode(bytes(r)) for r in refs_b]
+        conflict = {
+            str(ref): str(self.committed[ref])
+            for ref in refs
+            if ref in self.committed and self.committed[ref] != tx_id
+        }
+        if conflict:
+            return ["conflict", conflict]
+        for ref in refs:
+            self.committed[ref] = tx_id
+        return ["ok"]
+
+    # the UniquenessProvider surface ----------------------------------------
+
+    def commit_async(self, states, tx_id, requester) -> FlowFuture:
+        from .notary import UniquenessConflict
+
+        raft_fut = self.raft.submit(
+            ["commit", tx_id.bytes_, [ser.encode(r) for r in states]]
+        )
+        out = FlowFuture()
+
+        def adapt(fut: FlowFuture) -> None:
+            try:
+                result = fut.result()
+            except BaseException as e:
+                out.set_exception(e)
+                return
+            if result and result[0] == "conflict":
+                out.set_exception(UniquenessConflict(dict(result[1])))
+            else:
+                out.set_result(None)
+
+        raft_fut.add_done_callback(adapt)
+        return out
+
+    def commit(self, states, tx_id, requester) -> None:
+        raise NotImplementedError(
+            "Raft commits are asynchronous; use commit_async"
+        )

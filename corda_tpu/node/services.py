@@ -197,6 +197,11 @@ class KeyManagementService:
         self._keys[kp.public] = kp.private
         return kp.public
 
+    def register_keypair(self, kp: schemes.KeyPair) -> None:
+        """Install an externally-provisioned key (a notary cluster's
+        shared service key, distributed out of band)."""
+        self._keys[kp.public] = kp.private
+
     def sign(self, tx_id: SecureHash, key: schemes.PublicKey) -> TransactionSignature:
         priv = self._keys.get(key)
         if priv is None:
@@ -267,6 +272,11 @@ class NodeInfo:
     host: Optional[str] = None
     port: int = 0
     tls_fingerprint: Optional[bytes] = None
+    # distributed notaries: the shared service identity this member
+    # serves (reference: ServiceInfo with a cluster-wide notary
+    # identity; notary-demo Raft/BFT clusters). Transactions name the
+    # cluster party as their notary; any member answers for it.
+    cluster_identity: Optional[Party] = None
 
     @property
     def notary_identity(self) -> Party:
@@ -298,22 +308,55 @@ class NetworkMapCache:
 
     def __init__(self):
         self._nodes: dict[str, NodeInfo] = {}
+        # cluster party name -> member infos (in arrival order)
+        self._clusters: dict[str, list[NodeInfo]] = {}
+        self._cluster_parties: dict[str, Party] = {}
+        self._rr: dict[str, int] = {}   # round-robin cursor per cluster
         self.observers: list[Callable[[MapChange], None]] = []
 
     def add_node(self, info: NodeInfo) -> None:
         self._nodes[info.legal_identity.name] = info
+        if info.cluster_identity is not None:
+            cname = info.cluster_identity.name
+            members = self._clusters.setdefault(cname, [])
+            members[:] = [
+                m
+                for m in members
+                if m.legal_identity.name != info.legal_identity.name
+            ] + [info]
+            self._cluster_parties[cname] = info.cluster_identity
         for cb in list(self.observers):
             _safe_notify(cb, MapChange("added", info))
 
     def remove_node(self, info: NodeInfo) -> None:
         removed = self._nodes.pop(info.legal_identity.name, None)
         if removed is not None:
+            for cname, members in list(self._clusters.items()):
+                members[:] = [
+                    m
+                    for m in members
+                    if m.legal_identity.name != info.legal_identity.name
+                ]
+                if not members:
+                    del self._clusters[cname]
+                    self._cluster_parties.pop(cname, None)
             for cb in list(self.observers):
                 _safe_notify(cb, MapChange("removed", removed))
 
     def address_of(self, party: Party) -> Optional[str]:
+        """Message-level address resolution. For a cluster party this is
+        deliberately STICKY (first member): sessions are multi-message,
+        and rotating here would scatter one session's messages across
+        members. Load balancing lives in cluster_members(), which
+        rotates its starting member per call — flows that understand
+        clusters (NotaryFlow) address members directly."""
         info = self._nodes.get(party.name)
-        return info.address if info else None
+        if info is not None:
+            return info.address
+        members = self._clusters.get(party.name)
+        if members:
+            return members[0].address
+        return None
 
     def node_of(self, party: Party) -> Optional[NodeInfo]:
         return self._nodes.get(party.name)
@@ -322,17 +365,43 @@ class NetworkMapCache:
         return self._nodes.get(name)
 
     def notary_identities(self) -> list[Party]:
-        return [
+        singles = [
             n.legal_identity
             for n in self._nodes.values()
-            if any(s.startswith("corda.notary") for s in n.advertised_services)
+            if n.cluster_identity is None
+            and any(s.startswith("corda.notary") for s in n.advertised_services)
         ]
+        clusters = [
+            self._cluster_parties[cname]
+            for cname, members in self._clusters.items()
+            if any(
+                s.startswith("corda.notary")
+                for m in members
+                for s in m.advertised_services
+            )
+        ]
+        return singles + clusters
 
     def is_validating_notary(self, party: Party) -> bool:
         info = self._nodes.get(party.name)
-        return bool(
-            info and SERVICE_NOTARY_VALIDATING in info.advertised_services
+        if info is not None:
+            return SERVICE_NOTARY_VALIDATING in info.advertised_services
+        members = self._clusters.get(party.name, [])
+        return any(
+            SERVICE_NOTARY_VALIDATING in m.advertised_services
+            for m in members
         )
+
+    def cluster_members(self, party: Party) -> list[NodeInfo]:
+        """Members of a cluster service, rotated per call so successive
+        callers start at different members (the load-balancing role of
+        the reference's shared notary queues)."""
+        members = list(self._clusters.get(party.name, ()))
+        if not members:
+            return members
+        i = self._rr.get(party.name, 0) % len(members)
+        self._rr[party.name] = i + 1
+        return members[i:] + members[:i]
 
     def all_nodes(self) -> list[NodeInfo]:
         return list(self._nodes.values())

@@ -115,6 +115,9 @@ class MockNode:
         self.scheduler = NodeSchedulerService(
             self.services, self.smm.start_flow
         )
+        # extra per-pump tick hooks (raft timers etc.); each returns a
+        # count of actions so run() can detect quiescence
+        self.ticks: list = []
 
     # -- conveniences -------------------------------------------------------
 
@@ -167,6 +170,77 @@ class MockNetwork:
         return self.create_node(
             name, notary="validating" if validating else "simple"
         )
+
+    def create_raft_notary_cluster(
+        self,
+        n: int = 3,
+        name: str = "RaftNotary",
+        validating: bool = False,
+    ):
+        """n MockNodes forming one Raft notary cluster behind a shared
+        service identity (reference: notary-demo Raft cluster,
+        RaftUniquenessProvider.kt). Returns (service_party, members).
+        Elect a leader before notarising: run() + advance_clock loops
+        (see tests/test_raft_notary.py drive helper)."""
+        import random as _random
+
+        from ..core.identity import Party
+        from ..node.notary import SimpleNotaryService, ValidatingNotaryService
+        from ..node.raft import RaftNode, RaftUniquenessProvider
+
+        shared_kp = schemes.generate_keypair(seed=self.rng.getrandbits(256))
+        service_party = Party(name, shared_kp.public)
+        member_names = [f"{name}-{i}" for i in range(n)]
+        members = []
+        for mname in member_names:
+            node = self.create_node(mname)
+            node.services.key_management.register_keypair(shared_kp)
+            node.info = NodeInfo(
+                mname,
+                node.party,
+                (SERVICE_NOTARY_VALIDATING,) if validating else (SERVICE_NOTARY,),
+                cluster_identity=service_party,
+            )
+            node.services.my_info = node.info
+
+            def factory(apply_fn, _node=node, _mname=mname):
+                raft = RaftNode(
+                    _mname,
+                    member_names,
+                    _node.messaging,
+                    apply_fn,
+                    self.clock,
+                    db=getattr(_node.services, "db", None),
+                    rng=_random.Random(self.rng.getrandbits(32)),
+                )
+                _node.raft = raft
+                _node.ticks.append(raft.tick)
+                return raft
+
+            provider = RaftUniquenessProvider(factory)
+            cls = ValidatingNotaryService if validating else SimpleNotaryService
+            node.services.notary_service = cls(
+                node.services, provider, service_identity=service_party
+            )
+            members.append(node)
+        self._sync_directories()
+        return service_party, members
+
+    def elect(self, members, max_rounds: int = 300):
+        """Advance time until the cluster settles on a leader."""
+        from ..node.raft import LEADER
+
+        for _ in range(max_rounds):
+            self.clock.advance(20_000)
+            self.run()
+            leaders = [m for m in members if m.raft.role == LEADER]
+            if len(leaders) == 1 and all(
+                m.raft.leader == leaders[0].raft.name
+                for m in members
+                if m is not leaders[0]
+            ):
+                return leaders[0]
+        raise AssertionError("raft notary cluster failed to elect")
 
     def restart_node(self, node: MockNode) -> MockNode:
         """Kill a node and boot a replacement from its database — the
@@ -223,7 +297,10 @@ class MockNetwork:
             # (the reference's scheduler thread wakes on its own; in
             # Ring 3 the pump is the only driver, so ticks interleave
             # deterministically with delivery)
-            if not sum(n.scheduler.tick() for n in self.nodes):
+            actions = sum(n.scheduler.tick() for n in self.nodes)
+            actions += sum(t() for n in self.nodes for t in n.ticks)
+            actions += sum(n.smm.tick() for n in self.nodes)
+            if not actions and not self.fabric.pending:
                 return total
             rounds += 1
             if rounds > pump_limit:
