@@ -431,6 +431,11 @@ class NotaryServiceFlow(FlowLogic):
         result = yield from service.process(payload, self.other_party)
         if isinstance(result, NotaryError):
             resp = NotarisationResponse((), result)
+        elif isinstance(result, (list, tuple)):
+            # distributed notaries return one signature per agreeing
+            # replica; the requester checks them against the cluster's
+            # composite threshold identity (BFTSMaRt.kt ClusterResponse)
+            resp = NotarisationResponse(tuple(result), None)
         else:
             resp = NotarisationResponse((result,), None)
         yield from self.send(self.other_party, resp)

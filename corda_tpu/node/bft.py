@@ -1,0 +1,617 @@
+"""BFT notary: PBFT-style totally-ordered commits, f+1 reply aggregation.
+
+Reference: `BFTSMaRt` client/replica (node/.../transactions/
+BFTSMaRt.kt:52-173) + `BFTNonValidatingNotaryService`
+(BFTNonValidatingNotaryService.kt:29): a `CommitRequest` is totally
+ordered across 3f+1 replicas by the BFT-SMaRt library; every replica
+independently verifies the Merkle tear-off, commits the inputs to its
+own map, and SIGNS the transaction; the client aggregates replica
+signatures into a `ClusterResponse`, accepting once f+1 agree. The
+notary's service identity is a **composite key with threshold f+1**
+over the replica keys, so the ordinary signature-check path proves
+byzantine agreement.
+
+Here the library's role is played by an in-tree PBFT normal case
+(pre-prepare → 2f prepares → 2f+1 commits → in-order execution) plus a
+simplified view change (authenticated channels — the fabric's signed
+handshake — carry each replica's prepared set to the new primary, which
+re-proposes; full PBFT new-view proofs are descoped like the
+reference descopes them to the library). Liveness needs n-f live
+replicas; safety holds with ≤f byzantine ones because every quorum is
+2f+1 and replies only count with f+1 agreement.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+from ..core import serialization as ser
+from ..flows.api import FlowFuture
+from .messaging import Message, MessagingService
+
+TOPIC_BFT = "bft"
+
+
+class BftUnavailable(Exception):
+    pass
+
+
+ser.register_custom(
+    BftUnavailable, "BftUnavailable", lambda e: str(e), lambda v: BftUnavailable(v)
+)
+
+
+# -- wire --------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class BftRequest:
+    cmd_id: int
+    origin: str
+    command: Any
+
+
+@dataclass(frozen=True)
+class PrePrepare:
+    view: int
+    seq: int
+    cmd_id: int
+    origin: str
+    command: Any
+    # the primary's clock at ordering time: execution validates time
+    # windows against THIS (identical on every replica), not each
+    # replica's own clock — replicas sanity-check it for skew before
+    # preparing, so a lying primary can't shift time beyond tolerance
+    timestamp: int = 0
+
+
+@dataclass(frozen=True)
+class BftPrepare:
+    view: int
+    seq: int
+    digest: bytes
+    replica: str
+
+
+@dataclass(frozen=True)
+class BftCommitMsg:
+    view: int
+    seq: int
+    digest: bytes
+    replica: str
+
+
+@dataclass(frozen=True)
+class BftReply:
+    cmd_id: int
+    seq: int
+    outcome: Any               # canonical value; replies match on it
+    replica: str
+    signature: Optional[Any]   # replica's TransactionSignature (ok case)
+
+
+@dataclass(frozen=True)
+class ViewChange:
+    new_view: int
+    replica: str
+    # prepared set: tuple of (seq, view, cmd_id, origin, command)
+    prepared: tuple
+
+
+for _cls in (BftRequest, PrePrepare, BftPrepare, BftCommitMsg, BftReply, ViewChange):
+    ser.serializable(_cls)
+
+
+@dataclass(frozen=True)
+class BftConfig:
+    request_timeout_micros: int = 2_000_000    # before suspecting primary
+    client_deadline_micros: int = 10_000_000
+    timestamp_skew_micros: int = 60_000_000    # primary clock sanity bound
+
+
+def quorum_2f1(n: int) -> int:
+    f = (n - 1) // 3
+    return 2 * f + 1
+
+
+def weak_quorum(n: int) -> int:
+    f = (n - 1) // 3
+    return f + 1
+
+
+def _digest(command: Any) -> bytes:
+    import hashlib
+
+    return hashlib.sha256(ser.encode(command)).digest()
+
+
+class BftReplica:
+    """One PBFT replica + embedded client gateway.
+
+    `execute_fn(command) -> (outcome, signature)` is the deterministic
+    state machine (the notary's verify+commit+sign); `outcome` must be
+    canonical and equal across honest replicas, `signature` is this
+    replica's own signature share (excluded from reply matching).
+    """
+
+    def __init__(
+        self,
+        name: str,
+        peers: list[str],
+        messaging: MessagingService,
+        execute_fn: Callable[[Any], tuple],
+        clock,
+        cluster: str = "bft-notary",
+        rng=None,
+        config: BftConfig = BftConfig(),
+    ):
+        import random as _random
+
+        assert name in peers
+        self.name = name
+        self.peers = list(peers)
+        self.n = len(peers)
+        self.f = (self.n - 1) // 3
+        self.messaging = messaging
+        self.execute_fn = execute_fn
+        self.clock = clock
+        self.cluster = cluster
+        self.config = config
+        self.rng = rng or _random.Random()
+
+        self.view = 0
+        self.next_seq = 1                 # primary: next sequence to assign
+        self.exec_seq = 1                 # next sequence to execute
+        # seq -> (view, cmd_id, origin, command)
+        self.accepted: dict[int, tuple] = {}
+        self.prepares: dict[tuple, set[str]] = {}     # (view,seq,digest)->replicas
+        self.commits: dict[tuple, set[str]] = {}
+        self.prepared: dict[int, tuple] = {}          # seq -> accepted entry
+        self.committed: set[int] = set()
+        self.executed: dict[int, Any] = {}            # seq -> outcome
+        self.seen_requests: dict[tuple, int] = {}     # (origin, cmd_id) -> seq
+        # every replica remembers broadcast requests so a new primary
+        # can (re-)order ones the failed primary never pre-prepared
+        self.pending_requests: dict[tuple, Any] = {}  # (origin, cmd_id) -> cmd
+        # replies only count if this passes (the notary installs a
+        # signature-share check; a byzantine 'ok' with a missing or
+        # bogus signature must not reach the f+1 bucket)
+        self.validate_reply: Callable[[Any, str, Any], bool] = (
+            lambda outcome, replica, signature: True
+        )
+        # client side: cmd_id -> (future, deadline, {outcome_key: [(replica, sig)]})
+        self._client: dict[int, list] = {}
+        self._next_cmd = 0
+        # request watchdog: (origin, cmd_id) -> first-seen micros
+        self._watch: dict[tuple, int] = {}
+        self._view_votes: dict[int, dict[str, tuple]] = {}
+        self.stopped = False
+
+        self.topic = f"{TOPIC_BFT}.{cluster}"
+        messaging.add_handler(self.topic, self._on_message)
+
+    # -- roles ---------------------------------------------------------------
+
+    @property
+    def primary(self) -> str:
+        return self.peers[self.view % self.n]
+
+    @property
+    def is_primary(self) -> bool:
+        return self.primary == self.name
+
+    # -- client gateway ------------------------------------------------------
+
+    def submit(self, command: Any) -> FlowFuture:
+        """Broadcast a request; future resolves once f+1 replicas reply
+        with the same outcome — value is (outcome, [signatures])."""
+        self._next_cmd += 1
+        cmd_id = self._next_cmd
+        fut = FlowFuture()
+        deadline = self.clock.now_micros() + self.config.client_deadline_micros
+        self._client[cmd_id] = [fut, deadline, {}]
+        req = BftRequest(cmd_id, self.name, command)
+        payload = ser.encode(req)
+        for peer in self.peers:
+            if peer == self.name:
+                self._on_request(req)
+            else:
+                self.messaging.send(self.topic, payload, peer)
+        return fut
+
+    def _on_reply(self, m: BftReply) -> None:
+        entry = self._client.get(m.cmd_id)
+        if entry is None or m.replica not in self.peers:
+            return
+        if not self.validate_reply(m.outcome, m.replica, m.signature):
+            return
+        fut, deadline, buckets = entry
+        key = ser.encode(m.outcome)
+        votes = buckets.setdefault(key, [])
+        if any(r == m.replica for r, _ in votes):
+            return   # one vote per replica
+        votes.append((m.replica, m.signature))
+        if len(votes) >= weak_quorum(self.n):
+            del self._client[m.cmd_id]
+            sigs = [s for _, s in votes if s is not None]
+            fut.set_result([ser.decode(key), sigs])
+
+    # -- replica: request handling -------------------------------------------
+
+    def _on_request(self, m: BftRequest) -> None:
+        key = (m.origin, m.cmd_id)
+        seq = self.seen_requests.get(key)
+        if seq is not None:
+            # duplicate (client retry): re-reply if already executed
+            if seq in self.executed:
+                self._reply(seq)
+            return
+        self._watch.setdefault(key, self.clock.now_micros())
+        self.pending_requests[key] = m.command
+        if self.is_primary:
+            self._order(m.cmd_id, m.origin, m.command)
+
+    def _order(self, cmd_id: int, origin: str, command: Any) -> None:
+        seq = self.next_seq
+        self.next_seq += 1
+        pp = PrePrepare(
+            self.view, seq, cmd_id, origin, command,
+            self.clock.now_micros(),
+        )
+        self._accept_preprepare(pp)
+        self._broadcast(pp)
+
+    def _accept_preprepare(self, pp: PrePrepare) -> None:
+        if pp.seq in self.accepted and self.accepted[pp.seq][0] >= pp.view:
+            return   # first pre-prepare per (seq, view) wins; stale views drop
+        skew = abs(pp.timestamp - self.clock.now_micros())
+        if skew > self.config.timestamp_skew_micros:
+            return   # primary's clock is lying/broken: refuse to prepare
+        self.accepted[pp.seq] = (
+            pp.view, pp.cmd_id, pp.origin, pp.command, pp.timestamp,
+        )
+        self.seen_requests[(pp.origin, pp.cmd_id)] = pp.seq
+        d = _digest(list(pp.command) if isinstance(pp.command, tuple) else pp.command)
+        prep = BftPrepare(pp.view, pp.seq, d, self.name)
+        self._record_prepare(prep)
+        self._broadcast(prep)
+
+    def _on_preprepare(self, pp: PrePrepare, sender: str) -> None:
+        if sender != self.primary or pp.view != self.view:
+            return   # only the current primary may order
+        self._accept_preprepare(pp)
+
+    def _record_prepare(self, p: BftPrepare) -> None:
+        key = (p.view, p.seq, bytes(p.digest))
+        group = self.prepares.setdefault(key, set())
+        group.add(p.replica)
+        # prepared = pre-prepare accepted + 2f prepares (incl. our own)
+        if (
+            p.seq in self.accepted
+            and self.accepted[p.seq][0] == p.view
+            and len(group) >= quorum_2f1(self.n) - 1
+            and p.seq not in self.prepared
+        ):
+            self.prepared[p.seq] = self.accepted[p.seq]
+            c = BftCommitMsg(p.view, p.seq, bytes(p.digest), self.name)
+            self._record_commit(c)
+            self._broadcast(c)
+
+    def _record_commit(self, c: BftCommitMsg) -> None:
+        key = (c.view, c.seq, bytes(c.digest))
+        group = self.commits.setdefault(key, set())
+        group.add(c.replica)
+        if (
+            len(group) >= quorum_2f1(self.n)
+            and c.seq in self.prepared
+            and c.seq not in self.committed
+        ):
+            self.committed.add(c.seq)
+            self._execute_ready()
+
+    def _execute_ready(self) -> None:
+        """Execute committed entries strictly in sequence order. The
+        ordered timestamp rides along so time-dependent checks are
+        deterministic across replicas."""
+        while self.exec_seq in self.committed:
+            seq = self.exec_seq
+            self.exec_seq += 1
+            _view, cmd_id, origin, command, timestamp = self.accepted[seq]
+            outcome, signature = self.execute_fn(
+                list(command) if isinstance(command, tuple) else command,
+                timestamp,
+            )
+            self.executed[seq] = (cmd_id, origin, outcome, signature)
+            self._watch.pop((origin, cmd_id), None)
+            self.pending_requests.pop((origin, cmd_id), None)
+            self._reply(seq)
+
+    def _reply(self, seq: int) -> None:
+        cmd_id, origin, outcome, signature = self.executed[seq]
+        reply = BftReply(cmd_id, seq, outcome, self.name, signature)
+        if origin == self.name:
+            self._on_reply(reply)
+        else:
+            self.messaging.send(self.topic, ser.encode(reply), origin)
+
+    # -- view change (simplified) --------------------------------------------
+
+    def tick(self) -> int:
+        if self.stopped:
+            return 0
+        now = self.clock.now_micros()
+        sent = 0
+        # requests nobody will ever answer for stop driving view changes
+        # once past the client deadline
+        for k, t0 in list(self._watch.items()):
+            if now - t0 >= self.config.client_deadline_micros:
+                del self._watch[k]
+                self.pending_requests.pop(k, None)
+        overdue = [
+            k
+            for k, t0 in self._watch.items()
+            if now - t0 >= self.config.request_timeout_micros
+        ]
+        if overdue:
+            for k in overdue:
+                self._watch[k] = now   # re-arm
+            sent += self._vote_view_change(self.view + 1)
+        # expire client futures
+        for cmd_id, (fut, deadline, _b) in list(self._client.items()):
+            if now >= deadline:
+                del self._client[cmd_id]
+                fut.set_exception(
+                    BftUnavailable("no f+1 agreement within deadline")
+                )
+        return sent
+
+    def _vote_view_change(self, new_view: int) -> int:
+        prepared = tuple(
+            (seq, v, cmd_id, origin,
+             list(cmd) if isinstance(cmd, tuple) else cmd, ts)
+            for seq, (v, cmd_id, origin, cmd, ts) in sorted(
+                self.prepared.items()
+            )
+            if seq not in self.executed
+        )
+        vc = ViewChange(new_view, self.name, prepared)
+        self._record_view_change(vc)
+        self._broadcast(vc)
+        return self.n - 1
+
+    def _record_view_change(self, vc: ViewChange) -> None:
+        if vc.new_view <= self.view:
+            return
+        votes = self._view_votes.setdefault(vc.new_view, {})
+        votes[vc.replica] = vc.prepared
+        if len(votes) >= quorum_2f1(self.n):
+            self.view = vc.new_view
+            self._view_votes = {
+                v: m for v, m in self._view_votes.items() if v > self.view
+            }
+            if self.is_primary:
+                self._adopt_prepared(votes)
+
+    def _adopt_prepared(self, votes: dict[str, tuple]) -> None:
+        """New primary re-proposes every prepared-but-unexecuted entry
+        it learned from the view-change quorum (highest view wins), then
+        orders requests the failed primary never got to — every replica
+        saw the original broadcast, so the new primary has them in
+        pending_requests."""
+        best: dict[int, tuple] = {}
+        for prepared in votes.values():
+            for seq, v, cmd_id, origin, command, ts in prepared:
+                if seq not in best or best[seq][0] < v:
+                    best[seq] = (v, cmd_id, origin, command, ts)
+        for seq, (_v, cmd_id, origin, command, ts) in sorted(best.items()):
+            if seq in self.executed:
+                continue
+            self.next_seq = max(self.next_seq, seq + 1)
+            pp = PrePrepare(self.view, seq, cmd_id, origin, command, ts)
+            self._accept_preprepare(pp)
+            self._broadcast(pp)
+        for (origin, cmd_id), command in list(self.pending_requests.items()):
+            if (origin, cmd_id) in self.seen_requests:
+                continue   # already ordered (possibly re-proposed above)
+            self._order(cmd_id, origin, command)
+
+    # -- dispatch ------------------------------------------------------------
+
+    def _on_message(self, msg: Message) -> None:
+        if self.stopped:
+            return
+        try:
+            m = ser.decode(msg.payload)
+        except ser.SerializationError:
+            return
+        sender = msg.sender
+        if isinstance(m, BftRequest):
+            if sender == m.origin or sender == self.name:
+                self._on_request(m)
+        elif isinstance(m, PrePrepare):
+            self._on_preprepare(m, sender)
+        elif isinstance(m, BftPrepare):
+            if sender == m.replica and sender in self.peers:
+                self._record_prepare(m)
+        elif isinstance(m, BftCommitMsg):
+            if sender == m.replica and sender in self.peers:
+                self._record_commit(m)
+        elif isinstance(m, BftReply):
+            if sender == m.replica:
+                self._on_reply(m)
+        elif isinstance(m, ViewChange):
+            if sender == m.replica and sender in self.peers:
+                self._record_view_change(m)
+
+    def _broadcast(self, message) -> None:
+        payload = ser.encode(message)
+        for peer in self.peers:
+            if peer != self.name:
+                self.messaging.send(self.topic, payload, peer)
+
+    def stop(self) -> None:
+        self.stopped = True
+        remove = getattr(self.messaging, "remove_handler", None)
+        if remove is not None:
+            remove(self.topic, self._on_message)
+
+    def __repr__(self) -> str:
+        return (
+            f"<BftReplica {self.name} view={self.view}"
+            f" exec={self.exec_seq - 1}>"
+        )
+
+
+# ---------------------------------------------------------------------------
+# the BFT notary service
+
+
+class BFTNotaryService:
+    """Non-validating BFT notary (BFTNonValidatingNotaryService.kt:29).
+
+    The gateway member's service flow submits the tear-off to the
+    cluster; EVERY replica independently verifies it, commits inputs to
+    its own uniqueness map, and signs; the client side aggregates f+1
+    matching outcomes. The service identity's owning key is a
+    CompositeKey(threshold=f+1) over replica keys, so the standard
+    signature check proves agreement."""
+
+    validating = False
+
+    def __init__(
+        self,
+        services,
+        replica: BftReplica,
+        service_identity,
+        tolerance_micros: int = 30_000_000,
+        member_key=None,
+        member_keys: Optional[dict] = None,
+    ):
+        """`member_key`: the composite-leaf key this replica signs with
+        (must be in key management); defaults to the node identity key —
+        correct when the composite is built over member identities.
+        `member_keys`: replica name -> expected signing key, used to
+        validate reply signature shares before they count toward f+1
+        (a byzantine 'ok' without a valid share must not poison the
+        agreement bucket)."""
+        from .notary import TimeWindowChecker
+
+        self.services = services
+        self.replica = replica
+        self.service_identity = service_identity
+        self.tolerance_micros = tolerance_micros
+        self.time_window_checker = TimeWindowChecker(
+            services.clock, tolerance_micros
+        )
+        self.committed: dict = {}   # this replica's stateRef -> tx id
+        self._member_key = member_key
+        self._member_keys = member_keys or {}
+        replica.execute_fn = self._execute
+        replica.validate_reply = self._validate_reply
+
+    def _validate_reply(self, outcome, replica_name: str, signature) -> bool:
+        outcome = list(outcome)
+        if outcome and outcome[0] == "ok":
+            if signature is None:
+                return False
+            from ..crypto.hashes import SecureHash
+            from ..crypto.tx_signature import TransactionSignature
+
+            if not isinstance(signature, TransactionSignature):
+                return False
+            expected = self._member_keys.get(replica_name)
+            if expected is not None and signature.by != expected:
+                return False
+            try:
+                signature.verify(SecureHash(bytes(outcome[1])))
+            except Exception:
+                return False
+        return True
+
+    @property
+    def identity(self):
+        return self.service_identity
+
+    # -- the deterministic replica state machine -----------------------------
+
+    def _execute(self, command, timestamp: int):
+        """(outcome, signature): verify tear-off, commit, sign — what
+        the reference replica does in BFTSMaRt.Replica (BFTSMaRt.kt:
+        executeCommand: verify + commitInputStates + sign). `timestamp`
+        is the primary's ordering time: time-window validation uses it
+        so every replica computes the SAME outcome."""
+        from ..core.transactions import (
+            FilteredTransaction,
+            G_INPUTS,
+            G_NOTARY,
+            G_TIMEWINDOW,
+            TransactionVerificationError,
+        )
+        from ..crypto.hashes import SecureHash
+
+        kind, ftx_b = command
+        assert kind == "notarise", f"unknown bft command {kind!r}"
+        try:
+            ftx = ser.decode(bytes(ftx_b))
+        except ser.SerializationError:
+            return ["err", "invalid-proof", "undecodable tear-off"], None
+        if not isinstance(ftx, FilteredTransaction):
+            return ["err", "invalid-proof", "not a tear-off"], None
+        try:
+            ftx.verify()
+        except TransactionVerificationError as e:
+            return ["err", "invalid-proof", str(e)], None
+        for g, what in (
+            (G_INPUTS, "inputs"),
+            (G_NOTARY, "notary"),
+            (G_TIMEWINDOW, "time window"),
+        ):
+            if not ftx.all_revealed(g):
+                return ["err", "incomplete-tearoff", f"tear-off hides {what}"], None
+        if ftx.notary != self.identity:
+            return ["err", "wrong-notary", f"tx names {ftx.notary}"], None
+        if not self.time_window_checker.is_valid(
+            ftx.time_window, now=timestamp
+        ):
+            return ["err", "time-window-invalid", str(ftx.time_window)], None
+        conflict = {
+            str(ref): str(self.committed[ref])
+            for ref in ftx.inputs
+            if ref in self.committed and self.committed[ref] != ftx.id
+        }
+        if conflict:
+            return ["err", "conflict", conflict], None
+        for ref in ftx.inputs:
+            self.committed[ref] = ftx.id
+        sig = self.services.key_management.sign(
+            ftx.id,
+            self._member_key
+            or self.services.my_info.legal_identity.owning_key,
+        )
+        return ["ok", ftx.id.bytes_], sig
+
+    # -- the NotaryService surface (generator, like the others) --------------
+
+    def process(self, ftx, requester):
+        from ..core.transactions import FilteredTransaction
+        from ..flows.api import wait_future
+        from .notary import NotaryError
+
+        if not isinstance(ftx, FilteredTransaction):
+            return NotaryError("invalid-proof", "BFT notary takes a tear-off")
+        fut = self.replica.submit(["notarise", ser.encode(ftx)])
+        try:
+            outcome, sigs = yield from wait_future(fut)
+        except BftUnavailable as e:
+            return NotaryError("unavailable", str(e))
+        outcome = list(outcome)
+        if outcome[0] == "err":
+            kind, detail = outcome[1], outcome[2]
+            conflict = dict(detail) if kind == "conflict" else None
+            return NotaryError(
+                kind,
+                str(detail) if conflict is None else "input states consumed",
+                conflict=conflict,
+            )
+        return list(sigs)

@@ -87,9 +87,11 @@ class Node:
         self.tls = self._load_or_create_tls() if config.use_tls else None
 
         advertised: tuple[str, ...] = ()
-        if config.notary in ("simple", "raft"):
+        if config.notary in ("simple", "raft", "bft"):
+            # BFT is non-validating, like the reference's
+            # BFTNonValidatingNotaryService (its only BFT flavour)
             advertised = (SERVICE_NOTARY,)
-        elif config.notary in ("validating", "raft-validating", "bft"):
+        elif config.notary in ("validating", "raft-validating"):
             advertised = (SERVICE_NOTARY_VALIDATING,)
         if config.is_network_map_host:
             advertised = advertised + (SERVICE_NETWORK_MAP,)
@@ -100,20 +102,41 @@ class Node:
         self._cluster_identity = None
         self._cluster_keypair = None
         if config.notary in ("raft", "raft-validating", "bft"):
-            import hashlib
+            from .config import ConfigError
 
-            material = f"{config.cluster_name}:{config.cluster_key_seed}"
-            self._cluster_keypair = schemes.generate_keypair(
-                config.scheme_id,
-                seed=int.from_bytes(
-                    hashlib.sha256(material.encode()).digest()[:16], "big"
-                ),
-            )
+            if config.name not in config.cluster_peers:
+                raise ConfigError(
+                    f"{config.notary} notary needs cluster_peers including "
+                    f"this node"
+                )
+        if config.notary in ("raft", "raft-validating"):
             from ..core.identity import Party as _Party
 
+            self._cluster_keypair = self._derive_keypair(
+                f"{config.cluster_name}:{config.cluster_key_seed}"
+            )
             self._cluster_identity = _Party(
                 config.cluster_name, self._cluster_keypair.public
             )
+        elif config.notary == "bft":
+            # BFT: composite f+1 identity over per-member keys, all
+            # derivable from the shared (cluster_name, cluster_key_seed)
+            # config — dev-mode key provisioning, like the raft shared
+            # key (production distributes real key material out of band)
+            from ..core.identity import Party as _Party
+            from ..crypto.composite import CompositeKey
+
+            member_kps = {
+                peer: self._bft_member_keypair(peer)
+                for peer in config.cluster_peers
+            }
+            self._cluster_keypair = member_kps[config.name]
+            f = (len(config.cluster_peers) - 1) // 3
+            composite = CompositeKey.build(
+                [member_kps[p].public for p in config.cluster_peers],
+                threshold=f + 1,
+            )
+            self._cluster_identity = _Party(config.cluster_name, composite)
 
         self.info = NodeInfo(
             address=config.name,
@@ -208,6 +231,24 @@ class Node:
         self._worker_peers: dict[str, PeerAddress] = {}
         self.running = False
 
+    def _derive_keypair(self, material: str) -> schemes.KeyPair:
+        """Dev-mode key derivation from shared config material (cluster
+        service keys; production distributes real keys out of band)."""
+        import hashlib
+
+        return schemes.generate_keypair(
+            self.config.scheme_id,
+            seed=int.from_bytes(
+                hashlib.sha256(material.encode()).digest()[:16], "big"
+            ),
+        )
+
+    def _bft_member_keypair(self, member: str) -> schemes.KeyPair:
+        cfg = self.config
+        return self._derive_keypair(
+            f"{cfg.cluster_name}:{cfg.cluster_key_seed}:{member}"
+        )
+
     def _dev_seed(self, purpose: str):
         """Deterministic per-(node, purpose) RNG seed in dev mode, None
         (OS entropy) otherwise. The node name is mixed in: two dev nodes
@@ -289,6 +330,7 @@ class Node:
     def _install_notary(self) -> None:
         kind = self.config.notary
         self.raft = None
+        self.bft = None
         if kind == "":
             return
         if kind in ("simple", "validating"):
@@ -300,13 +342,8 @@ class Node:
             self.services.notary_service = cls(self.services, uniqueness)
             return
         if kind in ("raft", "raft-validating"):
-            from .config import ConfigError
             from .raft import RaftNode, RaftUniquenessProvider
 
-            if self.config.name not in self.config.cluster_peers:
-                raise ConfigError(
-                    "raft notary needs cluster_peers including this node"
-                )
             self.services.key_management.register_keypair(
                 self._cluster_keypair
             )
@@ -335,9 +372,35 @@ class Node:
                 service_identity=self._cluster_identity,
             )
             return
-        raise NotImplementedError(
-            f"notary kind {kind!r} lands with the BFT phase"
-        )
+        if kind == "bft":
+            from .bft import BftReplica, BFTNotaryService
+
+            # sign replies with the derived member key, not the node key
+            self.services.key_management.register_keypair(
+                self._cluster_keypair
+            )
+            replica = BftReplica(
+                self.config.name,
+                list(self.config.cluster_peers),
+                self.messaging,
+                lambda cmd, ts: (None, None),
+                self.services.clock,
+                cluster=self.config.cluster_name,
+                rng=random.Random(self._dev_seed("bft")),
+            )
+            self.bft = replica
+            self.services.notary_service = BFTNotaryService(
+                self.services,
+                replica,
+                self._cluster_identity,
+                member_key=self._cluster_keypair.public,
+                member_keys={
+                    peer: self._bft_member_keypair(peer).public
+                    for peer in self.config.cluster_peers
+                },
+            )
+            return
+        raise NotImplementedError(f"unknown notary kind {kind!r}")
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -381,22 +444,24 @@ class Node:
         self.running = True
         return self
 
-    def run(self) -> None:
-        """The pump loop — the single server thread (Node.kt:344)."""
-        while self.running:
-            self.messaging.pump(block=True, timeout=0.2)
-            self.scheduler.tick()
-            self.smm.tick()
-            if self.raft is not None:
-                self.raft.tick()
-
-    def pump(self, timeout: float = 0.0) -> int:
-        """One pump step (embedded/driver use)."""
-        n = self.messaging.pump(block=timeout > 0, timeout=timeout)
+    def _tick_services(self) -> None:
         self.scheduler.tick()
         self.smm.tick()
         if self.raft is not None:
             self.raft.tick()
+        if self.bft is not None:
+            self.bft.tick()
+
+    def run(self) -> None:
+        """The pump loop — the single server thread (Node.kt:344)."""
+        while self.running:
+            self.messaging.pump(block=True, timeout=0.2)
+            self._tick_services()
+
+    def pump(self, timeout: float = 0.0) -> int:
+        """One pump step (embedded/driver use)."""
+        n = self.messaging.pump(block=timeout > 0, timeout=timeout)
+        self._tick_services()
         return n
 
     def stop(self) -> None:
@@ -407,6 +472,8 @@ class Node:
         self.smm.stop()
         if self.raft is not None:
             self.raft.stop()
+        if self.bft is not None:
+            self.bft.stop()
         self.messaging.stop()
         self.db.close()
 

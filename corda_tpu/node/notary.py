@@ -129,10 +129,13 @@ class TimeWindowChecker:
         self.clock = clock
         self.tolerance = tolerance_micros
 
-    def is_valid(self, tw: Optional[TimeWindow]) -> bool:
+    def is_valid(self, tw: Optional[TimeWindow], now: Optional[int] = None) -> bool:
+        """`now` override: distributed notaries validate against the
+        consensus-ordered timestamp so every replica gets one answer."""
         if tw is None:
             return True
-        now = self.clock.now_micros()
+        if now is None:
+            now = self.clock.now_micros()
         if tw.until_time is not None and now - self.tolerance >= tw.until_time:
             return False
         if tw.from_time is not None and now + self.tolerance < tw.from_time:

@@ -226,6 +226,54 @@ class MockNetwork:
         self._sync_directories()
         return service_party, members
 
+    def create_bft_notary_cluster(self, n: int = 4, name: str = "BFTNotary"):
+        """3f+1 MockNodes forming a BFT notary cluster. The service
+        identity is a CompositeKey(threshold=f+1) over the member keys
+        (reference: BFTNonValidatingNotaryService.kt:29 + the cluster
+        composite identity in BFTSMaRt.kt). Returns (party, members)."""
+        import random as _random
+
+        from ..core.identity import Party
+        from ..crypto.composite import CompositeKey
+        from ..node.bft import BftReplica, BFTNotaryService
+
+        member_names = [f"{name}-{i}" for i in range(n)]
+        members = [self.create_node(m) for m in member_names]
+        f = (n - 1) // 3
+        composite = CompositeKey.build(
+            [m.party.owning_key for m in members], threshold=f + 1
+        )
+        service_party = Party(name, composite)
+        for node in members:
+            node.info = NodeInfo(
+                node.name,
+                node.party,
+                (SERVICE_NOTARY,),
+                cluster_identity=service_party,
+            )
+            node.services.my_info = node.info
+            replica = BftReplica(
+                node.name,
+                member_names,
+                node.messaging,
+                lambda cmd, ts: (None, None),   # rewired by the service
+                self.clock,
+                cluster=name,
+                rng=_random.Random(self.rng.getrandbits(32)),
+            )
+            node.bft = replica
+            node.ticks.append(replica.tick)
+            node.services.notary_service = BFTNotaryService(
+                node.services,
+                replica,
+                service_party,
+                member_keys={
+                    m.name: m.party.owning_key for m in members
+                },
+            )
+        self._sync_directories()
+        return service_party, members
+
     def elect(self, members, max_rounds: int = 300):
         """Advance time until the cluster settles on a leader."""
         from ..node.raft import LEADER
