@@ -7,6 +7,21 @@ from .cash import (
     CashPaymentFlow,
     CashState,
 )
+from .commercial_paper import (
+    CommercialPaper,
+    CommercialPaperState,
+)
+from .obligation import (
+    Obligation,
+    ObligationState,
+)
+from .trade_flows import (
+    BuyerFlow,
+    DealInstigatorFlow,
+    IssuanceRequesterFlow,
+    IssuerHandlerFlow,
+    SellerFlow,
+)
 
 __all__ = [
     "Cash",
@@ -14,4 +29,13 @@ __all__ = [
     "CashIssueFlow",
     "CashPaymentFlow",
     "CashState",
+    "CommercialPaper",
+    "CommercialPaperState",
+    "Obligation",
+    "ObligationState",
+    "BuyerFlow",
+    "DealInstigatorFlow",
+    "IssuanceRequesterFlow",
+    "IssuerHandlerFlow",
+    "SellerFlow",
 ]

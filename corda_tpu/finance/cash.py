@@ -225,9 +225,7 @@ class CashExitFlow(FlowLogic):
     def call(self):
         us = self.our_identity
         token = Issued(PartyAndReference(us, self.issuer_ref), self.currency)
-        lock_id = yield from self.record(
-            lambda: self.services.key_management.fresh_key().fingerprint()
-        )
+        lock_id = self.lock_id   # flow-scoped: auto-released on flow end
         coins = yield from self.record(
             lambda: self.services.vault.unconsumed_states_for_spending(
                 self.quantity,
@@ -262,9 +260,7 @@ def generate_spend(flow: FlowLogic, quantity: int, currency: str, to_key):
     soft-lock id)."""
     services = flow.services
     us = flow.our_identity
-    lock_id = yield from flow.record(
-        lambda: services.key_management.fresh_key().fingerprint()
-    )
+    lock_id = flow.lock_id   # flow-scoped: auto-released on flow end
     # The selection is journaled: on checkpoint replay the recorded
     # coins are reused verbatim (never re-selected against a vault that
     # may have changed), so the rebuilt tx id matches the journaled

@@ -287,6 +287,14 @@ class FlowLogic:
     def our_identity(self) -> Party:
         return self.services.my_info.legal_identity
 
+    @property
+    def lock_id(self) -> bytes:
+        """This flow's soft-lock id (= the flow id). Locks taken under
+        it are released automatically when the flow ends — success OR
+        failure (reference: VaultSoftLockManager's flow-lifecycle
+        release)."""
+        return self._machine.id
+
     def __repr__(self) -> str:
         return f"<{type(self).__name__}>"
 

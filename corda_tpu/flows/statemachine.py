@@ -169,6 +169,12 @@ class CheckpointCorruption(Exception):
     pass
 
 
+# Bump whenever the checkpoint record or journal-entry layout changes
+# (e.g. v2: flow-scoped lock ids removed the journaled lock-id record).
+# Restore refuses other versions instead of replaying shifted entries.
+CHECKPOINT_FORMAT = 2
+
+
 class StateMachineManager:
     """Runs flows over a MessagingService against a ServiceHub.
 
@@ -198,6 +204,17 @@ class StateMachineManager:
         tx_store = getattr(services, "validated_transactions", None)
         if tx_store is not None:
             tx_store.observers.append(self._notify_tx_recorded)
+        # flow-end soft-lock release rides the lifecycle seam (the
+        # VaultSoftLockManager role): a FAILED spend must not leave its
+        # coins unspendable. Registered here so every assembly gets it;
+        # replaceable/removable like any other lifecycle observer.
+        vault = getattr(services, "vault", None)
+        if vault is not None:
+            def _release_locks(kind: str, fsm: FlowStateMachine) -> None:
+                if kind == "removed":
+                    vault.release_soft_locks(fsm.id)
+
+            self.lifecycle.append(_release_locks)
 
     def stop(self) -> None:
         """Detach from the fabric and services. A node restart MUST stop
@@ -266,7 +283,16 @@ class StateMachineManager:
         return len(restored)
 
     def _restore_one(self, flow_id: bytes, rec: Any) -> FlowStateMachine:
-        tag, root_tag, snapshot, journal, send_seq, sess_snap = rec
+        if not rec or rec[0] != CHECKPOINT_FORMAT:
+            # a checkpoint from a different journal layout must fail
+            # loudly at restore, not wedge mid-replay with shifted
+            # journal entries masquerading as each other
+            raise CheckpointCorruption(
+                f"checkpoint format {rec[0] if rec else '?'} != "
+                f"{CHECKPOINT_FORMAT}; cannot resume flows written by a "
+                f"different framework version"
+            )
+        _version, tag, root_tag, snapshot, journal, send_seq, sess_snap = rec
         logic = _reconstruct_logic(tag, snapshot)
         fsm = FlowStateMachine(flow_id, logic, snapshot, root_tag)
         fsm.journal = journal
@@ -308,6 +334,7 @@ class StateMachineManager:
             for s in fsm.sessions.values()
         ]
         rec = ser.encode([
+            CHECKPOINT_FORMAT,
             _class_tag(type(fsm.logic)),
             fsm.root_tag,
             fsm.snapshot,

@@ -75,7 +75,7 @@ class NodeConfig:
     # CorDapp modules imported at boot: registers contract/state classes
     # with the codec and @initiated_by responders (the reference's
     # CorDapp classpath scan, AbstractNode.kt:427)
-    cordapps: tuple[str, ...] = ("corda_tpu.finance.cash",)
+    cordapps: tuple[str, ...] = ("corda_tpu.finance",)
 
     def __post_init__(self):
         if not self.name:

@@ -1,0 +1,494 @@
+"""Finance library: CommercialPaper, Obligation, trade & issuer flows.
+
+Reference behaviours under test: CommercialPaper.kt (issue/move/redeem
+rules), Obligation.kt (issue/move/settle/net/lifecycle),
+TwoPartyTradeFlow.kt (atomic DvP incl. dishonest-draft rejection),
+IssuerFlow.kt (bank issuance on request).
+"""
+
+import pytest
+
+from corda_tpu.core.contracts import (
+    Amount,
+    Command,
+    CommandWithParties,
+    ContractViolation,
+    Issued,
+    StateAndRef,
+    StateRef,
+    TimeWindow,
+    TransactionState,
+)
+from corda_tpu.core.identity import Party, PartyAndReference
+from corda_tpu.core.transactions import LedgerTransaction
+from corda_tpu.crypto import schemes
+from corda_tpu.crypto.hashes import SecureHash
+from corda_tpu.finance.cash import CASH_CONTRACT, CashMove, CashState
+from corda_tpu.finance.commercial_paper import (
+    CP_CONTRACT,
+    CommercialPaper,
+    CommercialPaperState,
+    CPIssue,
+    CPMove,
+    CPRedeem,
+)
+from corda_tpu.finance.obligation import (
+    NORMAL,
+    DEFAULTED,
+    OBLIGATION_CONTRACT,
+    Obligation,
+    ObligationIssue,
+    ObligationNet,
+    ObligationSetLifecycle,
+    ObligationSettle,
+    ObligationState,
+)
+
+# -- ring-2 fixtures ---------------------------------------------------------
+
+ISSUER_KP = schemes.generate_keypair(seed=101)
+ALICE_KP = schemes.generate_keypair(seed=102)
+BOB_KP = schemes.generate_keypair(seed=103)
+NOTARY_KP = schemes.generate_keypair(seed=104)
+
+ISSUER = Party("MegaCorp", ISSUER_KP.public)
+ALICE = Party("Alice", ALICE_KP.public)
+BOB = Party("Bob", BOB_KP.public)
+NOTARY = Party("Notary", NOTARY_KP.public)
+
+TOKEN = Issued(PartyAndReference(ISSUER, b"\x01"), "USD")
+MATURITY = 2_000_000_000_000_000   # some future microsecond
+
+
+def ltx(inputs=(), outputs=(), commands=(), time_window=None):
+    """Minimal ledger-DSL: states are (data, contract) pairs."""
+    ins = tuple(
+        StateAndRef(
+            TransactionState(data, contract, NOTARY),
+            StateRef(SecureHash.sha256(bytes([i])), i),
+        )
+        for i, (data, contract) in enumerate(inputs)
+    )
+    outs = tuple(
+        TransactionState(data, contract, NOTARY) for data, contract in outputs
+    )
+    cmds = tuple(
+        CommandWithParties(tuple(signers), (), value)
+        for value, signers in commands
+    )
+    return LedgerTransaction(
+        ins, outs, cmds, (), NOTARY, time_window,
+        SecureHash.sha256(b"test-tx"),
+    )
+
+
+def paper(owner=ALICE_KP.public, face=10_000, maturity=MATURITY):
+    return CommercialPaperState(
+        PartyAndReference(ISSUER, b"\x01"), owner, Amount(face, TOKEN), maturity
+    )
+
+
+def cash(qty, owner):
+    return CashState(Amount(qty, TOKEN), owner)
+
+
+# -- CommercialPaper contract ------------------------------------------------
+
+
+def test_cp_issue_valid():
+    CommercialPaper().verify(ltx(
+        outputs=[(paper(owner=ISSUER_KP.public), CP_CONTRACT)],
+        commands=[(CPIssue(), [ISSUER_KP.public])],
+        time_window=TimeWindow(until_time=MATURITY - 1),
+    ))
+
+
+def test_cp_issue_requires_issuer_signature():
+    with pytest.raises(ContractViolation, match="signed by the issuer"):
+        CommercialPaper().verify(ltx(
+            outputs=[(paper(), CP_CONTRACT)],
+            commands=[(CPIssue(), [ALICE_KP.public])],
+            time_window=TimeWindow(until_time=MATURITY - 1),
+        ))
+
+
+def test_cp_issue_rejects_past_maturity():
+    with pytest.raises(ContractViolation, match="maturity is in the future"):
+        CommercialPaper().verify(ltx(
+            outputs=[(paper(maturity=5), CP_CONTRACT)],
+            commands=[(CPIssue(), [ISSUER_KP.public])],
+            time_window=TimeWindow(until_time=MATURITY),
+        ))
+
+
+def test_cp_move_valid_and_ownership_checked():
+    CommercialPaper().verify(ltx(
+        inputs=[(paper(owner=ALICE_KP.public), CP_CONTRACT)],
+        outputs=[(paper(owner=BOB_KP.public), CP_CONTRACT)],
+        commands=[(CPMove(), [ALICE_KP.public])],
+    ))
+    with pytest.raises(ContractViolation, match="signed by the current owner"):
+        CommercialPaper().verify(ltx(
+            inputs=[(paper(owner=ALICE_KP.public), CP_CONTRACT)],
+            outputs=[(paper(owner=BOB_KP.public), CP_CONTRACT)],
+            commands=[(CPMove(), [BOB_KP.public])],
+        ))
+
+
+def test_cp_move_cannot_alter_face_value():
+    with pytest.raises(ContractViolation):
+        CommercialPaper().verify(ltx(
+            inputs=[(paper(face=10_000), CP_CONTRACT)],
+            outputs=[(paper(face=20_000, owner=BOB_KP.public), CP_CONTRACT)],
+            commands=[(CPMove(), [ALICE_KP.public])],
+        ))
+
+
+def test_cp_redeem_pays_face_value():
+    CommercialPaper().verify(ltx(
+        inputs=[
+            (paper(owner=ALICE_KP.public), CP_CONTRACT),
+            (cash(10_000, ISSUER_KP.public), CASH_CONTRACT),
+        ],
+        outputs=[(cash(10_000, ALICE_KP.public), CASH_CONTRACT)],
+        commands=[
+            (CPRedeem(), [ALICE_KP.public]),
+            (CashMove(), [ISSUER_KP.public]),
+        ],
+        time_window=TimeWindow(from_time=MATURITY),
+    ))
+
+
+def test_cp_redeem_underpayment_rejected():
+    with pytest.raises(ContractViolation, match="face value"):
+        CommercialPaper().verify(ltx(
+            inputs=[
+                (paper(owner=ALICE_KP.public), CP_CONTRACT),
+                (cash(4_000, ISSUER_KP.public), CASH_CONTRACT),
+            ],
+            outputs=[(cash(4_000, ALICE_KP.public), CASH_CONTRACT)],
+            commands=[
+                (CPRedeem(), [ALICE_KP.public]),
+                (CashMove(), [ISSUER_KP.public]),
+            ],
+            time_window=TimeWindow(from_time=MATURITY),
+        ))
+
+
+def test_cp_early_redeem_rejected():
+    with pytest.raises(ContractViolation, match="matured"):
+        CommercialPaper().verify(ltx(
+            inputs=[
+                (paper(owner=ALICE_KP.public), CP_CONTRACT),
+                (cash(10_000, ISSUER_KP.public), CASH_CONTRACT),
+            ],
+            outputs=[(cash(10_000, ALICE_KP.public), CASH_CONTRACT)],
+            commands=[
+                (CPRedeem(), [ALICE_KP.public]),
+                (CashMove(), [ISSUER_KP.public]),
+            ],
+            time_window=TimeWindow(from_time=MATURITY - 10),
+        ))
+
+
+# -- Obligation contract -----------------------------------------------------
+
+
+def iou(qty=5_000, obligor=ISSUER, beneficiary=ALICE_KP.public, lc=NORMAL):
+    return ObligationState(obligor, beneficiary, Amount(qty, TOKEN), MATURITY, lc)
+
+
+def test_obligation_issue():
+    Obligation().verify(ltx(
+        outputs=[(iou(), OBLIGATION_CONTRACT)],
+        commands=[(ObligationIssue(), [ISSUER_KP.public])],
+    ))
+    with pytest.raises(ContractViolation, match="signed by the obligor"):
+        Obligation().verify(ltx(
+            outputs=[(iou(), OBLIGATION_CONTRACT)],
+            commands=[(ObligationIssue(), [ALICE_KP.public])],
+        ))
+
+
+def test_obligation_settle_with_cash():
+    Obligation().verify(ltx(
+        inputs=[
+            (iou(5_000), OBLIGATION_CONTRACT),
+            (cash(5_000, ISSUER_KP.public), CASH_CONTRACT),
+        ],
+        outputs=[
+            (iou(2_000), OBLIGATION_CONTRACT),
+            (cash(3_000, ALICE_KP.public), CASH_CONTRACT),
+            (cash(2_000, ISSUER_KP.public), CASH_CONTRACT),
+        ],
+        commands=[
+            (ObligationSettle(Amount(3_000, TOKEN)), [ISSUER_KP.public]),
+            (CashMove(), [ISSUER_KP.public]),
+        ],
+    ))
+
+
+def test_obligation_settle_without_payment_rejected():
+    with pytest.raises(ContractViolation, match="paid the settled amount"):
+        Obligation().verify(ltx(
+            inputs=[(iou(5_000), OBLIGATION_CONTRACT)],
+            outputs=[(iou(2_000), OBLIGATION_CONTRACT)],
+            commands=[
+                (ObligationSettle(Amount(3_000, TOKEN)), [ISSUER_KP.public]),
+            ],
+        ))
+
+
+def test_obligation_bilateral_netting():
+    # MegaCorp owes Alice 5000; Alice(as obligor party) owes MegaCorp 2000
+    alice_party = Party("Alice", ALICE_KP.public)
+    a_owes_m = ObligationState(
+        alice_party, ISSUER_KP.public, Amount(2_000, TOKEN), MATURITY
+    )
+    m_owes_a = iou(5_000)
+    residual = iou(3_000)
+    Obligation().verify(ltx(
+        inputs=[
+            (m_owes_a, OBLIGATION_CONTRACT),
+            (a_owes_m, OBLIGATION_CONTRACT),
+        ],
+        outputs=[(residual, OBLIGATION_CONTRACT)],
+        commands=[
+            (ObligationNet(), [ISSUER_KP.public, ALICE_KP.public]),
+        ],
+    ))
+    # wrong residual amount rejected
+    with pytest.raises(ContractViolation, match="net positions"):
+        Obligation().verify(ltx(
+            inputs=[
+                (m_owes_a, OBLIGATION_CONTRACT),
+                (a_owes_m, OBLIGATION_CONTRACT),
+            ],
+            outputs=[(iou(4_000), OBLIGATION_CONTRACT)],
+            commands=[
+                (ObligationNet(), [ISSUER_KP.public, ALICE_KP.public]),
+            ],
+        ))
+
+
+def test_obligation_default_lifecycle():
+    Obligation().verify(ltx(
+        inputs=[(iou(), OBLIGATION_CONTRACT)],
+        outputs=[(iou(lc=DEFAULTED), OBLIGATION_CONTRACT)],
+        commands=[
+            (ObligationSetLifecycle(DEFAULTED), [ALICE_KP.public]),
+        ],
+        time_window=TimeWindow(from_time=MATURITY),
+    ))
+    # cannot default before the due date
+    with pytest.raises(ContractViolation, match="past the due date"):
+        Obligation().verify(ltx(
+            inputs=[(iou(), OBLIGATION_CONTRACT)],
+            outputs=[(iou(lc=DEFAULTED), OBLIGATION_CONTRACT)],
+            commands=[
+                (ObligationSetLifecycle(DEFAULTED), [ALICE_KP.public]),
+            ],
+            time_window=TimeWindow(from_time=MATURITY - 100),
+        ))
+
+
+# -- flows (ring 3) ----------------------------------------------------------
+
+
+@pytest.fixture
+def trade_net():
+    from corda_tpu.testing.mock_network import MockNetwork
+
+    net = MockNetwork(seed=77)
+    notary = net.create_notary("Notary")
+    bank = net.create_node("Bank")
+    seller = net.create_node("Seller")
+    buyer = net.create_node("Buyer")
+    return net, notary, bank, seller, buyer
+
+
+def issue_paper(net, node, notary, face=10_000):
+    """Self-issue commercial paper on `node` (trader-demo's seller prep)."""
+    from corda_tpu.core.transactions import TransactionBuilder
+    from corda_tpu.finance.commercial_paper import (
+        CommercialPaperState,
+        generate_issue,
+    )
+    from corda_tpu.flows.core_flows import FinalityFlow
+
+    token = Issued(PartyAndReference(node.party, b"\x01"), "USD")
+    builder = TransactionBuilder(notary.party)
+    builder.set_time_window(
+        TimeWindow(until_time=net.clock.now_micros() + 1_000_000)
+    )
+    generate_issue(
+        builder,
+        PartyAndReference(node.party, b"\x01"),
+        Amount(face, token),
+        net.clock.now_micros() + 10**9,
+    )
+    stx = node.services.sign_initial_transaction(builder)
+    node.run_flow(FinalityFlow(stx))
+    return node.vault.unconsumed_states(CommercialPaperState)[0]
+
+
+def test_two_party_trade_dvp(trade_net):
+    """trader-demo: Bank funds Buyer; Seller sells paper for cash."""
+    from corda_tpu.finance.cash import CashIssueFlow
+    from corda_tpu.finance.commercial_paper import CommercialPaperState
+    from corda_tpu.finance.trade_flows import SellerFlow
+
+    net, notary, bank, seller, buyer = trade_net
+    # fund the buyer with bank-issued USD
+    buyer.run_flow(CashIssueFlow(100_000, "USD", buyer.party, notary.party))
+    paper_sar = issue_paper(net, seller, notary)
+
+    fsm = seller.start_flow(
+        SellerFlow(
+            buyer.party,
+            paper_sar,
+            Amount(60_000, Issued(PartyAndReference(buyer.party, b"\x01"), "USD")),
+        )
+    )
+    net.run()
+    fsm.result_or_throw()
+
+    # seller got paid, buyer holds the paper
+    seller_cash = sum(
+        s.state.data.amount.quantity
+        for s in seller.vault.unconsumed_states(CashState)
+    )
+    assert seller_cash == 60_000
+    buyer_paper = buyer.vault.unconsumed_states(CommercialPaperState)
+    assert len(buyer_paper) == 1
+    assert buyer_paper[0].state.data.owner == buyer.party.owning_key
+    # and the trade was atomic: one transaction moved both legs
+    stx = buyer.services.validated_transactions.get(buyer_paper[0].ref.txhash)
+    assert any(
+        isinstance(t.data, CashState) for t in stx.wtx.outputs
+    )
+
+
+def test_seller_rejects_underpaying_draft(trade_net):
+    """A malicious buyer paying less than the asking price is refused
+    by the seller's draft check."""
+    from corda_tpu.finance.cash import CashIssueFlow
+    from corda_tpu.finance.trade_flows import BuyerFlow, SellerFlow
+    from corda_tpu.flows.api import FlowException
+
+    net, notary, bank, seller, buyer = trade_net
+    buyer.run_flow(CashIssueFlow(100_000, "USD", buyer.party, notary.party))
+    paper_sar = issue_paper(net, seller, notary)
+
+    # sabotage: buyer underpays by patching its generate_spend quantity
+    original_call = BuyerFlow.call
+
+    def stingy_call(self):
+        offer = yield from self.receive(self.seller, SellerTradeInfo)
+        from corda_tpu.finance.cash import generate_spend
+        from corda_tpu.finance.commercial_paper import CPMove
+        from corda_tpu.flows.core_flows import ResolveTransactionsFlow
+
+        yield from self.sub_flow(
+            ResolveTransactionsFlow([offer.asset.ref.txhash], self.seller)
+        )
+        builder, _ = yield from generate_spend(
+            self, 1_000, "USD", offer.seller_owner_key   # lowball!
+        )
+        builder.add_input_state(offer.asset)
+        builder.add_output_state(
+            offer.asset.state.data.with_owner(self.our_identity.owning_key),
+            offer.asset.state.contract,
+        )
+        builder.add_command(CPMove(), offer.asset.state.data.owner)
+        stx = self.services.sign_initial_transaction(builder)
+        yield from self.send(self.seller, stx)
+        return None
+
+    from corda_tpu.finance.trade_flows import SellerTradeInfo
+
+    BuyerFlow.call = stingy_call
+    try:
+        fsm = seller.start_flow(
+            SellerFlow(
+                buyer.party,
+                paper_sar,
+                Amount(60_000, Issued(PartyAndReference(buyer.party, b"\x01"), "USD")),
+            )
+        )
+        net.run()
+        with pytest.raises(FlowException, match="asking price"):
+            fsm.result_or_throw()
+    finally:
+        BuyerFlow.call = original_call
+
+
+def test_issuer_flow(trade_net):
+    """bank-of-corda: a party requests issuance from the bank."""
+    from corda_tpu.finance.trade_flows import IssuanceRequesterFlow
+
+    net, notary, bank, seller, buyer = trade_net
+    fsm = buyer.start_flow(IssuanceRequesterFlow(bank.party, 42_000, "CHF"))
+    net.run()
+    stx = fsm.result_or_throw()
+    assert stx is not None
+    balance = sum(
+        s.state.data.amount.quantity
+        for s in buyer.vault.unconsumed_states(CashState)
+    )
+    assert balance == 42_000
+    # the issuer of the cash is the bank
+    coin = buyer.vault.unconsumed_states(CashState)[0]
+    assert coin.state.data.issuer == bank.party
+
+
+def test_issuer_flow_policy_refusal(trade_net):
+    from corda_tpu.finance.trade_flows import IssuanceRequesterFlow
+    from corda_tpu.flows.api import FlowException
+
+    net, notary, bank, seller, buyer = trade_net
+
+    def policy(req, requester):
+        if req.quantity > 10_000:
+            raise ValueError("issuance cap exceeded")
+
+    bank.services.issuance_policy = policy
+    fsm = buyer.start_flow(IssuanceRequesterFlow(bank.party, 50_000, "CHF"))
+    net.run()
+    with pytest.raises(FlowException, match="cap exceeded"):
+        fsm.result_or_throw()
+
+
+class AbortAfterSelectFlow:
+    """Selects coins, then dies — the lock-leak reproduction."""
+
+
+def test_failed_spend_releases_soft_locks(trade_net):
+    """A flow that dies after coin selection must not leave its coins
+    locked (reference: VaultSoftLockManager releases on flow end)."""
+    from corda_tpu.finance.cash import (
+        CashIssueFlow,
+        CashPaymentFlow,
+        generate_spend,
+    )
+    from corda_tpu.flows.api import FlowException, FlowLogic
+
+    net, notary, bank, seller, buyer = trade_net
+    buyer.run_flow(CashIssueFlow(10_000, "USD", buyer.party, notary.party))
+
+    class _Abort(FlowLogic):
+        def call(self):
+            yield from generate_spend(
+                self, 8_000, "USD", seller.party.owning_key
+            )
+            raise FlowException("deliberate mid-flow failure")
+
+    fsm = buyer.start_flow(_Abort())
+    net.run()
+    with pytest.raises(FlowException, match="deliberate"):
+        fsm.result_or_throw()
+    assert buyer.vault._soft_locks == {}, "failed flow leaked soft locks"
+    # the coins are free again: a legitimate spend succeeds
+    fsm2 = buyer.start_flow(CashPaymentFlow(8_000, "USD", seller.party))
+    net.run()
+    fsm2.result_or_throw()
