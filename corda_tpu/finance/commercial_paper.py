@@ -86,6 +86,9 @@ class CommercialPaper:
         require_that("a CommercialPaper command is present", len(cmds) == 1)
         cmd = cmds[0]
         tw = ltx.time_window
+        # redemption cash is accounted GLOBALLY per (owner, token): the
+        # same cash output must not satisfy two papers (double-count)
+        redeem_required: dict = {}
         for group in groups:
             issuance, face_value, maturity = group.key
             if isinstance(cmd.value, CPIssue):
@@ -138,20 +141,24 @@ class CommercialPaper:
                     tw.from_time is not None and tw.from_time >= maturity,
                 )
                 for inp in group.inputs:
-                    received = sum(
-                        s.amount.quantity
-                        for s in ltx.outputs_of_type(CashState)
-                        if s.owner == inp.owner
-                        and s.amount.token == face_value.token
-                    )
-                    require_that(
-                        "owner receives the face value in cash",
-                        received >= face_value.quantity,
+                    key = (inp.owner, face_value.token)
+                    redeem_required[key] = (
+                        redeem_required.get(key, 0) + face_value.quantity
                     )
                     require_that(
                         "redeem is signed by the owner",
                         _signed_by(inp.owner, set(cmd.signers)),
                     )
+        for (owner, token), required in redeem_required.items():
+            received = sum(
+                s.amount.quantity
+                for s in ltx.outputs_of_type(CashState)
+                if s.owner == owner and s.amount.token == token
+            )
+            require_that(
+                "owner receives the face value of every redeemed paper",
+                received >= required,
+            )
 
 
 register_contract(CP_CONTRACT, CommercialPaper())

@@ -145,16 +145,36 @@ class Obligation:
                     in_sum - out_sum == settled.quantity
                     and settled.quantity > 0,
                 )
-                for s in group.inputs:
-                    paid = sum(
-                        c.amount.quantity
-                        for c in ltx.outputs_of_type(CashState)
-                        if c.owner == s.beneficiary and c.amount.token == token
+                # the obligor settles unilaterally, so the residual must
+                # be EXACTLY the input claim minus cash actually paid:
+                # same beneficiary, same lifecycle — anything else would
+                # let the obligor reassign or default the remainder
+                # without the beneficiary's signature
+                beneficiaries = {s.beneficiary for s in group.inputs}
+                require_that(
+                    "settle covers one beneficiary's obligations",
+                    len(beneficiaries) == 1,
+                )
+                lifecycles = {s.lifecycle for s in group.inputs}
+                (beneficiary,) = beneficiaries
+                for s in group.outputs:
+                    require_that(
+                        "residual keeps the input beneficiary",
+                        s.beneficiary == beneficiary,
                     )
                     require_that(
-                        "beneficiary is paid the settled amount in cash",
-                        paid >= settled.quantity,
+                        "residual keeps the input lifecycle",
+                        s.lifecycle in lifecycles,
                     )
+                paid = sum(
+                    c.amount.quantity
+                    for c in ltx.outputs_of_type(CashState)
+                    if c.owner == beneficiary and c.amount.token == token
+                )
+                require_that(
+                    "beneficiary is paid the settled amount in cash",
+                    paid >= settled.quantity,
+                )
                 require_that(
                     "settle is signed by the obligor",
                     _signed_by(obligor.owning_key, signers),

@@ -77,11 +77,21 @@ class SellerFlow(FlowLogic):
         return final
 
     def _check_draft(self, stx: SignedTransaction) -> None:
-        """The buyer's draft is untrusted: it must consume our asset and
-        pay us (at least) the asking price (Seller.checkProposal)."""
+        """The buyer's draft is untrusted: it must consume our asset,
+        pay us (at least) the asking price, and touch NOTHING ELSE of
+        ours (Seller.checkProposal) — our signature covers every input,
+        so a draft sneaking a second seller-owned state into another
+        group would move it for free."""
         wtx = stx.wtx
         if self.asset.ref not in wtx.inputs:
             raise FlowException("draft does not consume the offered asset")
+        for ref in wtx.inputs:
+            if ref == self.asset.ref:
+                continue
+            if self.services.vault.state_and_ref(ref) is not None:
+                raise FlowException(
+                    f"draft consumes our state {ref} beyond the offer"
+                )
         us = self.our_identity.owning_key
         paid = sum(
             t.data.amount.quantity

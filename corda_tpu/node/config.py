@@ -60,6 +60,10 @@ class NodeConfig:
     network_map_fingerprint: Optional[bytes] = None
     notary: str = ""
     verifier_type: str = "in_memory"
+    # which BatchSignatureVerifier backs signature checks: "tpu" (the
+    # production batch kernels) or "cpu" (the bit-exact reference —
+    # test/driver runs dodge per-process jit compiles with it)
+    verifier_backend: str = "tpu"
     dev_mode: bool = True
     key_seed: int = 0                       # dev: deterministic identity
     scheme: str = "ed25519"
@@ -87,6 +91,10 @@ class NodeConfig:
         if self.verifier_type not in VERIFIER_TYPES:
             raise ConfigError(
                 f"unknown verifier_type {self.verifier_type!r}"
+            )
+        if self.verifier_backend not in ("tpu", "cpu"):
+            raise ConfigError(
+                f"unknown verifier_backend {self.verifier_backend!r}"
             )
         if self.scheme not in _SCHEME_NAMES:
             raise ConfigError(
@@ -182,6 +190,7 @@ def write_config(cfg: NodeConfig, path: str) -> None:
         emit("network_map_fingerprint", cfg.network_map_fingerprint.hex())
     emit("notary", cfg.notary)
     emit("verifier_type", cfg.verifier_type)
+    emit("verifier_backend", cfg.verifier_backend)
     emit("dev_mode", cfg.dev_mode)
     emit("key_seed", cfg.key_seed)
     emit("scheme", cfg.scheme)

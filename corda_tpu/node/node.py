@@ -148,6 +148,11 @@ class Node:
             cluster_identity=self._cluster_identity,
         )
 
+        if batch_verifier is None and config.verifier_backend == "cpu":
+            from ..crypto.batch_verifier import CpuBatchVerifier
+
+            batch_verifier = CpuBatchVerifier()
+
         # -- services over one shared database -------------------------
         self.services = PersistentServiceHub.open(
             "",   # path unused: db is shared

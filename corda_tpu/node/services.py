@@ -645,8 +645,12 @@ class VaultService:
         ):
             if cls is not None and not isinstance(ts.data, cls):
                 continue
-            lock = self._soft_locks.get(ref)
-            if lock is not None and lock != lock_id:
+            # ANY live lock excludes the coin — including this flow's
+            # own: a second spend in the same flow must not re-select
+            # coins its first spend already committed to (replay never
+            # re-selects, it reuses the journaled picks, so self-lock
+            # re-selection is never needed)
+            if self._soft_locks.get(ref) is not None:
                 continue
             if not predicate(ts):
                 continue
@@ -655,7 +659,9 @@ class VaultService:
             if total >= amount_quantity:
                 break
         if total < amount_quantity:
-            self.release_soft_locks(lock_id)
+            # nothing to release: the picked coins were never locked,
+            # and dropping the whole lock_id here would free an EARLIER
+            # spend's in-flight locks in the same flow
             raise InsufficientBalanceError(amount_quantity - total)
         for sar in picked:
             self._soft_locks[sar.ref] = lock_id

@@ -208,3 +208,12 @@ class NoResponderFlow(FlowLogic):
     def call(self):
         reply = yield from self.send_and_receive(self.other, 1, int)
         return reply
+
+
+@initiating_flow
+class NoOpFlow(FlowLogic):
+    """The empty flow: no IO, returns immediately — the
+    NodePerformanceTests round-trip probe (NodePerformanceTests.kt:59)."""
+
+    def call(self):
+        return "done"

@@ -1,0 +1,79 @@
+"""Driver DSL + loadtest harness over real node processes.
+
+Reference behaviours under test: Driver.kt (map-first boot, port
+allocation, RPC handshake, teardown), LoadTest.kt (command stream +
+reconciliation), Disruption.kt (kill/restart interleaved with
+traffic), NodePerformanceTests.kt (empty-flow throughput probe).
+
+These are Ring-4 tests: every node is a separate OS process.
+"""
+
+import pytest
+
+from corda_tpu.finance.cash import CashIssueFlow, CashPaymentFlow
+from corda_tpu.node.vault_query import VaultQueryCriteria
+from corda_tpu.testing.driver import DriverTimeout, driver
+from corda_tpu.testing.loadtest import (
+    CrossCashLoadTest,
+    Disruption,
+    EmptyFlowLoadTest,
+    kill_and_restart,
+)
+
+
+@pytest.fixture
+def net(tmp_path):
+    with driver(str(tmp_path)) as d:
+        d.start_node("Hub", notary="validating")
+        alice = d.start_node("Alice")
+        bob = d.start_node("Bob")
+        d.wait_for_network(3)
+        yield d, alice, bob
+
+
+def test_driver_spins_up_and_pays(net):
+    d, alice, bob = net
+    notary = d.notary_identity()
+    cli = d.rpc(alice)
+    me = d.identity_of(alice)
+    handle = d.wait(cli.start_flow(CashIssueFlow(1_000, "USD", me, notary)))
+    d.wait(handle.result)
+    bob_party = d.identity_of(bob)
+    handle = d.wait(cli.start_flow(CashPaymentFlow(400, "USD", bob_party)))
+    d.wait(handle.result)
+
+    page = d.wait(d.rpc(bob).vault_query_by(VaultQueryCriteria()))
+    assert sum(s.state.data.amount.quantity for s in page.states) == 400
+
+
+def test_cross_cash_loadtest_reconciles(net):
+    d, alice, bob = net
+    lt = CrossCashLoadTest(
+        d, [alice, bob], d.notary_identity(), seed=9
+    )
+    result = lt.run(count=12)
+    assert result.failed == 0, (result.expected, result.actual)
+    assert result.reconciled, (result.expected, result.actual)
+    assert result.throughput > 0
+
+
+def test_loadtest_survives_kill_and_restart(net):
+    """Traffic interleaved with a kill -9 + restart of a random node
+    still reconciles (CrossCashTest under Disruption)."""
+    d, alice, bob = net
+    lt = CrossCashLoadTest(d, [alice, bob], d.notary_identity(), seed=10)
+    result = lt.run(
+        count=10,
+        disruptions=(
+            Disruption("kill+restart", 0.5, kill_and_restart),
+        ),
+        timeout_per_flow=180.0,
+    )
+    assert result.reconciled, (result.expected, result.actual)
+
+
+def test_empty_flow_throughput_probe(net):
+    d, alice, _bob = net
+    stats = EmptyFlowLoadTest(d, alice).run(count=10)
+    assert stats["flows_per_s"] > 0
+    assert stats["avg_latency_ms"] > 0

@@ -459,10 +459,6 @@ def test_issuer_flow_policy_refusal(trade_net):
         fsm.result_or_throw()
 
 
-class AbortAfterSelectFlow:
-    """Selects coins, then dies — the lock-leak reproduction."""
-
-
 def test_failed_spend_releases_soft_locks(trade_net):
     """A flow that dies after coin selection must not leave its coins
     locked (reference: VaultSoftLockManager releases on flow end)."""
@@ -492,3 +488,101 @@ def test_failed_spend_releases_soft_locks(trade_net):
     fsm2 = buyer.start_flow(CashPaymentFlow(8_000, "USD", seller.party))
     net.run()
     fsm2.result_or_throw()
+
+
+def test_cp_redeem_cannot_double_count_cash():
+    """Two identical papers redeemed for one face value's payment must
+    fail: cash accounting is global per (owner, token), not per input
+    (review finding: debt extinguished at half price)."""
+    with pytest.raises(ContractViolation, match="face value"):
+        CommercialPaper().verify(ltx(
+            inputs=[
+                (paper(owner=ALICE_KP.public), CP_CONTRACT),
+                (paper(owner=ALICE_KP.public), CP_CONTRACT),
+                (cash(10_000, ISSUER_KP.public), CASH_CONTRACT),
+            ],
+            outputs=[(cash(10_000, ALICE_KP.public), CASH_CONTRACT)],
+            commands=[
+                (CPRedeem(), [ALICE_KP.public]),
+                (CashMove(), [ISSUER_KP.public]),
+            ],
+            time_window=TimeWindow(from_time=MATURITY),
+        ))
+    # paying both face values passes
+    CommercialPaper().verify(ltx(
+        inputs=[
+            (paper(owner=ALICE_KP.public), CP_CONTRACT),
+            (paper(owner=ALICE_KP.public), CP_CONTRACT),
+            (cash(20_000, ISSUER_KP.public), CASH_CONTRACT),
+        ],
+        outputs=[(cash(20_000, ALICE_KP.public), CASH_CONTRACT)],
+        commands=[
+            (CPRedeem(), [ALICE_KP.public]),
+            (CashMove(), [ISSUER_KP.public]),
+        ],
+        time_window=TimeWindow(from_time=MATURITY),
+    ))
+
+
+def test_obligation_settle_cannot_reassign_residual():
+    """The obligor settling part of a claim cannot hand the remainder
+    to a different beneficiary or default it (review finding)."""
+    with pytest.raises(ContractViolation, match="beneficiary"):
+        Obligation().verify(ltx(
+            inputs=[
+                (iou(5_000), OBLIGATION_CONTRACT),
+                (cash(3_000, ISSUER_KP.public), CASH_CONTRACT),
+            ],
+            outputs=[
+                (iou(2_000, beneficiary=BOB_KP.public), OBLIGATION_CONTRACT),
+                (cash(3_000, ALICE_KP.public), CASH_CONTRACT),
+            ],
+            commands=[
+                (ObligationSettle(Amount(3_000, TOKEN)), [ISSUER_KP.public]),
+                (CashMove(), [ISSUER_KP.public]),
+            ],
+        ))
+    with pytest.raises(ContractViolation, match="lifecycle"):
+        Obligation().verify(ltx(
+            inputs=[
+                (iou(5_000), OBLIGATION_CONTRACT),
+                (cash(3_000, ISSUER_KP.public), CASH_CONTRACT),
+            ],
+            outputs=[
+                (iou(2_000, lc=DEFAULTED), OBLIGATION_CONTRACT),
+                (cash(3_000, ALICE_KP.public), CASH_CONTRACT),
+            ],
+            commands=[
+                (ObligationSettle(Amount(3_000, TOKEN)), [ISSUER_KP.public]),
+                (CashMove(), [ISSUER_KP.public]),
+            ],
+        ))
+
+
+def test_two_spends_in_one_flow_use_distinct_coins(trade_net):
+    """Sequential generate_spend calls inside one flow must not select
+    the same coins twice (review finding: flow-scoped lock reuse)."""
+    from corda_tpu.finance.cash import CashIssueFlow, generate_spend
+    from corda_tpu.flows.api import FlowLogic
+
+    net, notary, bank, seller, buyer = trade_net
+    # two 5k coins (distinct nonces: identical issuances are one tx)
+    buyer.run_flow(CashIssueFlow(5_000, "USD", buyer.party, notary.party, nonce=1))
+    buyer.run_flow(CashIssueFlow(5_000, "USD", buyer.party, notary.party, nonce=2))
+
+    class _DoubleSelect(FlowLogic):
+        def call(self):
+            b1, coins1 = yield from generate_spend(
+                self, 4_000, "USD", seller.party.owning_key
+            )
+            b2, coins2 = yield from generate_spend(
+                self, 4_000, "USD", seller.party.owning_key
+            )
+            refs1 = {c.ref for c in coins1}
+            refs2 = {c.ref for c in coins2}
+            assert not (refs1 & refs2), "same coin selected twice"
+            return len(refs1), len(refs2)
+
+    fsm = buyer.start_flow(_DoubleSelect())
+    net.run()
+    fsm.result_or_throw()
