@@ -111,6 +111,10 @@ class Obligation:
             return
 
         groups = ltx.group_states(ObligationState, lambda s: s.terms_key())
+        # settlement cash is accounted GLOBALLY per (beneficiary, token):
+        # one cash output must not satisfy two settle groups (same
+        # double-count class as CP redemption)
+        settle_required: dict = {}
         for group in groups:
             obligor, token, due = group.key
             in_sum = sum(s.amount.quantity for s in group.inputs)
@@ -156,7 +160,12 @@ class Obligation:
                     len(beneficiaries) == 1,
                 )
                 lifecycles = {s.lifecycle for s in group.inputs}
+                require_that(
+                    "settle covers one lifecycle's obligations",
+                    len(lifecycles) == 1,
+                )
                 (beneficiary,) = beneficiaries
+                (lifecycle,) = lifecycles
                 for s in group.outputs:
                     require_that(
                         "residual keeps the input beneficiary",
@@ -164,16 +173,11 @@ class Obligation:
                     )
                     require_that(
                         "residual keeps the input lifecycle",
-                        s.lifecycle in lifecycles,
+                        s.lifecycle == lifecycle,
                     )
-                paid = sum(
-                    c.amount.quantity
-                    for c in ltx.outputs_of_type(CashState)
-                    if c.owner == beneficiary and c.amount.token == token
-                )
-                require_that(
-                    "beneficiary is paid the settled amount in cash",
-                    paid >= settled.quantity,
+                key = (beneficiary, token)
+                settle_required[key] = (
+                    settle_required.get(key, 0) + settled.quantity
                 )
                 require_that(
                     "settle is signed by the obligor",
@@ -221,6 +225,16 @@ class Obligation:
                         "reset to NORMAL is agreed by the obligor",
                         _signed_by(obligor.owning_key, signers),
                     )
+        for (beneficiary, token), required in settle_required.items():
+            paid = sum(
+                c.amount.quantity
+                for c in ltx.outputs_of_type(CashState)
+                if c.owner == beneficiary and c.amount.token == token
+            )
+            require_that(
+                "beneficiary is paid the settled amount in cash",
+                paid >= required,
+            )
 
     @staticmethod
     def _verify_net(ltx: LedgerTransaction, signers) -> None:

@@ -1,0 +1,6 @@
+"""Demo CorDapps (reference: samples/ — 7 demos, SURVEY §2.10).
+
+Each demo module exposes `run(...)` executing its arc over a
+MockNetwork (deterministic) and a `main()` running it over real node
+processes via the Driver DSL where that adds value.
+"""

@@ -233,7 +233,10 @@ class Driver:
         self.nodes[cfg.name] = handle
         if self.map_host is None:
             self.map_host = handle
-        self._clients.pop(cfg.name, None)   # stale client after restart
+        for key in [
+            k for k in self._clients if k.split(":", 1)[0] == cfg.name
+        ]:
+            del self._clients[key]   # stale clients after restart
         return handle
 
     @staticmethod
@@ -263,7 +266,7 @@ class Driver:
         username: str = DEFAULT_USER.username,
         password: str = DEFAULT_USER.password,
     ) -> rpclib.RPCClient:
-        key = node.name
+        key = f"{node.name}:{username}"
         if key not in self._clients:
             self._clients[key] = rpclib.RPCClient(
                 self._console, node.name, username, password

@@ -59,7 +59,7 @@ class Generator:
         return Generator(lambda rng: f(self.generate(rng)).generate(rng))
 
     @staticmethod
-    def combine(*gens: "Generator", f: Callable = tuple) -> "Generator":
+    def combine(*gens: "Generator", f: Callable = lambda *xs: xs) -> "Generator":
         return Generator(lambda rng: f(*(g.generate(rng) for g in gens)))
 
     # -- primitives ----------------------------------------------------------

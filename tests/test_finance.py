@@ -586,3 +586,32 @@ def test_two_spends_in_one_flow_use_distinct_coins(trade_net):
     fsm = buyer.start_flow(_DoubleSelect())
     net.run()
     fsm.result_or_throw()
+
+
+def test_obligation_settle_cannot_double_count_cash():
+    """Two settle groups paid with ONE cash output must fail: cash is
+    accounted globally per (beneficiary, token) (review finding)."""
+    other_obligor = Party("OtherCorp", BOB_KP.public)
+    with pytest.raises(ContractViolation, match="paid the settled"):
+        Obligation().verify(ltx(
+            inputs=[
+                (iou(3_000), OBLIGATION_CONTRACT),
+                (iou(3_000, obligor=other_obligor), OBLIGATION_CONTRACT),
+                (cash(3_000, ISSUER_KP.public), CASH_CONTRACT),
+            ],
+            outputs=[(cash(3_000, ALICE_KP.public), CASH_CONTRACT)],
+            commands=[
+                (ObligationSettle(Amount(3_000, TOKEN)),
+                 [ISSUER_KP.public, BOB_KP.public]),
+                (CashMove(), [ISSUER_KP.public]),
+            ],
+        ))
+
+
+def test_generator_combine_default():
+    import random as _random
+
+    from corda_tpu.testing.generators import Generator
+
+    pair = Generator.combine(Generator.pure(1), Generator.pure(2))
+    assert pair.generate(_random.Random(0)) == (1, 2)

@@ -1,0 +1,144 @@
+"""Demo samples (reference: samples/ — SURVEY §2.10).
+
+Each demo's `run()` is its own acceptance test: the reference proves
+these arcs with integration drivers; the MockNetwork keeps them
+deterministic here.
+"""
+
+import pytest
+
+from corda_tpu.samples import (
+    attachment_demo,
+    bank_of_corda_demo,
+    irs_demo,
+    notary_demo,
+    trader_demo,
+)
+
+
+def test_trader_demo():
+    paper, seller_cash = trader_demo.run()
+    assert len(paper) == 1
+    assert seller_cash == 92_000
+
+
+def test_bank_of_corda_demo():
+    balances, refused = bank_of_corda_demo.run()
+    assert balances == {"USD": 7_000, "GBP": 3_000}
+    assert refused
+
+
+def test_attachment_demo():
+    att_id, data = attachment_demo.run()
+    assert len(data) > 1000
+
+
+def test_notary_demo_single():
+    signers, _ = notary_demo.run("single", n_txs=3)
+    assert all(len(s) == 1 for s in signers)
+
+
+def test_notary_demo_raft():
+    signers, _ = notary_demo.run("raft", n_txs=3)
+    # one signature by the shared cluster key per tx
+    assert all(len(s) == 1 for s in signers)
+
+
+def test_notary_demo_bft():
+    signers, _ = notary_demo.run("bft", n_txs=3)
+    # f+1 = 2 replica signatures per tx
+    assert all(len(s) >= 2 for s in signers)
+
+
+def test_irs_demo_scheduled_fixings():
+    """The full oracle arc: the scheduler fires each fixing at its
+    date; the oracle signs tear-offs; the swap accumulates fixings."""
+    final = irs_demo.run(n_fixings=3)
+    assert len(final.fixings) == 3
+    assert [f.rate_bps for f in final.fixings] == [500, 507, 514]
+    assert final.next_fixing_date() is None
+
+
+def test_oracle_refuses_wrong_rate_and_extra_reveals():
+    """The oracle must reject tear-offs with a wrong rate or with
+    non-command components revealed (privacy + integrity of the oracle
+    pattern, NodeInterestRates.sign)."""
+    from corda_tpu.core.contracts import StateRef
+    from corda_tpu.core.transactions import TransactionBuilder
+    from corda_tpu.samples.irs_demo import (
+        FixOf,
+        IRS_CONTRACT,
+        IRSFix,
+        InterestRateSwapState,
+        RateFix,
+        RateOracleService,
+    )
+    from corda_tpu.testing.mock_network import MockNetwork
+
+    net = MockNetwork(seed=50)
+    notary = net.create_notary("Notary")
+    a = net.create_node("A")
+    b = net.create_node("B")
+    oracle_node = net.create_node("Oracle")
+    fix_of = FixOf("LIBOR-3M", 1_000)
+    oracle = RateOracleService(oracle_node.services, {("LIBOR-3M", 1_000): 500})
+
+    swap = InterestRateSwapState(
+        a.party, b.party, oracle_node.party, 1_000_000, 450,
+        "LIBOR-3M", (1_000,),
+    )
+
+    def build(rate_bps):
+        builder = TransactionBuilder(notary.party)
+        builder.add_output_state(
+            swap.with_fixing(RateFix(fix_of, rate_bps)), IRS_CONTRACT
+        )
+        builder.add_command(
+            IRSFix(RateFix(fix_of, rate_bps)), oracle_node.party.owning_key
+        )
+        return a.services.sign_initial_transaction(builder)
+
+    # correct rate, command-only tear-off: signs
+    stx = build(500)
+    ftx = stx.wtx.build_filtered_transaction(
+        lambda c: hasattr(c, "value") and isinstance(c.value, IRSFix)
+    )
+    sig = oracle.sign(ftx)
+    sig.verify(stx.id)
+
+    # wrong rate: refused
+    bad = build(9_999)
+    ftx_bad = bad.wtx.build_filtered_transaction(
+        lambda c: hasattr(c, "value") and isinstance(c.value, IRSFix)
+    )
+    with pytest.raises(ValueError, match="rate"):
+        oracle.sign(ftx_bad)
+
+    # tear-off leaking a state component: refused (oracle must never
+    # sign over things it cannot vet)
+    ftx_leaky = stx.wtx.build_filtered_transaction(
+        lambda c: True   # reveal everything
+    )
+    with pytest.raises(ValueError, match="command"):
+        oracle.sign(ftx_leaky)
+
+
+def test_simm_demo():
+    from corda_tpu.samples import simm_demo
+
+    v = simm_demo.run()
+    assert v.portfolio_size == 3
+    assert v.margin > 0
+    # determinism: both sides' valuation function is pure
+    assert v.margin == simm_demo.run(seed=42).margin
+
+
+def test_network_simulation_trace():
+    from corda_tpu.samples.simulation import run_irs_simulation
+
+    sim = run_irs_simulation()
+    trace = sim.trace()
+    assert any("FixingFlow" in line for line in trace)
+    assert any("OracleSignHandler" in line for line in trace)
+    kinds = {e.kind for e in sim.events}
+    assert {"flow-added", "flow-removed"} <= kinds
