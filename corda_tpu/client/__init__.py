@@ -1,0 +1,2 @@
+"""Client-side libraries: JSON mapping, shell, web gateway
+(reference: client/ + webserver/ — SURVEY §2.9)."""

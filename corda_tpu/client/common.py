@@ -1,0 +1,47 @@
+"""Shared client plumbing: flow-class resolution + pump-driven waits
+(used by both the shell and the webserver so they cannot diverge)."""
+
+from __future__ import annotations
+
+import importlib
+import time
+from typing import Callable, Optional
+
+# flow classes may be referred to by their short name; search these
+# packages for a match (InteractiveShell does classpath search)
+FLOW_SEARCH_PACKAGES = (
+    "corda_tpu.finance.cash",
+    "corda_tpu.finance.trade_flows",
+    "corda_tpu.samples.irs_demo",
+    "corda_tpu.samples.attachment_demo",
+    "corda_tpu.testing.flows",
+)
+
+
+class FlowLookupError(ValueError):
+    pass
+
+
+def find_flow_class(name: str) -> str:
+    """Short flow name -> fully-qualified tag."""
+    if "." in name:
+        return name
+    for pkg in FLOW_SEARCH_PACKAGES:
+        try:
+            mod = importlib.import_module(pkg)
+        except ImportError:
+            continue
+        if hasattr(mod, name):
+            return f"{pkg}.{name}"
+    raise FlowLookupError(f"no flow class named {name!r} found")
+
+
+def wait_rpc(fut, pump: Callable[[], None], timeout: float):
+    """Pump until the RPC future resolves or the deadline passes."""
+    deadline = time.monotonic() + timeout
+    while not fut.done and time.monotonic() < deadline:
+        pump()
+        time.sleep(0.01)
+    if not fut.done:
+        raise TimeoutError("RPC call timed out")
+    return fut.get()

@@ -1,0 +1,185 @@
+"""Interactive shell: drive a node over RPC from a console.
+
+Reference: the embedded CRaSH SSH shell (node/.../shell/
+InteractiveShell.kt) — start flows from strings (`flow start CashIssue
+quantity: 100`), watch running flows, run RPC ops by name, with
+`StringToMethodCallParser` doing the argument binding and
+ANSIProgressRenderer painting flow progress.
+
+`Shell.run_command(line)` is the testable core; `Shell.repl()` wraps it
+in a stdin loop. The shell talks pure RPC — it has no more power than
+any other client (the reference's shell runs through CordaRPCOps the
+same way)."""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+from ..node import rpc as rpclib
+from . import json_support as js
+from .common import FLOW_SEARCH_PACKAGES, FlowLookupError, find_flow_class, wait_rpc
+
+HELP = """\
+commands:
+  flow start <FlowClass> [name: value, ...]   start a flow, wait for result
+  flow list                                   registered responder protocols
+  flow watch                                  live state-machine feed (10s)
+  run <rpc-method> [json-args...]             call any RPC method
+  peers                                       network map snapshot
+  notaries                                    notary identities
+  vault [ContractTag]                         unconsumed states
+  time                                        node clock
+  help                                        this text
+  quit                                        leave
+"""
+
+class Shell:
+    def __init__(
+        self,
+        client: rpclib.RPCClient,
+        pump: Callable[[], None],
+        timeout: float = 90.0,
+    ):
+        """`pump` drives message delivery while the shell waits (the
+        node loopback passes node.pump; a remote console pumps its own
+        endpoint)."""
+        self.client = client
+        self.pump = pump
+        self.timeout = timeout
+
+    # -- plumbing ------------------------------------------------------------
+
+    def wait(self, fut, timeout: Optional[float] = None):
+        return wait_rpc(fut, self.pump, timeout or self.timeout)
+
+    def _resolve_party(self, name: str):
+        for info in self.wait(self.client.network_map_snapshot()):
+            if info.legal_identity.name == name:
+                return info.legal_identity
+        for party in self.wait(self.client.notary_identities()):
+            if party.name == name:
+                return party
+        return None
+
+    # -- commands ------------------------------------------------------------
+
+    def run_command(self, line: str) -> str:
+        line = line.strip()
+        if not line or line == "help":
+            return HELP
+        try:
+            if line.startswith("flow start "):
+                return self._flow_start(line[len("flow start "):])
+            if line == "flow list":
+                flows = self.wait(self.client.registered_flows())
+                return "\n".join(flows)
+            if line.startswith("flow watch"):
+                return self._flow_watch()
+            if line.startswith("run "):
+                return self._run_rpc(line[len("run "):])
+            if line == "peers":
+                infos = self.wait(self.client.network_map_snapshot())
+                return "\n".join(
+                    f"{i.legal_identity.name:<20} {i.address}"
+                    f"{' [notary]' if any(s.startswith('corda.notary') for s in i.advertised_services) else ''}"
+                    for i in infos
+                )
+            if line == "notaries":
+                return "\n".join(
+                    p.name for p in self.wait(self.client.notary_identities())
+                )
+            if line.startswith("vault"):
+                return self._vault(line[len("vault"):].strip())
+            if line == "time":
+                return str(self.wait(self.client.current_node_time()))
+            return f"unknown command {line.split()[0]!r}; try 'help'"
+        except (js.CallParseError, FlowLookupError, TimeoutError, rpclib.RpcError) as e:
+            return f"error: {e}"
+
+    def _flow_start(self, rest: str) -> str:
+        parts = rest.split(None, 1)
+        flow_tag = find_flow_class(parts[0])
+        args = js.parse_flow_args(
+            parts[1] if len(parts) > 1 else "", self._resolve_party
+        )
+        handle = self.wait(self.client.call("start_flow", flow_tag, args))
+        try:
+            result = self.wait(handle.result)
+        except rpclib.RpcError as e:
+            return f"flow failed: {e}"
+        return f"flow completed: {_render(result)}"
+
+    def _flow_watch(self, duration: float = 10.0) -> str:
+        feed = self.wait(self.client.state_machines_feed())
+        lines = [
+            f"  {info.flow_id.hex()[:8]} {info.flow_tag}"
+            for info in feed.snapshot
+        ]
+        events: list[str] = []
+        feed.updates.subscribe(
+            lambda u: events.append(
+                f"  [{u.kind}] {u.info.flow_id.hex()[:8]} {u.info.flow_tag}"
+            )
+        )
+        deadline = time.monotonic() + duration
+        while time.monotonic() < deadline and not events:
+            self.pump()
+            time.sleep(0.05)
+        feed.close()
+        return "\n".join(
+            ["running:"] + (lines or ["  (none)"]) + ["events:"]
+            + (events or ["  (none)"])
+        )
+
+    def _run_rpc(self, rest: str) -> str:
+        parts = rest.split(None, 1)
+        method = parts[0]
+        args = ()
+        if len(parts) > 1:
+            import json as _json
+
+            parsed = _json.loads(f"[{parts[1]}]")
+            args = tuple(js.from_jsonable(a) for a in parsed)
+        result = self.wait(self.client.call(method, *args))
+        return _render(result)
+
+    def _vault(self, contract_tag: str) -> str:
+        from ..node.vault_query import VaultQueryCriteria
+
+        criteria = (
+            VaultQueryCriteria(contract_state_types=(contract_tag,))
+            if contract_tag
+            else VaultQueryCriteria()
+        )
+        page = self.wait(self.client.vault_query_by(criteria))
+        if not page.states:
+            return "(vault empty)"
+        out = []
+        for sar in page.states:
+            out.append(f"  {sar.ref}: {sar.state.data}")
+        out.append(f"total: {page.total_states_available}")
+        return "\n".join(out)
+
+    # -- interactive ---------------------------------------------------------
+
+    def repl(self, prompt: str = ">>> ") -> None:
+        print("corda_tpu shell — 'help' for commands")
+        while True:
+            try:
+                line = input(prompt)
+            except (EOFError, KeyboardInterrupt):
+                print()
+                return
+            if line.strip() in ("quit", "exit"):
+                return
+            out = self.run_command(line)
+            if out:
+                print(out)
+
+
+def _render(value) -> str:
+    try:
+        return js.dumps(value, indent=2)
+    except ValueError:
+        return repr(value)

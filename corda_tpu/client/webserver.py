@@ -1,0 +1,152 @@
+"""REST gateway: HTTP endpoints bridging to a node over RPC.
+
+Reference: the standalone `webserver` module (webserver/.../internal/
+NodeWebServer.kt:31,171-173) — a Jetty/Jersey process that talks to its
+node via RPC and exposes CorDapp REST APIs + static content. Here the
+stdlib HTTP server exposes the node surface as JSON (client/jackson's
+mapping), one gateway process (or thread) per node.
+
+  GET  /api/status                 identity + clock
+  GET  /api/network                network map snapshot
+  GET  /api/notaries               notary identities
+  GET  /api/vault[?contract=Tag]   unconsumed states
+  GET  /api/flows                  registered responder protocols
+  POST /api/flows/<FlowClass>      start a flow; JSON body = kwargs
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+from urllib.parse import parse_qs, urlparse
+
+from ..node import rpc as rpclib
+from . import json_support as js
+from .common import FlowLookupError, find_flow_class, wait_rpc
+
+
+class NodeWebServer:
+    """One gateway over one RPC client. `pump` drives the underlying
+    fabric (the node loopback or a console endpoint)."""
+
+    def __init__(
+        self,
+        client: rpclib.RPCClient,
+        pump: Callable[[], None],
+        host: str = "127.0.0.1",
+        port: int = 0,
+        rpc_timeout: float = 90.0,
+    ):
+        self.client = client
+        self.pump = pump
+        self.rpc_timeout = rpc_timeout
+        self._lock = threading.Lock()   # one RPC conversation at a time
+        gateway = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):   # quiet
+                pass
+
+            def do_GET(self):
+                gateway._handle(self, "GET")
+
+            def do_POST(self):
+                gateway._handle(self, "POST")
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "NodeWebServer":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True, name="webserver"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    # -- RPC plumbing --------------------------------------------------------
+
+    def _wait(self, fut):
+        return wait_rpc(fut, self.pump, self.rpc_timeout)
+
+    # -- dispatch ------------------------------------------------------------
+
+    def _handle(self, req: BaseHTTPRequestHandler, method: str) -> None:
+        try:
+            with self._lock:
+                status, body = self._route(req, method)
+        except (rpclib.RpcError, js.CallParseError, FlowLookupError,
+                json.JSONDecodeError, ValueError) as e:
+            status, body = 400, {"error": str(e)}
+        except TimeoutError as e:
+            status, body = 504, {"error": str(e)}
+        except Exception as e:   # pragma: no cover - defensive
+            status, body = 500, {"error": f"{type(e).__name__}: {e}"}
+        payload = json.dumps(body, indent=2).encode()
+        req.send_response(status)
+        req.send_header("Content-Type", "application/json")
+        req.send_header("Content-Length", str(len(payload)))
+        req.end_headers()
+        req.wfile.write(payload)
+
+    def _route(self, req, method: str):
+        url = urlparse(req.path)
+        parts = [p for p in url.path.split("/") if p]
+        if method == "GET":
+            if parts == ["api", "status"]:
+                info = self._wait(self.client.node_identity())
+                now = self._wait(self.client.current_node_time())
+                return 200, {
+                    "identity": js.to_jsonable(info.legal_identity),
+                    "address": info.address,
+                    "time_micros": now,
+                }
+            if parts == ["api", "network"]:
+                infos = self._wait(self.client.network_map_snapshot())
+                return 200, [js.to_jsonable(i) for i in infos]
+            if parts == ["api", "notaries"]:
+                ids = self._wait(self.client.notary_identities())
+                return 200, [js.to_jsonable(p) for p in ids]
+            if parts == ["api", "flows"]:
+                return 200, list(self._wait(self.client.registered_flows()))
+            if parts == ["api", "vault"]:
+                from ..node.vault_query import VaultQueryCriteria
+
+                q = parse_qs(url.query)
+                contract = q.get("contract", [None])[0]
+                criteria = (
+                    VaultQueryCriteria(contract_state_types=(contract,))
+                    if contract
+                    else VaultQueryCriteria()
+                )
+                page = self._wait(self.client.vault_query_by(criteria))
+                return 200, {
+                    "total": page.total_states_available,
+                    "states": [js.to_jsonable(s) for s in page.states],
+                }
+            return 404, {"error": f"no such endpoint {url.path}"}
+        if method == "POST" and parts[:2] == ["api", "flows"] and len(parts) == 3:
+            flow_tag = find_flow_class(parts[2])
+            length = int(req.headers.get("Content-Length", 0))
+            raw = req.rfile.read(length) if length else b"{}"
+            body = json.loads(raw)
+            if not isinstance(body, dict):
+                raise ValueError("flow POST body must be a JSON object")
+            kwargs = {k: js.from_jsonable(v) for k, v in body.items()}
+            handle = self._wait(
+                self.client.call("start_flow", flow_tag, kwargs)
+            )
+            result = self._wait(handle.result)
+            return 200, {"result": js.to_jsonable(result)}
+        return 404, {"error": f"no such endpoint {method} {url.path}"}
+

@@ -781,10 +781,7 @@ def _state_snapshot(logic: FlowLogic) -> dict:
     return out
 
 
-def _reconstruct_logic(tag: str, snapshot: dict) -> FlowLogic:
-    """FlowLogicRef equivalent (core/.../flows/FlowLogicRef.kt): rebuild
-    the flow object from its class tag + state snapshot, bypassing the
-    constructor."""
+def _class_from_tag(tag: str):
     parts = tag.split(".")
     obj = None
     for i in range(len(parts) - 1, 0, -1):
@@ -797,7 +794,26 @@ def _reconstruct_logic(tag: str, snapshot: dict) -> FlowLogic:
         raise CheckpointCorruption(f"cannot import flow class {tag!r}")
     for part in parts[i:]:
         obj = getattr(obj, part)
-    logic = obj.__new__(obj)
+    return obj
+
+
+def _reconstruct_logic(tag: str, snapshot: dict) -> FlowLogic:
+    """FlowLogicRef equivalent (core/.../flows/FlowLogicRef.kt): rebuild
+    the flow object from its class tag + state snapshot, bypassing the
+    constructor (checkpoint restore: the snapshot IS the full state)."""
+    cls = _class_from_tag(tag)
+    logic = cls.__new__(cls)
     for k, v in snapshot.items():
         setattr(logic, k, v)
     return logic
+
+
+def construct_logic(tag: str, kwargs: dict) -> FlowLogic:
+    """Build a flow through its CONSTRUCTOR (RPC/shell/web starts:
+    partial kwargs rely on parameter defaults — snapshot-style
+    reconstruction would leave them unset)."""
+    cls = _class_from_tag(tag)
+    try:
+        return cls(**kwargs)
+    except TypeError as e:
+        raise FlowException(f"cannot construct {tag}: {e}")

@@ -35,8 +35,7 @@ from ..flows.statemachine import (
     FlowStateMachine,
     StateMachineManager,
     _class_tag,
-    _reconstruct_logic,
-    _state_snapshot,
+    construct_logic,
 )
 from .messaging import Message, MessagingService
 from .services import DataFeed, Observable, ServiceHub
@@ -310,8 +309,8 @@ class CordaRPCOpsImpl:
 
     # start_flow is special-cased by the server (permissioning + flow
     # handle wiring); it is not a plain @rpc_method.
-    def start_flow(self, flow_tag: str, snapshot: dict) -> FlowStateMachine:
-        logic = _reconstruct_logic(flow_tag, snapshot)
+    def start_flow(self, flow_tag: str, kwargs: dict) -> FlowStateMachine:
+        logic = construct_logic(flow_tag, kwargs)
         return self.smm.start_flow(logic)
 
 
@@ -535,6 +534,32 @@ class RpcFuture:
         return self._value
 
 
+def _ctor_kwargs_of(logic) -> dict:
+    """Read a flow instance's constructor arguments back off its
+    attributes; loud error when __init__ doesn't store a parameter
+    under its own name (the server re-runs the constructor)."""
+    import inspect
+
+    sig = inspect.signature(type(logic).__init__)
+    kwargs = {}
+    for name, param in list(sig.parameters.items())[1:]:
+        if param.kind in (
+            inspect.Parameter.VAR_POSITIONAL, inspect.Parameter.VAR_KEYWORD
+        ):
+            raise TypeError(
+                f"{type(logic).__name__}.__init__ uses *args/**kwargs; "
+                f"start it via start_flow(FlowClass, **kwargs) instead"
+            )
+        if not hasattr(logic, name):
+            raise TypeError(
+                f"{type(logic).__name__} does not store __init__ param "
+                f"{name!r} as an attribute; start it via "
+                f"start_flow(FlowClass, **kwargs) instead"
+            )
+        kwargs[name] = getattr(logic, name)
+    return kwargs
+
+
 @dataclass
 class FlowHandle:
     """Client-side handle: flow id + result future (CordaRPCOps
@@ -578,12 +603,25 @@ class RPCClient:
         self._messaging.send(TOPIC_RPC_REQUEST, ser.encode(req), self._server)
         return fut
 
-    def start_flow(self, logic: FlowLogic) -> RpcFuture:
-        """Start a flow by instance; resolves to a FlowHandle. The flow
-        object is decomposed into (class tag, constructor-state
-        snapshot) — the FlowLogicRef move, FlowLogicRef.kt."""
+    def start_flow(self, logic_or_class, **kwargs) -> RpcFuture:
+        """Start a flow; resolves to a FlowHandle. Accepts a flow CLASS
+        (or tag string) plus constructor kwargs, or a flow INSTANCE —
+        decomposed into (class tag, constructor kwargs) by reading each
+        __init__ parameter back off the instance (the FlowLogicRef
+        move, FlowLogicRef.kt: the server re-runs the constructor, so
+        flows started this way must store parameters under their own
+        names — the standard pattern)."""
+        if isinstance(logic_or_class, str):
+            return self.call("start_flow", logic_or_class, kwargs)
+        if isinstance(logic_or_class, type):
+            return self.call(
+                "start_flow", _class_tag(logic_or_class), kwargs
+            )
+        logic = logic_or_class
+        if kwargs:
+            raise TypeError("pass kwargs with a class/tag, not an instance")
         return self.call(
-            "start_flow", _class_tag(type(logic)), _state_snapshot(logic)
+            "start_flow", _class_tag(type(logic)), _ctor_kwargs_of(logic)
         )
 
     def __getattr__(self, name: str):

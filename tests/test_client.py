@@ -1,0 +1,241 @@
+"""Client stack: JSON mapping, interactive shell, REST gateway.
+
+Reference behaviours under test: client/jackson serialisers +
+StringToMethodCallParser, node/.../shell/InteractiveShell.kt (flow
+start from strings, rpc run, watch), webserver/.../NodeWebServer.kt
+(REST over RPC).
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from corda_tpu.client import json_support as js
+from corda_tpu.client.shell import Shell
+from corda_tpu.core.contracts import Amount, Issued, StateRef
+from corda_tpu.core.identity import Party, PartyAndReference
+from corda_tpu.crypto import schemes
+from corda_tpu.crypto.hashes import SecureHash
+from corda_tpu.finance.cash import CashState
+from corda_tpu.node import rpc as rpclib
+from corda_tpu.testing.mock_network import MockNetwork
+
+
+# -- JSON --------------------------------------------------------------------
+
+
+def test_json_roundtrip_core_types():
+    kp = schemes.generate_keypair(seed=1)
+    party = Party("Alice", kp.public)
+    token = Issued(PartyAndReference(party, b"\x01"), "USD")
+    state = CashState(Amount(500, token), kp.public)
+    ref = StateRef(SecureHash.sha256(b"x"), 3)
+
+    for value in (party, token, state, ref, [state, ref], {"k": party}):
+        assert js.loads(js.dumps(value)) == _tuplify(value)
+
+
+def _tuplify(v):
+    if isinstance(v, list):
+        return tuple(v)
+    return v
+
+
+def test_json_rejects_unknown_tags():
+    with pytest.raises(ValueError, match="unknown type tag"):
+        js.loads('{"@type": "EvilClass", "x": 1}')
+
+
+def test_parse_flow_args():
+    party = Party("Bob", schemes.generate_keypair(seed=2).public)
+    args = js.parse_flow_args(
+        'quantity: 100, currency: "USD", recipient: Bob',
+        resolve_party=lambda name: party if name == "Bob" else None,
+    )
+    assert args == {"quantity": 100, "currency": "USD", "recipient": party}
+    with pytest.raises(js.CallParseError):
+        js.parse_flow_args("quantity: 100, who: Nobody",
+                           resolve_party=lambda n: None)
+
+
+# -- shell -------------------------------------------------------------------
+
+
+@pytest.fixture
+def shell_net():
+    net = MockNetwork(seed=66)
+    notary = net.create_notary("Notary")
+    alice = net.create_node("Alice")
+    bob = net.create_node("Bob")
+    users = rpclib.RPCUserService(rpclib.RpcUser("sh", "pw", ("ALL",)))
+    ops = rpclib.CordaRPCOpsImpl(alice.services, alice.smm)
+    rpclib.RPCServer(ops, alice.messaging, users)
+    client = rpclib.RPCClient(
+        net.fabric.endpoint("console"), "Alice", "sh", "pw"
+    )
+    shell = Shell(client, pump=lambda: net.run(), timeout=30)
+    return net, shell, alice, bob
+
+
+def test_shell_basic_commands(shell_net):
+    net, shell, alice, bob = shell_net
+    assert "Alice" in shell.run_command("peers")
+    assert "Notary" in shell.run_command("notaries")
+    assert shell.run_command("time").isdigit()
+    assert "(vault empty)" in shell.run_command("vault")
+    assert "SellerFlow" in shell.run_command("flow list")
+    assert "unknown command" in shell.run_command("bogus")
+
+
+def test_shell_flow_start_and_vault(shell_net):
+    net, shell, alice, bob = shell_net
+    out = shell.run_command(
+        'flow start CashIssueFlow quantity: 700, currency: "USD", '
+        "recipient: Alice, notary: Notary"
+    )
+    assert "flow completed" in out, out
+    vault = shell.run_command("vault CashState")
+    assert "700" in vault and "total: 1" in vault
+
+    out = shell.run_command(
+        'flow start CashPaymentFlow quantity: 250, currency: "USD", '
+        "recipient: Bob"
+    )
+    assert "flow completed" in out, out
+
+
+def test_shell_flow_errors_are_messages_not_tracebacks(shell_net):
+    net, shell, alice, bob = shell_net
+    out = shell.run_command(
+        'flow start CashPaymentFlow quantity: 1, currency: "XXX", '
+        "recipient: Bob"
+    )
+    assert "flow failed" in out and "insufficient" in out
+    out = shell.run_command("flow start NoSuchFlow x: 1")
+    assert "error" in out
+    out = shell.run_command(
+        'flow start CashIssueFlow quantity: 1'
+    )
+    assert "cannot construct" in out   # missing required args
+
+
+def test_shell_run_rpc(shell_net):
+    net, shell, alice, bob = shell_net
+    out = shell.run_command("run current_node_time")
+    assert out.strip().isdigit()
+
+
+# -- webserver ---------------------------------------------------------------
+
+
+@pytest.fixture
+def web(shell_net):
+    from corda_tpu.client.webserver import NodeWebServer
+
+    net, shell, alice, bob = shell_net
+    client = rpclib.RPCClient(
+        net.fabric.endpoint("web-console"), "Alice", "sh", "pw"
+    )
+    server = NodeWebServer(
+        client, pump=lambda: net.run(), rpc_timeout=30
+    ).start()
+    yield net, server, alice, bob
+    server.stop()
+
+
+def _get(server, path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{server.port}{path}", timeout=30
+    ) as r:
+        return r.status, json.loads(r.read())
+
+
+def _post(server, path, body):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}{path}",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=60) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_webserver_get_endpoints(web):
+    net, server, alice, bob = web
+    status, body = _get(server, "/api/status")
+    assert status == 200 and body["identity"]["name"] == "Alice"
+    status, body = _get(server, "/api/network")
+    assert {i["legal_identity"]["name"] for i in body} == {
+        "Notary", "Alice", "Bob",
+    }
+    status, body = _get(server, "/api/notaries")
+    assert body[0]["name"] == "Notary"
+    status, body = _get(server, "/api/flows")
+    assert any("SellerFlow" in f for f in body)
+
+
+def test_webserver_flow_post_and_vault(web):
+    net, server, alice, bob = web
+    notary = js.to_jsonable(
+        net.nodes[0].party   # Notary party
+    )
+    me = js.to_jsonable(alice.party)
+    status, body = _post(
+        server,
+        "/api/flows/CashIssueFlow",
+        {"quantity": 900, "currency": "USD", "recipient": me, "notary": notary},
+    )
+    assert status == 200, body
+    status, body = _get(server, "/api/vault?contract=CashState")
+    assert status == 200
+    assert body["total"] == 1
+    assert body["states"][0]["state"]["data"]["amount"]["quantity"] == 900
+
+
+def test_webserver_errors(web):
+    net, server, alice, bob = web
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(server, "/api/nope")
+    assert e.value.code == 404
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(server, "/api/flows/NoSuchFlow", {})
+    assert e.value.code == 400
+
+
+def test_parse_flow_args_escaped_quotes():
+    args = js.parse_flow_args(r'msg: "say \"hi, there\"", n: 2')
+    assert args == {"msg": 'say "hi, there"', "n": 2}
+
+
+def test_start_flow_by_class_and_kwargs(shell_net):
+    """start_flow(FlowClass, **kwargs) relies on constructor defaults
+    (the review's snapshot-vs-constructor contract)."""
+    from corda_tpu.finance.cash import CashIssueFlow
+
+    net, shell, alice, bob = shell_net
+    client = shell.client
+    fut = client.start_flow(
+        CashIssueFlow,
+        quantity=123,
+        currency="USD",
+        recipient=alice.party,
+        notary=net.nodes[0].party,
+    )
+    net.run()
+    handle = fut.get()
+    net.run()
+    assert handle.result.get() is not None
+
+
+def test_start_flow_instance_with_mismatched_ctor_raises():
+    from corda_tpu.flows.api import FlowLogic
+    from corda_tpu.node.rpc import _ctor_kwargs_of
+
+    class Odd(FlowLogic):
+        def __init__(self, amount):
+            self.qty = amount   # stored under a different name
+
+    with pytest.raises(TypeError, match="does not store"):
+        _ctor_kwargs_of(Odd(5))
