@@ -23,7 +23,11 @@ class FlowLookupError(ValueError):
 
 
 def find_flow_class(name: str) -> str:
-    """Short flow name -> fully-qualified tag."""
+    """Short flow name -> fully-qualified tag. Only FlowLogic
+    subclasses resolve — a state or helper class sharing the name must
+    fail HERE with a clear lookup error, not deep in the server."""
+    from ..flows.api import FlowLogic
+
     if "." in name:
         return name
     for pkg in FLOW_SEARCH_PACKAGES:
@@ -31,7 +35,11 @@ def find_flow_class(name: str) -> str:
             mod = importlib.import_module(pkg)
         except ImportError:
             continue
-        if hasattr(mod, name):
+        candidate = getattr(mod, name, None)
+        if (
+            isinstance(candidate, type)
+            and issubclass(candidate, FlowLogic)
+        ):
             return f"{pkg}.{name}"
     raise FlowLookupError(f"no flow class named {name!r} found")
 

@@ -53,14 +53,20 @@ class Shell:
     def wait(self, fut, timeout: Optional[float] = None):
         return wait_rpc(fut, self.pump, timeout or self.timeout)
 
-    def _resolve_party(self, name: str):
-        for info in self.wait(self.client.network_map_snapshot()):
-            if info.legal_identity.name == name:
-                return info.legal_identity
-        for party in self.wait(self.client.notary_identities()):
-            if party.name == name:
-                return party
-        return None
+    def _party_resolver(self):
+        """One snapshot fetch per command, however many bare-word
+        party arguments it has."""
+        cache: dict = {}
+
+        def resolve(name: str):
+            if not cache:
+                for info in self.wait(self.client.network_map_snapshot()):
+                    cache[info.legal_identity.name] = info.legal_identity
+                for party in self.wait(self.client.notary_identities()):
+                    cache.setdefault(party.name, party)
+            return cache.get(name)
+
+        return resolve
 
     # -- commands ------------------------------------------------------------
 
@@ -101,7 +107,7 @@ class Shell:
         parts = rest.split(None, 1)
         flow_tag = find_flow_class(parts[0])
         args = js.parse_flow_args(
-            parts[1] if len(parts) > 1 else "", self._resolve_party
+            parts[1] if len(parts) > 1 else "", self._party_resolver()
         )
         handle = self.wait(self.client.call("start_flow", flow_tag, args))
         try:

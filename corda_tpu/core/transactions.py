@@ -339,6 +339,17 @@ class SignedTransaction:
         services.transaction_verifier.verify(ltx).result()
 
 
+# Replacement-transaction dispatch (set by flows.replacement at import
+# time): fn(ltx) -> Optional[callable]; a non-None result verifies the
+# tx INSTEAD of its state contracts.
+_SPECIAL_VERIFIER = None
+
+
+def set_special_verifier(fn) -> None:
+    global _SPECIAL_VERIFIER
+    _SPECIAL_VERIFIER = fn
+
+
 @ser.serializable
 @dataclass(frozen=True)
 class LedgerTransaction:
@@ -358,7 +369,15 @@ class LedgerTransaction:
 
     def verify(self) -> None:
         """Run every referenced contract's verify (LedgerTransaction.kt:
-        64-79): each distinct contract sees the whole transaction."""
+        64-79): each distinct contract sees the whole transaction.
+        Replacement transactions (notary change / contract upgrade)
+        dispatch to their special rules instead — the reference models
+        those as separate LedgerTransaction classes
+        (NotaryChangeTransactions.kt); here one hook decides."""
+        special = _SPECIAL_VERIFIER(self) if _SPECIAL_VERIFIER else None
+        if special is not None:
+            special()
+            return
         names = {ts.contract for ts in self.outputs}
         names.update(sar.state.contract for sar in self.inputs)
         for name in sorted(names):

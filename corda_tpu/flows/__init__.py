@@ -15,6 +15,7 @@ from .api import (
     initiating_flow,
 )
 from .statemachine import StateMachineManager
+from . import replacement as _replacement   # notary-change/upgrade flows
 
 __all__ = [
     "FlowException",

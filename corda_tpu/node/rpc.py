@@ -540,7 +540,10 @@ def _ctor_kwargs_of(logic) -> dict:
     under its own name (the server re-runs the constructor)."""
     import inspect
 
-    sig = inspect.signature(type(logic).__init__)
+    init = type(logic).__init__
+    if init is object.__init__:
+        return {}   # no explicit constructor: a no-arg flow
+    sig = inspect.signature(init)
     kwargs = {}
     for name, param in list(sig.parameters.items())[1:]:
         if param.kind in (

@@ -1,0 +1,279 @@
+"""Notary change + contract upgrade flows.
+
+Reference behaviours under test: NotaryChangeTransactions.kt (special
+tx skips contracts, preserves states, needs all participants + old
+notary), AbstractStateReplacementFlow / NotaryChangeFlow /
+ContractUpgradeFlow semantics, per-node upgrade authorisation.
+"""
+
+from dataclasses import dataclass
+
+import pytest
+
+from corda_tpu.core import serialization as ser
+from corda_tpu.core.contracts import register_contract, require_that
+from corda_tpu.core.transactions import TransactionVerificationError
+from corda_tpu.finance.cash import CASH_CONTRACT, CashIssueFlow, CashState
+from corda_tpu.flows.api import FlowException
+from corda_tpu.flows.replacement import (
+    ContractUpgradeFlow,
+    NotaryChangeFlow,
+    register_upgrade,
+)
+from corda_tpu.node.notary import NotaryException
+from corda_tpu.testing.mock_network import MockNetwork
+
+
+@pytest.fixture
+def net():
+    net = MockNetwork(seed=88)
+    n1 = net.create_notary("NotaryOne", validating=True)
+    n2 = net.create_notary("NotaryTwo")
+    alice = net.create_node("Alice")
+    bob = net.create_node("Bob")
+    return net, n1, n2, alice, bob
+
+
+def test_notary_change_moves_state(net):
+    network, n1, n2, alice, bob = net
+    alice.run_flow(CashIssueFlow(1_000, "USD", alice.party, n1.party))
+    coin = alice.vault.unconsumed_states(CashState)[0]
+    assert coin.state.notary == n1.party
+
+    fsm = alice.start_flow(NotaryChangeFlow(coin, n2.party))
+    network.run()
+    stx = fsm.result_or_throw()
+    # the OLD notary notarised the change (it consumed the old state)
+    assert any(s.by == n1.party.owning_key for s in stx.sigs)
+
+    moved = alice.vault.unconsumed_states(CashState)[0]
+    assert moved.state.notary == n2.party
+    assert moved.state.data == coin.state.data
+
+    # the state now spends through the NEW notary
+    from corda_tpu.finance.cash import CashPaymentFlow
+
+    pay = alice.start_flow(CashPaymentFlow(400, "USD", bob.party))
+    network.run()
+    pay_stx = pay.result_or_throw()
+    assert any(s.by == n2.party.owning_key for s in pay_stx.sigs)
+
+
+def test_notary_change_to_same_notary_refused(net):
+    network, n1, n2, alice, bob = net
+    alice.run_flow(CashIssueFlow(100, "USD", alice.party, n1.party))
+    coin = alice.vault.unconsumed_states(CashState)[0]
+    fsm = alice.start_flow(NotaryChangeFlow(coin, n1.party))
+    network.run()
+    with pytest.raises(FlowException, match="already uses"):
+        fsm.result_or_throw()
+
+
+def test_old_state_cannot_be_double_spent_after_change(net):
+    network, n1, n2, alice, bob = net
+    alice.run_flow(CashIssueFlow(100, "USD", alice.party, n1.party))
+    coin = alice.vault.unconsumed_states(CashState)[0]
+    fsm = alice.start_flow(NotaryChangeFlow(coin, n2.party))
+    network.run()
+    fsm.result_or_throw()
+
+    # replaying a spend of the OLD ref against the old notary conflicts
+    from corda_tpu.core.transactions import TransactionBuilder
+    from corda_tpu.finance.cash import CashMove
+    from corda_tpu.flows.core_flows import FinalityFlow
+
+    b = TransactionBuilder()
+    b.add_input_state(coin)
+    b.add_output_state(
+        coin.state.data.with_owner(bob.party.owning_key), CASH_CONTRACT
+    )
+    b.add_command(CashMove(), alice.party.owning_key)
+    stx = alice.services.sign_initial_transaction(b)
+    f2 = alice.start_flow(FinalityFlow(stx))
+    network.run()
+    with pytest.raises(NotaryException) as exc:
+        f2.result_or_throw()
+    assert exc.value.error.kind == "conflict"
+
+
+# -- contract upgrade --------------------------------------------------------
+
+
+@ser.serializable
+@dataclass(frozen=True)
+class CashStateV2:
+    """The 'upgraded' cash: same fields + a version marker."""
+
+    amount: object
+    owner: object
+    version: int = 2
+
+    @property
+    def participants(self):
+        return (self.owner,)
+
+
+CASH_V2_CONTRACT = "corda_tpu.tests.CashV2"
+
+
+class CashV2:
+    def verify(self, ltx) -> None:
+        require_that(
+            "v2 states carry version 2",
+            all(s.version == 2 for s in ltx.outputs_of_type(CashStateV2)),
+        )
+
+
+register_contract(CASH_V2_CONTRACT, CashV2())
+
+
+def _authorise_everywhere(net):
+    register_upgrade(
+        CASH_CONTRACT,
+        CASH_V2_CONTRACT,
+        lambda old: CashStateV2(old.amount, old.owner),
+    )
+
+
+def test_contract_upgrade(net):
+    network, n1, n2, alice, bob = net
+    _authorise_everywhere(network)
+    alice.run_flow(CashIssueFlow(500, "USD", alice.party, n1.party))
+    coin = alice.vault.unconsumed_states(CashState)[0]
+
+    fsm = alice.start_flow(ContractUpgradeFlow(coin, CASH_V2_CONTRACT))
+    network.run()
+    fsm.result_or_throw()
+
+    upgraded = alice.vault.unconsumed_states(CashStateV2)
+    assert len(upgraded) == 1
+    assert upgraded[0].state.contract == CASH_V2_CONTRACT
+    assert upgraded[0].state.data.amount == coin.state.data.amount
+    assert alice.vault.unconsumed_states(CashState) == []
+
+
+def test_unauthorised_upgrade_rejected():
+    """A verifying node WITHOUT the registered upgrade path must reject
+    the transaction (per-node authorisation, ContractUpgradeFlow
+    Authorise)."""
+    from corda_tpu.core.contracts import CommandWithParties, StateAndRef, StateRef
+    from corda_tpu.core.transactions import LedgerTransaction, TransactionState
+    from corda_tpu.crypto.hashes import SecureHash
+    from corda_tpu.core.replacement import ContractUpgradeCommand, _UPGRADES
+    from corda_tpu.crypto import schemes
+    from corda_tpu.core.identity import Party
+    from corda_tpu.core.contracts import Amount, Issued, PartyAndReference
+
+    kp = schemes.generate_keypair(seed=7)
+    party = Party("X", kp.public)
+    token = Issued(PartyAndReference(party, b"\x01"), "USD")
+    old = CashState(Amount(5, token), kp.public)
+    notary = Party("N", schemes.generate_keypair(seed=8).public)
+    ltx = LedgerTransaction(
+        (StateAndRef(
+            TransactionState(old, CASH_CONTRACT, notary),
+            StateRef(SecureHash.sha256(b"a"), 0),
+        ),),
+        (TransactionState(CashStateV2(old.amount, old.owner), "corda_tpu.tests.Nope", notary),),
+        (CommandWithParties(
+            (kp.public,), (), ContractUpgradeCommand(CASH_CONTRACT, "corda_tpu.tests.Nope")
+        ),),
+        (), notary, None, SecureHash.sha256(b"tx"),
+    )
+    assert ("corda_tpu.finance.Cash", "corda_tpu.tests.Nope") not in _UPGRADES
+    with pytest.raises(TransactionVerificationError, match="not authorised"):
+        ltx.verify()
+
+
+def test_replacement_tx_cannot_smuggle_other_commands(net):
+    from corda_tpu.core.contracts import CommandWithParties, StateAndRef, StateRef
+    from corda_tpu.core.transactions import LedgerTransaction, TransactionState
+    from corda_tpu.crypto.hashes import SecureHash
+    from corda_tpu.flows.replacement import NotaryChangeCommand
+    from corda_tpu.finance.cash import CashMove
+    from corda_tpu.crypto import schemes
+    from corda_tpu.core.identity import Party
+    from corda_tpu.core.contracts import Amount, Issued, PartyAndReference
+
+    kp = schemes.generate_keypair(seed=9)
+    party = Party("X", kp.public)
+    token = Issued(PartyAndReference(party, b"\x01"), "USD")
+    n1 = Party("N1", schemes.generate_keypair(seed=10).public)
+    n2 = Party("N2", schemes.generate_keypair(seed=11).public)
+    state = CashState(Amount(5, token), kp.public)
+    ltx = LedgerTransaction(
+        (StateAndRef(
+            TransactionState(state, CASH_CONTRACT, n1),
+            StateRef(SecureHash.sha256(b"a"), 0),
+        ),),
+        (TransactionState(state, CASH_CONTRACT, n2),),
+        (
+            CommandWithParties((kp.public,), (), NotaryChangeCommand(n2)),
+            CommandWithParties((kp.public,), (), CashMove()),
+        ),
+        (), n1, None, SecureHash.sha256(b"tx"),
+    )
+    with pytest.raises(TransactionVerificationError, match="exactly one"):
+        ltx.verify()
+
+
+def test_composite_threshold_enforced_in_replacement():
+    """A 2-of-3 composite-owned state cannot be moved with one leaf
+    signature (review finding: leaf-intersection vs threshold)."""
+    from corda_tpu.core.contracts import (
+        Amount, CommandWithParties, ContractViolation, Issued,
+        PartyAndReference, StateAndRef, StateRef, TransactionState,
+    )
+    from corda_tpu.core.identity import Party
+    from corda_tpu.core.replacement import NotaryChangeCommand
+    from corda_tpu.core.transactions import LedgerTransaction
+    from corda_tpu.crypto import schemes
+    from corda_tpu.crypto.composite import CompositeKey
+    from corda_tpu.crypto.hashes import SecureHash
+
+    kps = [schemes.generate_keypair(seed=20 + i) for i in range(3)]
+    composite = CompositeKey.build([k.public for k in kps], threshold=2)
+    issuer = Party("I", schemes.generate_keypair(seed=30).public)
+    token = Issued(PartyAndReference(issuer, b"\x01"), "USD")
+    state = CashState(Amount(5, token), composite)
+    n1 = Party("N1", schemes.generate_keypair(seed=31).public)
+    n2 = Party("N2", schemes.generate_keypair(seed=32).public)
+
+    def make_ltx(signers):
+        return LedgerTransaction(
+            (StateAndRef(
+                TransactionState(state, CASH_CONTRACT, n1),
+                StateRef(SecureHash.sha256(b"a"), 0),
+            ),),
+            (TransactionState(state, CASH_CONTRACT, n2),),
+            (CommandWithParties(tuple(signers), (), NotaryChangeCommand(n2)),),
+            (), n1, None, SecureHash.sha256(b"tx"),
+        )
+
+    with pytest.raises(ContractViolation, match="threshold"):
+        make_ltx([kps[0].public]).verify()          # 1-of-3: refused
+    make_ltx([kps[0].public, kps[2].public]).verify()   # 2-of-3: ok
+
+
+def test_replacement_rules_apply_in_core_only_process():
+    """The special verifier must work without importing the flows layer
+    (review finding: the out-of-process verifier pool)."""
+    import subprocess
+    import sys
+
+    code = (
+        "import corda_tpu.core.transactions as t;"
+        "import sys;"
+        "assert t._SPECIAL_VERIFIER is not None, 'hook not installed';"
+        "assert not any(m.startswith('corda_tpu.flows') for m in sys.modules),"
+        " 'flows layer leaked into a core-only process';"
+        "print('ok')"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True,
+        env={"PYTHONPATH": "/root/repo", "PATH": "/usr/bin:/bin",
+             "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, out.stderr
+    assert "ok" in out.stdout
