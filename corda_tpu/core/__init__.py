@@ -7,12 +7,3 @@ byte encoding (serialization.py) is consensus-critical — transaction ids
 are Merkle roots over encoded components, and signatures cover encoded
 SignableData payloads.
 """
-
-# Install the replacement-transaction (notary change / contract
-# upgrade) verification hook in EVERY process that touches core types —
-# including out-of-process verifier workers, which never import the
-# flows layer.
-from . import transactions as _transactions
-from . import replacement as _replacement
-
-_transactions.set_special_verifier(_replacement.replacement_verifier)

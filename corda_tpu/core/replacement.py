@@ -101,6 +101,14 @@ def _verify_notary_change(ltx, cmd) -> None:
         require_that(
             "old and new notary differ", sar.state.notary != new_notary
         )
+        # the OLD notary must notarise the change — it is the one whose
+        # uniqueness map consumes the input. A tx notarised by the new
+        # notary would leave the input spendable at the old one: a
+        # cross-notary double spend.
+        require_that(
+            "the transaction is notarised by the inputs' current notary",
+            ltx.notary == sar.state.notary,
+        )
         _signed_by_participants(sar.state.data, signers)
 
 
@@ -131,6 +139,10 @@ def _verify_contract_upgrade(ltx, cmd) -> None:
             out.data == convert(sar.state.data),
         )
         require_that("notary is unchanged", out.notary == sar.state.notary)
+        require_that(
+            "the transaction is notarised by the inputs' notary",
+            ltx.notary == sar.state.notary,
+        )
         _signed_by_participants(sar.state.data, signers)
 
 

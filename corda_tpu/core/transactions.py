@@ -339,17 +339,6 @@ class SignedTransaction:
         services.transaction_verifier.verify(ltx).result()
 
 
-# Replacement-transaction dispatch (set by flows.replacement at import
-# time): fn(ltx) -> Optional[callable]; a non-None result verifies the
-# tx INSTEAD of its state contracts.
-_SPECIAL_VERIFIER = None
-
-
-def set_special_verifier(fn) -> None:
-    global _SPECIAL_VERIFIER
-    _SPECIAL_VERIFIER = fn
-
-
 @ser.serializable
 @dataclass(frozen=True)
 class LedgerTransaction:
@@ -373,8 +362,12 @@ class LedgerTransaction:
         Replacement transactions (notary change / contract upgrade)
         dispatch to their special rules instead — the reference models
         those as separate LedgerTransaction classes
-        (NotaryChangeTransactions.kt); here one hook decides."""
-        special = _SPECIAL_VERIFIER(self) if _SPECIAL_VERIFIER else None
+        (NotaryChangeTransactions.kt). The lazy import keeps the rules
+        in core (every verifying process gets them, including
+        out-of-process workers) without an import cycle."""
+        from . import replacement as _repl
+
+        special = _repl.replacement_verifier(self)
         if special is not None:
             special()
             return

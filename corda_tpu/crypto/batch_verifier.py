@@ -101,9 +101,15 @@ class TpuBatchVerifier(BatchSignatureVerifier):
                 return b
         return self.batch_sizes[-1]
 
-    def _dispatch(self, scheme_id: int, items: list, out, idxs) -> None:
-        """Verify one scheme bucket, chunking at the largest batch size."""
+    def _dispatch(self, scheme_id: int, items: list, out, idxs) -> list:
+        """Stage + launch one scheme bucket, chunking at the largest
+        batch size. Returns [(device_result, idxs_slice, n)] WITHOUT
+        forcing: jax dispatch is async, so the caller's later staging
+        (the host-bound 30-40% of the wall) overlaps device compute of
+        the chunks already in flight; everything syncs at the end of
+        verify_batch."""
         max_b = self.batch_sizes[-1]
+        pending = []
         for off in range(0, len(items), max_b):
             chunk = items[off : off + max_b]
             batch = self._pick_batch(len(chunk))
@@ -120,9 +126,9 @@ class TpuBatchVerifier(BatchSignatureVerifier):
                     k: meshlib.shard_operand(self.mesh, v)
                     for k, v in staged.items()
                 }
-            res = np.asarray(self._kernel(scheme_id, batch)(**staged))
-            for j, ok in enumerate(res[: len(chunk)].tolist()):
-                out[idxs[off + j]] = bool(ok)
+            res = self._kernel(scheme_id, batch)(**staged)
+            pending.append((res, idxs[off : off + len(chunk)], len(chunk)))
+        return pending
 
     # -- SPI ---------------------------------------------------------------
 
@@ -138,12 +144,18 @@ class TpuBatchVerifier(BatchSignatureVerifier):
                 idxs.append(i)
             else:
                 cpu_idx.append(i)
+        pending = []
         for sid, (items, idxs) in buckets.items():
-            self._dispatch(sid, items, out, idxs)
+            pending.extend(self._dispatch(sid, items, out, idxs))
         if cpu_idx:
+            # CPU fallbacks also overlap the in-flight device chunks
             cpu_res = self._cpu.verify_batch([requests[i] for i in cpu_idx])
             for i, ok in zip(cpu_idx, cpu_res):
                 out[i] = ok
+        for res, chunk_idxs, n in pending:
+            arr = np.asarray(res)
+            for j, ok in enumerate(arr[:n].tolist()):
+                out[chunk_idxs[j]] = bool(ok)
         return [bool(v) for v in out]
 
 

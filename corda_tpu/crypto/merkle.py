@@ -31,7 +31,15 @@ def _pad_leaves(leaves: list[SecureHash]) -> list[SecureHash]:
 
 
 def merkle_root(leaves: list[SecureHash]) -> SecureHash:
-    """Root of the zero-padded binary SHA-256 tree."""
+    """Root of the zero-padded binary SHA-256 tree. Uses the native
+    kernel when built (one C call instead of 2N-1 hashlib round trips —
+    transaction ids hash through here); differential-tested against
+    this Python path in tests/test_native.py."""
+    from ..native import get as _native
+
+    native = _native()
+    if native is not None:
+        return SecureHash(native.merkle_root([h.bytes_ for h in leaves]))
     level = _pad_leaves(leaves)
     while len(level) > 1:
         level = [

@@ -126,25 +126,36 @@ def _diag_mul(a, b):
     """Raw schoolbook column sums: [22,B] x [22,B] -> [44,B].
 
     Inputs must have bounded limbs (< 4200) so columns stay < 2^29.
+
+    Formulation: 22 shifted partial products accumulated into the
+    [44,B] output (`acc[i:i+22] += a[i] * b`). The working set stays at
+    one [44,B] accumulator + one [22,B] partial, so XLA never
+    materialises a [22,22,B] outer product in HBM — on a v5e this is
+    2.3x faster than the broadcast/pad/reshape/sum formulation, which
+    was HBM-bandwidth-bound on the 8MB-per-product intermediates
+    (measured in the ecdsa kernel: 4.3k -> 9.9k verifies/s at B=4096).
+    Rejected alternatives, measured on the same kernel: grouped 1-D
+    convolution (one HLO op, tiny graph — but 2.6k/s: group-per-batch
+    convs lower poorly on TPU) and a 4-bit windowed ladder on top of
+    this formulation (fewer point ops, but the unrolled update-slices
+    blow the XLA graph up enough that compiles run into minutes).
     """
     batch = a.shape[1]
-    prods = a[:, None, :] * b[None, :, :]                  # [22, 22, B]
-    padded = jnp.pad(prods, ((0, 0), (0, NLIMB + 1), (0, 0)))   # [22, 45, B]
-    flat = padded.reshape(NLIMB * (2 * NLIMB + 1), batch)
-    flat = flat[: NLIMB * 2 * NLIMB]
-    # 45 = 1 mod 44: flat column index == i + j for every product (i, j)
-    return flat.reshape(NLIMB, 2 * NLIMB, batch).sum(axis=0)
+    acc = jnp.zeros((2 * NLIMB, batch), dtype=jnp.int32)
+    for i in range(NLIMB):
+        acc = acc.at[i : i + NLIMB].add(a[i][None, :] * b)
+    return acc
 
 
 def _diag_mul_const(a, const_limbs: tuple[int, ...]):
-    """Schoolbook columns against a host-constant second operand."""
+    """Schoolbook columns against a host-constant second operand (zero
+    limbs of the constant cost nothing)."""
     batch = a.shape[1]
-    c = _const_col(const_limbs)                            # [22, 1]
-    prods = a[:, None, :] * c[None, :, :]                  # [22, 22, B]
-    padded = jnp.pad(prods, ((0, 0), (0, NLIMB + 1), (0, 0)))
-    flat = padded.reshape(NLIMB * (2 * NLIMB + 1), batch)
-    flat = flat[: NLIMB * 2 * NLIMB]
-    return flat.reshape(NLIMB, 2 * NLIMB, batch).sum(axis=0)
+    acc = jnp.zeros((2 * NLIMB, batch), dtype=jnp.int32)
+    for j in range(NLIMB):
+        if const_limbs[j]:
+            acc = acc.at[j : j + NLIMB].add(a * int(const_limbs[j]))
+    return acc
 
 
 def _mont_reduce(ctx: MontCtx, t_cols):
@@ -156,9 +167,13 @@ def _mont_reduce(ctx: MontCtx, t_cols):
     batch = t_cols.shape[1]
     if t_cols.shape[0] < 2 * NLIMB:
         t_cols = jnp.pad(t_cols, ((0, 2 * NLIMB - t_cols.shape[0]), (0, 0)))
-    # m = (T mod R) * pinv mod R — dropping columns/carries >= R is free
-    t_lo_b, _ = _rounds(t_cols[:NLIMB], 3)
-    m, _ = _rounds(_diag_mul_const(t_lo_b, ctx.pinv_limbs)[:NLIMB], 3)
+    # m = (T mod R) * pinv mod R — dropping columns/carries >= R is free.
+    # TWO carry rounds suffice here: columns < 2^29, so round 1 leaves
+    # limbs <= 4095 + 2^17, round 2 <= 4095 + 33 < 4200 — within the
+    # "bounded" discipline _diag_mul* requires. (Round 3 would only
+    # tighten 4128 -> 4097.)
+    t_lo_b, _ = _rounds(t_cols[:NLIMB], 2)
+    m, _ = _rounds(_diag_mul_const(t_lo_b, ctx.pinv_limbs)[:NLIMB], 2)
     # U = T + m*p == 0 (mod R); divide exactly by R
     u = t_cols + _diag_mul_const(m, ctx.p_limbs)
     lo, t_drop = _rounds(u[:NLIMB], 3)

@@ -1,0 +1,40 @@
+"""Native host-side kernels with transparent Python fallback.
+
+`get()` returns the compiled `_cts_hash` module or None; consumers
+(crypto/merkle.py, crypto/hashes.py) fall back to hashlib when the
+extension is absent, so a checkout with no toolchain still works —
+`python -m corda_tpu.native.build` compiles it (g++, CPython C API, no
+third-party build deps). CORDA_TPU_NATIVE=0 disables the native path.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+_native = None
+_tried = False
+
+
+def get():
+    """The native module, or None (cached)."""
+    global _native, _tried
+    if _tried:
+        return _native
+    _tried = True
+    if os.environ.get("CORDA_TPU_NATIVE", "1") == "0":
+        return None
+    try:
+        from . import _cts_hash   # type: ignore
+
+        _native = _cts_hash
+    except ImportError:
+        _native = None
+    return _native
+
+
+def reset_cache() -> None:
+    """Re-probe after an in-process build (tests)."""
+    global _tried, _native
+    _tried = False
+    _native = None

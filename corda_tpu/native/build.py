@@ -1,0 +1,43 @@
+"""Build the native extension in place: python -m corda_tpu.native.build
+
+Uses g++ directly against the CPython headers (no setuptools isolation,
+no pybind11 — both unavailable-by-policy in this environment)."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import sysconfig
+
+
+def build(verbose: bool = True) -> str:
+    here = os.path.dirname(os.path.abspath(__file__))
+    src = os.path.join(here, "cts_hash.cpp")
+    suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    out = os.path.join(here, f"_cts_hash{suffix}")
+    include = sysconfig.get_paths()["include"]
+    cmd = [
+        "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+        f"-I{include}", src, "-o", out,
+    ]
+    if verbose:
+        print(" ".join(cmd))
+    subprocess.run(cmd, check=True)
+    return out
+
+
+if __name__ == "__main__":
+    path = build()
+    print(f"built {path}")
+    # smoke check
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(path))))
+    from corda_tpu.native import reset_cache, get
+
+    reset_cache()
+    mod = get()
+    assert mod is not None, "extension built but not importable"
+    import hashlib
+
+    assert mod.sha256(b"abc") == hashlib.sha256(b"abc").digest()
+    print("smoke check ok")

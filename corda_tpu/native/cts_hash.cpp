@@ -1,0 +1,238 @@
+// Native host-side hashing: SHA-256, batched hashing, Merkle roots.
+//
+// The reference is 100% JVM (SURVEY.md: zero native code); this
+// framework's native runtime components accelerate the HOST side of
+// the consensus path — transaction ids are SHA-256 Merkle roots over
+// component encodings (core/.../crypto/MerkleTree.kt:14-60 semantics:
+// pairwise sha256(left||right), leaves zero-padded to a power of two),
+// and the verifier/notary batch paths hash thousands of payloads per
+// pump. One native call replaces 2N-1 Python-level hashlib round trips
+// per tree.
+//
+// Semantics are LOCKED to corda_tpu/crypto/{hashes,merkle}.py; the
+// differential tests in tests/test_native.py fuzz both against each
+// other. SHA-256 per FIPS 180-4 (public specification).
+//
+// Build: python -m corda_tpu.native.build   (g++, CPython C API only)
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// SHA-256 (FIPS 180-4)
+
+struct Sha256 {
+    uint32_t state[8];
+    uint64_t bitlen;
+    uint8_t buffer[64];
+    size_t buflen;
+
+    Sha256() { reset(); }
+
+    void reset() {
+        static const uint32_t init[8] = {
+            0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+            0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19,
+        };
+        std::memcpy(state, init, sizeof(init));
+        bitlen = 0;
+        buflen = 0;
+    }
+
+    static uint32_t rotr(uint32_t x, int n) {
+        return (x >> n) | (x << (32 - n));
+    }
+
+    void transform(const uint8_t* chunk) {
+        static const uint32_t K[64] = {
+            0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b,
+            0x59f111f1, 0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01,
+            0x243185be, 0x550c7dc3, 0x72be5d74, 0x80deb1fe, 0x9bdc06a7,
+            0xc19bf174, 0xe49b69c1, 0xefbe4786, 0x0fc19dc6, 0x240ca1cc,
+            0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da, 0x983e5152,
+            0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+            0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc,
+            0x53380d13, 0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85,
+            0xa2bfe8a1, 0xa81a664b, 0xc24b8b70, 0xc76c51a3, 0xd192e819,
+            0xd6990624, 0xf40e3585, 0x106aa070, 0x19a4c116, 0x1e376c08,
+            0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a, 0x5b9cca4f,
+            0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+            0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2,
+        };
+        uint32_t w[64];
+        for (int i = 0; i < 16; i++) {
+            w[i] = (uint32_t(chunk[i * 4]) << 24) |
+                   (uint32_t(chunk[i * 4 + 1]) << 16) |
+                   (uint32_t(chunk[i * 4 + 2]) << 8) |
+                   uint32_t(chunk[i * 4 + 3]);
+        }
+        for (int i = 16; i < 64; i++) {
+            uint32_t s0 = rotr(w[i - 15], 7) ^ rotr(w[i - 15], 18) ^
+                          (w[i - 15] >> 3);
+            uint32_t s1 = rotr(w[i - 2], 17) ^ rotr(w[i - 2], 19) ^
+                          (w[i - 2] >> 10);
+            w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+        }
+        uint32_t a = state[0], b = state[1], c = state[2], d = state[3];
+        uint32_t e = state[4], f = state[5], g = state[6], h = state[7];
+        for (int i = 0; i < 64; i++) {
+            uint32_t S1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25);
+            uint32_t ch = (e & f) ^ (~e & g);
+            uint32_t t1 = h + S1 + ch + K[i] + w[i];
+            uint32_t S0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22);
+            uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+            uint32_t t2 = S0 + maj;
+            h = g; g = f; f = e; e = d + t1;
+            d = c; c = b; b = a; a = t1 + t2;
+        }
+        state[0] += a; state[1] += b; state[2] += c; state[3] += d;
+        state[4] += e; state[5] += f; state[6] += g; state[7] += h;
+    }
+
+    void update(const uint8_t* data, size_t len) {
+        bitlen += uint64_t(len) * 8;
+        while (len > 0) {
+            size_t take = 64 - buflen;
+            if (take > len) take = len;
+            std::memcpy(buffer + buflen, data, take);
+            buflen += take;
+            data += take;
+            len -= take;
+            if (buflen == 64) {
+                transform(buffer);
+                buflen = 0;
+            }
+        }
+    }
+
+    void finish(uint8_t out[32]) {
+        uint64_t bits = bitlen;
+        uint8_t pad = 0x80;
+        update(&pad, 1);
+        uint8_t zero = 0;
+        while (buflen != 56) update(&zero, 1);
+        uint8_t lenb[8];
+        for (int i = 0; i < 8; i++) lenb[i] = uint8_t(bits >> (56 - 8 * i));
+        // write length directly (update would re-count the bits)
+        std::memcpy(buffer + 56, lenb, 8);
+        transform(buffer);
+        buflen = 0;
+        for (int i = 0; i < 8; i++) {
+            out[i * 4] = uint8_t(state[i] >> 24);
+            out[i * 4 + 1] = uint8_t(state[i] >> 16);
+            out[i * 4 + 2] = uint8_t(state[i] >> 8);
+            out[i * 4 + 3] = uint8_t(state[i]);
+        }
+    }
+};
+
+void sha256_once(const uint8_t* data, size_t len, uint8_t out[32]) {
+    Sha256 h;
+    h.update(data, len);
+    h.finish(out);
+}
+
+// ---------------------------------------------------------------------------
+// Python surface
+
+PyObject* py_sha256(PyObject*, PyObject* arg) {
+    Py_buffer view;
+    if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) < 0) return nullptr;
+    uint8_t out[32];
+    sha256_once(static_cast<const uint8_t*>(view.buf), view.len, out);
+    PyBuffer_Release(&view);
+    return PyBytes_FromStringAndSize(reinterpret_cast<char*>(out), 32);
+}
+
+PyObject* py_sha256_many(PyObject*, PyObject* arg) {
+    PyObject* seq = PySequence_Fast(arg, "sha256_many takes a sequence");
+    if (!seq) return nullptr;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    PyObject* result = PyList_New(n);
+    if (!result) { Py_DECREF(seq); return nullptr; }
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject* item = PySequence_Fast_GET_ITEM(seq, i);
+        Py_buffer view;
+        if (PyObject_GetBuffer(item, &view, PyBUF_SIMPLE) < 0) {
+            Py_DECREF(result); Py_DECREF(seq); return nullptr;
+        }
+        uint8_t out[32];
+        sha256_once(static_cast<const uint8_t*>(view.buf), view.len, out);
+        PyBuffer_Release(&view);
+        PyObject* b = PyBytes_FromStringAndSize(
+            reinterpret_cast<char*>(out), 32);
+        if (!b) { Py_DECREF(result); Py_DECREF(seq); return nullptr; }
+        PyList_SET_ITEM(result, i, b);
+    }
+    Py_DECREF(seq);
+    return result;
+}
+
+// merkle_root(leaves: sequence of 32-byte hashes) -> 32 bytes
+// MerkleTree.kt semantics: zero-pad to the next power of two, pairwise
+// sha256(left || right) up to the root.
+PyObject* py_merkle_root(PyObject*, PyObject* arg) {
+    PyObject* seq = PySequence_Fast(arg, "merkle_root takes a sequence");
+    if (!seq) return nullptr;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    if (n == 0) {
+        Py_DECREF(seq);
+        PyErr_SetString(PyExc_ValueError,
+                        "cannot build a Merkle tree with no leaves");
+        return nullptr;
+    }
+    size_t size = 1;
+    while (size < size_t(n)) size *= 2;
+    std::vector<uint8_t> level(size * 32, 0);   // zero padding built in
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject* item = PySequence_Fast_GET_ITEM(seq, i);
+        Py_buffer view;
+        if (PyObject_GetBuffer(item, &view, PyBUF_SIMPLE) < 0) {
+            Py_DECREF(seq); return nullptr;
+        }
+        if (view.len != 32) {
+            PyBuffer_Release(&view);
+            Py_DECREF(seq);
+            PyErr_SetString(PyExc_ValueError, "leaves must be 32 bytes");
+            return nullptr;
+        }
+        std::memcpy(&level[i * 32], view.buf, 32);
+        PyBuffer_Release(&view);
+    }
+    Py_DECREF(seq);
+    while (size > 1) {
+        for (size_t i = 0; i < size; i += 2) {
+            uint8_t out[32];
+            sha256_once(&level[i * 32], 64, out);
+            std::memcpy(&level[(i / 2) * 32], out, 32);
+        }
+        size /= 2;
+    }
+    return PyBytes_FromStringAndSize(
+        reinterpret_cast<char*>(level.data()), 32);
+}
+
+PyMethodDef methods[] = {
+    {"sha256", py_sha256, METH_O, "SHA-256 digest of a bytes-like."},
+    {"sha256_many", py_sha256_many, METH_O,
+     "SHA-256 digest of every item of a sequence of bytes-likes."},
+    {"merkle_root", py_merkle_root, METH_O,
+     "Root of the zero-padded pairwise-SHA-256 tree over 32-byte leaves."},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyModuleDef module = {
+    PyModuleDef_HEAD_INIT, "_cts_hash",
+    "Native SHA-256 / Merkle kernels (host side).",
+    -1, methods, nullptr, nullptr, nullptr, nullptr,
+};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__cts_hash(void) { return PyModule_Create(&module); }

@@ -14,6 +14,10 @@ import time
 
 import pytest
 
+import os as _os
+
+REPO_ROOT = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+
 from corda_tpu.finance.cash import CashIssueFlow, CashPaymentFlow, CashState
 from corda_tpu.node.config import (
     ConfigError,
@@ -202,7 +206,7 @@ def test_cli_entry(tmp_path):
     path = str(tmp_path / "solo.toml")
     write_config(cfg, path)
     env = dict(os.environ)
-    env["PYTHONPATH"] = "/root/repo:" + env.get("PYTHONPATH", "")
+    env["PYTHONPATH"] = REPO_ROOT + ":" + env.get("PYTHONPATH", "")
     env["JAX_PLATFORMS"] = "cpu"
     proc = subprocess.Popen(
         [sys.executable, "-m", "corda_tpu.node", "--config", path,

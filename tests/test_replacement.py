@@ -10,6 +10,10 @@ from dataclasses import dataclass
 
 import pytest
 
+import os as _os
+
+REPO_ROOT = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+
 from corda_tpu.core import serialization as ser
 from corda_tpu.core.contracts import register_contract, require_that
 from corda_tpu.core.transactions import TransactionVerificationError
@@ -263,8 +267,9 @@ def test_replacement_rules_apply_in_core_only_process():
 
     code = (
         "import corda_tpu.core.transactions as t;"
+        "import corda_tpu.core.replacement as r;"
         "import sys;"
-        "assert t._SPECIAL_VERIFIER is not None, 'hook not installed';"
+        "assert r.replacement_verifier is not None;"
         "assert not any(m.startswith('corda_tpu.flows') for m in sys.modules),"
         " 'flows layer leaked into a core-only process';"
         "print('ok')"
@@ -272,8 +277,41 @@ def test_replacement_rules_apply_in_core_only_process():
     out = subprocess.run(
         [sys.executable, "-c", code],
         capture_output=True, text=True,
-        env={"PYTHONPATH": "/root/repo", "PATH": "/usr/bin:/bin",
+        env={"PYTHONPATH": REPO_ROOT, "PATH": "/usr/bin:/bin",
              "JAX_PLATFORMS": "cpu"},
     )
     assert out.returncode == 0, out.stderr
     assert "ok" in out.stdout
+
+
+def test_notary_change_must_be_notarised_by_old_notary():
+    """A hand-crafted notary-change tx notarised by the NEW notary must
+    fail verification: only the old notary's uniqueness map consumes
+    the input (review finding: cross-notary double spend)."""
+    from corda_tpu.core.contracts import (
+        Amount, CommandWithParties, ContractViolation, Issued,
+        PartyAndReference, StateAndRef, StateRef, TransactionState,
+    )
+    from corda_tpu.core.identity import Party
+    from corda_tpu.core.replacement import NotaryChangeCommand
+    from corda_tpu.core.transactions import LedgerTransaction
+    from corda_tpu.crypto import schemes
+    from corda_tpu.crypto.hashes import SecureHash
+
+    kp = schemes.generate_keypair(seed=41)
+    party = Party("X", kp.public)
+    token = Issued(PartyAndReference(party, b"\x01"), "USD")
+    n1 = Party("Old", schemes.generate_keypair(seed=42).public)
+    n2 = Party("New", schemes.generate_keypair(seed=43).public)
+    state = CashState(Amount(5, token), kp.public)
+    ltx = LedgerTransaction(
+        (StateAndRef(
+            TransactionState(state, CASH_CONTRACT, n1),
+            StateRef(SecureHash.sha256(b"a"), 0),
+        ),),
+        (TransactionState(state, CASH_CONTRACT, n2),),
+        (CommandWithParties((kp.public,), (), NotaryChangeCommand(n2)),),
+        (), n2, None, SecureHash.sha256(b"tx"),   # notarised by NEW: bad
+    )
+    with pytest.raises(ContractViolation, match="current notary"):
+        ltx.verify()
