@@ -39,10 +39,15 @@ class NodeWebServer:
         host: str = "127.0.0.1",
         port: int = 0,
         rpc_timeout: float = 90.0,
+        metrics=None,
     ):
+        """`metrics`: an optional MetricRegistry served at GET /metrics
+        in prometheus exposition format (the reference exports
+        dropwizard metrics over JMX/Jolokia HTTP, Node.kt:306-308)."""
         self.client = client
         self.pump = pump
         self.rpc_timeout = rpc_timeout
+        self.metrics = metrics
         self._lock = threading.Lock()   # one RPC conversation at a time
         gateway = self
 
@@ -82,6 +87,19 @@ class NodeWebServer:
     # -- dispatch ------------------------------------------------------------
 
     def _handle(self, req: BaseHTTPRequestHandler, method: str) -> None:
+        if method == "GET" and urlparse(req.path).path == "/metrics":
+            text = (
+                self.metrics.to_prometheus()
+                if self.metrics is not None
+                else ""
+            )
+            payload = text.encode()
+            req.send_response(200 if self.metrics is not None else 404)
+            req.send_header("Content-Type", "text/plain; version=0.0.4")
+            req.send_header("Content-Length", str(len(payload)))
+            req.end_headers()
+            req.wfile.write(payload)
+            return
         try:
             with self._lock:
                 status, body = self._route(req, method)

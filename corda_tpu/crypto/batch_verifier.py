@@ -101,7 +101,7 @@ class TpuBatchVerifier(BatchSignatureVerifier):
                 return b
         return self.batch_sizes[-1]
 
-    def _dispatch(self, scheme_id: int, items: list, out, idxs) -> list:
+    def _dispatch(self, scheme_id: int, items: list, idxs) -> list:
         """Stage + launch one scheme bucket, chunking at the largest
         batch size. Returns [(device_result, idxs_slice, n)] WITHOUT
         forcing: jax dispatch is async, so the caller's later staging
@@ -146,7 +146,7 @@ class TpuBatchVerifier(BatchSignatureVerifier):
                 cpu_idx.append(i)
         pending = []
         for sid, (items, idxs) in buckets.items():
-            pending.extend(self._dispatch(sid, items, out, idxs))
+            pending.extend(self._dispatch(sid, items, idxs))
         if cpu_idx:
             # CPU fallbacks also overlap the in-flight device chunks
             cpu_res = self._cpu.verify_batch([requests[i] for i in cpu_idx])

@@ -1,0 +1,2 @@
+"""Experimental subsystems (reference: experimental/ — deterministic
+sandbox prototype, universal contracts)."""

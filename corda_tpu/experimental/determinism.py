@@ -1,0 +1,138 @@
+"""Deterministic-contract checker: static audit of contract verify code.
+
+Reference: the deterministic-JVM sandbox prototype (experimental/
+sandbox/ — `WhitelistClassLoader` + bytecode instrumentation rejecting
+non-deterministic APIs and metering cost, planned to wrap out-of-process
+verifiers, docs/source/out-of-process-verification.rst:11-13). The
+reference itself only has a prototype; matching scope here: a static
+AST audit that flags non-deterministic constructs in a contract's
+`verify`, usable as a CI gate and by the verifier pool before
+registering a contract.
+
+This is an AUDIT, not a sandbox: Python cannot be fully confined from
+inside; the check catches the accident class (clocks, randomness, IO,
+iteration-order hazards), while organisational review covers malice —
+the same posture the reference's prototype takes.
+"""
+
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+from dataclasses import dataclass
+
+# names whose *use* in contract code is non-deterministic or effectful
+FORBIDDEN_NAMES = {
+    "open", "input", "print", "eval", "exec", "compile", "globals",
+    "vars", "id", "hash", "object",
+}
+FORBIDDEN_MODULES = {
+    "time", "random", "os", "sys", "io", "socket", "subprocess",
+    "threading", "multiprocessing", "datetime", "secrets", "uuid",
+    "requests", "urllib", "pathlib", "tempfile",
+}
+FORBIDDEN_ATTRS = {
+    "now", "today", "urandom", "getrandbits", "random", "randint",
+    "choice", "shuffle", "time", "time_ns", "monotonic", "perf_counter",
+}
+
+
+@dataclass(frozen=True)
+class Violation:
+    line: int
+    message: str
+
+
+class DeterminismError(Exception):
+    def __init__(self, contract_name: str, violations: list[Violation]):
+        self.violations = violations
+        detail = "; ".join(f"L{v.line}: {v.message}" for v in violations)
+        super().__init__(
+            f"contract {contract_name} fails the determinism audit: {detail}"
+        )
+
+
+class _Auditor(ast.NodeVisitor):
+    def __init__(self):
+        self.violations: list[Violation] = []
+
+    def _flag(self, node: ast.AST, message: str) -> None:
+        self.violations.append(Violation(getattr(node, "lineno", 0), message))
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            root = alias.name.split(".")[0]
+            if root in FORBIDDEN_MODULES:
+                self._flag(node, f"imports non-deterministic module {root!r}")
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        root = (node.module or "").split(".")[0]
+        if root in FORBIDDEN_MODULES:
+            self._flag(node, f"imports non-deterministic module {root!r}")
+        self.generic_visit(node)
+
+    def visit_Name(self, node: ast.Name) -> None:
+        if isinstance(node.ctx, ast.Load):
+            if node.id in FORBIDDEN_NAMES:
+                self._flag(node, f"uses forbidden builtin {node.id!r}")
+            if node.id in FORBIDDEN_MODULES:
+                self._flag(node, f"references module {node.id!r}")
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if node.attr in FORBIDDEN_ATTRS:
+            self._flag(node, f"calls non-deterministic API .{node.attr}")
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        # unbounded loops are a cost/DoS hazard; contracts iterate over
+        # transaction components (bounded) with for-loops
+        self._flag(node, "while-loops are not allowed in contract code")
+        self.generic_visit(node)
+
+    def visit_Try(self, node: ast.Try) -> None:
+        for handler in node.handlers:
+            if handler.type is None:
+                self._flag(
+                    handler,
+                    "bare except can swallow verification failures",
+                )
+        self.generic_visit(node)
+
+
+def audit_source(source: str) -> list[Violation]:
+    tree = ast.parse(textwrap.dedent(source))
+    auditor = _Auditor()
+    auditor.visit(tree)
+    return sorted(auditor.violations, key=lambda v: v.line)
+
+
+def audit_contract(contract) -> list[Violation]:
+    """Audit a contract object's verify() source. Raises
+    DeterminismError when violations are found; returns [] when clean.
+    """
+    source = inspect.getsource(type(contract).verify)
+    violations = audit_source(source)
+    if violations:
+        raise DeterminismError(type(contract).__name__, violations)
+    return violations
+
+
+def audit_registered_contracts() -> dict[str, list[Violation]]:
+    """Audit every registered contract (the verifier-pool gate). Returns
+    {contract_name: violations} for OFFENDERS only."""
+    from ..core.contracts import _CONTRACT_REGISTRY
+
+    offenders: dict[str, list[Violation]] = {}
+    for name, contract in _CONTRACT_REGISTRY.items():
+        try:
+            audit_contract(contract)
+        except DeterminismError as e:
+            offenders[name] = e.violations
+        except (OSError, TypeError):
+            offenders[name] = [
+                Violation(0, "verify() source unavailable for audit")
+            ]
+    return offenders

@@ -206,13 +206,18 @@ class Node:
         self._install_notary()
         self.scheduler = NodeSchedulerService(self.services, self.smm.start_flow)
 
+        # -- metrics (MonitoringService's MetricRegistry; serve it with
+        # node.webserver() -> GET /metrics in prometheus format, the
+        # JMX/Jolokia role of Node.kt:306-308)
+        from ..utils.metrics import MetricRegistry
+
+        self.metrics = MetricRegistry()
+
         # -- verifier offload ------------------------------------------
         self.verifier_service = None
         if config.verifier_type == "out_of_process":
-            from ..utils.metrics import MetricRegistry
             from .verifier import OutOfProcessTransactionVerifierService
 
-            self.metrics = MetricRegistry()
             self.verifier_service = OutOfProcessTransactionVerifierService(
                 self.messaging,
                 metrics=self.metrics,
@@ -495,6 +500,20 @@ class Node:
         return rpclib.RPCClient(
             self.messaging, self.config.name, username, password
         )
+
+    def webserver(self, username: str, password: str, port: int = 0):
+        """Embedded web gateway over the node's own RPC surface, with
+        this node's MetricRegistry at /metrics. The node's pump loop
+        (run()) drives message delivery, so the gateway itself only
+        polls futures (pass a real pump when embedding without run())."""
+        from ..client.webserver import NodeWebServer
+
+        return NodeWebServer(
+            self.rpc_client(username, password),
+            pump=lambda: None,
+            port=port,
+            metrics=self.metrics,
+        ).start()
 
 
 def banner(config: NodeConfig) -> str:

@@ -71,7 +71,7 @@ def deploy_nodes(
     try:
         store = PersistentKVStore(db, "node_tls")
         cert, key = store.get(b"cert"), store.get(b"key")
-        if cert is None:
+        if cert is None or key is None:   # partial writes regenerate
             tls = TlsIdentity.generate(map_name)
             store.put(b"cert", tls.cert_pem)
             store.put(b"key", tls.key_pem)

@@ -1,0 +1,161 @@
+"""X.509 certificate hierarchy utilities (host side).
+
+Reference: `X509Utilities` (core/.../crypto/X509Utilities.kt, 235 LoC)
+and the dev-mode keystore generation (node/.../utilities/
+KeyStoreUtilities.kt): a three-level chain — root CA -> intermediate
+(doorman) CA -> node CA -> TLS/identity leaf certs — plus chain
+validation. Built on the `cryptography` package; these certs underpin
+production identity (PartyAndCertificate); the fabric's nonce-signed
+handshake remains the transport-auth mechanism either way.
+"""
+
+from __future__ import annotations
+
+import datetime
+from dataclasses import dataclass
+from typing import Optional
+
+from cryptography import x509
+from cryptography.hazmat.primitives import hashes as chashes
+from cryptography.hazmat.primitives import serialization as cser
+from cryptography.hazmat.primitives.asymmetric import ec as cec
+from cryptography.x509.oid import NameOID
+
+_NOT_BEFORE = datetime.datetime(2020, 1, 1)
+_VALIDITY = datetime.timedelta(days=365 * 80)   # dev certs: out-live the repo
+
+
+@dataclass
+class CertAndKey:
+    cert: x509.Certificate
+    key: cec.EllipticCurvePrivateKey
+
+    @property
+    def cert_pem(self) -> bytes:
+        return self.cert.public_bytes(cser.Encoding.PEM)
+
+    @property
+    def key_pem(self) -> bytes:
+        return self.key.private_bytes(
+            cser.Encoding.PEM,
+            cser.PrivateFormat.PKCS8,
+            cser.NoEncryption(),
+        )
+
+
+def _name(common_name: str, org: str = "corda_tpu") -> x509.Name:
+    return x509.Name(
+        [
+            x509.NameAttribute(NameOID.COMMON_NAME, common_name),
+            x509.NameAttribute(NameOID.ORGANIZATION_NAME, org),
+        ]
+    )
+
+
+def _build(
+    subject: str,
+    issuer: Optional[CertAndKey],
+    is_ca: bool,
+    path_len: Optional[int],
+) -> CertAndKey:
+    key = cec.generate_private_key(cec.SECP256R1())
+    subject_name = _name(subject)
+    issuer_name = issuer.cert.subject if issuer else subject_name
+    signing_key = issuer.key if issuer else key
+    builder = (
+        x509.CertificateBuilder()
+        .subject_name(subject_name)
+        .issuer_name(issuer_name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(_NOT_BEFORE)
+        .not_valid_after(_NOT_BEFORE + _VALIDITY)
+        .add_extension(
+            x509.BasicConstraints(ca=is_ca, path_length=path_len),
+            critical=True,
+        )
+    )
+    cert = builder.sign(signing_key, chashes.SHA256())
+    return CertAndKey(cert, key)
+
+
+def create_root_ca(common_name: str = "corda_tpu Root CA") -> CertAndKey:
+    """Self-signed root (X509Utilities.createSelfSignedCACert)."""
+    return _build(common_name, None, is_ca=True, path_len=2)
+
+
+def create_intermediate_ca(
+    root: CertAndKey, common_name: str = "corda_tpu Intermediate CA"
+) -> CertAndKey:
+    return _build(common_name, root, is_ca=True, path_len=1)
+
+
+def create_node_ca(intermediate: CertAndKey, legal_name: str) -> CertAndKey:
+    """The per-node CA under the network intermediate
+    (X509Utilities.createIntermediateCert for nodes)."""
+    return _build(f"{legal_name} Node CA", intermediate, is_ca=True, path_len=0)
+
+
+def create_leaf(
+    node_ca: CertAndKey, common_name: str, *, tls: bool = False
+) -> CertAndKey:
+    """Identity or TLS leaf under a node CA
+    (X509Utilities.createServerCert)."""
+    suffix = " TLS" if tls else " Identity"
+    return _build(common_name + suffix, node_ca, is_ca=False, path_len=None)
+
+
+def validate_chain(
+    *chain: x509.Certificate, at: Optional[datetime.datetime] = None
+) -> bool:
+    """leaf-first chain validation: every cert is signed by the next,
+    the last is self-signed, CA + path-length constraints hold, and
+    validity windows cover `at` (default: the actual current time —
+    expiry is enforced; X509Utilities.validateCertificateChain)."""
+    if not chain:
+        return False
+    now = at or datetime.datetime.now(datetime.timezone.utc)
+    if now.tzinfo is None:
+        now = now.replace(tzinfo=datetime.timezone.utc)
+    for i, cert in enumerate(chain):
+        if not (
+            cert.not_valid_before_utc <= now <= cert.not_valid_after_utc
+        ):
+            return False
+        signer = chain[i + 1] if i + 1 < len(chain) else cert
+        try:
+            cert.verify_directly_issued_by(signer)
+        except Exception:
+            return False
+        if i > 0:
+            try:
+                bc = cert.extensions.get_extension_for_class(
+                    x509.BasicConstraints
+                ).value
+            except x509.ExtensionNotFound:
+                return False
+            if not bc.ca:
+                return False
+            # path_length bounds how many CA certs may sit BELOW this
+            # one (excluding the leaf): a path_len=0 node CA must not
+            # be able to mint sub-CAs whose chains still validate
+            cas_below = i - 1
+            if bc.path_length is not None and cas_below > bc.path_length:
+                return False
+    return True
+
+
+def dev_certificate_hierarchy(legal_name: str) -> dict[str, CertAndKey]:
+    """The dev-mode keystore bundle a node gets at first boot
+    (KeyStoreUtilities dev certs): root, intermediate, node CA, and
+    identity + TLS leaves."""
+    root = create_root_ca()
+    inter = create_intermediate_ca(root)
+    node_ca = create_node_ca(inter, legal_name)
+    return {
+        "root": root,
+        "intermediate": inter,
+        "node_ca": node_ca,
+        "identity": create_leaf(node_ca, legal_name),
+        "tls": create_leaf(node_ca, legal_name, tls=True),
+    }

@@ -239,3 +239,25 @@ def test_start_flow_instance_with_mismatched_ctor_raises():
 
     with pytest.raises(TypeError, match="does not store"):
         _ctor_kwargs_of(Odd(5))
+
+
+def test_webserver_metrics_endpoint(web):
+    from corda_tpu.client.webserver import NodeWebServer
+    from corda_tpu.utils.metrics import MetricRegistry
+
+    net, server, alice, bob = web
+    registry = MetricRegistry()
+    registry.counter("rpc.requests").inc(7)
+    mserver = NodeWebServer(
+        rpclib.RPCClient(net.fabric.endpoint("m-console"), "Alice", "sh", "pw"),
+        pump=lambda: net.run(),
+        metrics=registry,
+    ).start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{mserver.port}/metrics", timeout=10
+        ) as r:
+            text = r.read().decode()
+        assert "rpc_requests" in text and "7" in text
+    finally:
+        mserver.stop()
