@@ -57,6 +57,9 @@ def main() -> None:
     batch = int(os.environ.get("BENCH_BATCH", "4096"))
     iters = int(os.environ.get("BENCH_ITERS", "5"))
     metric = os.environ.get("BENCH_METRIC", "p256")
+    if metric not in ("p256", "mixed"):
+        # a typo must not record a p256-only rate under another name
+        raise SystemExit(f"unknown BENCH_METRIC {metric!r}: p256 | mixed")
 
     from corda_tpu.crypto.batch_verifier import (
         CpuBatchVerifier,

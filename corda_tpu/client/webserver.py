@@ -88,13 +88,18 @@ class NodeWebServer:
 
     def _handle(self, req: BaseHTTPRequestHandler, method: str) -> None:
         if method == "GET" and urlparse(req.path).path == "/metrics":
-            text = (
-                self.metrics.to_prometheus()
-                if self.metrics is not None
-                else ""
-            )
+            try:
+                text = (
+                    self.metrics.to_prometheus()
+                    if self.metrics is not None
+                    else ""
+                )
+                status = 200 if self.metrics is not None else 404
+            except Exception as e:   # a bad gauge must yield a 500, not
+                text = f"# metrics rendering failed: {e}\n"   # a reset
+                status = 500
             payload = text.encode()
-            req.send_response(200 if self.metrics is not None else 404)
+            req.send_response(status)
             req.send_header("Content-Type", "text/plain; version=0.0.4")
             req.send_header("Content-Length", str(len(payload)))
             req.end_headers()

@@ -123,7 +123,16 @@ class WireTransaction:
 
     @property
     def id(self) -> SecureHash:
-        return merkle_root(self.leaf_hashes())
+        """Merkle root over component hashes — THE transaction identity.
+        Cached per instance: the encode-and-hash walk is a host hot
+        path (every signature check, vault notify, broadcast and
+        notary round asks for the id), and the instance is frozen so
+        the root can never change."""
+        cached = getattr(self, "_id_cache", None)
+        if cached is None:
+            cached = merkle_root(self.leaf_hashes())
+            object.__setattr__(self, "_id_cache", cached)
+        return cached
 
     # -- state access ------------------------------------------------------
 

@@ -110,10 +110,13 @@ def audit_source(source: str) -> list[Violation]:
 
 
 def audit_contract(contract) -> list[Violation]:
-    """Audit a contract object's verify() source. Raises
-    DeterminismError when violations are found; returns [] when clean.
-    """
-    source = inspect.getsource(type(contract).verify)
+    """Audit a contract CLASS's full source (verify plus every helper
+    method it may call — auditing verify alone would let `verify ->
+    self._helper -> random()` slip through). Module-level helpers
+    outside the class remain out of scope; keep contract logic on the
+    class. Raises DeterminismError on violations; returns [] when
+    clean."""
+    source = inspect.getsource(type(contract))
     violations = audit_source(source)
     if violations:
         raise DeterminismError(type(contract).__name__, violations)

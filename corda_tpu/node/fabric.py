@@ -133,36 +133,13 @@ class TlsIdentity:
 
     @staticmethod
     def generate(common_name: str) -> "TlsIdentity":
-        from cryptography import x509
-        from cryptography.hazmat.primitives import hashes as chashes
-        from cryptography.hazmat.primitives import serialization as cser
-        from cryptography.hazmat.primitives.asymmetric import ec
-        from cryptography.x509.oid import NameOID
-        import datetime
+        # one certificate-construction recipe for the whole codebase
+        # (utils.x509 owns it; the identity-hierarchy path and this
+        # self-signed TLS path must not silently diverge)
+        from ..utils.x509 import _build
 
-        key = ec.generate_private_key(ec.SECP256R1())
-        name = x509.Name(
-            [x509.NameAttribute(NameOID.COMMON_NAME, common_name)]
-        )
-        now = datetime.datetime(2020, 1, 1)
-        cert = (
-            x509.CertificateBuilder()
-            .subject_name(name)
-            .issuer_name(name)
-            .public_key(key.public_key())
-            .serial_number(x509.random_serial_number())
-            .not_valid_before(now)
-            .not_valid_after(now + datetime.timedelta(days=365 * 30))
-            .sign(key, chashes.SHA256())
-        )
-        return TlsIdentity(
-            cert.public_bytes(cser.Encoding.PEM),
-            key.private_bytes(
-                cser.Encoding.PEM,
-                cser.PrivateFormat.PKCS8,
-                cser.NoEncryption(),
-            ),
-        )
+        pair = _build(common_name, None, is_ca=False, path_len=None)
+        return TlsIdentity(pair.cert_pem, pair.key_pem)
 
     def server_context(self) -> ssl.SSLContext:
         import tempfile

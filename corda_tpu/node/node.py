@@ -464,9 +464,15 @@ class Node:
 
     def run(self) -> None:
         """The pump loop — the single server thread (Node.kt:344)."""
-        while self.running:
-            self.messaging.pump(block=True, timeout=0.2)
-            self._tick_services()
+        import threading
+
+        self._run_thread = threading.current_thread()
+        try:
+            while self.running:
+                self.messaging.pump(block=True, timeout=0.2)
+                self._tick_services()
+        finally:
+            self._run_thread = None
 
     def pump(self, timeout: float = 0.0) -> int:
         """One pump step (embedded/driver use)."""
@@ -475,9 +481,19 @@ class Node:
         return n
 
     def stop(self) -> None:
+        import threading
+
         if not self.running:
             return
         self.running = False
+        # an embedded run() thread must drain its current pump before
+        # the database closes under it
+        run_thread = getattr(self, "_run_thread", None)
+        if (
+            run_thread is not None
+            and run_thread is not threading.current_thread()
+        ):
+            run_thread.join(timeout=5)
         self.scheduler.stop()
         self.smm.stop()
         if self.raft is not None:
