@@ -12,6 +12,8 @@ from typing import Callable, Optional
 FLOW_SEARCH_PACKAGES = (
     "corda_tpu.finance.cash",
     "corda_tpu.finance.trade_flows",
+    "corda_tpu.flows.core_flows",
+    "corda_tpu.flows.replacement",
     "corda_tpu.samples.irs_demo",
     "corda_tpu.samples.attachment_demo",
     "corda_tpu.testing.flows",

@@ -578,3 +578,117 @@ class SignTransactionFlow(FlowLogic):
         sig = self.services.key_management.sign(stx.id, key)
         yield from self.send(self.other_party, sig)
         return None
+
+
+# ---------------------------------------------------------------------------
+# confidential identities
+
+
+@ser.serializable
+@dataclass(frozen=True)
+class AnonymousIdentity:
+    """A freshly-minted anonymous key claimed by a well-known party.
+    TWO signatures bind the pair (the certificate's role in the
+    reference's TransactionKeyFlow): the well-known key endorses the
+    fresh key, and the fresh key proves POSSESSION — without the
+    latter, a counterparty could claim someone else's key and hijack
+    that key's identity mapping at every peer."""
+
+    well_known: Party
+    fresh_key: Any                      # PublicKey
+    signature: bytes                    # by well_known over the bind
+    fresh_signature: bytes              # by fresh_key over the bind
+
+    def bind_bytes(self) -> bytes:
+        return b"confidential-identity" + ser.encode(
+            [self.well_known, self.fresh_key]
+        )
+
+    def verify(self) -> bool:
+        from ..crypto import schemes as _schemes
+
+        bind = self.bind_bytes()
+        return _schemes.verify_one(
+            self.well_known.owning_key, self.signature, bind
+        ) and _schemes.verify_one(
+            self.fresh_key, self.fresh_signature, bind
+        )
+
+
+@initiating_flow
+class SwapIdentitiesFlow(FlowLogic):
+    """TransactionKeyFlow: both sides mint fresh (anonymous) keys for
+    one transaction and exchange them with ownership proofs, recording
+    the key->party mapping in their identity services. Returns
+    {party: AnonymousParty} for us and the counterparty."""
+
+    def __init__(self, other: Party):
+        self.other = other
+
+    def call(self):
+        from ..core.identity import AnonymousParty
+
+        ours = yield from self.record(
+            lambda: _minted_identity(self.services)
+        )
+        # our own mapping too: we must resolve our own anonymous key
+        # when it later appears as a signer/participant
+        self.services.identity.register_anonymous(
+            AnonymousParty(ours.fresh_key), self.our_identity
+        )
+        theirs = yield from self.send_and_receive(
+            self.other, ours, AnonymousIdentity
+        )
+        _accept_identity(self.services, theirs, expected=self.other)
+        return {
+            self.our_identity: AnonymousParty(ours.fresh_key),
+            self.other: AnonymousParty(theirs.fresh_key),
+        }
+
+
+@initiated_by(SwapIdentitiesFlow)
+class SwapIdentitiesHandler(FlowLogic):
+    def __init__(self, other: Party):
+        self.other = other
+
+    def call(self):
+        from ..core.identity import AnonymousParty
+
+        theirs = yield from self.receive(self.other, AnonymousIdentity)
+        _accept_identity(self.services, theirs, expected=self.other)
+        ours = yield from self.record(
+            lambda: _minted_identity(self.services)
+        )
+        self.services.identity.register_anonymous(
+            AnonymousParty(ours.fresh_key), self.our_identity
+        )
+        yield from self.send(self.other, ours)
+        return None
+
+
+def _minted_identity(services) -> AnonymousIdentity:
+    """Mint + self-certify a fresh key (journaled: replays reuse it)."""
+    me = services.my_info.legal_identity
+    fresh = services.key_management.fresh_key()
+    bind = AnonymousIdentity(me, fresh, b"", b"").bind_bytes()
+    sig = services.key_management.sign_bytes(bind, me.owning_key)
+    fresh_sig = services.key_management.sign_bytes(bind, fresh)
+    return AnonymousIdentity(me, fresh, sig, fresh_sig)
+
+
+def _accept_identity(services, ident: AnonymousIdentity, expected: Party):
+    """Validate + register a counterparty's anonymous identity."""
+    if ident.well_known != expected:
+        raise FlowException(
+            f"identity claims {ident.well_known}, session is with {expected}"
+        )
+    if not ident.verify():
+        raise FlowException("anonymous identity proof failed verification")
+    from ..core.identity import AnonymousParty
+
+    try:
+        services.identity.register_anonymous(
+            AnonymousParty(ident.fresh_key), ident.well_known
+        )
+    except ValueError as e:
+        raise FlowException(f"identity registration refused: {e}")

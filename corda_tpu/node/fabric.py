@@ -136,9 +136,9 @@ class TlsIdentity:
         # one certificate-construction recipe for the whole codebase
         # (utils.x509 owns it; the identity-hierarchy path and this
         # self-signed TLS path must not silently diverge)
-        from ..utils.x509 import _build
+        from ..utils.x509 import create_self_signed
 
-        pair = _build(common_name, None, is_ca=False, path_len=None)
+        pair = create_self_signed(common_name)
         return TlsIdentity(pair.cert_pem, pair.key_pem)
 
     def server_context(self) -> ssl.SSLContext:

@@ -208,6 +208,14 @@ class KeyManagementService:
             raise KeyError(f"no private key for {key}")
         return sign_tx_id(priv, tx_id)
 
+    def sign_bytes(self, data: bytes, key: schemes.PublicKey) -> bytes:
+        """Raw scheme signature over arbitrary bytes (identity binds,
+        registrations — NOT transactions, which go through sign())."""
+        priv = self._keys.get(key)
+        if priv is None:
+            raise KeyError(f"no private key for {key}")
+        return priv.sign(data)
+
     def our_first_key_for(self, candidates: Iterable) -> Optional[schemes.PublicKey]:
         """First leaf of any candidate key that we control."""
         for k in candidates:
@@ -229,6 +237,22 @@ class IdentityService:
     def register(self, party: Party) -> None:
         self._by_key[_key_fp(party.owning_key)] = party
         self._by_name[party.name] = party
+
+    def register_anonymous(self, anonymous, well_known: Party) -> None:
+        """Record that an anonymous key belongs to a well-known party
+        (confidential identities — the mapping TransactionKeyFlow
+        exchanges; reference: IdentityService.registerAnonymousIdentity).
+        Refuses to REBIND a key already mapped to a different party —
+        silently overwriting would let a counterparty hijack someone
+        else's identity resolution on this node."""
+        fp = _key_fp(anonymous.owning_key)
+        existing = self._by_key.get(fp)
+        if existing is not None and existing != well_known:
+            raise ValueError(
+                f"key already registered to {existing}; refusing rebind "
+                f"to {well_known}"
+            )
+        self._by_key[fp] = well_known
 
     def party_from_key(self, key) -> Optional[Party]:
         return self._by_key.get(_key_fp(key))

@@ -79,6 +79,12 @@ def _build(
     return CertAndKey(cert, key)
 
 
+def create_self_signed(common_name: str) -> CertAndKey:
+    """Standalone self-signed leaf — the node TLS identity shape
+    (pinned by fingerprint, no chain)."""
+    return _build(common_name, None, is_ca=False, path_len=None)
+
+
 def create_root_ca(common_name: str = "corda_tpu Root CA") -> CertAndKey:
     """Self-signed root (X509Utilities.createSelfSignedCACert)."""
     return _build(common_name, None, is_ca=True, path_len=2)

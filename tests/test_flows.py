@@ -203,3 +203,99 @@ def test_mid_conversation_restore():
     net.run()
     fsm2 = next(iter(alice.smm.flows.values()))
     assert fsm2.result_or_throw() == 1 + 2 + 3 + 4
+
+
+def test_swap_identities_flow():
+    """TransactionKeyFlow: both sides exchange certified fresh keys and
+    can resolve each other's anonymous identities afterwards; a
+    confidential payment to the anonymous key lands in the vault."""
+    from corda_tpu.core.identity import AnonymousParty
+    from corda_tpu.flows.core_flows import SwapIdentitiesFlow
+
+    net, notary, alice, bob = make_net()
+    fsm = alice.start_flow(SwapIdentitiesFlow(bob.party))
+    net.run()
+    mapping = fsm.result_or_throw()
+    anon_alice = mapping[alice.party]
+    anon_bob = mapping[bob.party]
+    assert isinstance(anon_bob, AnonymousParty)
+    assert anon_bob.owning_key != bob.party.owning_key
+    # both sides can resolve the anonymous keys to well-known parties
+    assert alice.services.identity.well_known_party(anon_bob) == bob.party
+    assert bob.services.identity.well_known_party(anon_alice) == alice.party
+
+    # pay the ANONYMOUS key: relevancy still routes it to bob's vault
+    alice.run_flow(CashIssueFlow(500, "USD", alice.party, notary.party))
+    from corda_tpu.finance.cash import CashPaymentFlow
+
+    fsm = alice.start_flow(
+        CashPaymentFlow(200, "USD", AnonymousParty(anon_bob.owning_key))
+    )
+    net.run()
+    fsm.result_or_throw()
+    assert balance(bob) == 200
+
+
+def test_swap_identities_rejects_forged_proof():
+    from corda_tpu.flows.core_flows import AnonymousIdentity, _accept_identity
+    from corda_tpu.flows.api import FlowException
+
+    net, notary, alice, bob = make_net()
+    fresh = bob.services.key_management.fresh_key()
+    forged = AnonymousIdentity(bob.party, fresh, b"\x00" * 64, b"\x00" * 64)
+    import pytest as _pytest
+
+    with _pytest.raises(FlowException, match="proof failed"):
+        _accept_identity(alice.services, forged, expected=bob.party)
+    wrong_claim = AnonymousIdentity(
+        alice.party, fresh, b"\x00" * 64, b"\x00" * 64
+    )
+    with _pytest.raises(FlowException, match="session is with"):
+        _accept_identity(alice.services, wrong_claim, expected=bob.party)
+
+
+def test_swap_identities_requires_possession_and_no_rebind():
+    """A well-known party endorsing a key it does NOT control must be
+    rejected (possession proof), and an accepted key cannot be rebound
+    to another party later (review findings)."""
+    from corda_tpu.core.identity import AnonymousParty
+    from corda_tpu.flows.api import FlowException
+    from corda_tpu.flows.core_flows import AnonymousIdentity, _accept_identity
+
+    net, notary, alice, bob = make_net()
+    # Bob endorses CHARLIE's key (which Bob cannot sign with)
+    from corda_tpu.crypto import schemes as _schemes
+
+    charlie_key = _schemes.generate_keypair(seed=777).public
+    bind = AnonymousIdentity(bob.party, charlie_key, b"", b"").bind_bytes()
+    bob_sig = bob.services.key_management.sign_bytes(
+        bind, bob.party.owning_key
+    )
+    hijack = AnonymousIdentity(bob.party, charlie_key, bob_sig, b"\x00" * 64)
+    import pytest as _pytest
+
+    with _pytest.raises(FlowException, match="proof failed"):
+        _accept_identity(alice.services, hijack, expected=bob.party)
+
+    # no-rebind: a key mapped to Bob cannot be re-registered to Alice
+    fresh = bob.services.key_management.fresh_key()
+    alice.services.identity.register_anonymous(
+        AnonymousParty(fresh), bob.party
+    )
+    with _pytest.raises(ValueError, match="refusing rebind"):
+        alice.services.identity.register_anonymous(
+            AnonymousParty(fresh), alice.party
+        )
+
+
+def test_swap_registers_own_identity_locally():
+    from corda_tpu.flows.core_flows import SwapIdentitiesFlow
+
+    net, notary, alice, bob = make_net()
+    fsm = alice.start_flow(SwapIdentitiesFlow(bob.party))
+    net.run()
+    mapping = fsm.result_or_throw()
+    anon_alice = mapping[alice.party]
+    # ALICE can resolve her OWN anonymous key (review finding:
+    # asymmetric resolution views)
+    assert alice.services.identity.well_known_party(anon_alice) == alice.party
