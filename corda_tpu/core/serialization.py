@@ -43,6 +43,24 @@ class SerializationError(Exception):
     pass
 
 
+# When set, unknown object tags are handed to this callable
+# (tag, field_dict) -> object instead of raising, and reconstruction of
+# known classes is field-tolerant. Installed only by the carpenter
+# (core/carpenter.py) in contexts that opt in. THREAD-LOCAL on purpose:
+# the fabric decodes P2P frames on its own loop thread, and a tooling
+# thread inside a carpenter context must not make that consensus path
+# tolerant — it stays whitelist-only (CordaClassResolver.kt stance).
+_HANDLER_SLOT = __import__("threading").local()
+
+
+def _unknown_tag_handler() -> Optional[Callable[[str, dict], Any]]:
+    return getattr(_HANDLER_SLOT, "fn", None)
+
+
+def set_unknown_tag_handler(fn: Optional[Callable[[str, dict], Any]]) -> None:
+    _HANDLER_SLOT.fn = fn
+
+
 def serializable(cls=None, *, tag: Optional[str] = None):
     """Register a (data)class for canonical object encoding."""
 
@@ -148,8 +166,17 @@ def _enc(obj: Any, out: bytearray) -> None:
         out += _varint(len(items))
         for e in items:
             out += e
-    elif type(obj) in _REGISTRY_BY_TYPE:
-        tag = _REGISTRY_BY_TYPE[type(obj)]
+    else:
+        # registered object — or a carpenter-synthesized type, which
+        # encodes under its original wire tag (__cts_tag__) so an
+        # unknown object round-trips bit-identically
+        tag = _REGISTRY_BY_TYPE.get(type(obj)) or getattr(
+            type(obj), "__cts_tag__", None
+        )
+        if tag is None:
+            raise SerializationError(
+                f"type {type(obj).__name__} is not canonically serializable"
+            )
         out.append(0x09)
         tb = tag.encode("utf-8")
         out += _varint(len(tb))
@@ -166,10 +193,6 @@ def _enc(obj: Any, out: bytearray) -> None:
             for name, value in fields:
                 _enc(name, out)
                 _enc(value, out)
-    else:
-        raise SerializationError(
-            f"type {type(obj).__name__} is not canonically serializable"
-        )
 
 
 def decode(buf: bytes) -> Any:
@@ -226,6 +249,15 @@ def _dec(buf: bytes, i: int) -> tuple[Any, int]:
         i += n
         cls = _REGISTRY_BY_TAG.get(tname)
         if cls is None:
+            handler = _unknown_tag_handler()
+            if handler is not None and tname not in _CUSTOM_DEC:
+                nf, i = _read_varint(buf, i)
+                kwargs = {}
+                for _ in range(nf):
+                    name, i = _dec(buf, i)
+                    value, i = _dec(buf, i)
+                    kwargs[name] = value
+                return handler(tname, kwargs), i
             raise SerializationError(f"unknown object tag {tname!r}")
         if tname in _CUSTOM_DEC:
             payload, i = _dec(buf, i)
@@ -251,4 +283,16 @@ def _decode_dataclass(cls, kwargs):
     try:
         return cls(**{k: _tuplify(v) for k, v in kwargs.items()})
     except TypeError as e:
+        if _unknown_tag_handler() is not None and dataclasses.is_dataclass(cls):
+            # evolution tolerance (carpenter contexts only): drop fields
+            # this version doesn't know; removed-then-defaulted fields
+            # fill from dataclass defaults
+            known = {f.name for f in dataclasses.fields(cls)}
+            trimmed = {
+                k: _tuplify(v) for k, v in kwargs.items() if k in known
+            }
+            try:
+                return cls(**trimmed)
+            except TypeError:
+                pass
         raise SerializationError(f"cannot reconstruct {cls.__name__}: {e}")

@@ -64,7 +64,7 @@ class TpuBatchVerifier(BatchSignatureVerifier):
     Requests are grouped by scheme, padded up to the next configured
     batch size (so jit caches stay warm across calls), verified on
     device, and scattered back into request order. Schemes without a
-    batch kernel (RSA, SPHINCS placeholder) fall back to the CPU path.
+    batch kernel (RSA, SPHINCS — host hash-tree machinery, not MXU work) fall back to the CPU path.
     """
 
     def __init__(

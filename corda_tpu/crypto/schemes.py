@@ -9,8 +9,8 @@ Mirrors the reference's scheme table (core/.../crypto/Crypto.kt:78-184):
   3   ECDSA_SECP256R1_SHA256    TPU batch kernel (ecdsa.py)
   4   EDDSA_ED25519_SHA512      default scheme (Crypto.kt:171); TPU
                                 batch kernel (eddsa.py)
-  5   SPHINCS256_SHA256         post-quantum hash-based; descoped this
-                                round (raises UnsupportedScheme)
+  5   SPHINCS256_SHA256         post-quantum hash-based (sphincs.py,
+                                host-side; not an MXU workload)
   6   COMPOSITE                 threshold key trees (composite.py)
 
 Signing happens on the host (nodes sign one transaction at a time — it
@@ -147,6 +147,18 @@ def generate_keypair(scheme_id: int = DEFAULT_SCHEME, seed: Optional[int] = None
         )
         pub = PublicKey(scheme_id, pub_der)
         return KeyPair(PrivateKey(scheme_id, sk_der, pub), pub)
+    if scheme_id == SPHINCS256_SHA256:
+        from . import sphincs
+
+        if seed is not None:
+            seed_bytes = seed.to_bytes(32, "big", signed=False)
+        else:
+            import secrets
+
+            seed_bytes = secrets.token_bytes(32)
+        sk, pk = sphincs.keygen(seed_bytes)
+        pub = PublicKey(scheme_id, pk)
+        return KeyPair(PrivateKey(scheme_id, sk, pub), pub)
     raise UnsupportedScheme(f"scheme {scheme_id}")
 
 
@@ -172,6 +184,11 @@ def keypair_from_private(scheme_id: int, data: bytes) -> KeyPair:
         )
         pub = PublicKey(scheme_id, pub_der)
         return KeyPair(PrivateKey(scheme_id, data, pub), pub)
+    if scheme_id == SPHINCS256_SHA256:
+        from . import sphincs
+
+        pub = PublicKey(scheme_id, sphincs.public_from_private(data))
+        return KeyPair(PrivateKey(scheme_id, data, pub), pub)
     raise UnsupportedScheme(f"scheme {scheme_id}")
 
 
@@ -190,6 +207,10 @@ def sign(priv: PrivateKey, message: bytes) -> bytes:
     if sid == RSA_SHA256:
         sk = serialization.load_der_private_key(priv.data, password=None)
         return sk.sign(message, cpad.PKCS1v15(), hashes.SHA256())
+    if sid == SPHINCS256_SHA256:
+        from . import sphincs
+
+        return sphincs.sign(priv.data, message)
     raise UnsupportedScheme(f"scheme {sid}")
 
 
@@ -218,4 +239,8 @@ def verify_one(pub: PublicKey, signature: bytes, message: bytes) -> bool:
             return True
         except Exception:
             return False
+    if sid == SPHINCS256_SHA256:
+        from . import sphincs
+
+        return sphincs.verify(pub.data, signature, message)
     raise UnsupportedScheme(f"scheme {sid}")

@@ -1,11 +1,16 @@
 """Financial contracts & flows (reference: finance/ module)."""
 
+from .asset import OnLedgerAsset
 from .cash import (
     Cash,
     CashExitFlow,
     CashIssueFlow,
     CashPaymentFlow,
     CashState,
+)
+from .commodity import (
+    Commodity,
+    CommodityState,
 )
 from .commercial_paper import (
     CommercialPaper,
@@ -24,6 +29,9 @@ from .trade_flows import (
 )
 
 __all__ = [
+    "OnLedgerAsset",
+    "Commodity",
+    "CommodityState",
     "Cash",
     "CashExitFlow",
     "CashIssueFlow",

@@ -1,0 +1,209 @@
+"""OnLedgerAsset: the generic fungible-asset contract base.
+
+Reference: finance/.../contracts/asset/OnLedgerAsset.kt — the shared
+issue/move/exit machinery behind Cash, CommodityContract and Obligation
+— together with the clause stack those contracts instantiate
+(finance/.../clause/{Issue,Move,Exit}... over
+core/.../contracts/clauses/, SURVEY.md §2.1/§2.10).
+
+An asset contract here is an `OnLedgerAsset` instance parameterised by
+its state class and its three command types. Verification is the
+canonical clause tree:
+
+    GroupClauseVerifier(by issued token,
+        FirstOf(IssueClause, ExitClause, MoveClause))
+
+with per-group conservation arithmetic on integer `Amount`s and
+composite-aware signature checks.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable
+
+from ..core import serialization as ser
+from ..core.clauses import Clause, GroupClauseVerifier, mark, verify_clauses
+from ..core.contracts import Amount, ContractViolation, require_that
+from ..crypto.composite import is_fulfilled_by, leaves_of
+
+
+def signed_by(key, signers) -> bool:
+    """Composite-aware signer check: `key` is satisfied when it (or,
+    for composite keys, a fulfilling set of its leaves) appears among
+    the command signers' leaves (CompositeKey.isFulfilledBy,
+    core/.../crypto/composite/CompositeKey.kt:168)."""
+    leaf_pool = set()
+    for s in signers:
+        leaf_pool.update(leaves_of(s))
+        leaf_pool.add(s)
+    return key in leaf_pool or is_fulfilled_by(key, leaf_pool)
+
+
+class IssueClause(Clause):
+    """New value appears: no inputs in the group, positive outputs,
+    signed by the issuer (AbstractIssue.kt)."""
+
+    def __init__(self, issue_cmd: type):
+        self.required_commands = (issue_cmd,)
+
+    def verify(self, ltx, inputs, outputs, commands, group_key=None) -> set:
+        cmds = self.matched_commands(commands)
+        if inputs:
+            raise ContractViolation(
+                "issue group must not consume inputs"
+            )
+        out_sum = sum(s.amount.quantity for s in outputs)
+        require_that("issued amount is positive", out_sum > 0)
+        require_that(
+            "output amounts are positive",
+            all(s.amount.quantity > 0 for s in outputs),
+        )
+        issuer_key = group_key.issuer.party.owning_key
+        all_signers = {k for c in cmds for k in c.signers}
+        require_that(
+            "issue is signed by the issuer",
+            signed_by(issuer_key, all_signers),
+        )
+        return mark(cmds)
+
+
+class MoveClause(Clause):
+    """Value changes hands: conservation per group, every input owner
+    signs (ConserveAmount + move checks, Cash.kt Clauses.Move)."""
+
+    def __init__(self, move_cmd: type):
+        self.required_commands = (move_cmd,)
+
+    def verify(self, ltx, inputs, outputs, commands, group_key=None) -> set:
+        cmds = self.matched_commands(commands)
+        in_sum = sum(s.amount.quantity for s in inputs)
+        out_sum = sum(s.amount.quantity for s in outputs)
+        require_that(
+            "output amounts are positive",
+            all(s.amount.quantity > 0 for s in outputs),
+        )
+        require_that(
+            "value is conserved (inputs == outputs)",
+            in_sum == out_sum and in_sum > 0,
+        )
+        all_signers = {k for c in commands for k in c.signers}
+        for owner in {s.owner for s in inputs}:
+            require_that(
+                "move is signed by every input owner",
+                signed_by(owner, all_signers),
+            )
+        return mark(cmds)
+
+
+class ExitClause(Clause):
+    """Value is destroyed: inputs − outputs == exited amount for this
+    group's token; issuer and input owners sign (AbstractConserveAmount
+    exit handling). The exit command must carry `amount: Amount`."""
+
+    def __init__(self, exit_cmd: type):
+        self.required_commands = (exit_cmd,)
+
+    def verify(self, ltx, inputs, outputs, commands, group_key=None) -> set:
+        group_exits = [
+            c
+            for c in self.matched_commands(commands)
+            if c.value.amount.token == group_key
+        ]
+        if not group_exits:
+            # an exit of another token group; this group is a plain move
+            raise ContractViolation(
+                "exit command does not apply to this token group"
+            )
+        require_that(
+            "output amounts are positive",
+            all(s.amount.quantity > 0 for s in outputs),
+        )
+        in_sum = sum(s.amount.quantity for s in inputs)
+        out_sum = sum(s.amount.quantity for s in outputs)
+        exited = sum(c.value.amount.quantity for c in group_exits)
+        require_that("exit conserves value", in_sum - out_sum == exited)
+        exit_signers = {k for c in group_exits for k in c.signers}
+        issuer_key = group_key.issuer.party.owning_key
+        require_that(
+            "exit is signed by the issuer",
+            signed_by(issuer_key, exit_signers),
+        )
+        all_signers = {k for c in commands for k in c.signers}
+        for owner in {s.owner for s in inputs}:
+            require_that(
+                "exit is signed by every input owner",
+                signed_by(owner, all_signers),
+            )
+        return mark(group_exits)
+
+
+class AssetGroupClause(Clause):
+    """Group-aware if/elif over Issue/Exit/Move. `FirstOf` alone cannot
+    choose here because exit-vs-move is decided by the *group's* token
+    (an exit of token A must not constrain a simultaneous move of token
+    B), and clause matching only sees commands — so this clause does
+    the dispatch with group context, mirroring how the reference's Cash
+    group clause scopes exits to its issued-token group."""
+
+    def __init__(self, issue: IssueClause, exit_: ExitClause, move: MoveClause):
+        self.issue = issue
+        self.exit_ = exit_
+        self.move = move
+
+    def matches(self, commands) -> bool:
+        return True
+
+    def verify(self, ltx, inputs, outputs, commands, group_key=None) -> set:
+        if self.issue.matches(commands) and not inputs:
+            return self.issue.verify(
+                ltx, inputs, outputs, commands, group_key
+            )
+        group_exits = [
+            c
+            for c in self.exit_.matched_commands(commands)
+            if c.value.amount.token == group_key
+        ]
+        if group_exits:
+            return self.exit_.verify(
+                ltx, inputs, outputs, commands, group_key
+            )
+        return self.move.verify(ltx, inputs, outputs, commands, group_key)
+
+
+class OnLedgerAsset:
+    """Generic fungible-asset contract. Concrete assets instantiate it
+    with their state class + command types and register the instance
+    (OnLedgerAsset.kt; Cash/Commodity are thin instantiations)."""
+
+    def __init__(
+        self,
+        state_class: type,
+        issue_cmd: type,
+        move_cmd: type,
+        exit_cmd: type,
+        token_of: Callable[[Any], Any] = lambda s: s.amount.token,
+    ):
+        self.state_class = state_class
+        self.issue_cmd = issue_cmd
+        self.move_cmd = move_cmd
+        self.exit_cmd = exit_cmd
+        self.token_of = token_of
+        group_clause = AssetGroupClause(
+            IssueClause(issue_cmd),
+            ExitClause(exit_cmd),
+            MoveClause(move_cmd),
+        )
+        self._tree = GroupClauseVerifier(
+            group_clause, state_class, token_of
+        )
+
+    def verify(self, ltx) -> None:
+        cmds = [
+            c
+            for c in ltx.commands
+            if type(c.value)
+            in (self.issue_cmd, self.move_cmd, self.exit_cmd)
+        ]
+        require_that("an asset command is present", len(cmds) >= 1)
+        verify_clauses(ltx, self._tree, cmds)

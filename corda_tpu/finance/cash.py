@@ -18,13 +18,12 @@ from ..core import serialization as ser
 from ..core.contracts import (
     Amount,
     Issued,
-    StateAndRef,
     register_contract,
-    require_that,
 )
 from ..core.identity import Party, PartyAndReference
-from ..core.transactions import LedgerTransaction, TransactionBuilder
-from ..crypto.composite import AnyKey, leaves_of
+from ..core.transactions import TransactionBuilder
+from ..crypto.composite import AnyKey
+from .asset import OnLedgerAsset
 from ..flows.api import FlowException, FlowLogic, initiating_flow
 from ..flows.core_flows import FinalityFlow
 from ..node.services import InsufficientBalanceError
@@ -74,82 +73,12 @@ class CashExit:
     amount: Amount
 
 
-class Cash:
-    """The contract: verify() is the deterministic rule set
-    (Cash.kt clause stack → flat checks here)."""
+# The contract: the canonical OnLedgerAsset clause stack (Cash.kt's
+# clause-based verify — issue/move/exit dispatched per issued-token
+# group; see finance/asset.py for the clauses).
+Cash = OnLedgerAsset(CashState, CashIssue, CashMove, CashExit)
 
-    def verify(self, ltx: LedgerTransaction) -> None:
-        groups = ltx.group_states(CashState, lambda s: s.amount.token)
-        cmds = [
-            c for c in ltx.commands
-            if isinstance(c.value, (CashIssue, CashMove, CashExit))
-        ]
-        require_that("a Cash command is present", len(cmds) >= 1)
-        all_signers = {k for c in cmds for k in c.signers}
-        for group in groups:
-            token = group.key
-            issuer_key = token.issuer.party.owning_key
-            in_sum = sum(s.amount.quantity for s in group.inputs)
-            out_sum = sum(s.amount.quantity for s in group.outputs)
-            require_that(
-                "output amounts are positive",
-                all(s.amount.quantity > 0 for s in group.outputs),
-            )
-            issue = [c for c in cmds if isinstance(c.value, CashIssue)]
-            # exits apply per token group, not globally — an exit of
-            # token A must not constrain a simultaneous move of token B
-            group_exits = [
-                c for c in cmds
-                if isinstance(c.value, CashExit)
-                and c.value.amount.token == token
-            ]
-            if issue and not group.inputs:
-                require_that("issued amount is positive", out_sum > 0)
-                require_that(
-                    "issue is signed by the issuer",
-                    _signed_by(issuer_key, all_signers),
-                )
-                continue
-            if group_exits:
-                exited = sum(
-                    c.value.amount.quantity for c in group_exits
-                )
-                require_that(
-                    "exit conserves value", in_sum - out_sum == exited
-                )
-                require_that(
-                    "exit signed by issuer",
-                    _signed_by(
-                        issuer_key,
-                        {k for c in group_exits for k in c.signers},
-                    ),
-                )
-            else:
-                require_that(
-                    "cash is conserved (inputs == outputs)",
-                    in_sum == out_sum and in_sum > 0,
-                )
-            for owner in {s.owner for s in group.inputs}:
-                require_that(
-                    "move/exit is signed by every input owner",
-                    _signed_by(owner, all_signers),
-                )
-
-
-def _signed_by(key, signers) -> bool:
-    """Composite-aware: `key` is satisfied when it (or, for composite
-    keys, a fulfilling set of its leaves) appears among the command
-    signers' leaves."""
-    leaf_pool = set()
-    for s in signers:
-        leaf_pool.update(leaves_of(s))
-        leaf_pool.add(s)
-    from ..crypto.composite import is_fulfilled_by
-
-    return key in leaf_pool or is_fulfilled_by(key, leaf_pool)
-
-
-register_contract(CASH_CONTRACT, Cash())
+register_contract(CASH_CONTRACT, Cash)
 
 
 # ---------------------------------------------------------------------------

@@ -25,7 +25,8 @@ from ..core.contracts import (
 from ..core.identity import PartyAndReference
 from ..core.transactions import LedgerTransaction, TransactionBuilder
 from ..crypto.composite import AnyKey
-from .cash import CashState, _signed_by
+from .asset import signed_by as _signed_by
+from .cash import CashState
 
 CP_CONTRACT = "corda_tpu.finance.CommercialPaper"
 

@@ -20,7 +20,8 @@ from ..core.contracts import Amount, register_contract, require_that
 from ..core.identity import Party
 from ..core.transactions import LedgerTransaction, TransactionBuilder
 from ..crypto.composite import AnyKey
-from .cash import CashState, _signed_by
+from .asset import signed_by as _signed_by
+from .cash import CashState
 
 OBLIGATION_CONTRACT = "corda_tpu.finance.Obligation"
 

@@ -682,7 +682,14 @@ def _accept_identity(services, ident: AnonymousIdentity, expected: Party):
         raise FlowException(
             f"identity claims {ident.well_known}, session is with {expected}"
         )
-    if not ident.verify():
+    try:
+        ok = ident.verify()
+    except Exception:
+        # fresh_key is attacker-controlled wire data: a composite key
+        # or non-key value makes verify_one raise (UnsupportedScheme /
+        # AttributeError) rather than return False — same verdict
+        ok = False
+    if not ok:
         raise FlowException("anonymous identity proof failed verification")
     from ..core.identity import AnonymousParty
 

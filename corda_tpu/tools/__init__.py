@@ -1,0 +1,4 @@
+"""Operator tooling (reference: tools/ — explorer, demobench, graphs)
+plus packaging (node/capsule analogue). The loadtest harness lives in
+corda_tpu.testing.loadtest; cordform deployment in
+corda_tpu.testing.cordform."""

@@ -1,0 +1,83 @@
+"""Self-contained runnable artefact builder (capsule analogue).
+
+Reference: node/capsule/ + webserver/webcapsule/ — gradle tasks that
+pack the node / webserver into single runnable fat jars (`corda.jar`,
+`corda-webserver.jar`). The python-native equivalent is a zipapp: one
+`.pyz` file embedding the whole corda_tpu package with a chosen
+entry point, runnable as `python corda.pyz --config node.toml`
+anywhere the interpreter + baked-in deps exist.
+"""
+
+from __future__ import annotations
+
+import os
+import py_compile
+import zipfile
+
+ENTRY_POINTS = {
+    "node": "corda_tpu.node.__main__",
+    "webserver": "corda_tpu.client.webserver",
+    "demobench": "corda_tpu.tools.demobench",
+    "explorer": "corda_tpu.tools.explorer",
+}
+
+
+def build_zipapp(
+    output: str,
+    entry: str = "node",
+    package_root: str | None = None,
+) -> str:
+    """Pack corda_tpu into a runnable .pyz with `entry`'s main() as
+    __main__ (capsule's role). Returns the output path."""
+    if entry not in ENTRY_POINTS:
+        raise ValueError(
+            f"unknown entry {entry!r}; choose from {sorted(ENTRY_POINTS)}"
+        )
+    module = ENTRY_POINTS[entry]
+    if package_root is None:
+        import corda_tpu
+
+        package_root = os.path.dirname(
+            os.path.dirname(os.path.abspath(corda_tpu.__file__))
+        )
+    pkg_dir = os.path.join(package_root, "corda_tpu")
+    if not os.path.isdir(pkg_dir):
+        raise FileNotFoundError(f"no corda_tpu package under {package_root}")
+    with zipfile.ZipFile(output, "w", zipfile.ZIP_DEFLATED) as zf:
+        for dirpath, dirnames, filenames in os.walk(pkg_dir):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if fn.endswith((".py", ".cpp", ".so", ".toml")):
+                    full = os.path.join(dirpath, fn)
+                    arc = os.path.relpath(full, package_root)
+                    # catch syntax errors at build time, like javac
+                    if fn.endswith(".py"):
+                        py_compile.compile(full, doraise=True)
+                    zf.write(full, arc)
+        zf.writestr(
+            "__main__.py",
+            "import runpy, sys\n"
+            f"runpy.run_module({module!r}, run_name='__main__')\n",
+        )
+    return output
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="corda_tpu.tools.package",
+        description="Build a runnable .pyz artefact (capsule analogue)",
+    )
+    parser.add_argument("output", help="e.g. corda.pyz")
+    parser.add_argument(
+        "--entry", default="node", choices=sorted(ENTRY_POINTS)
+    )
+    args = parser.parse_args(argv)
+    path = build_zipapp(args.output, args.entry)
+    print(f"built {path} (entry: {args.entry})")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,28 @@
+"""API-surface scanner (gradle-plugins/api-scanner analogue)."""
+
+import os
+
+from corda_tpu.tools import api_scanner
+
+
+def test_scan_contains_known_surface():
+    text = api_scanner.scan()
+    for needle in (
+        "class corda_tpu.flows.api.FlowLogic",
+        "class corda_tpu.crypto.batch_verifier.BatchSignatureVerifier",
+        "def corda_tpu.crypto.schemes.generate_keypair",
+        "class corda_tpu.finance.cash.CashState",
+        "class corda_tpu.testing.mock_network.MockNetwork",
+    ):
+        assert needle in text, f"missing from API scan: {needle}"
+    # internals stay out
+    assert "corda_tpu.node." not in text
+
+
+def test_api_surface_matches_committed_file():
+    """The committed api-current.txt is the reviewed API. If this
+    fails, the public surface changed: review the diff and refresh
+    with `python -m corda_tpu.tools.api_scanner --write`."""
+    assert os.path.exists(api_scanner.default_path())
+    diff = api_scanner.check()
+    assert not diff, "\n".join(diff)

@@ -1,0 +1,91 @@
+"""Carpenter: runtime type synthesis (ClassCarpenter.kt analogue)."""
+
+import dataclasses
+
+import pytest
+
+from corda_tpu.core import carpenter, serialization as ser
+
+
+def _wire_object(tag: str, fields: dict) -> bytes:
+    """Hand-encode an object of a type this process doesn't have."""
+    out = bytearray([0x09])
+    tb = tag.encode()
+    out += ser._varint(len(tb)) + tb
+    out += ser._varint(len(fields))
+    for name, value in fields.items():
+        out += ser.encode(name)
+        out += ser.encode(value)
+    return bytes(out)
+
+
+def test_unknown_tag_raises_outside_carpenter_context():
+    buf = _wire_object("ExoticState", {"x": 1})
+    with pytest.raises(ser.SerializationError, match="unknown object tag"):
+        ser.decode(buf)
+
+
+def test_carpenter_synthesizes_and_roundtrips():
+    buf = _wire_object(
+        "ExoticState", {"x": 42, "who": "alice", "blob": b"\x01\x02"}
+    )
+    obj = carpenter.decode_tolerant(buf)
+    assert carpenter.is_synthesized(obj)
+    assert (obj.x, obj.who, obj.blob) == (42, "alice", b"\x01\x02")
+    assert dataclasses.is_dataclass(obj)
+    # re-encodes bit-identically under the original wire tag
+    assert ser.encode(obj) == buf
+
+
+def test_same_schema_shares_a_type_and_equality():
+    a = carpenter.decode_tolerant(_wire_object("PairLike", {"a": 1, "b": 2}))
+    b = carpenter.decode_tolerant(_wire_object("PairLike", {"a": 1, "b": 2}))
+    c = carpenter.decode_tolerant(_wire_object("PairLike", {"a": 9, "b": 2}))
+    assert type(a) is type(b)
+    assert a == b and a != c
+
+
+def test_nested_unknown_types():
+    inner = _wire_object("InnerThing", {"v": 7})
+    outer = bytearray([0x09])
+    tb = b"OuterThing"
+    outer += ser._varint(len(tb)) + tb
+    outer += ser._varint(1)
+    outer += ser.encode("inner")
+    outer += bytes(inner)
+    obj = carpenter.decode_tolerant(bytes(outer))
+    assert obj.inner.v == 7
+
+
+def test_hostile_field_names_rejected():
+    for bad in ("not a name", "class", "__dict__;x"):
+        buf = _wire_object("Evil", {bad: 1})
+        with pytest.raises(carpenter.CarpenterError):
+            carpenter.decode_tolerant(buf)
+
+
+def test_evolution_added_field_dropped_in_context():
+    @ser.serializable(tag="EvoV1")
+    @dataclasses.dataclass(frozen=True)
+    class EvoV1:
+        x: int
+        y: int = 5
+
+    # a newer sender adds field z; old class decodes without it
+    buf = _wire_object("EvoV1", {"x": 1, "y": 2, "z": 3})
+    with pytest.raises(ser.SerializationError):
+        ser.decode(buf)                      # strict mode still rejects
+    obj = carpenter.decode_tolerant(buf)
+    assert obj == EvoV1(1, 2)
+
+    # a sender omits a defaulted field; default fills it
+    buf2 = _wire_object("EvoV1", {"x": 4, "z": 9})
+    obj2 = carpenter.decode_tolerant(buf2)
+    assert obj2 == EvoV1(4, 5)
+
+
+def test_known_types_unaffected_inside_context():
+    from corda_tpu.crypto.hashes import SecureHash
+
+    h = SecureHash.sha256(b"payload")
+    assert carpenter.decode_tolerant(ser.encode(h)) == h

@@ -1,0 +1,261 @@
+"""Clause framework + OnLedgerAsset/Commodity.
+
+Reference behaviours under test: core/.../contracts/clauses/ (AllOf,
+AnyOf, FirstOf, GroupClauseVerifier, verifyClause's unmatched-command
+rule) and finance/.../asset/{OnLedgerAsset,CommodityContract}.kt.
+"""
+
+import pytest
+
+from corda_tpu.core.clauses import (
+    AllOf,
+    AnyOf,
+    Clause,
+    FirstOf,
+    GroupClauseVerifier,
+    mark,
+    verify_clauses,
+)
+from corda_tpu.core.contracts import (
+    Amount,
+    CommandWithParties,
+    ContractViolation,
+    Issued,
+    StateAndRef,
+    StateRef,
+    TransactionState,
+)
+from corda_tpu.core.identity import Party, PartyAndReference
+from corda_tpu.core.transactions import LedgerTransaction
+from corda_tpu.crypto import schemes
+from corda_tpu.crypto.hashes import SecureHash
+from corda_tpu.finance.commodity import (
+    COMMODITY_CONTRACT,
+    Commodity,
+    CommodityExit,
+    CommodityIssue,
+    CommodityMove,
+    CommodityState,
+    commodity_token,
+)
+
+ISSUER_KP = schemes.generate_keypair(seed=301)
+ALICE_KP = schemes.generate_keypair(seed=302)
+BOB_KP = schemes.generate_keypair(seed=303)
+NOTARY_KP = schemes.generate_keypair(seed=304)
+
+ISSUER = Party("GoldCorp", ISSUER_KP.public)
+ALICE = Party("Alice", ALICE_KP.public)
+BOB = Party("Bob", BOB_KP.public)
+NOTARY = Party("Notary", NOTARY_KP.public)
+
+GOLD = commodity_token(ISSUER, "XAU")
+FCOJ = commodity_token(ISSUER, "FCOJ")
+
+
+def ltx(inputs=(), outputs=(), commands=()):
+    ins = tuple(
+        StateAndRef(
+            TransactionState(data, COMMODITY_CONTRACT, NOTARY),
+            StateRef(SecureHash.sha256(bytes([i])), i),
+        )
+        for i, data in enumerate(inputs)
+    )
+    outs = tuple(
+        TransactionState(data, COMMODITY_CONTRACT, NOTARY)
+        for data in outputs
+    )
+    cmds = tuple(
+        CommandWithParties(tuple(signers), (), value)
+        for value, signers in commands
+    )
+    return LedgerTransaction(
+        ins, outs, cmds, (), NOTARY, None, SecureHash.sha256(b"clause-tx")
+    )
+
+
+def gold(qty, owner):
+    return CommodityState(Amount(qty, GOLD), owner)
+
+
+def fcoj(qty, owner):
+    return CommodityState(Amount(qty, FCOJ), owner)
+
+
+# -- clause combinators ------------------------------------------------------
+
+
+class CmdA:
+    pass
+
+
+class CmdB:
+    pass
+
+
+class Trace(Clause):
+    """Records invocations; consumes its required commands."""
+
+    def __init__(self, cmd_type, log, fail=False):
+        self.required_commands = (cmd_type,)
+        self.log = log
+        self.fail = fail
+
+    def verify(self, ltx, inputs, outputs, commands, group_key=None):
+        self.log.append((type(self).__name__, group_key))
+        if self.fail:
+            raise ContractViolation("traced failure")
+        return mark(self.matched_commands(commands))
+
+
+def test_allof_requires_every_subclause_to_match():
+    log = []
+    tree = AllOf(Trace(CmdA, log), Trace(CmdB, log))
+    tx = ltx(commands=[(CmdA(), [ALICE_KP.public])])
+    with pytest.raises(ContractViolation, match="did not match"):
+        verify_clauses(tx, tree)
+
+
+def test_allof_runs_all_and_marks_commands():
+    log = []
+    tree = AllOf(Trace(CmdA, log), Trace(CmdB, log))
+    tx = ltx(commands=[
+        (CmdA(), [ALICE_KP.public]), (CmdB(), [ALICE_KP.public]),
+    ])
+    verify_clauses(tx, tree)
+    assert len(log) == 2
+
+
+def test_anyof_needs_at_least_one_match():
+    tree = AnyOf(Trace(CmdA, []), Trace(CmdB, []))
+    with pytest.raises(ContractViolation, match="no clause"):
+        verify_clauses(ltx(commands=[]), tree)
+
+
+def test_firstof_picks_first_match_only():
+    log = []
+    tree = FirstOf(Trace(CmdA, log), Trace(CmdB, log))
+    tx = ltx(commands=[(CmdA(), [ALICE_KP.public])])
+    verify_clauses(tx, tree)
+    assert len(log) == 1
+
+
+def test_unmatched_command_is_a_violation():
+    tree = FirstOf(Trace(CmdA, []))
+    tx = ltx(commands=[
+        (CmdA(), [ALICE_KP.public]), (CmdB(), [ALICE_KP.public]),
+    ])
+    with pytest.raises(ContractViolation, match="not processed"):
+        verify_clauses(tx, tree)
+
+
+def test_group_clause_verifier_runs_per_group():
+    log = []
+
+    class PerGroup(Clause):
+        def verify(self, ltx, inputs, outputs, commands, group_key=None):
+            log.append(group_key)
+            return mark(commands)
+
+    tree = GroupClauseVerifier(
+        PerGroup(), CommodityState, lambda s: s.amount.token
+    )
+    tx = ltx(
+        outputs=[gold(5, ALICE_KP.public), fcoj(7, BOB_KP.public)],
+        commands=[(CmdA(), [ISSUER_KP.public])],
+    )
+    verify_clauses(tx, tree)
+    assert set(log) == {GOLD, FCOJ}
+
+
+# -- Commodity via OnLedgerAsset ---------------------------------------------
+
+
+def test_commodity_issue_valid():
+    Commodity.verify(ltx(
+        outputs=[gold(100, ALICE_KP.public)],
+        commands=[(CommodityIssue(), [ISSUER_KP.public])],
+    ))
+
+
+def test_commodity_issue_requires_issuer_signature():
+    with pytest.raises(ContractViolation, match="signed by the issuer"):
+        Commodity.verify(ltx(
+            outputs=[gold(100, ALICE_KP.public)],
+            commands=[(CommodityIssue(), [ALICE_KP.public])],
+        ))
+
+
+def test_commodity_move_conserves_value():
+    Commodity.verify(ltx(
+        inputs=[gold(100, ALICE_KP.public)],
+        outputs=[gold(60, BOB_KP.public), gold(40, ALICE_KP.public)],
+        commands=[(CommodityMove(), [ALICE_KP.public])],
+    ))
+    with pytest.raises(ContractViolation, match="conserved"):
+        Commodity.verify(ltx(
+            inputs=[gold(100, ALICE_KP.public)],
+            outputs=[gold(90, BOB_KP.public)],
+            commands=[(CommodityMove(), [ALICE_KP.public])],
+        ))
+
+
+def test_commodity_move_requires_owner_signature():
+    with pytest.raises(ContractViolation, match="every input owner"):
+        Commodity.verify(ltx(
+            inputs=[gold(100, ALICE_KP.public)],
+            outputs=[gold(100, BOB_KP.public)],
+            commands=[(CommodityMove(), [BOB_KP.public])],
+        ))
+
+
+def test_commodity_exit_destroys_value():
+    Commodity.verify(ltx(
+        inputs=[gold(100, ALICE_KP.public)],
+        outputs=[gold(70, ALICE_KP.public)],
+        commands=[(
+            CommodityExit(Amount(30, GOLD)),
+            [ISSUER_KP.public, ALICE_KP.public],
+        )],
+    ))
+
+
+def test_commodity_exit_rejects_zero_dust_outputs():
+    with pytest.raises(ContractViolation, match="positive"):
+        Commodity.verify(ltx(
+            inputs=[gold(100, ALICE_KP.public)],
+            outputs=[
+                gold(70, ALICE_KP.public),
+                CommodityState(Amount(0, GOLD), ALICE_KP.public),
+            ],
+            commands=[(
+                CommodityExit(Amount(30, GOLD)),
+                [ISSUER_KP.public, ALICE_KP.public],
+            )],
+        ))
+
+
+def test_commodity_exit_scoped_to_its_token_group():
+    """An exit of FCOJ must not constrain a simultaneous GOLD move."""
+    Commodity.verify(ltx(
+        inputs=[gold(10, ALICE_KP.public), fcoj(50, ALICE_KP.public)],
+        outputs=[gold(10, BOB_KP.public), fcoj(20, ALICE_KP.public)],
+        commands=[
+            (CommodityMove(), [ALICE_KP.public]),
+            (
+                CommodityExit(Amount(30, FCOJ)),
+                [ISSUER_KP.public, ALICE_KP.public],
+            ),
+        ],
+    ))
+
+
+def test_commodity_mixed_issue_and_move_groups():
+    Commodity.verify(ltx(
+        inputs=[gold(10, ALICE_KP.public)],
+        outputs=[gold(10, BOB_KP.public), fcoj(5, ALICE_KP.public)],
+        commands=[
+            (CommodityMove(), [ALICE_KP.public]),
+            (CommodityIssue(), [ISSUER_KP.public]),
+        ],
+    ))

@@ -1,0 +1,119 @@
+"""Expect DSL (test-utils/.../testing/Expect.kt analogue)."""
+
+import pytest
+
+from corda_tpu.node.services import Observable
+from corda_tpu.testing.expect import (
+    expect,
+    expect_events,
+    parallel,
+    record,
+    replicate,
+    sequence,
+)
+
+
+class Ping:
+    def __init__(self, n):
+        self.n = n
+
+    def __repr__(self):
+        return f"Ping({self.n})"
+
+
+class Pong:
+    def __init__(self, n):
+        self.n = n
+
+
+def test_sequence_in_order():
+    expect_events(
+        [Ping(1), Pong(2)],
+        sequence(expect(Ping), expect(Pong)),
+    )
+
+
+def test_sequence_rejects_out_of_order():
+    with pytest.raises(AssertionError):
+        expect_events(
+            [Pong(2), Ping(1)],
+            sequence(expect(Ping), expect(Pong)),
+        )
+
+
+def test_parallel_any_interleaving():
+    for events in ([Ping(1), Pong(2)], [Pong(2), Ping(1)]):
+        expect_events(
+            events, parallel(expect(Ping), expect(Pong))
+        )
+
+
+def test_predicate_filters():
+    with pytest.raises(AssertionError):
+        expect_events(
+            [Ping(5)],
+            expect(Ping, lambda p: p.n == 6),
+        )
+
+
+def test_strict_rejects_unconsumed_event():
+    with pytest.raises(AssertionError, match="unexpected event"):
+        expect_events(
+            [Ping(1), Ping(2)],
+            expect(Ping),
+        )
+
+
+def test_non_strict_ignores_extras():
+    expect_events(
+        [Pong(0), Ping(1), Pong(2)],
+        expect(Ping),
+        strict=False,
+    )
+
+
+def test_incomplete_match_fails():
+    with pytest.raises(AssertionError, match="not satisfied"):
+        expect_events(
+            [Ping(1)],
+            sequence(expect(Ping), expect(Pong)),
+        )
+
+
+def test_replicate_and_nested_backtracking():
+    # two Pings in parallel with an ordered (Ping then Pong) thread:
+    # needs backtracking to assign the right Pings to the sequence.
+    events = [Ping(1), Ping(2), Ping(3), Pong(4)]
+    expect_events(
+        events,
+        parallel(
+            replicate(2, lambda i: expect(Ping)),
+            sequence(expect(Ping), expect(Pong)),
+        ),
+    )
+
+
+def test_actions_fire_once_on_surviving_branch():
+    hits = []
+    expect_events(
+        [Ping(1), Pong(2)],
+        sequence(
+            expect(Ping, action=lambda e: hits.append(("ping", e.n))),
+            expect(Pong, action=lambda e: hits.append(("pong", e.n))),
+        ),
+    )
+    assert sorted(hits) == [("ping", 1), ("pong", 2)]
+
+
+def test_record_over_observable():
+    obs = Observable()
+
+    def pump():
+        obs.emit(Ping(1))
+        obs.emit(Pong(2))
+
+    events = record(obs, pump)
+    expect_events(events, sequence(expect(Ping), expect(Pong)))
+    # after record() the subscription is gone
+    obs.emit(Ping(9))
+    assert len(events) == 2

@@ -252,6 +252,17 @@ def test_swap_identities_rejects_forged_proof():
     )
     with _pytest.raises(FlowException, match="session is with"):
         _accept_identity(alice.services, wrong_claim, expected=bob.party)
+    # hostile fresh_key shapes must fail cleanly, not crash: a
+    # composite key (no batch scheme) and a non-key value
+    from corda_tpu.crypto.composite import CompositeKey
+
+    composite = CompositeKey.build([fresh, bob.party.owning_key])
+    for bad_key in (composite, b"not-a-key"):
+        hostile = AnonymousIdentity(
+            bob.party, bad_key, b"\x00" * 64, b"\x00" * 64
+        )
+        with _pytest.raises(FlowException, match="proof failed"):
+            _accept_identity(alice.services, hostile, expected=bob.party)
 
 
 def test_swap_identities_requires_possession_and_no_rebind():

@@ -1,0 +1,71 @@
+"""SPHINCS-256 hash-based signatures (scheme id 5 in the registry,
+mirroring Crypto.kt's SPHINCS256_SHA512_256 entry)."""
+
+import pytest
+
+from corda_tpu.crypto import schemes, sphincs
+from corda_tpu.crypto.batch_verifier import (
+    CpuBatchVerifier,
+    VerificationRequest,
+)
+
+# one sign is ~500k hash invocations; share a keypair + signature
+# across tests (module-scoped fixtures keep the suite fast)
+
+
+@pytest.fixture(scope="module")
+def kp():
+    return schemes.generate_keypair(schemes.SPHINCS256_SHA256, seed=777)
+
+
+@pytest.fixture(scope="module")
+def signed(kp):
+    msg = b"sphincs message"
+    return msg, kp.private.sign(msg)
+
+
+def test_sign_verify_roundtrip(kp, signed):
+    msg, sig = signed
+    assert len(sig) == sphincs.SIG_SIZE
+    assert schemes.verify_one(kp.public, sig, msg)
+
+
+def test_rejects_wrong_message(kp, signed):
+    _, sig = signed
+    assert not schemes.verify_one(kp.public, sig, b"other message")
+
+
+def test_rejects_tampered_signature(kp, signed):
+    msg, sig = signed
+    for pos in (0, 40, sphincs.SIG_SIZE // 2, sphincs.SIG_SIZE - 1):
+        bad = bytearray(sig)
+        bad[pos] ^= 0x01
+        assert not schemes.verify_one(kp.public, bytes(bad), msg)
+    assert not schemes.verify_one(kp.public, sig[:-1], msg)
+
+
+def test_rejects_wrong_key(kp, signed):
+    msg, sig = signed
+    other = schemes.generate_keypair(schemes.SPHINCS256_SHA256, seed=778)
+    assert not schemes.verify_one(other.public, sig, msg)
+
+
+def test_deterministic_keygen_and_reload(kp):
+    again = schemes.generate_keypair(schemes.SPHINCS256_SHA256, seed=777)
+    assert again.public == kp.public
+    reloaded = schemes.keypair_from_private(
+        schemes.SPHINCS256_SHA256, kp.private.data
+    )
+    assert reloaded.public == kp.public
+
+
+def test_cpu_batch_fallback_mixes_schemes(kp, signed):
+    msg, sig = signed
+    ec = schemes.generate_keypair(schemes.ECDSA_SECP256R1_SHA256, seed=9)
+    ec_msg = b"ec message"
+    reqs = [
+        VerificationRequest(kp.public, sig, msg),
+        VerificationRequest(ec.public, ec.private.sign(ec_msg), ec_msg),
+        VerificationRequest(kp.public, sig, b"forged"),
+    ]
+    assert CpuBatchVerifier().verify_batch(reqs) == [True, True, False]

@@ -1,0 +1,302 @@
+"""Universal contracts DSL (experimental/universal analogue).
+
+Reference behaviours under test: universal/UniversalContract.kt —
+issue/action/fix evolution of arrangement trees, perceivable
+evaluation, roll-out schedule expansion.
+"""
+
+import pytest
+
+from corda_tpu.core.contracts import (
+    CommandWithParties,
+    ContractViolation,
+    StateAndRef,
+    StateRef,
+    TimeWindow,
+    TransactionState,
+)
+from corda_tpu.core.identity import Party
+from corda_tpu.core.transactions import LedgerTransaction
+from corda_tpu.crypto import schemes
+from corda_tpu.crypto.hashes import SecureHash
+from corda_tpu.experimental.universal import (
+    UNIVERSAL_CONTRACT,
+    UniversalAction,
+    UniversalContract,
+    UniversalFix,
+    UniversalIssue,
+    UniversalState,
+    action,
+    actions,
+    all_of,
+    const,
+    liable_parties,
+    obligation,
+    observable,
+    perceive,
+    roll_out,
+    time_after,
+    zero,
+)
+
+ACME_KP = schemes.generate_keypair(seed=401)
+HIBU_KP = schemes.generate_keypair(seed=402)
+NOTARY_KP = schemes.generate_keypair(seed=403)
+ORACLE_KP = schemes.generate_keypair(seed=404)
+
+ACME = Party("ACME", ACME_KP.public)
+HIBU = Party("HighStreetBank", HIBU_KP.public)
+NOTARY = Party("Notary", NOTARY_KP.public)
+ORACLE = Party("RatesOracle", ORACLE_KP.public)
+
+MATURITY = 1_900_000_000_000_000
+
+
+def ltx(inputs=(), outputs=(), commands=(), time_window=None):
+    ins = tuple(
+        StateAndRef(
+            TransactionState(data, UNIVERSAL_CONTRACT, NOTARY),
+            StateRef(SecureHash.sha256(bytes([i])), i),
+        )
+        for i, data in enumerate(inputs)
+    )
+    outs = tuple(
+        TransactionState(data, UNIVERSAL_CONTRACT, NOTARY)
+        for data in outputs
+    )
+    cmds = tuple(
+        CommandWithParties(tuple(signers), (), value)
+        for value, signers in commands
+    )
+    return LedgerTransaction(
+        ins, outs, cmds, (), NOTARY, time_window,
+        SecureHash.sha256(b"universal-tx"),
+    )
+
+
+def zcb():
+    """Zero-coupon bond: after maturity the holder may demand payment."""
+    return actions(
+        action(
+            "execute",
+            time_after(MATURITY),
+            HIBU,
+            obligation(const(1_000_000), "USD", ACME, HIBU),
+        ),
+        action(
+            "cancel",
+            const(True),
+            (ACME, HIBU),
+            zero,
+        ),
+    )
+
+
+def test_perceivable_arithmetic_and_fixings():
+    notional = const(100) * observable("LIBOR", "3M")
+    assert perceive(notional, {("LIBOR", "3M"): 7}, None) == 700
+    expr = (const(5) + const(3)) * const(2) - const(1)
+    assert perceive(expr, {}, None) == 15
+    assert perceive(time_after(10), {}, 11) is True
+    assert perceive(time_after(10), {}, 9) is False
+
+
+def test_issue_requires_liable_party_signature():
+    state = UniversalState((ACME, HIBU), zcb())
+    UniversalContract().verify(ltx(
+        outputs=[state],
+        commands=[(UniversalIssue(), [ACME_KP.public])],
+    ))
+    with pytest.raises(ContractViolation, match="liable party"):
+        UniversalContract().verify(ltx(
+            outputs=[state],
+            commands=[(UniversalIssue(), [HIBU_KP.public])],
+        ))
+
+
+def test_action_fires_when_condition_holds_and_actor_signs():
+    before = UniversalState((ACME, HIBU), zcb())
+    after = UniversalState(
+        (ACME, HIBU), obligation(const(1_000_000), "USD", ACME, HIBU)
+    )
+    UniversalContract().verify(ltx(
+        inputs=[before],
+        outputs=[after],
+        commands=[(UniversalAction("execute"), [HIBU_KP.public])],
+        time_window=TimeWindow(from_time=MATURITY + 1),
+    ))
+
+
+def test_action_rejected_before_maturity():
+    before = UniversalState((ACME, HIBU), zcb())
+    after = UniversalState(
+        (ACME, HIBU), obligation(const(1_000_000), "USD", ACME, HIBU)
+    )
+    with pytest.raises(ContractViolation, match="condition"):
+        UniversalContract().verify(ltx(
+            inputs=[before],
+            outputs=[after],
+            commands=[(UniversalAction("execute"), [HIBU_KP.public])],
+            time_window=TimeWindow(
+                from_time=MATURITY - 10, until_time=MATURITY - 5
+            ),
+        ))
+
+
+def test_action_requires_actor_signature():
+    before = UniversalState((ACME, HIBU), zcb())
+    with pytest.raises(ContractViolation, match="signed by actor"):
+        UniversalContract().verify(ltx(
+            inputs=[before],
+            outputs=[UniversalState(
+                (ACME, HIBU),
+                obligation(const(1_000_000), "USD", ACME, HIBU),
+            )],
+            commands=[(UniversalAction("execute"), [ACME_KP.public])],
+            time_window=TimeWindow(from_time=MATURITY + 1),
+        ))
+
+
+def test_wrong_continuation_rejected():
+    before = UniversalState((ACME, HIBU), zcb())
+    with pytest.raises(ContractViolation, match="continuation"):
+        UniversalContract().verify(ltx(
+            inputs=[before],
+            outputs=[UniversalState(
+                (ACME, HIBU),
+                obligation(const(2_000_000), "USD", ACME, HIBU),
+            )],
+            commands=[(UniversalAction("execute"), [HIBU_KP.public])],
+            time_window=TimeWindow(from_time=MATURITY + 1),
+        ))
+
+
+def test_cancel_discharges_to_zero():
+    before = UniversalState((ACME, HIBU), zcb())
+    UniversalContract().verify(ltx(
+        inputs=[before],
+        outputs=[],
+        commands=[(
+            UniversalAction("cancel"),
+            [ACME_KP.public, HIBU_KP.public],
+        )],
+    ))
+
+
+def test_fix_substitutes_observables():
+    libor = observable("LIBOR", "3M-2026Q3")
+    oracles = (("LIBOR", ORACLE),)
+    floating = UniversalState(
+        (ACME, HIBU),
+        obligation(const(1000) * libor, "USD", ACME, HIBU),
+        oracles,
+    )
+    fixed = UniversalState(
+        (ACME, HIBU),
+        obligation(const(1000) * const(4), "USD", ACME, HIBU),
+        oracles,
+    )
+    fixings = ((("LIBOR", "3M-2026Q3"), 4),)
+    UniversalContract().verify(ltx(
+        inputs=[floating],
+        outputs=[fixed],
+        commands=[(
+            UniversalFix(fixings), [ACME_KP.public, ORACLE_KP.public],
+        )],
+    ))
+    with pytest.raises(ContractViolation, match="substitutes"):
+        UniversalContract().verify(ltx(
+            inputs=[floating],
+            outputs=[UniversalState(
+                (ACME, HIBU),
+                obligation(const(1000) * const(5), "USD", ACME, HIBU),
+                oracles,
+            )],
+            commands=[(
+                UniversalFix(fixings),
+                [ACME_KP.public, ORACLE_KP.public],
+            )],
+        ))
+
+
+def test_fix_requires_oracle_signature():
+    libor = observable("LIBOR", "3M-2026Q3")
+    oracles = (("LIBOR", ORACLE),)
+    floating = UniversalState(
+        (ACME, HIBU),
+        obligation(const(1000) * libor, "USD", ACME, HIBU),
+        oracles,
+    )
+    fixed = UniversalState(
+        (ACME, HIBU),
+        obligation(const(1000) * const(4), "USD", ACME, HIBU),
+        oracles,
+    )
+    fixings = ((("LIBOR", "3M-2026Q3"), 4),)
+    # a party fabricating a rate without the oracle's signature
+    with pytest.raises(ContractViolation, match="signed by its oracle"):
+        UniversalContract().verify(ltx(
+            inputs=[floating],
+            outputs=[fixed],
+            commands=[(UniversalFix(fixings), [ACME_KP.public])],
+        ))
+    # no oracle registered for the source at all
+    unregistered = UniversalState(
+        (ACME, HIBU),
+        obligation(const(1000) * libor, "USD", ACME, HIBU),
+    )
+    with pytest.raises(ContractViolation, match="oracle is registered"):
+        UniversalContract().verify(ltx(
+            inputs=[unregistered],
+            outputs=[UniversalState(
+                (ACME, HIBU),
+                obligation(const(1000) * const(4), "USD", ACME, HIBU),
+            )],
+            commands=[(
+                UniversalFix(fixings),
+                [ACME_KP.public, ORACLE_KP.public],
+            )],
+        ))
+
+
+def test_time_before_is_sound_over_the_whole_window():
+    from corda_tpu.experimental.universal import time_before, perceive
+
+    # window ends past the deadline: notary could stamp after T
+    assert perceive(time_before(100), {}, (0, 1000)) is False
+    # window closed before the deadline: sound
+    assert perceive(time_before(100), {}, (0, 90)) is True
+    # open-ended window can never prove "before"
+    assert perceive(time_before(100), {}, (50, None)) is False
+
+
+def test_roll_out_expands_schedule_with_continuations():
+    """Three coupon periods; each period offers a 'pay coupon' action
+    whose continuation embeds the remaining schedule."""
+
+    def coupon(start, end, nxt):
+        return actions(action(
+            f"pay-{start}",
+            time_after(end),
+            HIBU,
+            all_of(obligation(const(50), "USD", ACME, HIBU), nxt),
+        ))
+
+    arr = roll_out(0, 30, 10, coupon)
+    # outermost period is the first one
+    assert arr.actions[0].name == "pay-0"
+    first = arr.actions[0].arrangement
+    # its continuation holds the next coupon's actions
+    inner = [
+        a for a in first.arrangements if hasattr(a, "actions")
+    ]
+    assert inner and inner[0].actions[0].name == "pay-10"
+    assert liable_parties(arr) == {ACME}
+
+
+def test_all_of_flattens_and_drops_zero():
+    a = obligation(const(1), "USD", ACME, HIBU)
+    b = obligation(const(2), "USD", HIBU, ACME)
+    assert all_of(zero, a) == a
+    combined = all_of(a, all_of(b, zero))
+    assert combined.arrangements == (a, b)
