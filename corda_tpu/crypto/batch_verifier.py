@@ -24,12 +24,13 @@ from functools import partial
 from typing import Optional, Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..parallel import mesh as meshlib
 from . import encodings, schemes
 from .curves import SECP256K1, SECP256R1
-from .ecdsa import ecdsa_verify_batch
+from .ecdsa import ecdsa_verify_batch, ecdsa_verify_packed
 from .eddsa import ed25519_verify_batch
 
 
@@ -91,7 +92,7 @@ class TpuBatchVerifier(BatchSignatureVerifier):
                     schemes.ECDSA_SECP256K1_SHA256: SECP256K1,
                     schemes.ECDSA_SECP256R1_SHA256: SECP256R1,
                 }[scheme_id]
-                fn = jax.jit(partial(ecdsa_verify_batch, curve))
+                fn = jax.jit(partial(ecdsa_verify_packed, curve))
             self._kernels[key] = fn
         return self._kernels[key]
 
@@ -120,10 +121,15 @@ class TpuBatchVerifier(BatchSignatureVerifier):
                     schemes.ECDSA_SECP256K1_SHA256: SECP256K1,
                     schemes.ECDSA_SECP256R1_SHA256: SECP256R1,
                 }[scheme_id]
-                staged = encodings.stage_ecdsa_batch(curve, chunk, batch)
+                packed, valid = encodings.stage_ecdsa_packed(
+                    curve, chunk, batch
+                )
+                staged = {"packed": packed, "valid_in": valid}
             if self.mesh is not None:
                 staged = {
-                    k: meshlib.shard_operand(self.mesh, v)
+                    k: meshlib.shard_operand(
+                        self.mesh, v, batch_axis=0 if k == "packed" else -1
+                    )
                     for k, v in staged.items()
                 }
             res = self._kernel(scheme_id, batch)(**staged)
@@ -152,10 +158,18 @@ class TpuBatchVerifier(BatchSignatureVerifier):
             cpu_res = self._cpu.verify_batch([requests[i] for i in cpu_idx])
             for i, ok in zip(cpu_idx, cpu_res):
                 out[i] = ok
-        for res, chunk_idxs, n in pending:
-            arr = np.asarray(res)
-            for j, ok in enumerate(arr[:n].tolist()):
-                out[chunk_idxs[j]] = bool(ok)
+        if pending:
+            # ONE device->host fetch for all chunks: on a
+            # remote-attached TPU each fetch pays ~50-100 ms of link
+            # latency, so per-chunk np.asarray calls would serialise
+            # round-trips the concatenation avoids
+            flat = np.asarray(jnp.concatenate([res for res, _, _ in pending]))
+            off = 0
+            for res, chunk_idxs, n in pending:
+                arr = flat[off : off + res.shape[0]]
+                off += res.shape[0]
+                for j, ok in enumerate(arr[:n].tolist()):
+                    out[chunk_idxs[j]] = bool(ok)
         return [bool(v) for v in out]
 
 

@@ -16,22 +16,43 @@ inputs arrive as valid_in=False rows with benign placeholder values.
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 
 from .curves import WeierstrassCurve
 from .ec import (
+    const_batch,
     wei_affine_to_proj,
     wei_double_scalar_mul,
     wei_is_infinity,
+    wei_select,
 )
+from .limbs import LIMB_BITS, NLIMB, R_BITS, int_to_limbs
 from .modmath import (
+    add_mod,
     eq,
     from_mont,
     mont_canon,
     mont_inv,
     mont_mul,
+    mont_mul_const,
     mont_one,
+    mont_sqr,
+    select,
     to_mont,
 )
+
+
+def _use_pallas_ladder() -> bool:
+    """Pallas ladder on real TPU; plain-XLA ladder elsewhere (the CPU
+    test mesh exercises the same field/point code either way, and an
+    interpret-mode test covers the kernel wrapper itself)."""
+    import os
+
+    import jax
+
+    if os.environ.get("CORDA_TPU_NO_PALLAS"):
+        return False
+    return jax.default_backend() == "tpu"
 
 
 def ecdsa_verify_batch(
@@ -54,9 +75,16 @@ def ecdsa_verify_batch(
     u1 = from_mont(fn, mont_mul(fn, to_mont(fn, z), w))
     u2 = from_mont(fn, mont_mul(fn, to_mont(fn, r), w))
 
-    # R = u1*G + u2*Q
-    Q = wei_affine_to_proj(fp, to_mont(fp, qx), to_mont(fp, qy))
-    R = wei_double_scalar_mul(curve, u1, u2, Q, nbits=256)
+    # R = u1*G + u2*Q — the ladder is ~95% of compute; on TPU it runs
+    # as a Pallas kernel with the whole loop VMEM-resident (pallas_ec)
+    qx_m, qy_m = to_mont(fp, qx), to_mont(fp, qy)
+    if _use_pallas_ladder():
+        from .pallas_ec import wei_ladder_pallas
+
+        R = wei_ladder_pallas(curve, u1, u2, qx_m, qy_m)
+    else:
+        Q = wei_affine_to_proj(fp, qx_m, qy_m)
+        R = wei_double_scalar_mul(curve, u1, u2, Q, nbits=256)
     X, _Y, Z = R
     not_inf = ~wei_is_infinity(fp, R)
 
@@ -67,3 +95,102 @@ def ecdsa_verify_batch(
     chk1 = eq(mont_canon(fp, mont_mul(fp, to_mont(fp, c1), Z)), rhs)
 
     return valid_in & not_inf & (chk0 | (chk1 & c1_ok))
+
+
+# ---------------------------------------------------------------------------
+# packed fast path: raw byte records in, limb expansion + checks on device
+
+
+def _unpack_be32(cols):
+    """[32, B] big-endian byte columns (int32 0..255) -> [22, B] limbs.
+
+    Same 12-bit digit extraction as encodings.ints_to_limbs_np, done on
+    device so the host->device wire carries 32 bytes per field element
+    instead of 88 (22 int32 limbs)."""
+    a = cols[::-1]                                   # little-endian bytes
+    a = jnp.concatenate([a, jnp.zeros_like(a[:1])], axis=0)   # pad byte 32
+    t = np.arange(NLIMB // 2)
+    even = a[3 * t] | ((a[3 * t + 1] & 0xF) << 8)    # [11, B]
+    odd = (a[3 * t + 1] >> 4) | (a[3 * t + 2] << 4)
+    return jnp.stack([even, odd], axis=1).reshape(NLIMB, a.shape[1])
+
+
+def _lex_lt(x, b_limbs: tuple[int, ...]):
+    """[B] bool: canonical-digit value(x) < b."""
+    lt = jnp.zeros_like(x[0], dtype=jnp.bool_)
+    for k in range(NLIMB):
+        bk = int(b_limbs[k]) if k < len(b_limbs) else 0
+        lt = (x[k] < bk) | ((x[k] == bk) & lt)
+    return lt
+
+
+def _nonzero(x):
+    return jnp.any(x != 0, axis=0)
+
+
+def _carry_exact(x):
+    """Exact sequential carry to canonical 12-bit digits (value < 2^264)."""
+    rows = [x[i] for i in range(NLIMB)]
+    for k in range(NLIMB - 1):
+        c = rows[k] >> LIMB_BITS
+        rows[k] = rows[k] - (c << LIMB_BITS)
+        rows[k + 1] = rows[k + 1] + c
+    return jnp.stack(rows, axis=0)
+
+
+def ecdsa_verify_packed(curve: WeierstrassCurve, packed, valid_in):
+    """[B] bool from [B, 160] uint8 records (z|r|s|qx|qy, 32-byte
+    big-endian each; see encodings.stage_ecdsa_packed).
+
+    Device-side validation replicates the host prefilter bit-exactly:
+    0 < r < n, 0 < s < n, coordinates < p, point on curve. Rows failing
+    any check verify as False; their values are replaced with benign
+    ones (s=1, Q=G) so the shared ladder still runs on defined inputs.
+    """
+    fn, fp = curve.fn, curve.fp
+    pb = packed.T.astype(jnp.int32)                  # [160, B]
+    batch = pb.shape[1]
+    z = _unpack_be32(pb[0:32])
+    r = _unpack_be32(pb[32:64])
+    s = _unpack_be32(pb[64:96])
+    qx = _unpack_be32(pb[96:128])
+    qy = _unpack_be32(pb[128:160])
+
+    n_limbs = tuple(int(v) for v in int_to_limbs(curve.n))
+    p_limbs = tuple(int(v) for v in int_to_limbs(curve.p))
+    r_ok = _nonzero(r) & _lex_lt(r, n_limbs)
+    s_ok = _nonzero(s) & _lex_lt(s, n_limbs)
+
+    # on-curve: y^2 == x^3 + a*x + b (mod p), computed in Montgomery
+    # domain; curve.a_mont is the same limb tuple ec.wei_add consumes
+    xm = to_mont(fp, qx)
+    ym = to_mont(fp, qy)
+    b_mont = const_batch((curve.b << R_BITS) % curve.p, batch)
+    x3 = mont_mul(fp, mont_sqr(fp, xm), xm)
+    rhs = add_mod(
+        fp, add_mod(fp, x3, mont_mul_const(fp, xm, curve.a_mont)), b_mont
+    )
+    q_ok = (
+        _lex_lt(qx, p_limbs)
+        & _lex_lt(qy, p_limbs)
+        & eq(mont_canon(fp, mont_sqr(fp, ym), 2), mont_canon(fp, rhs, 6))
+    )
+
+    # benign substitution for rows that failed a check
+    one = const_batch(1, batch)
+    s_use = select(s_ok, s, one)
+    r_use = select(r_ok, r, one)
+    gx = const_batch(curve.gx, batch)
+    gy = const_batch(curve.gy, batch)
+    qx_use = select(q_ok, qx, gx)
+    qy_use = select(q_ok, qy, gy)
+
+    # second x-candidate c1 = r + n and its c1 < p gate
+    n_col = jnp.asarray(np.array(n_limbs, dtype=np.int32))[:, None]
+    c1 = _carry_exact(r_use + n_col)
+    c1_ok = _lex_lt(c1, p_limbs)
+
+    valid = valid_in & r_ok & s_ok & q_ok
+    return ecdsa_verify_batch(
+        curve, z, r_use, s_use, qx_use, qy_use, c1, c1_ok, valid
+    )

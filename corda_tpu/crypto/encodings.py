@@ -191,7 +191,10 @@ def stage_ecdsa_batch(
     items: list[tuple[bytes, bytes, bytes]],  # (pubkey_sec1, der_sig, message)
     batch: int,
 ):
-    """Host prefilter + limb staging for ecdsa_verify_batch.
+    """Host prefilter + limb staging for the limb-level
+    `ecdsa_verify_batch` API (used by __graft_entry__'s compile checks
+    and kernel-level tests; the SPI serving path uses
+    `stage_ecdsa_packed`, which moves these checks on device).
 
     Returns dict of numpy arrays padded to `batch` rows; padding rows are
     valid_in=False with benign values (s=1 invertible, Q=G).
@@ -241,6 +244,76 @@ def stage_ecdsa_batch(
         c1_ok=c1_ok,
         valid_in=valid,
     )
+
+
+ECDSA_RECORD_BYTES = 160    # z | r | s | qx | qy, 32-byte big-endian each
+
+
+def stage_ecdsa_packed(
+    curve: WeierstrassCurve,
+    items: list[tuple[bytes, bytes, bytes]],  # (pubkey_sec1, der_sig, message)
+    batch: int,
+):
+    """Compact staging for ecdsa_verify_packed: ONE [batch, 160] uint8
+    array + [batch] valid mask.
+
+    The wire format to the device is raw 32-byte big-endian field
+    elements (z, r, s, qx, qy) — 160 B/signature vs ~530 B for the limb
+    staging — because on a remote-attached TPU the host<->device link is
+    the bottleneck, not the MXU/VPU (measured: the 4096-batch limb form
+    moves 2.1 MB for ~0.6 ms of device compute). Limb expansion, range
+    checks (0 < r,s < n), coordinate bounds and the on-curve check all
+    run on device; the host keeps only what it must: strict DER parsing
+    (variable-length, consensus-critical — same code path as the CPU
+    reference), SHA-256, and SEC1 decompression for compressed points.
+    """
+    n_items = len(items)
+    assert n_items <= batch
+    g_rec = (
+        curve.gx.to_bytes(32, "big") + curve.gy.to_bytes(32, "big")
+    )
+    benign = b"\x00" * 32 + _ONE32 + _ONE32 + g_rec
+    records = []
+    valid = np.zeros(batch, dtype=bool)
+    for i, (pub, sig, msg) in enumerate(items):
+        z_b = hashlib.sha256(msg).digest()
+        rs_pair = parse_der_ecdsa(sig)
+        pt_b = _sec1_bytes(curve, pub)
+        if (
+            rs_pair is None
+            or pt_b is None
+            or rs_pair[0] >> 256
+            or rs_pair[1] >> 256
+        ):
+            records.append(benign)
+            continue
+        r, s = rs_pair
+        records.append(
+            z_b + r.to_bytes(32, "big") + s.to_bytes(32, "big") + pt_b
+        )
+        valid[i] = True
+    records.extend([benign] * (batch - n_items))
+    packed = np.frombuffer(b"".join(records), dtype=np.uint8).reshape(
+        batch, ECDSA_RECORD_BYTES
+    )
+    return packed, valid
+
+
+_ONE32 = (1).to_bytes(32, "big")
+
+
+def _sec1_bytes(curve: WeierstrassCurve, data: bytes) -> Optional[bytes]:
+    """SEC1 point -> 64 raw coordinate bytes, WITHOUT the on-curve /
+    range checks (those run on device). Compressed points are
+    decompressed here (host sqrt); structurally-bad encodings -> None."""
+    if len(data) == 65 and data[0] == 0x04:
+        return data[1:]
+    if len(data) == 33 and data[0] in (0x02, 0x03):
+        pt = parse_sec1_point(curve, data)
+        if pt is None:
+            return None
+        return pt[0].to_bytes(32, "big") + pt[1].to_bytes(32, "big")
+    return None
 
 
 def stage_ed25519_batch(

@@ -98,7 +98,36 @@ class MontCtx:
 
 def _const_col(limbs: tuple[int, ...]):
     """[N, 1] int32 device constant from a limb tuple."""
+    if _scalar_consts():
+        return jnp.stack(
+            [jnp.full((1,), int(v), jnp.int32) for v in limbs]
+        )
     return jnp.asarray(np.array(limbs, dtype=np.int32))[:, None]
+
+
+# --- scalar-constants mode (Pallas kernels) --------------------------------
+#
+# Pallas kernel tracing rejects captured ARRAY constants ("pass them as
+# inputs"), but python-int scalars are fine. Inside a kernel, constant
+# field elements and constant multiplications therefore rebuild from
+# per-limb python ints (broadcasts + scalar multiplies) instead of
+# embedded numpy arrays / the int8 MXU matrices. pallas_ec.py enables
+# this around kernel tracing.
+
+_SCALAR_CONSTS = __import__("threading").local()
+
+
+def _scalar_consts() -> bool:
+    return getattr(_SCALAR_CONSTS, "on", False)
+
+
+class scalar_consts_mode:
+    def __enter__(self):
+        self._prev = _scalar_consts()
+        _SCALAR_CONSTS.on = True
+
+    def __exit__(self, *exc):
+        _SCALAR_CONSTS.on = self._prev
 
 
 # ---------------------------------------------------------------------------
@@ -113,12 +142,14 @@ def _rounds(x, n: int):
     use them for exact division by R. Three rounds take columns < 2^30
     down to limbs <= 4096.
     """
-    out = jnp.zeros_like(x[0])
+    out = jnp.zeros((x.shape[1],), dtype=x.dtype)
     for _ in range(n):
         low = x & LIMB_MASK
         c = x >> LIMB_BITS
         x = low + jnp.concatenate([jnp.zeros_like(c[:1]), c[:-1]], axis=0)
-        out = out + c[-1]
+        # c[-1] via static slice + squeeze: negative int indexing emits
+        # a dynamic_slice, which Mosaic (Pallas) cannot lower
+        out = out + jnp.squeeze(c[-1:], axis=0)
     return x, out
 
 
@@ -143,19 +174,96 @@ def _diag_mul(a, b):
     batch = a.shape[1]
     acc = jnp.zeros((2 * NLIMB, batch), dtype=jnp.int32)
     for i in range(NLIMB):
-        acc = acc.at[i : i + NLIMB].add(a[i][None, :] * b)
+        acc = _window_add(acc, i, a[i][None, :] * b)
     return acc
+
+
+def _window_add(acc, i: int, part):
+    """acc[i:i+NLIMB] += part, static i. Scatter-add under XLA; Mosaic
+    (Pallas) lowers neither scatter-add nor value dynamic-slices, so
+    there the partial is zero-padded to full height (a concat — cheap
+    in VMEM) and added."""
+    if _scalar_consts():
+        batch = part.shape[1]
+        pieces = []
+        if i:
+            pieces.append(jnp.zeros((i, batch), dtype=acc.dtype))
+        pieces.append(part)
+        tail = acc.shape[0] - i - part.shape[0]
+        if tail:
+            pieces.append(jnp.zeros((tail, batch), dtype=acc.dtype))
+        return acc + jnp.concatenate(pieces, axis=0)
+    return acc.at[i : i + NLIMB].add(part)
+
+
+_CONST_MXU_CACHE: dict[tuple[int, ...], np.ndarray] = {}
+
+
+def _const_mxu_matrix(const_limbs: tuple[int, ...]) -> jnp.ndarray:
+    """[88, 22] int8 block matrix for MXU constant multiplication.
+
+    The column sums U[k] = sum_i a[i] * c[k-i] are a LINEAR map of a —
+    a Toeplitz matmul. The MXU multiplies int8 natively (s8 x s8 -> s32
+    accumulation), so the 12-bit constant digits split into 6-bit
+    halves c = c0 + 64*c1, giving two stacked [44, 22] matrices whose
+    products are recombined with shifts. This moves 2/3 of the VPU
+    int32 multiply load of a Montgomery multiply (the two reduction
+    constant-multiplies) onto the otherwise-idle MXU.
+    """
+    key = tuple(int(v) for v in const_limbs)
+    if key not in _CONST_MXU_CACHE:
+        m = np.zeros((2 * NLIMB, NLIMB), dtype=np.int64)
+        for k in range(2 * NLIMB):
+            for i in range(NLIMB):
+                j = k - i
+                if 0 <= j < len(key):
+                    m[k, i] = key[j]
+        m0 = (m & 63).astype(np.int8)
+        m1 = (m >> 6).astype(np.int8)
+        assert (m >> 12).max() == 0
+        # cache the HOST array: a jnp constant created inside a trace
+        # would leak that trace's tracer into later jits
+        _CONST_MXU_CACHE[key] = np.concatenate([m0, m1], axis=0)
+    return jnp.asarray(_CONST_MXU_CACHE[key])
 
 
 def _diag_mul_const(a, const_limbs: tuple[int, ...]):
-    """Schoolbook columns against a host-constant second operand (zero
-    limbs of the constant cost nothing)."""
+    """Column sums against a host-constant operand, on the MXU.
+
+    a: [22, B] non-negative bounded limbs (< 8192 = 13 bits; the carry
+    rounds guarantee < 4200). Split a = a0 + 128*a1 into int8 halves,
+    one s8 dot against the stacked constant matrix, recombine:
+      U = M0*a0 + 64*M1*a0 + 128*M0*a1 + 8192*M1*a1.
+    Max accumulator term: 63 * 127 * 22 < 2^18 — exact in s32.
+
+    In scalar-consts (Pallas) mode: the shifted-accumulate VPU form
+    with python-int coefficients — inside a VMEM-resident kernel the
+    accumulator never touches HBM, so the MXU detour buys nothing.
+    """
+    if _scalar_consts():
+        batch = a.shape[1]
+        acc = jnp.zeros((2 * NLIMB, batch), dtype=jnp.int32)
+        for j in range(NLIMB):
+            if j < len(const_limbs) and const_limbs[j]:
+                acc = _window_add(acc, j, a * int(const_limbs[j]))
+        return acc
+    mat = _const_mxu_matrix(const_limbs)
+    a0 = (a & 127).astype(jnp.int8)
+    a1 = (a >> 7).astype(jnp.int8)
+    x = jnp.concatenate([a0, a1], axis=1)            # [22, 2B]
+    prod = lax.dot_general(
+        mat, x,
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )                                                 # [88, 2B]
     batch = a.shape[1]
-    acc = jnp.zeros((2 * NLIMB, batch), dtype=jnp.int32)
-    for j in range(NLIMB):
-        if const_limbs[j]:
-            acc = acc.at[j : j + NLIMB].add(a * int(const_limbs[j]))
-    return acc
+    lo, hi = prod[: 2 * NLIMB], prod[2 * NLIMB :]
+    return (
+        lo[:, :batch]
+        + (hi[:, :batch] << 6)
+        + (lo[:, batch:] << 7)
+        + (hi[:, batch:] << 13)
+    )
 
 
 def _mont_reduce(ctx: MontCtx, t_cols):
@@ -179,7 +287,11 @@ def _mont_reduce(ctx: MontCtx, t_cols):
     lo, t_drop = _rounds(u[:NLIMB], 3)
     # remaining low value is a multiple of R in [0, 1.001*R) => 0 or R
     t = t_drop + jnp.any(lo != 0, axis=0).astype(jnp.int32)
-    hi = u[NLIMB:].at[0].add(t)
+    hi = u[NLIMB:]
+    if _scalar_consts():   # Mosaic: no scatter-add — concat instead
+        hi = jnp.concatenate([hi[:1] + t[None, :], hi[1:]], axis=0)
+    else:
+        hi = hi.at[0].add(t)
     out, top = _rounds(hi, 3)
     del top  # value < 2p < 2^258 fits 22 limbs; top carries are zero
     return out
@@ -307,6 +419,10 @@ def mont_one(ctx: MontCtx, batch: int):
 def const_batch(value: int, batch: int):
     """Broadcast a host integer to a canonical [NLIMB, batch] limb array."""
     limbs = int_to_limbs(value)
+    if _scalar_consts():
+        return jnp.stack(
+            [jnp.full((batch,), int(v), jnp.int32) for v in limbs]
+        )
     return jnp.broadcast_to(
         jnp.asarray(limbs, dtype=jnp.int32)[:, None], (NLIMB, batch)
     ).astype(jnp.int32)

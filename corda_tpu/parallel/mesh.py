@@ -25,7 +25,10 @@ def make_mesh(devices: Optional[list] = None) -> Mesh:
     return Mesh(jax.numpy.array(devices).reshape(-1), (BATCH_AXIS,))
 
 
-def shard_operand(mesh: Mesh, x):
-    """Place a host array on the mesh, batch axis (last dim) sharded."""
-    spec = P(*([None] * (x.ndim - 1) + [BATCH_AXIS]))
+def shard_operand(mesh: Mesh, x, batch_axis: int = -1):
+    """Place a host array on the mesh with its batch axis sharded
+    (last dim for [limbs, B] operands; axis 0 for [B, bytes] packed
+    records)."""
+    axis = batch_axis % x.ndim
+    spec = P(*[BATCH_AXIS if d == axis else None for d in range(x.ndim)])
     return jax.device_put(x, NamedSharding(mesh, spec))

@@ -84,6 +84,7 @@ class DemoBench:
         self.base_port = base_port
         self.nodes: dict[str, BenchNode] = {}
         self._order: list[str] = []
+        self._ports_used = 0
         self._console = None
         self._console_db = None
         self._clients: dict[str, rpclib.RPCClient] = {}
@@ -99,7 +100,10 @@ class DemoBench:
     ) -> BenchNode:
         if name in self.nodes and self.nodes[name].alive:
             raise ValueError(f"node {name!r} already running")
-        port = self.base_port + len(self._order)
+        # monotonic allocation: a stop/re-add cycle must never hand a
+        # port that a later add would also compute
+        port = self.base_port + self._ports_used
+        self._ports_used += 1
         map_host = self._map_host()
         if map_host is not None:
             config_kw.setdefault("network_map_peer", map_host.name)

@@ -212,6 +212,10 @@ def main(argv=None) -> int:
             if not args.watch:
                 return 0
             time.sleep(args.watch)
+            # feeds only deliver while the console fabric pumps; a
+            # cheap RPC round-trip drains pending updates into the
+            # models before the next render
+            client.current_node_time()
             print("\033[2J\033[H", end="")
     except KeyboardInterrupt:
         return 0
