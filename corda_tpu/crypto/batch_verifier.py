@@ -31,7 +31,7 @@ from ..parallel import mesh as meshlib
 from . import encodings, schemes
 from .curves import SECP256K1, SECP256R1
 from .ecdsa import ecdsa_verify_batch, ecdsa_verify_packed
-from .eddsa import ed25519_verify_batch
+from .eddsa import ed25519_verify_batch, ed25519_verify_packed
 
 
 @dataclass(frozen=True)
@@ -85,14 +85,21 @@ class TpuBatchVerifier(BatchSignatureVerifier):
     def _kernel(self, scheme_id: int, batch: int):
         key = (scheme_id, batch)
         if key not in self._kernels:
+            # under a GSPMD mesh the XLA ladder must be used: Mosaic
+            # (Pallas) custom calls have no partitioning rule
+            use_pallas = False if self.mesh is not None else None
             if scheme_id == schemes.EDDSA_ED25519_SHA512:
-                fn = jax.jit(ed25519_verify_batch)
+                fn = jax.jit(
+                    partial(ed25519_verify_packed, use_pallas=use_pallas)
+                )
             else:
                 curve = {
                     schemes.ECDSA_SECP256K1_SHA256: SECP256K1,
                     schemes.ECDSA_SECP256R1_SHA256: SECP256R1,
                 }[scheme_id]
-                fn = jax.jit(partial(ecdsa_verify_packed, curve))
+                fn = jax.jit(
+                    partial(ecdsa_verify_packed, curve, use_pallas=use_pallas)
+                )
             self._kernels[key] = fn
         return self._kernels[key]
 
@@ -115,7 +122,15 @@ class TpuBatchVerifier(BatchSignatureVerifier):
             chunk = items[off : off + max_b]
             batch = self._pick_batch(len(chunk))
             if scheme_id == schemes.EDDSA_ED25519_SHA512:
-                staged = encodings.stage_ed25519_batch(chunk, batch)
+                packed, a_signs, r_signs, valid = (
+                    encodings.stage_ed25519_packed(chunk, batch)
+                )
+                staged = {
+                    "packed": packed,
+                    "a_sign": a_signs,
+                    "exp_sign": r_signs,
+                    "valid_in": valid,
+                }
             else:
                 curve = {
                     schemes.ECDSA_SECP256K1_SHA256: SECP256K1,

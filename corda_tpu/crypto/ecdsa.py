@@ -29,6 +29,10 @@ from .ec import (
 from .limbs import LIMB_BITS, NLIMB, R_BITS, int_to_limbs
 from .modmath import (
     add_mod,
+    canon,
+    lex_lt as _lex_lt,
+    nonzero as _nonzero,
+    unpack_be32 as _unpack_be32,
     eq,
     from_mont,
     mont_canon,
@@ -42,14 +46,22 @@ from .modmath import (
 )
 
 
-def _use_pallas_ladder() -> bool:
-    """Pallas ladder on real TPU; plain-XLA ladder elsewhere (the CPU
-    test mesh exercises the same field/point code either way, and an
-    interpret-mode test covers the kernel wrapper itself)."""
+def _use_pallas_ladder(use_pallas=None) -> bool:
+    """Pallas ladder on real TPU; plain-XLA ladder elsewhere. The CPU
+    test mesh exercises the same field/point code through
+    scalar_consts_mode equivalence tests (test_pallas_path.py); the
+    kernel wrapper itself is validated on hardware by bench.py's CPU
+    spot-check and `python -m corda_tpu.testing.tpu_selfcheck`.
+
+    `use_pallas=False` forces the XLA ladder — required when the kernel
+    runs under a GSPMD mesh (Mosaic custom calls have no partitioning
+    rule; batch_verifier passes this for mesh-sharded operands)."""
     import os
 
     import jax
 
+    if use_pallas is not None:
+        return bool(use_pallas)
     if os.environ.get("CORDA_TPU_NO_PALLAS"):
         return False
     return jax.default_backend() == "tpu"
@@ -65,6 +77,7 @@ def ecdsa_verify_batch(
     c1,         # [22,B] r + n (second x-candidate)
     c1_ok,      # [B] bool: r + n < p
     valid_in,   # [B] bool host prefilter result
+    use_pallas=None,   # None = auto (TPU backend); False under meshes
 ):
     """[B] bool: SEC1 ECDSA verification, bit-exact accept/reject."""
     fn, fp = curve.fn, curve.fp
@@ -78,7 +91,7 @@ def ecdsa_verify_batch(
     # R = u1*G + u2*Q — the ladder is ~95% of compute; on TPU it runs
     # as a Pallas kernel with the whole loop VMEM-resident (pallas_ec)
     qx_m, qy_m = to_mont(fp, qx), to_mont(fp, qy)
-    if _use_pallas_ladder():
+    if _use_pallas_ladder(use_pallas):
         from .pallas_ec import wei_ladder_pallas
 
         R = wei_ladder_pallas(curve, u1, u2, qx_m, qy_m)
@@ -101,44 +114,7 @@ def ecdsa_verify_batch(
 # packed fast path: raw byte records in, limb expansion + checks on device
 
 
-def _unpack_be32(cols):
-    """[32, B] big-endian byte columns (int32 0..255) -> [22, B] limbs.
-
-    Same 12-bit digit extraction as encodings.ints_to_limbs_np, done on
-    device so the host->device wire carries 32 bytes per field element
-    instead of 88 (22 int32 limbs)."""
-    a = cols[::-1]                                   # little-endian bytes
-    a = jnp.concatenate([a, jnp.zeros_like(a[:1])], axis=0)   # pad byte 32
-    t = np.arange(NLIMB // 2)
-    even = a[3 * t] | ((a[3 * t + 1] & 0xF) << 8)    # [11, B]
-    odd = (a[3 * t + 1] >> 4) | (a[3 * t + 2] << 4)
-    return jnp.stack([even, odd], axis=1).reshape(NLIMB, a.shape[1])
-
-
-def _lex_lt(x, b_limbs: tuple[int, ...]):
-    """[B] bool: canonical-digit value(x) < b."""
-    lt = jnp.zeros_like(x[0], dtype=jnp.bool_)
-    for k in range(NLIMB):
-        bk = int(b_limbs[k]) if k < len(b_limbs) else 0
-        lt = (x[k] < bk) | ((x[k] == bk) & lt)
-    return lt
-
-
-def _nonzero(x):
-    return jnp.any(x != 0, axis=0)
-
-
-def _carry_exact(x):
-    """Exact sequential carry to canonical 12-bit digits (value < 2^264)."""
-    rows = [x[i] for i in range(NLIMB)]
-    for k in range(NLIMB - 1):
-        c = rows[k] >> LIMB_BITS
-        rows[k] = rows[k] - (c << LIMB_BITS)
-        rows[k + 1] = rows[k + 1] + c
-    return jnp.stack(rows, axis=0)
-
-
-def ecdsa_verify_packed(curve: WeierstrassCurve, packed, valid_in):
+def ecdsa_verify_packed(curve: WeierstrassCurve, packed, valid_in, use_pallas=None):
     """[B] bool from [B, 160] uint8 records (z|r|s|qx|qy, 32-byte
     big-endian each; see encodings.stage_ecdsa_packed).
 
@@ -187,10 +163,12 @@ def ecdsa_verify_packed(curve: WeierstrassCurve, packed, valid_in):
 
     # second x-candidate c1 = r + n and its c1 < p gate
     n_col = jnp.asarray(np.array(n_limbs, dtype=np.int32))[:, None]
-    c1 = _carry_exact(r_use + n_col)
+    # exact carry only (bound_mul=1): c1 may exceed p by design
+    c1 = canon(fp, r_use + n_col, bound_mul=1)
     c1_ok = _lex_lt(c1, p_limbs)
 
     valid = valid_in & r_ok & s_ok & q_ok
     return ecdsa_verify_batch(
-        curve, z, r_use, s_use, qx_use, qy_use, c1, c1_ok, valid
+        curve, z, r_use, s_use, qx_use, qy_use, c1, c1_ok, valid,
+        use_pallas=use_pallas,
     )

@@ -443,6 +443,34 @@ def select(mask, a, b):
     return jnp.where(mask[None, :], a, b)
 
 
+def unpack_be32(cols):
+    """[32, B] big-endian byte columns (int32 0..255) -> [22, B] limbs.
+
+    Device-side counterpart of encodings.ints_to_limbs_np's 12-bit
+    digit extraction: the host->device wire carries 32 raw bytes per
+    field element instead of 88 bytes of int32 limbs."""
+    a = cols[::-1]                                   # little-endian bytes
+    a = jnp.concatenate([a, jnp.zeros_like(a[:1])], axis=0)   # pad byte 32
+    t = np.arange(NLIMB // 2)
+    even = a[3 * t] | ((a[3 * t + 1] & 0xF) << 8)    # [11, B]
+    odd = (a[3 * t + 1] >> 4) | (a[3 * t + 2] << 4)
+    return jnp.stack([even, odd], axis=1).reshape(NLIMB, a.shape[1])
+
+
+def lex_lt(x, b_limbs):
+    """[B] bool: canonical-digit value(x) < b (python-int limb tuple)."""
+    lt = jnp.zeros_like(x[0], dtype=jnp.bool_)
+    for k in range(NLIMB):
+        bk = int(b_limbs[k]) if k < len(b_limbs) else 0
+        lt = (x[k] < bk) | ((x[k] == bk) & lt)
+    return lt
+
+
+def nonzero(x):
+    """[B] bool: any non-zero digit."""
+    return jnp.any(x != 0, axis=0)
+
+
 def get_bit(x, i):
     """Bit i of canonical standard-domain limb array x: [B] int32 in {0,1}.
 

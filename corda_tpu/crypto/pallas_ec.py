@@ -33,12 +33,22 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .curves import WeierstrassCurve
+from .curves import EdwardsCurve, WeierstrassCurve
 from .limbs import LIMB_BITS, NLIMB, R_BITS
 from .modmath import const_batch, mont_one, scalar_consts_mode
 from . import ec
 
 DEFAULT_BLOCK = 256
+
+
+def _fit_block(batch: int, block: int) -> int:
+    """Largest divisor of `batch` that is <= `block`: ~1 MB of ladder
+    state per 256 signatures, so a silent block=batch fallback for odd
+    batch sizes would blow VMEM (e.g. batch 6000 -> ~23 MB)."""
+    block = min(block, batch)
+    while batch % block:
+        block -= 1
+    return block
 
 
 def _g_mont_limbs(curve: WeierstrassCurve, batch: int):
@@ -61,8 +71,7 @@ def wei_ladder_pallas(
 ):
     """R = u1*G + u2*Q, batched; returns Montgomery projective (X, Y, Z)."""
     batch = u1.shape[1]
-    if batch % block:
-        block = batch          # single block (small/odd batches)
+    block = _fit_block(batch, block)
 
     def kernel(u1_ref, u2_ref, qx_ref, qy_ref, x_ref, y_ref, z_ref):
         # scalar-consts mode: Pallas rejects captured array constants,
@@ -112,3 +121,65 @@ def wei_ladder_pallas(
         out_shape=(shape, shape, shape),
         interpret=interpret,
     )(u1, u2, qx_m, qy_m)
+
+
+def ed_ladder_pallas(
+    curve: EdwardsCurve,
+    s,                  # [22, B] canonical signature-scalar digits
+    k,                  # [22, B] canonical digest-scalar digits
+    ax_m,               # [22, B] Montgomery-domain affine point (e.g. -A)
+    ay_m,               # [22, B]
+    block: int = DEFAULT_BLOCK,
+    interpret: bool = False,
+):
+    """R = s*B + k*A on the twisted Edwards curve (B = base point),
+    VMEM-resident per block like the Weierstrass ladder; returns
+    extended coordinates (X, Y, Z, T) in Montgomery domain."""
+    batch = s.shape[1]
+    block = _fit_block(batch, block)
+
+    R = 1 << R_BITS
+
+    def kernel(s_ref, k_ref, ax_ref, ay_ref, x_ref, y_ref, z_ref, t_ref):
+        with scalar_consts_mode():
+            ctx = curve.fp
+            A = ec.ed_affine_to_ext(ctx, ax_ref[:], ay_ref[:])
+            bx = const_batch((curve.gx * R) % curve.p, block)
+            by = const_batch((curve.gy * R) % curve.p, block)
+            Bp = ec.ed_affine_to_ext(ctx, bx, by)
+            BA = ec.ed_add(curve, Bp, A)
+            ident = ec.ed_identity(ctx, block)
+
+            acc = ident
+            for limb in range(NLIMB - 1, -1, -1):
+                row_s = s_ref[limb, :]
+                row_k = k_ref[limb, :]
+
+                def step(j, acc, row_s=row_s, row_k=row_k):
+                    bit = LIMB_BITS - 1 - j
+                    with scalar_consts_mode():
+                        acc = ec.ed_add(curve, acc, acc)
+                        bs = ((row_s >> bit) & 1).astype(jnp.bool_)
+                        bk = ((row_k >> bit) & 1).astype(jnp.bool_)
+                        lo = ec.ed_select(bs, Bp, ident)
+                        hi = ec.ed_select(bs, BA, A)
+                        P = ec.ed_select(bk, hi, lo)
+                        return ec.ed_add(curve, acc, P)
+
+                acc = lax.fori_loop(0, LIMB_BITS, step, acc)
+            X, Y, Z, T = acc
+            x_ref[:] = X
+            y_ref[:] = Y
+            z_ref[:] = Z
+            t_ref[:] = T
+
+    spec = pl.BlockSpec((NLIMB, block), lambda i: (0, i))
+    shape = jax.ShapeDtypeStruct((NLIMB, batch), jnp.int32)
+    return pl.pallas_call(
+        kernel,
+        grid=(batch // block,),
+        in_specs=[spec, spec, spec, spec],
+        out_specs=(spec, spec, spec, spec),
+        out_shape=(shape, shape, shape, shape),
+        interpret=interpret,
+    )(s, k, ax_m, ay_m)

@@ -1,0 +1,89 @@
+"""On-hardware self-check for the TPU verification kernels.
+
+The CI test mesh is CPU-only (tests/conftest.py), where the Pallas
+ladder kernels do not run — their field/point arithmetic is pinned by
+the scalar-consts equivalence tests (tests/test_pallas_path.py), but
+the kernel wrappers themselves (BlockSpecs, grids, ref indexing,
+Mosaic lowering) only execute on a real TPU. This module is the
+hardware gate: run it on a TPU host to assert the full packed SPI
+path — Pallas ladders, device-side validation and ed25519
+decompression — is bit-exact against the CPU reference on adversarial
+inputs across all three batched schemes.
+
+    python -m corda_tpu.testing.tpu_selfcheck [--n 256]
+
+bench.py additionally spot-checks 32 rows against the CPU reference on
+every benchmark run, so a broken kernel cannot record a number.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+
+
+def build_requests(n: int, seed: int = 99):
+    """Mixed-scheme requests incl. tampered/malformed rows."""
+    from ..crypto import schemes
+    from ..crypto.batch_verifier import VerificationRequest
+
+    sids = (
+        schemes.ECDSA_SECP256R1_SHA256,
+        schemes.ECDSA_SECP256K1_SHA256,
+        schemes.EDDSA_ED25519_SHA512,
+    )
+    rng = random.Random(seed)
+    reqs = []
+    for i in range(n):
+        sid = sids[i % 3]
+        kp = schemes.generate_keypair(sid, seed=rng.getrandbits(64))
+        msg = rng.randbytes(48)
+        sig = kp.private.sign(msg)
+        kind = i % 8
+        if kind == 5:
+            msg = msg + b"!"                       # wrong message
+        elif kind == 6:
+            pos = len(sig) // 2
+            sig = sig[:pos] + bytes([sig[pos] ^ 1]) + sig[pos + 1:]
+        elif kind == 7:
+            sig = sig[: len(sig) // 2]             # truncated
+        reqs.append(VerificationRequest(kp.public, sig, msg))
+    return reqs
+
+
+def run(n: int = 256, batch_size: int = 256) -> dict:
+    """Verify n adversarial requests on the device and compare against
+    the CPU reference; raises AssertionError on any mismatch."""
+    import jax
+
+    from ..crypto.batch_verifier import CpuBatchVerifier, TpuBatchVerifier
+
+    reqs = build_requests(n)
+    t0 = time.perf_counter()
+    dev = TpuBatchVerifier(batch_sizes=(batch_size,)).verify_batch(reqs)
+    wall = time.perf_counter() - t0
+    cpu = CpuBatchVerifier().verify_batch(reqs)
+    mismatches = [i for i, (a, b) in enumerate(zip(dev, cpu)) if a != b]
+    assert not mismatches, f"device != CPU at rows {mismatches[:10]}"
+    return {
+        "backend": jax.default_backend(),
+        "n": n,
+        "accepts": sum(cpu),
+        "device_wall_s": round(wall, 2),
+    }
+
+
+def main(argv=None) -> int:
+    import argparse
+    import json
+
+    parser = argparse.ArgumentParser(prog="corda_tpu.testing.tpu_selfcheck")
+    parser.add_argument("--n", type=int, default=256)
+    parser.add_argument("--batch-size", type=int, default=256)
+    args = parser.parse_args(argv)
+    print(json.dumps(run(args.n, args.batch_size)))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
