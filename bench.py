@@ -20,6 +20,64 @@ import time
 BASELINE = 50_000.0  # verifies/sec target per BASELINE.json
 
 
+def _merkle_metric(batch: int, iters: int) -> dict:
+    """FilteredTransaction-shape verification (BASELINE.md row:
+    'FilteredTransaction Merkle + multi-sig batch verify'): each item is
+    a 6-of-64-leaf partial Merkle proof (native SHA-256 kernels on the
+    host) plus one notary signature over the root drained through the
+    TPU SPI."""
+    import random as _r
+
+    from corda_tpu.crypto import schemes
+    from corda_tpu.crypto.batch_verifier import (
+        TpuBatchVerifier,
+        VerificationRequest,
+    )
+    from corda_tpu.crypto.hashes import SecureHash
+    from corda_tpu.crypto.merkle import PartialMerkleTree, merkle_root
+
+    rng = _r.Random(7)
+    keys = [
+        schemes.generate_keypair(
+            schemes.ECDSA_SECP256R1_SHA256, seed=rng.getrandbits(64)
+        )
+        for _ in range(8)
+    ]
+    items = []
+    for i in range(batch):
+        leaves = [SecureHash.sha256(rng.randbytes(64)) for _ in range(64)]
+        included = [leaves[j] for j in sorted(rng.sample(range(64), 6))]
+        pmt = PartialMerkleTree.build(leaves, included)
+        root = merkle_root(leaves)
+        kp = keys[i % 8]
+        sig = kp.private.sign(root.bytes_)
+        items.append((pmt, root, included, kp.public, sig))
+
+    chunk = min(int(os.environ.get("BENCH_CHUNK", "8192")), batch)
+    verifier = TpuBatchVerifier(batch_sizes=(chunk,))
+
+    def run_once() -> None:
+        reqs = []
+        for pmt, root, included, pub, sig in items:
+            assert pmt.verify(root, included)
+            reqs.append(VerificationRequest(pub, sig, root.bytes_))
+        results = verifier.verify_batch(reqs)
+        assert all(results)
+
+    run_once()                       # warm-up: compile + correctness
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        run_once()
+    dt = time.perf_counter() - t0
+    rate = batch * iters / dt
+    return {
+        "metric": "filtered_tx_merkle_plus_sig_verifies_per_sec",
+        "value": round(rate, 1),
+        "unit": "verifies/s",
+        "vs_baseline": round(rate / BASELINE, 3),
+    }
+
+
 def _requests(batch: int, metric: str):
     from corda_tpu.crypto import schemes
     from corda_tpu.crypto.batch_verifier import VerificationRequest
@@ -61,9 +119,14 @@ def main() -> None:
     batch = int(os.environ.get("BENCH_BATCH", "32768"))
     iters = int(os.environ.get("BENCH_ITERS", "3"))
     metric = os.environ.get("BENCH_METRIC", "p256")
-    if metric not in ("p256", "mixed"):
+    if metric not in ("p256", "mixed", "merkle"):
         # a typo must not record a p256-only rate under another name
-        raise SystemExit(f"unknown BENCH_METRIC {metric!r}: p256 | mixed")
+        raise SystemExit(
+            f"unknown BENCH_METRIC {metric!r}: p256 | mixed | merkle"
+        )
+    if metric == "merkle":
+        print(json.dumps(_merkle_metric(min(batch, 8192), iters)))
+        return
 
     from corda_tpu.crypto.batch_verifier import (
         CpuBatchVerifier,

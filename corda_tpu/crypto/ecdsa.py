@@ -47,24 +47,9 @@ from .modmath import (
 
 
 def _use_pallas_ladder(use_pallas=None) -> bool:
-    """Pallas ladder on real TPU; plain-XLA ladder elsewhere. The CPU
-    test mesh exercises the same field/point code through
-    scalar_consts_mode equivalence tests (test_pallas_path.py); the
-    kernel wrapper itself is validated on hardware by bench.py's CPU
-    spot-check and `python -m corda_tpu.testing.tpu_selfcheck`.
+    from .pallas_ec import use_pallas_ladder
 
-    `use_pallas=False` forces the XLA ladder — required when the kernel
-    runs under a GSPMD mesh (Mosaic custom calls have no partitioning
-    rule; batch_verifier passes this for mesh-sharded operands)."""
-    import os
-
-    import jax
-
-    if use_pallas is not None:
-        return bool(use_pallas)
-    if os.environ.get("CORDA_TPU_NO_PALLAS"):
-        return False
-    return jax.default_backend() == "tpu"
+    return use_pallas_ladder(use_pallas)
 
 
 def ecdsa_verify_batch(

@@ -53,9 +53,9 @@ def ed25519_verify_batch(
     """[B] bool: cofactorless ed25519 verification."""
     fp = ED25519.fp
     nax_m, nay_m = to_mont(fp, nax), to_mont(fp, nay)
-    from .ecdsa import _use_pallas_ladder
+    from .pallas_ec import use_pallas_ladder
 
-    if _use_pallas_ladder(use_pallas):
+    if use_pallas_ladder(use_pallas):
         from .pallas_ec import ed_ladder_pallas
 
         R = ed_ladder_pallas(ED25519, s, k, nax_m, nay_m)

@@ -41,6 +41,20 @@ from . import ec
 DEFAULT_BLOCK = 256
 
 
+def use_pallas_ladder(use_pallas=None) -> bool:
+    """Shared Pallas-vs-XLA dispatch policy for every scheme's ladder:
+    Pallas on a real TPU backend, XLA elsewhere; `use_pallas=False`
+    forces XLA (required under GSPMD meshes — Mosaic custom calls have
+    no partitioning rule); CORDA_TPU_NO_PALLAS=1 disables globally."""
+    import os
+
+    if use_pallas is not None:
+        return bool(use_pallas)
+    if os.environ.get("CORDA_TPU_NO_PALLAS"):
+        return False
+    return jax.default_backend() == "tpu"
+
+
 def _fit_block(batch: int, block: int) -> int:
     """Largest divisor of `batch` that is <= `block`: ~1 MB of ladder
     state per 256 signatures, so a silent block=batch fallback for odd

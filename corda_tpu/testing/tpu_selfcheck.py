@@ -51,12 +51,23 @@ def build_requests(n: int, seed: int = 99):
     return reqs
 
 
-def run(n: int = 256, batch_size: int = 256) -> dict:
+def run(n: int = 256, batch_size: int = 256, allow_cpu: bool = False) -> dict:
     """Verify n adversarial requests on the device and compare against
-    the CPU reference; raises AssertionError on any mismatch."""
+    the CPU reference; raises AssertionError on any mismatch.
+
+    Refuses to run on a non-TPU backend unless allow_cpu=True: a
+    silent CPU fallback would skip the Pallas kernels this gate exists
+    to validate and pass vacuously."""
     import jax
 
     from ..crypto.batch_verifier import CpuBatchVerifier, TpuBatchVerifier
+
+    if jax.default_backend() != "tpu" and not allow_cpu:
+        raise SystemExit(
+            f"backend is {jax.default_backend()!r}, not 'tpu' — the "
+            "Pallas kernels would not run; pass --allow-cpu to check "
+            "the XLA path anyway"
+        )
 
     reqs = build_requests(n)
     t0 = time.perf_counter()
@@ -80,8 +91,9 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="corda_tpu.testing.tpu_selfcheck")
     parser.add_argument("--n", type=int, default=256)
     parser.add_argument("--batch-size", type=int, default=256)
+    parser.add_argument("--allow-cpu", action="store_true")
     args = parser.parse_args(argv)
-    print(json.dumps(run(args.n, args.batch_size)))
+    print(json.dumps(run(args.n, args.batch_size, args.allow_cpu)))
     return 0
 
 
