@@ -6,9 +6,10 @@ point operation materialises its [22, B] limb intermediates to HBM —
 at B=32k that is hundreds of GB of HBM traffic per batch and the
 program is bandwidth-bound (measured ~17k verifies/s on one v5e). This
 kernel runs the ENTIRE ladder for a block of the batch inside VMEM:
-the grid splits the batch into blocks of 256 signatures (~1 MB of live
-state per block), and all 6,000+ field multiplies per signature happen
-without leaving on-chip memory.
+the grid splits the batch into blocks of 128 signatures (~0.5 MB of
+live state per block; swept 64/128/256/512 on a v5e — 128 wins at 62k
+vs 49k verifies/s for 256), and all 6,000+ field multiplies per
+signature happen without leaving on-chip memory.
 
 The field/point arithmetic is the same code XLA traces
 (modmath/ec.py) — Pallas kernels are jax-traceable functions, so the
@@ -25,6 +26,7 @@ the complete formulas absorb.
 
 from __future__ import annotations
 
+import os
 from functools import partial
 
 import jax
@@ -38,7 +40,17 @@ from .limbs import LIMB_BITS, NLIMB, R_BITS
 from .modmath import const_batch, mont_one, scalar_consts_mode
 from . import ec
 
-DEFAULT_BLOCK = 256
+DEFAULT_BLOCK = 128
+
+
+def _block_or_default(block) -> int:
+    """Resolve the batch block: explicit arg, else CORDA_TPU_PALLAS_BLOCK,
+    else DEFAULT_BLOCK (read per call, not frozen at import — and kept
+    out of public signature defaults so the recorded API surface is not
+    environment-dependent)."""
+    if block is not None:
+        return block
+    return int(os.environ.get("CORDA_TPU_PALLAS_BLOCK", str(DEFAULT_BLOCK)))
 
 
 def use_pallas_ladder(use_pallas=None) -> bool:
@@ -46,8 +58,6 @@ def use_pallas_ladder(use_pallas=None) -> bool:
     Pallas on a real TPU backend, XLA elsewhere; `use_pallas=False`
     forces XLA (required under GSPMD meshes — Mosaic custom calls have
     no partitioning rule); CORDA_TPU_NO_PALLAS=1 disables globally."""
-    import os
-
     if use_pallas is not None:
         return bool(use_pallas)
     if os.environ.get("CORDA_TPU_NO_PALLAS"):
@@ -80,12 +90,12 @@ def wei_ladder_pallas(
     u2,                 # [22, B]
     qx_m,               # [22, B] Montgomery-domain affine Q (bounded limbs)
     qy_m,               # [22, B]
-    block: int = DEFAULT_BLOCK,
+    block: int | None = None,
     interpret: bool = False,
 ):
     """R = u1*G + u2*Q, batched; returns Montgomery projective (X, Y, Z)."""
     batch = u1.shape[1]
-    block = _fit_block(batch, block)
+    block = _fit_block(batch, _block_or_default(block))
 
     def kernel(u1_ref, u2_ref, qx_ref, qy_ref, x_ref, y_ref, z_ref):
         # scalar-consts mode: Pallas rejects captured array constants,
@@ -143,14 +153,14 @@ def ed_ladder_pallas(
     k,                  # [22, B] canonical digest-scalar digits
     ax_m,               # [22, B] Montgomery-domain affine point (e.g. -A)
     ay_m,               # [22, B]
-    block: int = DEFAULT_BLOCK,
+    block: int | None = None,
     interpret: bool = False,
 ):
     """R = s*B + k*A on the twisted Edwards curve (B = base point),
     VMEM-resident per block like the Weierstrass ladder; returns
     extended coordinates (X, Y, Z, T) in Montgomery domain."""
     batch = s.shape[1]
-    block = _fit_block(batch, block)
+    block = _fit_block(batch, _block_or_default(block))
 
     R = 1 << R_BITS
 

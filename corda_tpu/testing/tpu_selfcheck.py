@@ -63,10 +63,10 @@ def run(n: int = 256, batch_size: int = 256, allow_cpu: bool = False) -> dict:
     from ..crypto.batch_verifier import CpuBatchVerifier, TpuBatchVerifier
 
     if jax.default_backend() != "tpu" and not allow_cpu:
-        raise SystemExit(
+        raise RuntimeError(
             f"backend is {jax.default_backend()!r}, not 'tpu' — the "
-            "Pallas kernels would not run; pass --allow-cpu to check "
-            "the XLA path anyway"
+            "Pallas kernels would not run; pass allow_cpu=True "
+            "(--allow-cpu on the CLI) to check the XLA path anyway"
         )
 
     reqs = build_requests(n)
@@ -93,7 +93,10 @@ def main(argv=None) -> int:
     parser.add_argument("--batch-size", type=int, default=256)
     parser.add_argument("--allow-cpu", action="store_true")
     args = parser.parse_args(argv)
-    print(json.dumps(run(args.n, args.batch_size, args.allow_cpu)))
+    try:
+        print(json.dumps(run(args.n, args.batch_size, args.allow_cpu)))
+    except RuntimeError as e:
+        raise SystemExit(str(e))
     return 0
 
 
