@@ -23,7 +23,10 @@ _SCHEME_NAMES = {
     "ed25519": schemes.EDDSA_ED25519_SHA512,
 }
 
-NOTARY_KINDS = ("", "simple", "validating", "raft", "raft-validating", "bft")
+NOTARY_KINDS = (
+    "", "simple", "validating", "batching",
+    "raft", "raft-validating", "bft",
+)
 VERIFIER_TYPES = ("in_memory", "out_of_process")
 
 

@@ -29,6 +29,7 @@ from .config import NodeConfig
 from .fabric import FabricEndpoint, PeerAddress, TlsIdentity
 from .notary import (
     InMemoryUniquenessProvider,
+    BatchingNotaryService,
     SimpleNotaryService,
     ValidatingNotaryService,
 )
@@ -91,7 +92,7 @@ class Node:
             # BFT is non-validating, like the reference's
             # BFTNonValidatingNotaryService (its only BFT flavour)
             advertised = (SERVICE_NOTARY,)
-        elif config.notary in ("validating", "raft-validating"):
+        elif config.notary in ("validating", "batching", "raft-validating"):
             advertised = (SERVICE_NOTARY_VALIDATING,)
         if config.is_network_map_host:
             advertised = advertised + (SERVICE_NETWORK_MAP,)
@@ -343,12 +344,13 @@ class Node:
         self.bft = None
         if kind == "":
             return
-        if kind in ("simple", "validating"):
+        if kind in ("simple", "validating", "batching"):
             uniqueness = PersistentUniquenessProvider(self.db)
-            cls = (
-                SimpleNotaryService if kind == "simple"
-                else ValidatingNotaryService
-            )
+            cls = {
+                "simple": SimpleNotaryService,
+                "validating": ValidatingNotaryService,
+                "batching": BatchingNotaryService,
+            }[kind]
             self.services.notary_service = cls(self.services, uniqueness)
             return
         if kind in ("raft", "raft-validating"):
@@ -457,6 +459,11 @@ class Node:
     def _tick_services(self) -> None:
         self.scheduler.tick()
         self.smm.tick()
+        notary = getattr(self.services, "notary_service", None)
+        if isinstance(notary, BatchingNotaryService):
+            # the pump interval is the batch deadline: everything that
+            # queued since the last pump shares one SPI dispatch
+            notary.tick()
         if self.raft is not None:
             self.raft.tick()
         if self.bft is not None:

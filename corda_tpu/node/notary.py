@@ -7,13 +7,15 @@ PersistentUniquenessProvider.kt:20, commit :63+), TimeWindowChecker
 (core/.../node/services/TimeWindowChecker.kt), and the NotaryFlow
 service side (core/.../flows/NotaryFlow.kt:107-130).
 
-TPU-first: the notary is the batch seam. `NotaryService.process_batch`
-drains every queued request through ONE BatchSignatureVerifier dispatch
-(signature checks across all pending transactions in a single padded
-XLA program) before committing inputs — the serving path the reference
-approximates with horizontally-scaled verifier processes (SURVEY §2.5).
-The flow-level server handles one request per session; the Phase-4
-batching notary enqueues requests and answers them from the batch loop.
+TPU-first: the notary is the batch seam. `BatchingNotaryService`
+accumulates concurrent notarisation requests in a queue and, on each
+pump tick (or when `max_batch` fills), drains EVERY pending
+transaction's signature checks through ONE BatchSignatureVerifier
+dispatch — a single padded XLA program across transactions — then
+commits inputs and scatters signed replies back to the waiting service
+flows. This is the serving path the reference approximates with
+horizontally-scaled verifier processes (SURVEY §2.5,
+OutOfProcessTransactionVerifierService.kt:19-73).
 """
 
 from __future__ import annotations
@@ -246,6 +248,153 @@ class SimpleNotaryService(NotaryService):
                 ftx.id, list(ftx.inputs), ftx.time_window, requester
             )
         )
+
+
+@dataclass
+class _PendingNotarisation:
+    stx: SignedTransaction
+    requester: Party
+    future: Any   # FlowFuture resolved with TransactionSignature | NotaryError
+
+
+class BatchingNotaryService(NotaryService):
+    """Batch-committing validating notary — the north-star serving path
+    (SURVEY §7 Phase 4).
+
+    `process` enqueues the request and suspends the service flow on a
+    future; `flush` (driven by the node pump tick, or immediately when
+    `max_batch` requests are queued) drains the queue:
+
+      queue -> ONE BatchSignatureVerifier dispatch over every pending
+      transaction's signatures (the SPI pads/buckets into fixed XLA
+      shapes) -> per-tx required-signer/contract/time-window checks ->
+      uniqueness commit in arrival order -> scatter signed replies.
+
+    Under the pump model the batch window is one delivery round: every
+    request that arrived since the last quiescent point shares a single
+    TPU dispatch, which is exactly the queue->pad/bucket->dispatch->
+    scatter loop the reference approximates with horizontally-scaled
+    verifier processes (NotaryFlow.kt:107-130 per-request service,
+    OutOfProcessTransactionVerifierService.kt:19-73 offload seam).
+    """
+
+    validating = True
+
+    def __init__(
+        self,
+        services: ServiceHub,
+        uniqueness: Optional[UniquenessProvider] = None,
+        tolerance_micros: int = 30_000_000,
+        service_identity: Optional[Party] = None,
+        max_batch: int = 512,
+    ):
+        super().__init__(
+            services, uniqueness, tolerance_micros, service_identity
+        )
+        self.max_batch = max_batch
+        self._pending: list[_PendingNotarisation] = []
+        # metrics: dispatches vs requests shows the batching ratio
+        self.batches_dispatched = 0
+        self.requests_batched = 0
+
+    def process(self, stx: SignedTransaction, requester: Party):
+        from ..flows.api import FlowFuture, wait_future
+
+        if stx.wtx.notary != self.identity:
+            return NotaryError(
+                "wrong-notary",
+                f"tx names notary {stx.wtx.notary}, I am {self.identity}",
+            )
+        fut = FlowFuture()
+        self._pending.append(_PendingNotarisation(stx, requester, fut))
+        if len(self._pending) >= self.max_batch:
+            self.flush()
+        result = yield from wait_future(fut)
+        return result
+
+    def tick(self) -> int:
+        """Pump hook (MockNetwork `node.ticks` / Node._tick_services):
+        flush whatever accumulated during the last delivery round.
+        Returns the number of requests answered (0 = quiescent)."""
+        n = len(self._pending)
+        if n:
+            self.flush()
+        return n
+
+    def flush(self) -> None:
+        pending, self._pending = self._pending, []
+        if not pending:
+            return
+        # phase 1 — ONE SPI dispatch across all pending transactions
+        reqs: list = []
+        spans: list[tuple[int, int]] = []
+        for p in pending:
+            rs = p.stx.signature_requests()
+            spans.append((len(reqs), len(rs)))
+            reqs.extend(rs)
+        try:
+            results = self.services.batch_verifier.verify_batch(reqs)
+        except Exception as e:
+            # a failed dispatch (unsupported scheme in the batch, device
+            # unavailable) must answer every waiting requester, not
+            # strand them and crash the pump tick
+            for p in pending:
+                p.future.set_result(
+                    NotaryError("verification-unavailable", str(e))
+                )
+            return
+        self.batches_dispatched += 1
+        self.requests_batched += len(pending)
+        # phase 2 — per-tx validation + commit in arrival order
+        for p, (off, n) in zip(pending, spans):
+            self._finish_one(p, results[off : off + n])
+
+    def _finish_one(
+        self, p: _PendingNotarisation, sig_results: list[bool]
+    ) -> None:
+        stx = p.stx
+        try:
+            stx.raise_on_invalid(sig_results)
+            stx.verify_required_signatures({self.identity.owning_key})
+            ltx = stx.to_ledger_transaction(self.services)
+            self.services.transaction_verifier.verify(ltx).result()
+        except Exception as e:
+            p.future.set_result(NotaryError("invalid-transaction", str(e)))
+            return
+        if not self.time_window_checker.is_valid(stx.wtx.time_window):
+            p.future.set_result(
+                NotaryError(
+                    "time-window-invalid",
+                    f"window {stx.wtx.time_window} outside notary clock "
+                    "tolerance",
+                )
+            )
+            return
+        commit_fut = self.uniqueness.commit_async(
+            list(stx.wtx.inputs), stx.id, p.requester
+        )
+
+        def done(f, p=p, stx=stx):
+            try:
+                f.result()
+            except UniquenessConflict as e:
+                p.future.set_result(
+                    NotaryError(
+                        "conflict",
+                        str(e),
+                        conflict={str(r): h for r, h in e.conflict.items()},
+                    )
+                )
+            except Exception as e:
+                p.future.set_result(NotaryError("commit-unavailable", str(e)))
+            else:
+                p.future.set_result(
+                    self.services.key_management.sign(
+                        stx.id, self.identity.owning_key
+                    )
+                )
+
+        commit_fut.add_done_callback(done)
 
 
 class ValidatingNotaryService(NotaryService):

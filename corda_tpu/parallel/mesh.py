@@ -14,6 +14,7 @@ from __future__ import annotations
 from typing import Optional
 
 import jax
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 BATCH_AXIS = "batch"
@@ -21,8 +22,8 @@ BATCH_AXIS = "batch"
 
 def make_mesh(devices: Optional[list] = None) -> Mesh:
     """1-D data-parallel mesh over all (or the given) devices."""
-    devices = devices if devices is not None else jax.devices()
-    return Mesh(jax.numpy.array(devices).reshape(-1), (BATCH_AXIS,))
+    devices = list(devices) if devices is not None else jax.devices()
+    return Mesh(np.array(devices, dtype=object).reshape(-1), (BATCH_AXIS,))
 
 
 def shard_operand(mesh: Mesh, x, batch_axis: int = -1):

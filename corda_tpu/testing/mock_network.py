@@ -25,6 +25,7 @@ from ..flows.statemachine import FlowStateMachine, StateMachineManager
 from ..node import messaging as msglib
 from ..node.notary import (
     InMemoryUniquenessProvider,
+    BatchingNotaryService,
     SimpleNotaryService,
     ValidatingNotaryService,
 )
@@ -63,7 +64,7 @@ class MockNode:
         advertised: tuple[str, ...] = ()
         if notary == "simple":
             advertised = (SERVICE_NOTARY,)
-        elif notary == "validating":
+        elif notary in ("validating", "batching"):
             advertised = (SERVICE_NOTARY_VALIDATING,)
         elif notary is not None:
             raise ValueError(f"unknown notary type {notary!r}")
@@ -112,12 +113,20 @@ class MockNode:
             self.services.notary_service = ValidatingNotaryService(
                 self.services, uniqueness()
             )
+        elif notary == "batching":
+            self.services.notary_service = BatchingNotaryService(
+                self.services, uniqueness()
+            )
         self.scheduler = NodeSchedulerService(
             self.services, self.smm.start_flow
         )
         # extra per-pump tick hooks (raft timers etc.); each returns a
         # count of actions so run() can detect quiescence
         self.ticks: list = []
+        if notary == "batching":
+            # the pump tick IS the batch deadline: requests that arrived
+            # during one delivery round share one SPI dispatch
+            self.ticks.append(self.services.notary_service.tick)
 
     # -- conveniences -------------------------------------------------------
 
@@ -166,10 +175,18 @@ class MockNetwork:
         self._sync_directories()
         return node
 
-    def create_notary(self, name: str = "Notary", validating: bool = False):
-        return self.create_node(
-            name, notary="validating" if validating else "simple"
+    def create_notary(
+        self,
+        name: str = "Notary",
+        validating: bool = False,
+        batching: bool = False,
+    ):
+        kind = (
+            "batching" if batching
+            else "validating" if validating
+            else "simple"
         )
+        return self.create_node(name, notary=kind)
 
     def create_raft_notary_cluster(
         self,

@@ -1,0 +1,49 @@
+"""Process-level JAX environment setup shared by tests and driver entry.
+
+Two concerns that MUST happen before jax initialises a backend:
+provisioning virtual host devices (XLA reads
+--xla_force_host_platform_device_count at CPU-client creation) and
+pointing the persistent compile cache at a stable dir (the EC ladder
+kernels take 20-350 s to compile per shape, so the cache is
+load-bearing for suite and dryrun wall time).
+
+Importing this module does NOT import jax — callers control ordering.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+COMPILE_CACHE_DIR = "/tmp/jax_compile_cache"
+
+_COUNT_RE = re.compile(r"--xla_force_host_platform_device_count=(\d+)")
+
+
+def force_host_device_count(n: int) -> None:
+    """Ensure XLA_FLAGS requests >= n virtual host (CPU) devices.
+
+    Raises an existing smaller count rather than silently keeping it;
+    must run before the CPU backend initialises.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = _COUNT_RE.search(flags)
+    if m is None:
+        flags = (flags + f" --xla_force_host_platform_device_count={n}").strip()
+    elif int(m.group(1)) < n:
+        flags = _COUNT_RE.sub(
+            f"--xla_force_host_platform_device_count={n}", flags
+        )
+    os.environ["XLA_FLAGS"] = flags
+
+
+def enable_compile_cache() -> None:
+    """Point jax at the persistent compile cache (idempotent)."""
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", COMPILE_CACHE_DIR)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+    try:
+        jax.config.update("jax_persistent_cache_enable_xla_caches", "all")
+    except Exception:
+        pass  # knob not present on older jax

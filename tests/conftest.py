@@ -8,13 +8,9 @@ chip sharding paths run on the virtual CPU mesh (the driver separately
 dry-runs the multi-chip path via __graft_entry__.dryrun_multichip).
 """
 
-import os
+from corda_tpu.utils import jaxenv
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+jaxenv.force_host_device_count(8)
 
 import jax
 
@@ -22,6 +18,4 @@ jax.config.update("jax_platforms", "cpu")
 
 # persistent XLA compile cache: the EC kernels take 20-200 s to compile
 # per (shape, backend) and dominate suite wall time on fresh processes
-jax.config.update("jax_compilation_cache_dir", "/tmp/jax_compile_cache")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
-jax.config.update("jax_persistent_cache_enable_xla_caches", "all")
+jaxenv.enable_compile_cache()
