@@ -41,7 +41,11 @@ def _merkle_metric(batch: int, iters: int) -> dict:
         VerificationRequest,
     )
     from corda_tpu.crypto.hashes import SecureHash
-    from corda_tpu.crypto.merkle import PartialMerkleTree, merkle_root
+    from corda_tpu.crypto.merkle import (
+        PartialMerkleTree,
+        merkle_root,
+        verify_proofs,
+    )
 
     rng = _r.Random(7)
     keys = [
@@ -60,18 +64,25 @@ def _merkle_metric(batch: int, iters: int) -> dict:
         sig = kp.private.sign(root.bytes_)
         items.append((pmt, root, included, kp.public, sig))
 
-    chunk = min(int(os.environ.get("BENCH_CHUNK", "8192")), batch)
+    chunk = min(int(os.environ.get("BENCH_CHUNK", "4096")), batch)
     verifier = TpuBatchVerifier(batch_sizes=(chunk,))
 
     def run_once() -> None:
         # explicit raises, not asserts: the proof verification IS the
-        # measured work and must survive python -O
-        reqs = []
-        for pmt, root, included, pub, sig in items:
-            if not pmt.verify(root, included):
-                raise SystemExit("merkle proof failed — bench aborted")
-            reqs.append(VerificationRequest(pub, sig, root.bytes_))
-        if not all(verifier.verify_batch(reqs)):
+        # measured work and must survive python -O. Signatures dispatch
+        # to the device FIRST (async), then the native bulk proof kernel
+        # (ONE C call, SHA-NI) runs on host while the device computes;
+        # one collect at the end.
+        reqs = [
+            VerificationRequest(pub, sig, root.bytes_)
+            for _, root, _, pub, sig in items
+        ]
+        handle = verifier.verify_batch_async(reqs)
+        if not all(
+            verify_proofs([(pmt, root, incl) for pmt, root, incl, _, _ in items])
+        ):
+            raise SystemExit("merkle proof failed — bench aborted")
+        if not all(handle.result()):
             raise SystemExit("signature verify failed — bench aborted")
 
     run_once()                       # warm-up: compile + correctness
@@ -115,7 +126,7 @@ def _notary_metric(batch: int, iters: int) -> dict:
     from corda_tpu.core.contracts import Amount, Issued, StateRef
     from corda_tpu.core.identity import PartyAndReference
 
-    chunk = min(int(os.environ.get("BENCH_CHUNK", "8192")), batch)
+    chunk = min(int(os.environ.get("BENCH_CHUNK", "4096")), batch)
     net = MockNetwork(
         seed=5, batch_verifier=TpuBatchVerifier(batch_sizes=(chunk,))
     )
@@ -226,7 +237,7 @@ def main() -> None:
             f"unknown BENCH_METRIC {metric!r}: p256 | mixed | merkle | notary"
         )
     if metric == "merkle":
-        print(json.dumps(_merkle_metric(min(batch, 8192), iters)))
+        print(json.dumps(_merkle_metric(min(batch, 32768), iters)))
         return
     if metric == "notary":
         print(json.dumps(_notary_metric(min(batch, 4096), iters)))
@@ -243,7 +254,12 @@ def main() -> None:
     # both sizes so caches stay warm. BENCH_CHUNK < batch splits the
     # batch into pipelined chunks: host staging of chunk k+1 overlaps
     # device compute of chunk k (dispatch is async).
-    chunk = int(os.environ.get("BENCH_CHUNK", "8192"))
+    # 4096 swept best on the remote-attached chip (2026-07-30 sweep:
+    # 1024=43k, 2048=53k, 4096=63k, 8192=54k, 16384=48k, 32768=42k
+    # p256/s): small enough that host staging of chunk k+1 fully hides
+    # behind device compute of chunk k, large enough that per-dispatch
+    # link latency amortises
+    chunk = int(os.environ.get("BENCH_CHUNK", "4096"))
     chunk = min(chunk, batch)
     # one size for both metrics: per-scheme buckets chunk at `chunk`
     # (smaller mixed buckets pad up to it — padding is cheaper than

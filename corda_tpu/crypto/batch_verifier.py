@@ -153,7 +153,13 @@ class TpuBatchVerifier(BatchSignatureVerifier):
 
     # -- SPI ---------------------------------------------------------------
 
-    def verify_batch(self, requests: Sequence[VerificationRequest]) -> list[bool]:
+    def verify_batch_async(
+        self, requests: Sequence[VerificationRequest]
+    ) -> "PendingVerification":
+        """Stage + dispatch every request without forcing the results:
+        jax dispatch is async, so the caller can do host work (Merkle
+        proofs, contract checks, staging the next batch) while the
+        device computes, then collect with `.result()`."""
         out: list[Optional[bool]] = [None] * len(requests)
         buckets: dict[int, tuple[list, list]] = {}
         cpu_idx: list[int] = []
@@ -173,19 +179,43 @@ class TpuBatchVerifier(BatchSignatureVerifier):
             cpu_res = self._cpu.verify_batch([requests[i] for i in cpu_idx])
             for i, ok in zip(cpu_idx, cpu_res):
                 out[i] = ok
-        if pending:
-            # ONE device->host fetch for all chunks: on a
-            # remote-attached TPU each fetch pays ~50-100 ms of link
-            # latency, so per-chunk np.asarray calls would serialise
-            # round-trips the concatenation avoids
-            flat = np.asarray(jnp.concatenate([res for res, _, _ in pending]))
-            off = 0
-            for res, chunk_idxs, n in pending:
-                arr = flat[off : off + res.shape[0]]
-                off += res.shape[0]
-                for j, ok in enumerate(arr[:n].tolist()):
-                    out[chunk_idxs[j]] = bool(ok)
-        return [bool(v) for v in out]
+        return PendingVerification(out, pending)
+
+    def verify_batch(self, requests: Sequence[VerificationRequest]) -> list[bool]:
+        return self.verify_batch_async(requests).result()
+
+
+class PendingVerification:
+    """Handle for an in-flight TpuBatchVerifier dispatch."""
+
+    def __init__(self, out, pending):
+        self._out = out
+        self._pending = pending
+        self._done = False
+
+    def result(self) -> list[bool]:
+        if not self._done:
+            out, pending = self._out, self._pending
+            if pending:
+                # ONE device->host fetch for all chunks: on a
+                # remote-attached TPU each fetch pays ~50-100 ms of link
+                # latency, so per-chunk np.asarray calls would serialise
+                # round-trips the concatenation avoids
+                flat = np.asarray(
+                    jnp.concatenate([res for res, _, _ in pending])
+                )
+                off = 0
+                for res, chunk_idxs, n in pending:
+                    arr = flat[off : off + res.shape[0]]
+                    off += res.shape[0]
+                    for j, ok in enumerate(arr[:n].tolist()):
+                        out[chunk_idxs[j]] = bool(ok)
+            # only mark done once the fetch succeeded: a transient link
+            # failure must surface on retry, not hand back None rows
+            self._out = [bool(v) for v in out]
+            self._pending = None
+            self._done = True
+        return self._out
 
 
 SCHEME_KERNELS = frozenset(

@@ -60,6 +60,28 @@ def merkle_levels(leaves: list[SecureHash]) -> list[list[SecureHash]]:
     return levels
 
 
+def verify_proofs(
+    items: list[tuple["PartialMerkleTree", SecureHash, list[SecureHash]]],
+) -> list[bool]:
+    """Bulk partial-proof verification: [(pmt, root, leaves)] -> [bool].
+
+    One native C call for the whole batch when the extension is built
+    (the notary/verifier tear-off hot path — PartialMerkleTree.kt:130
+    verify semantics, differential-fuzzed in tests/test_native.py);
+    falls back to the per-item Python walk otherwise.
+    """
+    from ..native import get as _native
+
+    native = _native()
+    if native is not None:
+        return list(
+            native.pmt_verify_many(
+                [pmt.as_native_item(root, leaves) for pmt, root, leaves in items]
+            )
+        )
+    return [pmt.verify(root, leaves) for pmt, root, leaves in items]
+
+
 @ser.serializable
 @dataclass(frozen=True)
 class PartialMerkleTree:
@@ -107,9 +129,24 @@ class PartialMerkleTree:
         except (ValueError, IndexError):
             return False
 
+    def as_native_item(
+        self, root: SecureHash, leaves: list[SecureHash]
+    ) -> tuple:
+        """The (tree_size, indices, proof, leaves, root) record the
+        native bulk verifier consumes."""
+        return (
+            self.tree_size,
+            self.included_indices,
+            [h.bytes_ for h in self.hashes],
+            [h.bytes_ for h in leaves],
+            root.bytes_,
+        )
+
     def _root_for(self, leaves: list[SecureHash]) -> SecureHash:
         if len(leaves) != len(self.included_indices):
             raise ValueError("leaf count mismatch")
+        if not self.included_indices:
+            raise ValueError("proof proves no leaves")
         if self.tree_size & (self.tree_size - 1) or self.tree_size <= 0:
             raise ValueError("tree size not a power of two")
         known: dict[int, SecureHash] = dict(zip(self.included_indices, leaves))

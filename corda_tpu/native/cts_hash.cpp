@@ -18,14 +18,104 @@
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
 
+#include <algorithm>
+#include <array>
 #include <cstdint>
 #include <cstring>
 #include <vector>
+
+#if defined(__x86_64__)
+#include <immintrin.h>
+#endif
 
 namespace {
 
 // ---------------------------------------------------------------------------
 // SHA-256 (FIPS 180-4)
+
+static const uint32_t SHA256_K[64] = {
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b,
+    0x59f111f1, 0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01,
+    0x243185be, 0x550c7dc3, 0x72be5d74, 0x80deb1fe, 0x9bdc06a7,
+    0xc19bf174, 0xe49b69c1, 0xefbe4786, 0x0fc19dc6, 0x240ca1cc,
+    0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da, 0x983e5152,
+    0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc,
+    0x53380d13, 0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85,
+    0xa2bfe8a1, 0xa81a664b, 0xc24b8b70, 0xc76c51a3, 0xd192e819,
+    0xd6990624, 0xf40e3585, 0x106aa070, 0x19a4c116, 0x1e376c08,
+    0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a, 0x5b9cca4f,
+    0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2,
+};
+
+#if defined(__x86_64__)
+// Hardware SHA extension path (runtime-dispatched; the portable
+// transform below stays the reference). The message schedule is the
+// W4-chunk recurrence W4[g] = msg2(msg1(W4[g-4], W4[g-3]) +
+// alignr(W4[g-1], W4[g-2], 4), W4[g-1]) — computed up front, then 16
+// paired rnds2 rounds. Semantics pinned by the hashlib differential
+// tests in tests/test_native.py.
+__attribute__((target("sha,sse4.1,ssse3")))
+void sha256_blocks_shani(uint32_t state[8], const uint8_t* data,
+                         size_t nblocks) {
+    const __m128i MASK = _mm_set_epi64x(
+        0x0c0d0e0f08090a0bULL, 0x0405060700010203ULL);
+    __m128i TMP = _mm_loadu_si128(
+        reinterpret_cast<const __m128i*>(&state[0]));          // DCBA
+    __m128i STATE1 = _mm_loadu_si128(
+        reinterpret_cast<const __m128i*>(&state[4]));          // HGFE
+    TMP = _mm_shuffle_epi32(TMP, 0xB1);                        // CDAB
+    STATE1 = _mm_shuffle_epi32(STATE1, 0x1B);                  // EFGH
+    __m128i STATE0 = _mm_alignr_epi8(TMP, STATE1, 8);          // ABEF
+    STATE1 = _mm_blend_epi16(STATE1, TMP, 0xF0);               // CDGH
+
+    while (nblocks--) {
+        const __m128i ABEF_SAVE = STATE0;
+        const __m128i CDGH_SAVE = STATE1;
+        __m128i w4[16];
+        for (int g = 0; g < 4; g++) {
+            w4[g] = _mm_shuffle_epi8(
+                _mm_loadu_si128(
+                    reinterpret_cast<const __m128i*>(data + 16 * g)),
+                MASK);
+        }
+        for (int g = 4; g < 16; g++) {
+            __m128i t = _mm_sha256msg1_epu32(w4[g - 4], w4[g - 3]);
+            t = _mm_add_epi32(t, _mm_alignr_epi8(w4[g - 1], w4[g - 2], 4));
+            w4[g] = _mm_sha256msg2_epu32(t, w4[g - 1]);
+        }
+        for (int g = 0; g < 16; g++) {
+            __m128i MSG = _mm_add_epi32(
+                w4[g],
+                _mm_set_epi32(SHA256_K[4 * g + 3], SHA256_K[4 * g + 2],
+                              SHA256_K[4 * g + 1], SHA256_K[4 * g]));
+            STATE1 = _mm_sha256rnds2_epu32(STATE1, STATE0, MSG);
+            MSG = _mm_shuffle_epi32(MSG, 0x0E);
+            STATE0 = _mm_sha256rnds2_epu32(STATE0, STATE1, MSG);
+        }
+        STATE0 = _mm_add_epi32(STATE0, ABEF_SAVE);
+        STATE1 = _mm_add_epi32(STATE1, CDGH_SAVE);
+        data += 64;
+    }
+
+    TMP = _mm_shuffle_epi32(STATE0, 0x1B);                     // FEBA
+    STATE1 = _mm_shuffle_epi32(STATE1, 0xB1);                  // DCHG
+    STATE0 = _mm_blend_epi16(TMP, STATE1, 0xF0);               // DCBA
+    STATE1 = _mm_alignr_epi8(STATE1, TMP, 8);                  // HGFE
+    _mm_storeu_si128(reinterpret_cast<__m128i*>(&state[0]), STATE0);
+    _mm_storeu_si128(reinterpret_cast<__m128i*>(&state[4]), STATE1);
+}
+
+bool has_shani() {
+    static const bool v = __builtin_cpu_supports("sha") &&
+                          __builtin_cpu_supports("sse4.1") &&
+                          __builtin_cpu_supports("ssse3");
+    return v;
+}
+#else
+bool has_shani() { return false; }
+#endif
 
 struct Sha256 {
     uint32_t state[8];
@@ -50,21 +140,13 @@ struct Sha256 {
     }
 
     void transform(const uint8_t* chunk) {
-        static const uint32_t K[64] = {
-            0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b,
-            0x59f111f1, 0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01,
-            0x243185be, 0x550c7dc3, 0x72be5d74, 0x80deb1fe, 0x9bdc06a7,
-            0xc19bf174, 0xe49b69c1, 0xefbe4786, 0x0fc19dc6, 0x240ca1cc,
-            0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da, 0x983e5152,
-            0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
-            0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc,
-            0x53380d13, 0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85,
-            0xa2bfe8a1, 0xa81a664b, 0xc24b8b70, 0xc76c51a3, 0xd192e819,
-            0xd6990624, 0xf40e3585, 0x106aa070, 0x19a4c116, 0x1e376c08,
-            0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a, 0x5b9cca4f,
-            0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
-            0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2,
-        };
+#if defined(__x86_64__)
+        if (has_shani()) {
+            sha256_blocks_shani(state, chunk, 1);
+            return;
+        }
+#endif
+        const uint32_t* K = SHA256_K;
         uint32_t w[64];
         for (int i = 0; i < 16; i++) {
             w[i] = (uint32_t(chunk[i * 4]) << 24) |
@@ -218,7 +300,187 @@ PyObject* py_merkle_root(PyObject*, PyObject* arg) {
         reinterpret_cast<char*>(level.data()), 32);
 }
 
+// ---------------------------------------------------------------------------
+// Batched partial-Merkle-proof verification.
+//
+// Semantics locked to crypto/merkle.py PartialMerkleTree._root_for
+// (PartialMerkleTree.kt:130 verify): walk known (index, hash) pairs up
+// the padded tree, consuming proof hashes bottom-up left-to-right for
+// missing siblings; reject on leaf-count mismatch, non-pow2 size,
+// out-of-range index, exhausted or unused proof. Duplicate indices
+// collapse last-wins exactly like dict(zip(indices, leaves)).
+
+bool pmt_root_for(long tree_size,
+                  const std::vector<long>& indices,
+                  const std::vector<const uint8_t*>& leaves,
+                  const std::vector<const uint8_t*>& proof,
+                  uint8_t out_root[32]) {
+    if (tree_size <= 0 || (tree_size & (tree_size - 1))) return false;
+    if (indices.size() != leaves.size()) return false;
+    if (indices.empty()) return false;   // a proof must prove something
+    // dict(zip(indices, leaves)): insertion order, later wins
+    std::vector<std::pair<long, std::array<uint8_t, 32>>> known;
+    for (size_t k = 0; k < indices.size(); k++) {
+        long idx = indices[k];
+        if (idx < 0 || idx >= tree_size) return false;
+        bool replaced = false;
+        for (auto& kv : known) {
+            if (kv.first == idx) {
+                std::memcpy(kv.second.data(), leaves[k], 32);
+                replaced = true;
+                break;
+            }
+        }
+        if (!replaced) {
+            std::array<uint8_t, 32> h;
+            std::memcpy(h.data(), leaves[k], 32);
+            known.emplace_back(idx, h);
+        }
+    }
+    std::sort(known.begin(), known.end(),
+              [](const auto& a, const auto& b) { return a.first < b.first; });
+    size_t proof_pos = 0;
+    long size = tree_size;
+    while (size > 1) {
+        std::vector<std::pair<long, std::array<uint8_t, 32>>> next;
+        for (size_t i = 0; i < known.size();) {
+            long idx = known[i].first;
+            long sib = idx ^ 1;
+            uint8_t buf[64];
+            std::array<uint8_t, 32> parent;
+            if (i + 1 < known.size() && known[i + 1].first == sib) {
+                std::memcpy(buf, known[i].second.data(), 32);
+                std::memcpy(buf + 32, known[i + 1].second.data(), 32);
+                i += 2;
+            } else {
+                if (proof_pos >= proof.size()) return false;
+                const uint8_t* sh = proof[proof_pos++];
+                if (idx % 2 == 0) {
+                    std::memcpy(buf, known[i].second.data(), 32);
+                    std::memcpy(buf + 32, sh, 32);
+                } else {
+                    std::memcpy(buf, sh, 32);
+                    std::memcpy(buf + 32, known[i].second.data(), 32);
+                }
+                i += 1;
+            }
+            sha256_once(buf, 64, parent.data());
+            next.emplace_back(idx / 2, parent);
+        }
+        known = std::move(next);
+        size /= 2;
+    }
+    if (proof_pos != proof.size()) return false;
+    std::memcpy(out_root, known[0].second.data(), 32);
+    return true;
+}
+
+// collect a sequence of 32-byte bytes-likes into `out` pointer views;
+// the Py_buffer views must stay alive while pointers are used
+bool collect_hashes(PyObject* seq_obj, std::vector<Py_buffer>& views,
+                    std::vector<const uint8_t*>& out) {
+    PyObject* seq = PySequence_Fast(seq_obj, "expected a sequence of hashes");
+    if (!seq) return false;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    for (Py_ssize_t i = 0; i < n; i++) {
+        Py_buffer view;
+        if (PyObject_GetBuffer(PySequence_Fast_GET_ITEM(seq, i), &view,
+                               PyBUF_SIMPLE) < 0) {
+            Py_DECREF(seq);
+            return false;
+        }
+        if (view.len != 32) {
+            PyBuffer_Release(&view);
+            Py_DECREF(seq);
+            PyErr_SetString(PyExc_ValueError, "hashes must be 32 bytes");
+            return false;
+        }
+        views.push_back(view);
+        out.push_back(static_cast<const uint8_t*>(view.buf));
+    }
+    Py_DECREF(seq);
+    return true;
+}
+
+// pmt_verify_many(items) -> list[bool]
+// items: sequence of (tree_size, indices, proof_hashes, leaves, root)
+PyObject* py_pmt_verify_many(PyObject*, PyObject* arg) {
+    PyObject* seq = PySequence_Fast(arg, "pmt_verify_many takes a sequence");
+    if (!seq) return nullptr;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    PyObject* result = PyList_New(n);
+    if (!result) { Py_DECREF(seq); return nullptr; }
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject* item = PySequence_Fast_GET_ITEM(seq, i);
+        PyObject* size_obj = PySequence_GetItem(item, 0);
+        PyObject* idx_obj = PySequence_GetItem(item, 1);
+        PyObject* proof_obj = PySequence_GetItem(item, 2);
+        PyObject* leaves_obj = PySequence_GetItem(item, 3);
+        PyObject* root_obj = PySequence_GetItem(item, 4);
+        bool ok = false;
+        bool error = false;
+        if (size_obj && idx_obj && proof_obj && leaves_obj && root_obj) {
+            long tree_size = PyLong_AsLong(size_obj);
+            std::vector<long> indices;
+            PyObject* idx_seq = PySequence_Fast(idx_obj, "indices");
+            if (idx_seq && !(tree_size == -1 && PyErr_Occurred())) {
+                Py_ssize_t ni = PySequence_Fast_GET_SIZE(idx_seq);
+                indices.reserve(ni);
+                for (Py_ssize_t k = 0; k < ni && !error; k++) {
+                    long v = PyLong_AsLong(
+                        PySequence_Fast_GET_ITEM(idx_seq, k));
+                    if (v == -1 && PyErr_Occurred()) error = true;
+                    indices.push_back(v);
+                }
+                std::vector<Py_buffer> views;
+                std::vector<const uint8_t*> proof, leaves;
+                Py_buffer root_view;
+                bool have_root = false;
+                if (!error && collect_hashes(proof_obj, views, proof) &&
+                    collect_hashes(leaves_obj, views, leaves)) {
+                    if (PyObject_GetBuffer(root_obj, &root_view,
+                                           PyBUF_SIMPLE) == 0) {
+                        have_root = true;
+                        if (root_view.len == 32) {
+                            uint8_t got[32];
+                            ok = pmt_root_for(tree_size, indices, leaves,
+                                              proof, got) &&
+                                 std::memcmp(
+                                     got, root_view.buf, 32) == 0;
+                        }
+                    } else {
+                        error = true;
+                    }
+                } else {
+                    error = PyErr_Occurred() != nullptr;
+                }
+                for (auto& v : views) PyBuffer_Release(&v);
+                if (have_root) PyBuffer_Release(&root_view);
+            } else {
+                error = true;
+            }
+            Py_XDECREF(idx_seq);
+        } else {
+            error = true;
+        }
+        Py_XDECREF(size_obj); Py_XDECREF(idx_obj); Py_XDECREF(proof_obj);
+        Py_XDECREF(leaves_obj); Py_XDECREF(root_obj);
+        if (error && PyErr_Occurred()) {
+            Py_DECREF(result); Py_DECREF(seq);
+            return nullptr;
+        }
+        PyObject* b = ok ? Py_True : Py_False;
+        Py_INCREF(b);
+        PyList_SET_ITEM(result, i, b);
+    }
+    Py_DECREF(seq);
+    return result;
+}
+
 PyMethodDef methods[] = {
+    {"pmt_verify_many", py_pmt_verify_many, METH_O,
+     "Verify many partial-Merkle proofs: "
+     "[(tree_size, indices, proof, leaves, root)] -> [bool]."},
     {"sha256", py_sha256, METH_O, "SHA-256 digest of a bytes-like."},
     {"sha256_many", py_sha256_many, METH_O,
      "SHA-256 digest of every item of a sequence of bytes-likes."},

@@ -332,8 +332,25 @@ class BatchingNotaryService(NotaryService):
             rs = p.stx.signature_requests()
             spans.append((len(reqs), len(rs)))
             reqs.extend(rs)
+        verifier = self.services.batch_verifier
         try:
-            results = self.services.batch_verifier.verify_batch(reqs)
+            if hasattr(verifier, "verify_batch_async"):
+                handle = verifier.verify_batch_async(reqs)
+            else:
+                results = verifier.verify_batch(reqs)
+                handle = None
+            # overlap: contract execution (host Python) runs while the
+            # device computes the signature batch
+            contract_errs: list[Optional[Exception]] = []
+            for p in pending:
+                try:
+                    ltx = p.stx.to_ledger_transaction(self.services)
+                    self.services.transaction_verifier.verify(ltx).result()
+                    contract_errs.append(None)
+                except Exception as e:
+                    contract_errs.append(e)
+            if handle is not None:
+                results = handle.result()
         except Exception as e:
             # a failed dispatch (unsupported scheme in the batch, device
             # unavailable) must answer every waiting requester, not
@@ -346,18 +363,24 @@ class BatchingNotaryService(NotaryService):
         self.batches_dispatched += 1
         self.requests_batched += len(pending)
         # phase 2 — per-tx validation + commit in arrival order
-        for p, (off, n) in zip(pending, spans):
-            self._finish_one(p, results[off : off + n])
+        for p, (off, n), cerr in zip(pending, spans, contract_errs):
+            self._finish_one(p, results[off : off + n], cerr)
 
     def _finish_one(
-        self, p: _PendingNotarisation, sig_results: list[bool]
+        self,
+        p: _PendingNotarisation,
+        sig_results: list[bool],
+        contract_err: Optional[Exception] = None,
     ) -> None:
         stx = p.stx
         try:
+            # signature errors take precedence over the (overlapped)
+            # contract result, matching the reference's check order
+            # (SignedTransaction.kt:143-149)
             stx.raise_on_invalid(sig_results)
             stx.verify_required_signatures({self.identity.owning_key})
-            ltx = stx.to_ledger_transaction(self.services)
-            self.services.transaction_verifier.verify(ltx).result()
+            if contract_err is not None:
+                raise contract_err
         except Exception as e:
             p.future.set_result(NotaryError("invalid-transaction", str(e)))
             return

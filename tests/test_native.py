@@ -126,3 +126,108 @@ def test_native_is_faster_for_large_trees(native):
         py_once()
         python_t = min(python_t, time.perf_counter() - t0)
     assert native_t < python_t * 1.2, (native_t, python_t)
+
+
+# -- batched partial-Merkle-proof verification -------------------------------
+
+
+def _random_pmt_case(rng, n_leaves=None, n_included=None):
+    n_leaves = n_leaves or rng.choice([1, 2, 3, 7, 8, 16, 33, 64])
+    leaves = [
+        SecureHash.sha256(rng.randbytes(16)) for _ in range(n_leaves)
+    ]
+    k = n_included or rng.randint(1, n_leaves)
+    included = [leaves[i] for i in sorted(rng.sample(range(n_leaves), k))]
+    pmt = merkle.PartialMerkleTree.build(leaves, included)
+    root = merkle.merkle_root(leaves)
+    return pmt, root, included
+
+
+def test_pmt_verify_many_matches_python(native):
+    rng = random.Random(77)
+    items = []
+    for _ in range(200):
+        pmt, root, included = _random_pmt_case(rng)
+        kind = rng.randrange(6)
+        if kind == 1:   # wrong root
+            root = SecureHash.sha256(b"wrong")
+        elif kind == 2:  # tampered leaf
+            included = list(included)
+            included[0] = SecureHash.sha256(b"evil")
+        elif kind == 3:  # wrong leaf count
+            included = included + [SecureHash.sha256(b"extra")]
+        elif kind == 4:  # truncated proof
+            pmt = merkle.PartialMerkleTree(
+                pmt.tree_size, pmt.included_indices, pmt.hashes[:-1]
+            )
+        elif kind == 5:  # corrupted structure
+            pmt = merkle.PartialMerkleTree(
+                pmt.tree_size + 1, pmt.included_indices, pmt.hashes
+            )
+        items.append((pmt, root, included))
+    got = [
+        bool(b)
+        for b in native.pmt_verify_many(
+            [p.as_native_item(r, l) for p, r, l in items]
+        )
+    ]
+    want = [p.verify(r, l) for p, r, l in items]
+    assert got == want
+    assert True in want and False in want
+
+
+def test_pmt_verify_many_edge_semantics(native):
+    """Adversarial encodings must match the Python walk bit-for-bit:
+    duplicate indices (dict-collapse last-wins), out-of-range index,
+    unused proof hashes, empty proof, single-leaf tree."""
+    rng = random.Random(5)
+    cases = []
+    pmt, root, included = _random_pmt_case(rng, n_leaves=8, n_included=2)
+    # duplicate indices: same number of leaves as indices
+    dup = merkle.PartialMerkleTree(
+        pmt.tree_size,
+        (pmt.included_indices[0],) * 2,
+        pmt.hashes,
+    )
+    cases.append((dup, root, included))
+    # out-of-range index
+    oob = merkle.PartialMerkleTree(pmt.tree_size, (0, 99), pmt.hashes)
+    cases.append((oob, root, included))
+    # unused proof hashes
+    extra = merkle.PartialMerkleTree(
+        pmt.tree_size,
+        pmt.included_indices,
+        pmt.hashes + (SecureHash.sha256(b"pad"),),
+    )
+    cases.append((extra, root, included))
+    # single-leaf tree: proof empty, leaf IS the root
+    leaf = SecureHash.sha256(b"solo")
+    solo = merkle.PartialMerkleTree(1, (0,), ())
+    cases.append((solo, leaf, [leaf]))
+    cases.append((solo, SecureHash.sha256(b"not"), [leaf]))
+    # empty proof (proves nothing): both paths must reject, not crash
+    empty = merkle.PartialMerkleTree(2, (), ())
+    cases.append((empty, root, []))
+    got = [
+        bool(b)
+        for b in native.pmt_verify_many(
+            [p.as_native_item(r, l) for p, r, l in cases]
+        )
+    ]
+    want = [p.verify(r, l) for p, r, l in cases]
+    assert got == want
+
+
+def test_verify_proofs_wrapper_with_and_without_native(native):
+    rng = random.Random(3)
+    items = [_random_pmt_case(rng) for _ in range(20)]
+    got = merkle.verify_proofs(items)
+    assert got == [True] * 20
+    import corda_tpu.native as nat
+
+    old = nat._native
+    try:
+        nat._native = None
+        assert merkle.verify_proofs(items) == got
+    finally:
+        nat._native = old
