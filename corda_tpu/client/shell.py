@@ -25,6 +25,7 @@ commands:
   flow start <FlowClass> [name: value, ...]   start a flow, wait for result
   flow list                                   registered responder protocols
   flow watch                                  live state-machine feed (10s)
+  flow watch <FlowClass> [name: value, ...]   start + live progress render
   run <rpc-method> [json-args...]             call any RPC method
   peers                                       network map snapshot
   notaries                                    notary identities
@@ -80,7 +81,9 @@ class Shell:
             if line == "flow list":
                 flows = self.wait(self.client.registered_flows())
                 return "\n".join(flows)
-            if line.startswith("flow watch"):
+            if line.startswith("flow watch "):
+                return self._flow_watch_one(line[len("flow watch "):])
+            if line == "flow watch":
                 return self._flow_watch()
             if line.startswith("run "):
                 return self._run_rpc(line[len("run "):])
@@ -137,6 +140,44 @@ class Shell:
             ["running:"] + (lines or ["  (none)"]) + ["events:"]
             + (events or ["  (none)"])
         )
+
+    def _flow_watch_one(self, rest: str, echo=None) -> str:
+        """`flow watch <FlowClass> [args]`: start the flow and live-
+        render its progress-step tree from the RPC progress feed
+        (InteractiveShell flow watch + ANSIProgressRenderer.kt /
+        FlowWatchPrintingSubscriber.kt). `echo` receives each repaint in
+        the repl; the final frame + result is the return value."""
+        from ..flows.api import ProgressTracker
+        from ..utils.progress_render import render
+
+        parts = rest.split(None, 1)
+        flow_tag = find_flow_class(parts[0])
+        args = js.parse_flow_args(
+            parts[1] if len(parts) > 1 else "", self._party_resolver()
+        )
+        handle = self.wait(self.client.call("start_flow", flow_tag, args))
+        mirror = ProgressTracker()
+
+        def on_label(label: str) -> None:
+            mirror.current = label
+            mirror.history.append(label)
+            if echo is not None:
+                echo(render(mirror, ansi=True))
+
+        unsub = (
+            handle.progress.subscribe(on_label)
+            if handle.progress is not None
+            else lambda: None
+        )
+        try:
+            result = self.wait(handle.result)
+            outcome = f"flow completed: {_render(result)}"
+        except rpclib.RpcError as e:
+            outcome = f"flow failed: {e}"
+        finally:
+            unsub()
+        tree = render(mirror, ansi=False)
+        return (tree + "\n" if tree else "") + outcome
 
     def _run_rpc(self, rest: str) -> str:
         parts = rest.split(None, 1)

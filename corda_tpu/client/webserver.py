@@ -19,6 +19,7 @@ from __future__ import annotations
 import json
 import threading
 import time
+from dataclasses import dataclass
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 from urllib.parse import parse_qs, urlparse
@@ -26,6 +27,68 @@ from urllib.parse import parse_qs, urlparse
 from ..node import rpc as rpclib
 from . import json_support as js
 from .common import FlowLookupError, find_flow_class, wait_rpc
+
+
+# ---------------------------------------------------------------------------
+# CorDapp web APIs (WebServerPluginRegistry, NodeWebServer.kt:171-173)
+
+
+class WebContext:
+    """What a CorDapp route handler gets: the RPC client + a wait that
+    pumps the fabric — the same power as any RPC client, no more."""
+
+    def __init__(self, gateway: "NodeWebServer"):
+        self.client = gateway.client
+        self.wait = gateway._wait
+
+
+@dataclass(frozen=True)
+class WebApiPlugin:
+    """A CorDapp's REST surface, mounted at /api/<prefix>/<subpath>
+    (and /web/<prefix>/<path> for static content). `routes` maps
+    (method, subpath) to `handler(ctx, query, body) -> (status,
+    jsonable)`; `static` maps path -> (content_type, bytes)."""
+
+    prefix: str
+    routes: tuple   # ((method, subpath, handler), ...)
+    static: tuple = ()   # ((path, content_type, bytes), ...)
+
+    def route(self, method: str, subpath: str):
+        for m, p, h in self.routes:
+            if m == method and p == subpath:
+                return h
+        return None
+
+    def static_for(self, path: str):
+        for p, ctype, data in self.static:
+            if p == path:
+                return ctype, data
+        return None
+
+
+_WEB_PLUGINS: dict[str, WebApiPlugin] = {}
+
+
+_RESERVED_PREFIXES = frozenset(
+    {"status", "network", "notaries", "vault", "flows", "plugins"}
+)
+
+
+def register_web_api(plugin: WebApiPlugin) -> None:
+    """Install a CorDapp web API process-wide (call from the cordapp
+    module — the ServiceLoader-scan analogue)."""
+    if plugin.prefix in _RESERVED_PREFIXES:
+        raise ValueError(
+            f"prefix {plugin.prefix!r} shadows a built-in /api endpoint"
+        )
+    existing = _WEB_PLUGINS.get(plugin.prefix)
+    if existing is not None and existing != plugin:
+        raise ValueError(f"web api prefix {plugin.prefix!r} already taken")
+    _WEB_PLUGINS[plugin.prefix] = plugin
+
+
+def registered_web_apis() -> tuple[WebApiPlugin, ...]:
+    return tuple(_WEB_PLUGINS.values())
 
 
 class NodeWebServer:
@@ -87,6 +150,25 @@ class NodeWebServer:
     # -- dispatch ------------------------------------------------------------
 
     def _handle(self, req: BaseHTTPRequestHandler, method: str) -> None:
+        path = urlparse(req.path).path
+        if method == "GET" and path.startswith("/web/"):
+            # CorDapp static content: /web/<prefix>/<path>
+            parts = [p for p in path.split("/") if p]
+            hit = None
+            if len(parts) >= 2 and parts[1] in _WEB_PLUGINS:
+                hit = _WEB_PLUGINS[parts[1]].static_for("/".join(parts[2:]))
+            if hit is None:
+                payload = json.dumps({"error": f"no such content {path}"}).encode()
+                ctype, status = "application/json", 404
+            else:
+                ctype, payload = hit[0], hit[1]
+                status = 200
+            req.send_response(status)
+            req.send_header("Content-Type", ctype)
+            req.send_header("Content-Length", str(len(payload)))
+            req.end_headers()
+            req.wfile.write(payload)
+            return
         if method == "GET" and urlparse(req.path).path == "/metrics":
             try:
                 text = (
@@ -157,6 +239,26 @@ class NodeWebServer:
                     "total": page.total_states_available,
                     "states": [js.to_jsonable(s) for s in page.states],
                 }
+            if parts == ["api", "plugins"]:
+                return 200, sorted(_WEB_PLUGINS)
+        # CorDapp-mounted REST APIs: /api/<prefix>/<subpath>
+        # (WebServerPluginRegistry mounting, NodeWebServer.kt:171-173)
+        if len(parts) >= 2 and parts[0] == "api" and parts[1] in _WEB_PLUGINS:
+            plugin = _WEB_PLUGINS[parts[1]]
+            subpath = "/".join(parts[2:])
+            handler = plugin.route(method, subpath)
+            if handler is None:
+                return 404, {
+                    "error": f"plugin {plugin.prefix!r} has no "
+                    f"{method} /{subpath}"
+                }
+            body = None
+            if method == "POST":
+                length = int(req.headers.get("Content-Length", 0))
+                raw = req.rfile.read(length) if length else b"{}"
+                body = json.loads(raw)
+            return handler(WebContext(self), parse_qs(url.query), body)
+        if method == "GET":
             return 404, {"error": f"no such endpoint {url.path}"}
         if method == "POST" and parts[:2] == ["api", "flows"] and len(parts) == 3:
             flow_tag = find_flow_class(parts[2])

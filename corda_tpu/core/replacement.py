@@ -121,6 +121,13 @@ def _verify_contract_upgrade(ltx, cmd) -> None:
     old_c, new_c = cmd.value.old_contract, cmd.value.new_contract
     convert = registered_upgrade(old_c, new_c)
     if convert is None:
+        # code delivery: the upgrade tx may ship its own sandboxed
+        # conversion as an attachment (ContractUpgradeFlow's
+        # AttachmentsClassLoader analogue — see core/sandbox.py)
+        from .sandbox import upgrade_from_attachments
+
+        convert = upgrade_from_attachments(old_c, new_c, ltx.attachments)
+    if convert is None:
         raise TransactionVerificationError(
             f"upgrade {old_c} -> {new_c} is not authorised on this node"
         )

@@ -383,7 +383,17 @@ class LedgerTransaction:
         names = {ts.contract for ts in self.outputs}
         names.update(sar.state.contract for sar in self.inputs)
         for name in sorted(names):
-            contract_by_name(name).verify(self)
+            try:
+                contract = contract_by_name(name)
+            except ContractViolation:
+                # not installed locally: load sandboxed code from the
+                # transaction's own attachments (AttachmentsClassLoader
+                # .kt:23 analogue — the tx references the attachment
+                # hash, so the code identity is signed over)
+                from .sandbox import contract_from_attachments
+
+                contract = contract_from_attachments(name, self.attachments)
+            contract.verify(self)
 
     # -- state grouping (LedgerTransaction.groupStates:142) ----------------
 

@@ -26,6 +26,7 @@ from typing import Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import PartitionSpec as P
 
 from ..parallel import mesh as meshlib
 from . import encodings, schemes
@@ -85,20 +86,46 @@ class TpuBatchVerifier(BatchSignatureVerifier):
     def _kernel(self, scheme_id: int, batch: int):
         key = (scheme_id, batch)
         if key not in self._kernels:
-            # under a GSPMD mesh the XLA ladder must be used: Mosaic
-            # (Pallas) custom calls have no partitioning rule
-            use_pallas = False if self.mesh is not None else None
-            if scheme_id == schemes.EDDSA_ED25519_SHA512:
-                fn = jax.jit(
-                    partial(ed25519_verify_packed, use_pallas=use_pallas)
-                )
+            ed = scheme_id == schemes.EDDSA_ED25519_SHA512
+            if ed:
+                inner = ed25519_verify_packed
             else:
                 curve = {
                     schemes.ECDSA_SECP256K1_SHA256: SECP256K1,
                     schemes.ECDSA_SECP256R1_SHA256: SECP256R1,
                 }[scheme_id]
+                inner = partial(ecdsa_verify_packed, curve)
+            if self.mesh is None:
+                fn = jax.jit(partial(inner, use_pallas=None))
+            else:
+                # GSPMD has no partitioning rule for Mosaic custom
+                # calls, but shard_map sidesteps GSPMD: the kernel runs
+                # per-shard, so each device keeps the fast Pallas
+                # ladder instead of regressing to the XLA one. The
+                # whole verify program is elementwise over the batch
+                # axis — every operand shards on it, no collectives.
+                B = meshlib.BATCH_AXIS
+                if ed:
+                    in_specs = (P(B, None), P(B), P(B), P(B))
+                    arg_order = ("packed", "a_sign", "exp_sign", "valid_in")
+                else:
+                    in_specs = (P(B, None), P(B))
+                    arg_order = ("packed", "valid_in")
+                # check_vma off: the scan carries in modmath start from
+                # replicated constants and become shard-varying, which
+                # the VMA checker rejects; the program is collective-
+                # free so the check buys nothing here
+                smapped = jax.shard_map(
+                    partial(inner, use_pallas=None),
+                    mesh=self.mesh,
+                    in_specs=in_specs,
+                    out_specs=P(B),
+                    check_vma=False,
+                )
                 fn = jax.jit(
-                    partial(ecdsa_verify_packed, curve, use_pallas=use_pallas)
+                    lambda _o=arg_order, _f=smapped, **kw: _f(
+                        *[kw[k] for k in _o]
+                    )
                 )
             self._kernels[key] = fn
         return self._kernels[key]

@@ -62,7 +62,7 @@ def ecdsa_verify_batch(
     c1,         # [22,B] r + n (second x-candidate)
     c1_ok,      # [B] bool: r + n < p
     valid_in,   # [B] bool host prefilter result
-    use_pallas=None,   # None = auto (TPU backend); False under meshes
+    use_pallas=None,   # None = auto (TPU backend; shard_map keeps it on meshes)
 ):
     """[B] bool: SEC1 ECDSA verification, bit-exact accept/reject."""
     fn, fp = curve.fn, curve.fp

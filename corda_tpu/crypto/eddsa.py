@@ -48,7 +48,7 @@ def ed25519_verify_batch(
     exp_y,        # [22,B] y value from signature R bytes (may be >= p)
     exp_sign,     # [B] int32 sign bit from signature R bytes
     valid_in,     # [B] bool host prefilter (decoding succeeded etc.)
-    use_pallas=None,   # None = auto (TPU backend); False under meshes
+    use_pallas=None,   # None = auto (TPU backend; shard_map keeps it on meshes)
 ):
     """[B] bool: cofactorless ed25519 verification."""
     fp = ED25519.fp

@@ -56,8 +56,10 @@ def _block_or_default(block) -> int:
 def use_pallas_ladder(use_pallas=None) -> bool:
     """Shared Pallas-vs-XLA dispatch policy for every scheme's ladder:
     Pallas on a real TPU backend, XLA elsewhere; `use_pallas=False`
-    forces XLA (required under GSPMD meshes — Mosaic custom calls have
-    no partitioning rule); CORDA_TPU_NO_PALLAS=1 disables globally."""
+    forces XLA; CORDA_TPU_NO_PALLAS=1 disables globally. Under meshes
+    the SPI wraps the kernel in shard_map (batch_verifier._kernel), so
+    the auto policy keeps Pallas per shard — GSPMD alone could not
+    partition the Mosaic custom call."""
     if use_pallas is not None:
         return bool(use_pallas)
     if os.environ.get("CORDA_TPU_NO_PALLAS"):
@@ -92,8 +94,14 @@ def wei_ladder_pallas(
     qy_m,               # [22, B]
     block: int | None = None,
     interpret: bool = False,
+    limbs: int = NLIMB,
 ):
-    """R = u1*G + u2*Q, batched; returns Montgomery projective (X, Y, Z)."""
+    """R = u1*G + u2*Q, batched; returns Montgomery projective (X, Y, Z).
+
+    `limbs` < NLIMB scans only the low `limbs` digit rows (scalars must
+    be < 2^(12*limbs)) — a test-only reduction that makes interpret-mode
+    runs of the full kernel tractable on CPU; production always scans
+    all NLIMB rows."""
     batch = u1.shape[1]
     block = _fit_block(batch, _block_or_default(block))
 
@@ -114,7 +122,7 @@ def wei_ladder_pallas(
             # VPU op), keeping the program ~22 traced bodies rather
             # than 264
             acc = inf
-            for limb in range(NLIMB - 1, -1, -1):
+            for limb in range(limbs - 1, -1, -1):
                 row1 = u1_ref[limb, :]
                 row2 = u2_ref[limb, :]
 

@@ -9,10 +9,13 @@ AST audit that flags non-deterministic constructs in a contract's
 `verify`, usable as a CI gate and by the verifier pool before
 registering a contract.
 
-This is an AUDIT, not a sandbox: Python cannot be fully confined from
-inside; the check catches the accident class (clocks, randomness, IO,
-iteration-order hazards), while organisational review covers malice —
-the same posture the reference's prototype takes.
+This module is the STATIC half; the RUNTIME half (restricted builtins,
+allowlisted imports, operation-budget metering for attachment-carried
+contract code) lives in core/sandbox.py, which calls `audit_source`
+before executing anything. Python cannot be fully confined from inside
+one process; together the two catch the accident class (clocks,
+randomness, IO, runaway loops), while organisational review covers
+malice — the same posture the reference's prototype takes.
 """
 
 from __future__ import annotations
@@ -54,7 +57,11 @@ class DeterminismError(Exception):
 
 
 class _Auditor(ast.NodeVisitor):
-    def __init__(self):
+    def __init__(self, sandbox: bool = False):
+        # sandbox mode adds the escape-surface rules that only make
+        # sense for UNREVIEWED attachment-shipped code (core/sandbox.py);
+        # installed contracts may use private helpers freely
+        self.sandbox = sandbox
         self.violations: list[Violation] = []
 
     def _flag(self, node: ast.AST, message: str) -> None:
@@ -84,6 +91,13 @@ class _Auditor(ast.NodeVisitor):
     def visit_Attribute(self, node: ast.Attribute) -> None:
         if node.attr in FORBIDDEN_ATTRS:
             self._flag(node, f"calls non-deterministic API .{node.attr}")
+        if self.sandbox and node.attr.startswith("_"):
+            # underscore attributes are the sandbox-escape surface:
+            # __class__/__subclasses__/__globals__ walks, and private
+            # module internals like dataclasses.sys
+            self._flag(
+                node, f"underscore attribute access .{node.attr} is forbidden"
+            )
         self.generic_visit(node)
 
     def visit_While(self, node: ast.While) -> None:
@@ -102,9 +116,9 @@ class _Auditor(ast.NodeVisitor):
         self.generic_visit(node)
 
 
-def audit_source(source: str) -> list[Violation]:
+def audit_source(source: str, sandbox: bool = False) -> list[Violation]:
     tree = ast.parse(textwrap.dedent(source))
-    auditor = _Auditor()
+    auditor = _Auditor(sandbox=sandbox)
     auditor.visit(tree)
     return sorted(auditor.violations, key=lambda v: v.line)
 

@@ -20,6 +20,7 @@ from .obligation import (
     Obligation,
     ObligationState,
 )
+from . import schemas as _schemas  # noqa: F401 - registers MappedSchemas
 from .trade_flows import (
     BuyerFlow,
     DealInstigatorFlow,

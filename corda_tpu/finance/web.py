@@ -1,0 +1,79 @@
+"""Finance CorDapp web API (reference: each CorDapp's
+WebServerPluginRegistry REST surface mounted by NodeWebServer.kt:
+171-173 — e.g. bank-of-corda's BankOfCordaWebApi).
+
+Mounted at /api/cash:
+  GET  /api/cash/balances            {currency: total} of unconsumed cash
+  POST /api/cash/issue               {"quantity", "currency", "recipient",
+                                      "notary"} -> issue via CashIssueFlow
+Static demo page at /web/cash/index.html.
+"""
+
+from __future__ import annotations
+
+from ..client.webserver import WebApiPlugin, register_web_api
+from ..node.vault_query import VaultQueryCriteria
+from .cash import CashState
+
+
+def _balances(ctx, query, body):
+    page = ctx.wait(
+        ctx.client.vault_query_by(
+            VaultQueryCriteria(contract_state_types=(CashState,))
+        )
+    )
+    totals: dict[str, int] = {}
+    for sar in page.states:
+        amount = sar.state.data.amount
+        key = str(amount.token.product)
+        totals[key] = totals.get(key, 0) + amount.quantity
+    return 200, totals
+
+
+def _issue(ctx, query, body):
+    if not isinstance(body, dict):
+        return 400, {"error": "JSON object body required"}
+    try:
+        quantity = int(body["quantity"])
+        currency = str(body["currency"])
+        recipient = str(body["recipient"])
+        notary = str(body["notary"])
+    except (KeyError, ValueError) as e:
+        return 400, {"error": f"bad issue request: {e}"}
+    parties = {}
+    for info in ctx.wait(ctx.client.network_map_snapshot()):
+        parties[info.legal_identity.name] = info.legal_identity
+    for p in ctx.wait(ctx.client.notary_identities()):
+        parties.setdefault(p.name, p)
+    if recipient not in parties or notary not in parties:
+        return 400, {"error": "unknown recipient or notary party"}
+    handle = ctx.wait(
+        ctx.client.start_flow(
+            "corda_tpu.finance.cash.CashIssueFlow",
+            quantity=quantity,
+            currency=currency,
+            recipient=parties[recipient],
+            notary=parties[notary],
+        )
+    )
+    stx = ctx.wait(handle.result)
+    return 200, {"tx_id": stx.id.bytes_.hex()}
+
+
+_INDEX = b"""<!doctype html>
+<title>corda_tpu cash</title>
+<h1>Cash CorDapp</h1>
+<p>GET <a href="/api/cash/balances">/api/cash/balances</a> |
+POST /api/cash/issue</p>
+"""
+
+CASH_WEB_API = WebApiPlugin(
+    prefix="cash",
+    routes=(
+        ("GET", "balances", _balances),
+        ("POST", "issue", _issue),
+    ),
+    static=(("index.html", "text/html", _INDEX),),
+)
+
+register_web_api(CASH_WEB_API)

@@ -392,17 +392,39 @@ class PersistentVaultService(VaultService):
     def __init__(self, services):
         super().__init__(services)
         self._db: NodeDatabase = services.db
+        self._ensured_schemas: set[str] = set()
+        self._ensure_schema_tables()
         for row in self._db.query(
             "SELECT ref_tx, ref_index, state, status FROM vault_states"
         ):
             ref = StateRef(SecureHash(bytes(row[0])), row[1])
             ts = ser.decode(bytes(row[2]))
             (self._unconsumed if row[3] == 0 else self._consumed)[ref] = ts
+    def _ensure_schema_tables(self) -> None:
+        """Create every registered MappedSchema's table (memoized).
+        Runs at open AND before queries: cordapps may register schemas
+        after the vault opened, and a custom-column query over a table
+        no state ever populated must return empty, not crash."""
+        from .schemas import registered_schemas
+
+        missing = [
+            s
+            for s in registered_schemas()
+            if s.name not in self._ensured_schemas
+        ]
+        if not missing:
+            return
+        with self._db.transaction() as conn:
+            for schema in missing:
+                conn.execute(schema.ddl())
+                self._ensured_schemas.add(schema.name)
+
     def query_by(self, criteria, paging=None, sorting=None):
         """Same criteria AST as the in-memory vault, compiled to SQL
         over vault_states (the HibernateQueryCriteriaParser role)."""
         from .vault_query import PageSpecification, Sort, run_sql
 
+        self._ensure_schema_tables()
         return run_sql(
             self._db,
             criteria,
@@ -454,6 +476,23 @@ class PersistentVaultService(VaultService):
                         "INSERT INTO vault_parts"
                         " (ref_tx, ref_index, fingerprint) VALUES (?,?,?)",
                         (ref.txhash.bytes_, ref.index, fp),
+                    )
+                # CorDapp-registered schema projections (the
+                # HibernateObserver role, node/.../services/schema/):
+                # one row per applying MappedSchema, in ITS table,
+                # within the same delta transaction
+                from .schemas import schemas_for
+
+                for schema in schemas_for(ts.data):
+                    if schema.name not in self._ensured_schemas:
+                        conn.execute(schema.ddl())
+                        self._ensured_schemas.add(schema.name)
+                    values = schema.row_values(ts.data)
+                    marks = ",".join("?" * (2 + len(values)))
+                    conn.execute(
+                        f"INSERT OR REPLACE INTO {schema.table} VALUES"
+                        f" ({marks})",
+                        (ref.txhash.bytes_, ref.index, *values),
                     )
 
 

@@ -126,10 +126,14 @@ class FeedHandle:
 
 @dataclass(frozen=True)
 class FlowHandleWire:
-    """Marker for a started flow: its id + the one-shot result stream."""
+    """Marker for a started flow: its id, the one-shot result stream,
+    and the progress-step stream captured from the moment the flow
+    started (CordaRPCOps FlowProgressHandle — capture must begin at
+    start or synchronously-completing flows lose every label)."""
 
     flow_id: bytes
     result_observable_id: int
+    progress_observable_id: Optional[int] = None
 
 
 @dataclass(frozen=True)
@@ -160,6 +164,18 @@ class StateMachineUpdate:
     info: StateMachineInfo
 
 
+@dataclass(frozen=True)
+class FlowProgressSnapshot:
+    """A flow's progress-tracker state at subscription time: declared
+    steps, the labels already announced, and the current one (CordaRPCOps
+    FlowProgressHandle — what ANSIProgressRenderer consumes)."""
+
+    flow_id: bytes
+    steps: tuple[str, ...]
+    history: tuple[str, ...]
+    current: Optional[str]
+
+
 for _cls in (
     RpcRequest,
     RpcReply,
@@ -169,6 +185,7 @@ for _cls in (
     RpcUnsubscribe,
     StateMachineInfo,
     StateMachineUpdate,
+    FlowProgressSnapshot,
 ):
     ser.serializable(_cls)
 
@@ -307,6 +324,32 @@ class CordaRPCOpsImpl:
         unsub = _subscribe_list(self.smm.lifecycle, on_change)
         return DataFeed(self.state_machines_snapshot(), updates, dispose=unsub)
 
+    @rpc_method
+    def flow_progress_feed(self, flow_id: bytes) -> DataFeed:
+        """Snapshot + live stream of one flow's progress-step labels
+        (CordaRPCOps FlowProgressHandle; the shell's `flow watch`
+        renders it with utils/progress_render)."""
+        fsm = self.smm.flows.get(flow_id)
+        tracker = (
+            getattr(fsm.logic, "progress_tracker", None)
+            if fsm is not None
+            else None
+        )
+        snapshot = FlowProgressSnapshot(
+            flow_id,
+            tuple(tracker.steps) if tracker else (),
+            tuple(tracker.history) if tracker else (),
+            tracker.current if tracker else None,
+        )
+        updates = Observable()
+
+        def on_step(changed_fsm, label: str) -> None:
+            if changed_fsm.id == flow_id:
+                updates.emit(label)
+
+        unsub = _subscribe_list(self.smm.changes, on_step)
+        return DataFeed(snapshot, updates, dispose=unsub)
+
     # start_flow is special-cased by the server (permissioning + flow
     # handle wiring); it is not a plain @rpc_method.
     def start_flow(self, flow_tag: str, kwargs: dict) -> FlowStateMachine:
@@ -389,8 +432,21 @@ class RPCServer:
                 raise RpcPermissionError(
                     f"user {user.username!r} may not start {flow_tag}"
                 )
-            fsm = self._ops.start_flow(flow_tag, dict(snapshot))
-            return self._flow_handle(fsm, client)
+            # capture progress from BEFORE the flow is created: the
+            # state machine may run it to completion inline, and labels
+            # emitted during that run must still reach the client
+            buffered: list[tuple[Any, str]] = []
+            capture = lambda fsm, label: buffered.append((fsm, label))  # noqa: E731
+            self._ops.smm.changes.append(capture)
+            try:
+                fsm = self._ops.start_flow(flow_tag, dict(snapshot))
+            finally:
+                self._ops.smm.changes.remove(capture)
+            return self._flow_handle(
+                fsm,
+                client,
+                early_labels=[lb for f, lb in buffered if f.id == fsm.id],
+            )
         fn = getattr(self._ops, req.method, None)
         if fn is None or not getattr(fn, "_rpc_exposed", False):
             raise RpcPermissionError(f"no such RPC method {req.method!r}")
@@ -443,8 +499,33 @@ class RPCServer:
         self._subs[(client, obs_id)] = dispose
         return FeedHandle(obs_id, feed.snapshot)
 
-    def _flow_handle(self, fsm: FlowStateMachine, client: str) -> FlowHandleWire:
+    def _flow_handle(
+        self,
+        fsm: FlowStateMachine,
+        client: str,
+        early_labels: Optional[list[str]] = None,
+    ) -> FlowHandleWire:
         obs_id = self._fresh_obs_id()
+        prog_id = self._fresh_obs_id()
+
+        def send_label(label: str) -> None:
+            self._messaging.send(
+                TOPIC_RPC_OBSERVATION,
+                ser.encode(RpcObservation(prog_id, label)),
+                client,
+            )
+
+        for label in early_labels or []:
+            # labels from the inline run flush after the reply so the
+            # client has the handle before observations arrive
+            self._deferred.append(lambda lb=label: send_label(lb))
+        if not fsm.done:
+            def on_step(step_fsm, label: str) -> None:
+                if step_fsm.id == fsm.id:
+                    send_label(label)
+
+            unsub_prog = _subscribe_list(self._ops.smm.changes, on_step)
+            self._subs[(client, prog_id)] = unsub_prog
 
         def send_result() -> None:
             if fsm.exception is not None:
@@ -472,10 +553,13 @@ class RPCServer:
                     send_result()
                     unsub()
                     self._subs.pop((client, obs_id), None)
+                    dispose_prog = self._subs.pop((client, prog_id), None)
+                    if dispose_prog is not None:
+                        dispose_prog()
 
             unsub = _subscribe_list(self._ops.smm.lifecycle, on_change)
             self._subs[(client, obs_id)] = unsub
-        return FlowHandleWire(fsm.id, obs_id)
+        return FlowHandleWire(fsm.id, obs_id, prog_id)
 
     # -- unsubscription ------------------------------------------------------
 
@@ -563,13 +647,33 @@ def _ctor_kwargs_of(logic) -> dict:
     return kwargs
 
 
+class ReplayObservable(Observable):
+    """Observable that replays everything already emitted to late
+    subscribers — progress labels often arrive in the same pump round
+    as the flow handle itself, before the caller can subscribe."""
+
+    def __init__(self):
+        super().__init__()
+        self._history: list = []
+
+    def subscribe(self, cb):
+        for item in list(self._history):
+            cb(item)
+        return super().subscribe(cb)
+
+    def emit(self, item) -> None:
+        self._history.append(item)
+        super().emit(item)
+
+
 @dataclass
 class FlowHandle:
-    """Client-side handle: flow id + result future (CordaRPCOps
-    FlowHandle)."""
+    """Client-side handle: flow id + result future + progress-label
+    stream (CordaRPCOps FlowHandle / FlowProgressHandle)."""
 
     flow_id: bytes
     result: RpcFuture
+    progress: Optional[Observable] = None
 
 
 class RPCClient:
@@ -591,6 +695,7 @@ class RPCClient:
         self._pending: dict[int, RpcFuture] = {}
         self._observables: dict[int, Observable] = {}
         self._flow_futures: dict[int, RpcFuture] = {}
+        self._flow_progress: dict[int, int] = {}   # result obs -> prog obs
         messaging.add_handler(TOPIC_RPC_REPLY, self._on_reply)
         messaging.add_handler(TOPIC_RPC_OBSERVATION, self._on_observation)
 
@@ -663,7 +768,14 @@ class RPCClient:
         if isinstance(value, FlowHandleWire):
             fut = RpcFuture()
             self._flow_futures[value.result_observable_id] = fut
-            return FlowHandle(value.flow_id, fut)
+            progress = None
+            if value.progress_observable_id is not None:
+                progress = ReplayObservable()
+                self._observables[value.progress_observable_id] = progress
+                self._flow_progress[value.result_observable_id] = (
+                    value.progress_observable_id
+                )
+            return FlowHandle(value.flow_id, fut, progress)
         return value
 
     def _unsubscribe(self, obs_id: int) -> None:
@@ -680,6 +792,11 @@ class RPCClient:
         obs = ser.decode(msg.payload)
         flow_fut = self._flow_futures.pop(obs.observable_id, None)
         if flow_fut is not None:
+            # the flow is over: drop its progress stream too, or a
+            # long-lived client leaks one ReplayObservable per flow
+            prog_id = self._flow_progress.pop(obs.observable_id, None)
+            if prog_id is not None:
+                self._observables.pop(prog_id, None)
             status = obs.item[0]
             if status == "ok":
                 flow_fut._resolve(obs.item[1])

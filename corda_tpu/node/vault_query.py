@@ -328,6 +328,56 @@ class And(QueryCriteria):
 
 
 @dataclass(frozen=True)
+class CustomColumnCriteria(QueryCriteria):
+    """Criterion over a CorDapp-registered MappedSchema column
+    (VaultCustomQueryCriteria, QueryCriteria.kt + the custom-column
+    branch of HibernateQueryCriteriaParser.kt).
+
+    SQL path: row-value subquery into the schema's own table; in-memory
+    path: run the schema's `project` on the live state. States the
+    schema does not apply to never match.
+    """
+
+    schema_name: str
+    column: str
+    predicate: ColumnPredicate
+    status: str = UNCONSUMED
+
+    def _schema(self):
+        from .schemas import schema_by_name
+
+        return schema_by_name(self.schema_name)
+
+    def matches(self, row: VaultRow) -> bool:
+        if not _status_match(self.status, row):
+            return False
+        schema = self._schema()
+        data = row.state_and_ref.state.data
+        if not isinstance(data, schema.applies_to):
+            return False
+        value = schema.project(data).get(self.column)
+        if value is None:
+            # SQL three-valued logic: NULL never satisfies any
+            # comparison (incl. <>), and both backends must agree
+            return False
+        return _OPS[self.predicate.op](value, self.predicate.value)
+
+    def sql(self) -> tuple[str, list]:
+        schema = self._schema()
+        if self.column not in {c for c, _ in schema.columns}:
+            raise ValueError(
+                f"schema {schema.name!r} has no column {self.column!r}"
+            )
+        ss, sp = _status_sql(self.status)
+        frag = (
+            f"({ss}) AND (v.ref_tx, v.ref_index) IN "
+            f"(SELECT ref_tx, ref_index FROM {schema.table}"
+            f" WHERE {self.column} {_SQL_OPS[self.predicate.op]} ?)"
+        )
+        return frag, sp + [self.predicate.value]
+
+
+@dataclass(frozen=True)
 class Or(QueryCriteria):
     left: QueryCriteria
     right: QueryCriteria

@@ -1,0 +1,102 @@
+"""End-to-end MockNetwork arcs on the TpuBatchVerifier.
+
+Every other Ring-3 test uses CpuBatchVerifier for speed; these run the
+full DvP arc — issue, pay, transitive pay with backchain resolution,
+double-spend rejection — with the jitted XLA kernels in the signature
+path, so the SPI *integration* (staging, padding, async dispatch,
+scatter, error mapping), not just the kernels, is exercised end-to-end.
+In CI the conftest pins the 8-virtual-CPU backend, so the XLA ladder
+runs on the CPU mesh; on hardware the same test takes the TPU path.
+Reference shape: the verifier driver's requesting-node e2e
+(verifier/src/integration-test/.../VerifierTests.kt:24-60).
+"""
+
+import pytest
+
+from corda_tpu.core.transactions import TransactionBuilder
+from corda_tpu.crypto.batch_verifier import TpuBatchVerifier
+from corda_tpu.finance import CashIssueFlow, CashPaymentFlow
+from corda_tpu.finance.cash import CASH_CONTRACT, CashMove, CashState
+from corda_tpu.flows.core_flows import FinalityFlow
+from corda_tpu.node.notary import NotaryException
+from corda_tpu.testing.mock_network import MockNetwork
+
+
+@pytest.fixture(scope="module")
+def net():
+    # small batch sizes: jit shapes compile fast and stay warm via the
+    # conftest persistent compile cache
+    network = MockNetwork(
+        seed=11, batch_verifier=TpuBatchVerifier(batch_sizes=(8, 32))
+    )
+    notary = network.create_notary("Notary", validating=True)
+    bank = network.create_node("Bank")
+    alice = network.create_node("Alice")
+    bob = network.create_node("Bob")
+    return network, notary, bank, alice, bob
+
+
+def test_dvp_arc_on_tpu_verifier(net):
+    network, notary, bank, alice, bob = net
+    bank.run_flow(CashIssueFlow(1000, "USD", alice.party, notary.party))
+    alice.run_flow(CashPaymentFlow(400, "USD", bob.party))
+    # transitive: bob's payment to bank forces backchain resolution at
+    # the bank THROUGH the TPU verifier
+    bob.run_flow(CashPaymentFlow(150, "USD", bank.party))
+
+    def balance(node):
+        return sum(
+            s.state.data.amount.quantity
+            for s in node.vault.unconsumed_states(CashState)
+            if s.state.data.owner == node.party.owning_key
+        )
+
+    assert balance(alice) == 600
+    assert balance(bob) == 250
+    assert balance(bank) == 150
+
+
+def test_double_spend_rejected_on_tpu_verifier(net):
+    network, notary, bank, alice, bob = net
+    held = alice.vault.unconsumed_states(CashState)
+    st = held[0]
+
+    def spend_to(dest):
+        b = TransactionBuilder(notary.party)
+        b.add_input_state(st)
+        b.add_output_state(
+            st.state.data.with_owner(dest.party.owning_key),
+            CASH_CONTRACT,
+            notary.party,
+        )
+        b.add_command(CashMove(), alice.party.owning_key)
+        return alice.services.sign_initial_transaction(b)
+
+    alice.run_flow(FinalityFlow(spend_to(bob)))
+    with pytest.raises(NotaryException) as exc:
+        alice.run_flow(FinalityFlow(spend_to(bank)))
+    assert exc.value.error.kind == "conflict"
+
+
+def test_tampered_signature_rejected_on_tpu_verifier(net):
+    network, notary, bank, alice, bob = net
+    st = bob.vault.unconsumed_states(CashState)[0]
+    b = TransactionBuilder(notary.party)
+    b.add_input_state(st)
+    b.add_output_state(
+        st.state.data.with_owner(alice.party.owning_key),
+        CASH_CONTRACT,
+        notary.party,
+    )
+    b.add_command(CashMove(), bob.party.owning_key)
+    stx = bob.services.sign_initial_transaction(b)
+    sig = stx.sigs[0]
+    bad = type(sig)(
+        by=sig.by,
+        signature=sig.signature[:-1] + bytes([sig.signature[-1] ^ 1]),
+        metadata=sig.metadata,
+    )
+    stx_bad = type(stx)(stx.wtx, (bad,))
+    with pytest.raises(Exception) as exc:
+        bob.run_flow(FinalityFlow(stx_bad))
+    assert "invalid" in str(exc.value).lower()

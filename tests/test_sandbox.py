@@ -1,0 +1,351 @@
+"""Attachment-carried contract code + runtime determinism sandbox.
+
+Covers the AttachmentsClassLoader gap (core/.../serialization/
+AttachmentsClassLoader.kt:23 — contract code shipped with the tx) and
+the deterministic-sandbox gap (experimental/sandbox/.../
+RuntimeCostAccounter.java — runtime rejection of non-deterministic
+APIs and cost overruns), per corda_tpu/core/sandbox.py.
+"""
+
+import pytest
+
+from corda_tpu.core.contracts import Attachment, ContractViolation
+from corda_tpu.core.sandbox import (
+    CostLimitExceeded,
+    SandboxViolation,
+    contract_from_attachments,
+    load_contract_source,
+    make_contract_attachment,
+    parse_contract_attachment,
+)
+from corda_tpu.core.transactions import TransactionBuilder
+from corda_tpu.finance import CashIssueFlow
+from corda_tpu.finance.cash import CashMove, CashState
+from corda_tpu.flows.core_flows import FinalityFlow
+from corda_tpu.testing.mock_network import MockNetwork
+
+# A contract that exists ONLY as attachment source — never registered
+# in the process-wide registry, so every verifying node (requester,
+# notary, recipient) must load it from the transaction's attachment.
+MAGIC_SOURCE = '''
+from corda_tpu.finance.cash import CashState
+
+class MagicContract:
+    """Cash-like conservation: total in == total out per token."""
+
+    def verify(self, ltx):
+        ins = ltx.inputs_of_type(CashState)
+        outs = ltx.outputs_of_type(CashState)
+        if not ins:
+            return  # issuance
+        total_in = sum(s.amount.quantity for s in ins)
+        total_out = sum(s.amount.quantity for s in outs)
+        if total_in != total_out:
+            raise ContractViolation("magic cash not conserved")
+'''
+
+MAGIC = "demo.magic"
+
+
+def magic_attachment() -> Attachment:
+    return make_contract_attachment(MAGIC, "MagicContract", MAGIC_SOURCE)
+
+
+def test_attachment_roundtrip():
+    att = magic_attachment()
+    name, cls, src = parse_contract_attachment(att)
+    assert (name, cls) == (MAGIC, "MagicContract")
+    assert "not conserved" in src
+    assert parse_contract_attachment(Attachment.of(b"just bytes")) is None
+
+
+def test_contract_ships_with_transaction_end_to_end():
+    """Node A packages the contract as an attachment; the validating
+    notary and node B verify the tx with the attachment-shipped code —
+    no local registration anywhere."""
+    net = MockNetwork(seed=21)
+    notary = net.create_notary("Notary", validating=True)
+    bank = net.create_node("Bank")
+    alice = net.create_node("Alice")
+    bank.run_flow(CashIssueFlow(500, "USD", alice.party, notary.party))
+    st = alice.vault.unconsumed_states(CashState)[0]
+
+    att = magic_attachment()
+    alice.services.attachments.import_attachment(att.data)
+
+    b = TransactionBuilder(notary.party)
+    b.add_input_state(st)
+    b.add_output_state(
+        st.state.data.with_owner(bank.party.owning_key), MAGIC, notary.party
+    )
+    b.add_command(CashMove(), alice.party.owning_key)
+    b.add_attachment(att.id)
+    stx = alice.services.sign_initial_transaction(b)
+    alice.run_flow(FinalityFlow(stx))
+    # the bank recorded a state governed by the attachment-only contract
+    got = [
+        s
+        for s in bank.vault.unconsumed_states(CashState)
+        if s.state.contract == MAGIC
+    ]
+    assert len(got) == 1
+
+
+def test_attachment_contract_rejects_violations():
+    net = MockNetwork(seed=22)
+    notary = net.create_notary("Notary", validating=True)
+    bank = net.create_node("Bank")
+    alice = net.create_node("Alice")
+    bank.run_flow(CashIssueFlow(500, "USD", alice.party, notary.party))
+    st = alice.vault.unconsumed_states(CashState)[0]
+    att = magic_attachment()
+    alice.services.attachments.import_attachment(att.data)
+
+    b = TransactionBuilder(notary.party)
+    b.add_input_state(st)
+    # NOT conserved: 500 in, 400 out
+    out = CashState(
+        type(st.state.data.amount)(400, st.state.data.amount.token),
+        bank.party.owning_key,
+    )
+    b.add_output_state(out, MAGIC, notary.party)
+    b.add_command(CashMove(), alice.party.owning_key)
+    b.add_attachment(att.id)
+    stx = alice.services.sign_initial_transaction(b)
+    with pytest.raises(Exception) as exc:
+        alice.run_flow(FinalityFlow(stx))
+    assert "conserved" in str(exc.value) or "invalid" in str(exc.value).lower()
+
+
+def test_missing_attachment_is_unknown_contract():
+    with pytest.raises(ContractViolation) as exc:
+        contract_from_attachments(MAGIC, [Attachment.of(b"unrelated")])
+    assert "no attachment carries it" in str(exc.value)
+
+
+# -- runtime sandbox ---------------------------------------------------------
+
+
+def test_wall_clock_contract_rejected_statically():
+    src = """
+    import time
+
+    class EvilContract:
+        def verify(self, ltx):
+            if time.time() > 0:
+                return
+    """
+    with pytest.raises(SandboxViolation):
+        load_contract_source(src, "EvilContract")
+
+
+def test_wall_clock_rejected_at_runtime_even_without_audit():
+    src = """
+    class EvilContract:
+        def verify(self, ltx):
+            import time
+            return time.time()
+    """
+    c = load_contract_source(src, "EvilContract", audit=False)
+    with pytest.raises(SandboxViolation):
+        c.verify(None)
+
+
+def test_runaway_recursion_hits_cost_budget():
+    src = """
+    class LoopContract:
+        def verify(self, ltx):
+            self.spin(0)
+
+        def spin(self, n):
+            self.spin(n + 1)
+    """
+    c = load_contract_source(src, "LoopContract", op_budget=5_000)
+    with pytest.raises(CostLimitExceeded):
+        c.verify(None)
+
+
+def test_huge_range_hits_cost_budget():
+    src = """
+    class RangeContract:
+        def verify(self, ltx):
+            total = 0
+            for i in range(10 ** 12):
+                total += i
+    """
+    c = load_contract_source(src, "RangeContract", op_budget=10_000)
+    with pytest.raises(CostLimitExceeded):
+        c.verify(None)
+
+
+def test_budget_resets_between_verifies():
+    src = """
+    class OkContract:
+        def verify(self, ltx):
+            total = 0
+            for i in range(900):
+                total += i
+    """
+    c = load_contract_source(src, "OkContract", op_budget=1_000)
+    for _ in range(5):   # would exhaust a non-resetting budget
+        c.verify(None)
+
+
+def test_forbidden_builtins_absent():
+    src = """
+    class SneakyContract:
+        def verify(self, ltx):
+            open("/etc/passwd")
+    """
+    # static audit catches `open`; without it, NameError at runtime
+    with pytest.raises(SandboxViolation):
+        load_contract_source(src, "SneakyContract")
+    c = load_contract_source(src, "SneakyContract", audit=False)
+    with pytest.raises(NameError):
+        c.verify(None)
+
+
+# -- verifier pool rejects sandboxed failures --------------------------------
+
+
+def test_verifier_pool_rejects_evil_attachment_contracts():
+    """The out-of-process worker verifies a tx whose contract arrives
+    via attachment; wall-clock and runaway code must come back as
+    verification FAILURES (not hangs or worker crashes).
+    Ref: experimental/sandbox wrapping of out-of-process verifiers,
+    docs/source/out-of-process-verification.rst:11-13."""
+    from corda_tpu.node.verifier import (
+        OutOfProcessTransactionVerifierService,
+        VerifierWorker,
+    )
+
+    evil_src = """
+    class EvilContract:
+        def verify(self, ltx):
+            n = 0
+            for i in range(10 ** 12):
+                n += i
+    """
+    net = MockNetwork(seed=23)
+    notary = net.create_notary("Notary")
+    alice = net.create_node("Alice")
+    bank = net.create_node("Bank")
+    bank.run_flow(CashIssueFlow(100, "USD", alice.party, notary.party))
+    st = alice.vault.unconsumed_states(CashState)[0]
+    att = make_contract_attachment("demo.evil", "EvilContract", evil_src)
+    alice.services.attachments.import_attachment(att.data)
+    b = TransactionBuilder(notary.party)
+    b.add_input_state(st)
+    b.add_output_state(
+        st.state.data.with_owner(bank.party.owning_key),
+        "demo.evil",
+        notary.party,
+    )
+    b.add_command(CashMove(), alice.party.owning_key)
+    b.add_attachment(att.id)
+    stx = alice.services.sign_initial_transaction(b)
+    ltx = alice.services.resolve_transaction(stx.wtx)
+
+    svc = OutOfProcessTransactionVerifierService(alice.messaging)
+    VerifierWorker(net.fabric.endpoint("worker-1"), "Alice")
+    net.fabric.run()
+    fut = svc.verify(ltx, stx)
+    net.fabric.run()
+    with pytest.raises(Exception) as exc:
+        fut.result()
+    assert "budget" in str(exc.value)
+
+
+def test_contract_upgrade_via_attachment():
+    """ContractUpgradeFlow code delivery: a node with NO registered
+    upgrade path verifies an upgrade tx whose conversion ships as a
+    sandboxed attachment (ContractUpgradeFlow.kt + AttachmentsClassLoader
+    analogue)."""
+    from corda_tpu.core.contracts import (
+        Amount,
+        CommandWithParties,
+        Issued,
+        PartyAndReference,
+        StateAndRef,
+        StateRef,
+        TransactionState,
+    )
+    from corda_tpu.core.identity import Party
+    from corda_tpu.core.replacement import ContractUpgradeCommand
+    from corda_tpu.core.sandbox import make_contract_attachment
+    from corda_tpu.core.transactions import LedgerTransaction
+    from corda_tpu.crypto import schemes
+    from corda_tpu.crypto.hashes import SecureHash
+    from corda_tpu.finance.cash import CASH_CONTRACT
+
+    upgrade_src = """
+    from corda_tpu.finance.cash import CashState
+
+    class MagicContract:
+        def verify(self, ltx):
+            return
+
+    def convert(old_state):
+        return CashState(old_state.amount, old_state.owner)
+    """
+    att = make_contract_attachment(
+        MAGIC, "MagicContract", upgrade_src, upgrades_from=CASH_CONTRACT
+    )
+
+    kp = schemes.generate_keypair(seed=7)
+    party = Party("X", kp.public)
+    token = Issued(PartyAndReference(party, b"\x01"), "USD")
+    old = CashState(Amount(5, token), kp.public)
+    notary = Party("N", schemes.generate_keypair(seed=8).public)
+    cmd = CommandWithParties(
+        (kp.public,), (party,), ContractUpgradeCommand(CASH_CONTRACT, MAGIC)
+    )
+    ltx = LedgerTransaction(
+        (
+            StateAndRef(
+                TransactionState(old, CASH_CONTRACT, notary),
+                StateRef(SecureHash.sha256(b"a"), 0),
+            ),
+        ),
+        (TransactionState(CashState(old.amount, old.owner), MAGIC, notary),),
+        (cmd,),
+        (att,),
+        notary,
+        None,
+        SecureHash.sha256(b"tx"),
+    )
+    ltx.verify()   # would raise "not authorised" without the attachment
+
+
+def test_module_attribute_escape_blocked():
+    """The dataclasses.sys -> os escape (review finding): allowed
+    modules expose only public non-module names, and underscore
+    attribute access fails the sandbox audit."""
+    src = """
+    import dataclasses
+
+    class EscapeContract:
+        def verify(self, ltx):
+            dataclasses.sys.modules
+    """
+    c = load_contract_source(src, "EscapeContract", audit=False)
+    with pytest.raises(AttributeError):
+        c.verify(None)
+
+
+def test_dunder_traversal_blocked_by_audit():
+    src = """
+    class EscapeContract:
+        def verify(self, ltx):
+            ().__class__.__bases__[0].__subclasses__()
+    """
+    with pytest.raises(SandboxViolation) as exc:
+        load_contract_source(src, "EscapeContract")
+    assert "underscore attribute" in str(exc.value)
+
+
+def test_attachment_code_gate(monkeypatch):
+    monkeypatch.setenv("CORDA_TPU_ATTACHMENT_CODE", "0")
+    with pytest.raises(ContractViolation) as exc:
+        contract_from_attachments(MAGIC, [magic_attachment()])
+    assert "disabled" in str(exc.value)
