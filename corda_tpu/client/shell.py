@@ -48,6 +48,9 @@ class Shell:
         self.client = client
         self.pump = pump
         self.timeout = timeout
+        # live-repaint sink for `flow watch` (the repl sets it to print;
+        # embedded/test use reads the returned final frame instead)
+        self.echo: Optional[Callable[[str], None]] = None
 
     # -- plumbing ------------------------------------------------------------
 
@@ -155,8 +158,17 @@ class Shell:
         args = js.parse_flow_args(
             parts[1] if len(parts) > 1 else "", self._party_resolver()
         )
+        echo = echo if echo is not None else self.echo
         handle = self.wait(self.client.call("start_flow", flow_tag, args))
-        mirror = ProgressTracker()
+        # declared steps (pending rows in the render) come from the
+        # progress feed's snapshot; live labels from the handle's
+        # replayed stream, which missed nothing since flow start
+        try:
+            feed = self.wait(self.client.flow_progress_feed(handle.flow_id))
+            mirror = ProgressTracker(*feed.snapshot.steps)
+            feed.close()
+        except (rpclib.RpcError, TimeoutError):
+            mirror = ProgressTracker()
 
         def on_label(label: str) -> None:
             mirror.current = label
@@ -212,6 +224,8 @@ class Shell:
 
     def repl(self, prompt: str = ">>> ") -> None:
         print("corda_tpu shell — 'help' for commands")
+        if self.echo is None:
+            self.echo = print   # live progress repaints
         while True:
             try:
                 line = input(prompt)

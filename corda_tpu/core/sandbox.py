@@ -56,7 +56,7 @@ ALLOWED_MODULES = (
 _SAFE_BUILTIN_NAMES = (
     "abs", "all", "any", "bool", "bytes", "callable", "chr", "dict",
     "divmod", "enumerate", "filter", "float", "format", "frozenset",
-    "int", "isinstance", "issubclass", "iter", "len", "list", "map",
+    "int", "isinstance", "issubclass", "len", "list", "map",
     "max", "min", "next", "ord", "pow", "property", "repr", "reversed",
     "round", "set", "slice", "sorted", "staticmethod", "classmethod",
     "str", "sum", "super", "tuple", "type", "zip",
@@ -168,8 +168,15 @@ def _sandbox_env(budget_cell: list[int]) -> dict[str, Any]:
             }
         )
 
+    def _iter(obj):
+        # one-arg form only: iter(callable, sentinel) builds infinite
+        # iterators that C-level consumers (any/sum/...) drain without
+        # ever passing an instrumented tick point
+        return iter(obj)
+
     safe = {n: getattr(_b, n) for n in _SAFE_BUILTIN_NAMES}
     safe["range"] = _range
+    safe["iter"] = _iter
     safe["__import__"] = _import
     safe["__build_class__"] = _b.__build_class__
     safe["ContractViolation"] = ContractViolation
@@ -300,6 +307,7 @@ def parse_contract_attachment(
 
 
 _loaded_cache: dict[bytes, tuple[str, SandboxedContract]] = {}
+_upgrade_cache: dict[bytes, Any] = {}
 
 
 def contract_from_attachments(name: str, attachments) -> SandboxedContract:
@@ -352,6 +360,9 @@ def upgrade_from_attachments(
         ):
             continue
         _check_enabled()
+        cached = _upgrade_cache.get(att.id.bytes_)
+        if cached is not None:
+            return cached
         env, budget_cell = _exec_sandboxed(
             source, DEFAULT_OP_BUDGET, audit=True
         )
@@ -370,5 +381,6 @@ def upgrade_from_attachments(
                     "conversion exceeded the recursion limit (cost budget)"
                 ) from e
 
+        _upgrade_cache[att.id.bytes_] = budgeted_convert
         return budgeted_convert
     return None

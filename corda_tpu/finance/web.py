@@ -38,7 +38,7 @@ def _issue(ctx, query, body):
         currency = str(body["currency"])
         recipient = str(body["recipient"])
         notary = str(body["notary"])
-    except (KeyError, ValueError) as e:
+    except (KeyError, TypeError, ValueError) as e:
         return 400, {"error": f"bad issue request: {e}"}
     parties = {}
     for info in ctx.wait(ctx.client.network_map_snapshot()):

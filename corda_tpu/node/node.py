@@ -200,6 +200,11 @@ class Node:
             )
 
         # -- flows, notary, scheduler ----------------------------------
+        # @corda_service instances from the imported cordapps, before
+        # any flow can run (installCordaServices, AbstractNode.kt:226)
+        from .cordapp import install_cordapp_services
+
+        install_cordapp_services(self.services)
         self.smm = StateMachineManager(
             self.services, self.messaging,
             rng=random.Random(self._dev_seed("smm")),

@@ -393,13 +393,14 @@ class PersistentVaultService(VaultService):
         super().__init__(services)
         self._db: NodeDatabase = services.db
         self._ensured_schemas: set[str] = set()
-        self._ensure_schema_tables()
         for row in self._db.query(
             "SELECT ref_tx, ref_index, state, status FROM vault_states"
         ):
             ref = StateRef(SecureHash(bytes(row[0])), row[1])
             ts = ser.decode(bytes(row[2]))
             (self._unconsumed if row[3] == 0 else self._consumed)[ref] = ts
+        # after the state load: table creation backfills from the maps
+        self._ensure_schema_tables()
     def _ensure_schema_tables(self) -> None:
         """Create every registered MappedSchema's table (memoized).
         Runs at open AND before queries: cordapps may register schemas
@@ -417,6 +418,22 @@ class PersistentVaultService(VaultService):
         with self._db.transaction() as conn:
             for schema in missing:
                 conn.execute(schema.ddl())
+                # backfill: states recorded before this schema was
+                # registered (cordapp installed onto an existing node)
+                # must project too, or the SQL and in-memory vaults
+                # answer CustomColumnCriteria differently
+                for ref, ts in list(self._unconsumed.items()) + list(
+                    self._consumed.items()
+                ):
+                    if not isinstance(ts.data, schema.applies_to):
+                        continue
+                    values = schema.row_values(ts.data)
+                    marks = ",".join("?" * (2 + len(values)))
+                    conn.execute(
+                        f"INSERT OR REPLACE INTO {schema.table} VALUES"
+                        f" ({marks})",
+                        (ref.txhash.bytes_, ref.index, *values),
+                    )
                 self._ensured_schemas.add(schema.name)
 
     def query_by(self, criteria, paging=None, sorting=None):

@@ -816,11 +816,23 @@ class ServiceHub:
         self.vault = (vault_factory or VaultService)(self)
         self.transaction_verifier = InMemoryTransactionVerifierService()
         self._batch_verifier = batch_verifier
+        # @corda_service instances, filled by cordapp.install_cordapp_services
+        self.cordapp_services: dict = {}
 
     @property
     def batch_verifier(self) -> BatchSignatureVerifier:
         """The TPU signature-verification SPI for this node."""
         return self._batch_verifier or default_verifier()
+
+    def cordapp_service(self, cls):
+        """This node's instance of a @corda_service class (reference:
+        ServiceHub.cordaService(Class), AbstractNode.kt:226-279)."""
+        svc = self.cordapp_services.get(cls)
+        if svc is None:
+            raise KeyError(
+                f"no @corda_service {cls.__name__} installed on this node"
+            )
+        return svc
 
     # -- recording ----------------------------------------------------------
 

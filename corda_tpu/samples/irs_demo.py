@@ -37,6 +37,7 @@ from ..flows.api import (
     initiating_flow,
 )
 from ..flows.core_flows import CollectSignaturesFlow, FinalityFlow
+from ..node.cordapp import corda_service
 
 IRS_CONTRACT = "corda_tpu.samples.InterestRateSwap"
 
@@ -177,21 +178,36 @@ register_contract(IRS_CONTRACT, InterestRateSwap())
 # -- the oracle (NodeInterestRates) ------------------------------------------
 
 
+@corda_service
 class RateOracleService:
-    """Installed on the oracle node (`services.rate_oracle`): a rate
-    table answering queries and signing fixing tear-offs. The sign
-    check: EVERY revealed component must be an IRSFix command whose
-    rate matches our table — the oracle never sees (and cannot be
-    tricked into signing) anything else (NodeInterestRates.sign)."""
+    """A @corda_service (reference: `@CordaService class Oracle`,
+    NodeInterestRates.kt + AbstractNode.kt:226-279): discovered from
+    the cordapp module and constructed with the ServiceHub on every
+    node that installs it; only nodes whose operator `configure()`s a
+    rate table act as oracles. The sign check: EVERY revealed component
+    must be an IRSFix command whose rate matches our table — the oracle
+    never sees (and cannot be tricked into signing) anything else
+    (NodeInterestRates.sign)."""
 
-    def __init__(self, services, rates: dict[tuple[str, int], int]):
+    def __init__(self, services):
         self.services = services
+        self.rates: Optional[dict[tuple[str, int], int]] = None
+
+    def configure(self, rates: dict[tuple[str, int], int]) -> None:
         self.rates = dict(rates)
 
+    @property
+    def configured(self) -> bool:
+        return self.rates is not None
+
     def query(self, fix_of: FixOf) -> Optional[int]:
+        if self.rates is None:
+            return None
         return self.rates.get((fix_of.name, fix_of.date_micros))
 
     def sign(self, ftx: FilteredTransaction) -> TransactionSignature:
+        if self.rates is None:
+            raise ValueError("this node's oracle is not configured")
         ftx.verify()
         revealed = [
             (g, c) for g, _i, c in ftx.components if g != 6   # not meta
@@ -252,8 +268,11 @@ class OracleQueryHandler(FlowLogic):
 
     def call(self):
         q = yield from self.receive(self.other, RateQuery)
-        oracle = getattr(self.services, "rate_oracle", None)
-        if oracle is None:
+        try:
+            oracle = self.services.cordapp_service(RateOracleService)
+        except KeyError:
+            oracle = None
+        if oracle is None or not oracle.configured:
             raise FlowException("this node is not a rate oracle")
         yield from self.send(
             self.other, RateQueryResponse(oracle.query(q.fix_of))
@@ -288,8 +307,11 @@ class OracleSignHandler(FlowLogic):
 
     def call(self):
         ftx = yield from self.receive(self.other, FilteredTransaction)
-        oracle = getattr(self.services, "rate_oracle", None)
-        if oracle is None:
+        try:
+            oracle = self.services.cordapp_service(RateOracleService)
+        except KeyError:
+            oracle = None
+        if oracle is None or not oracle.configured:
             raise FlowException("this node is not a rate oracle")
         try:
             sig = oracle.sign(ftx)
@@ -394,9 +416,7 @@ def run(seed: int = 42, n_fixings: int = 3):
     now = net.clock.now_micros()
     dates = tuple(now + (i + 1) * 1_000_000 for i in range(n_fixings))
     rates = {("LIBOR-3M", d): 500 + 7 * i for i, d in enumerate(dates)}
-    oracle_node.services.rate_oracle = RateOracleService(
-        oracle_node.services, rates
-    )
+    oracle_node.services.cordapp_service(RateOracleService).configure(rates)
 
     swap = InterestRateSwapState(
         fixed_payer=bank_a.party,

@@ -82,9 +82,8 @@ def run_irs_simulation(seed: int = 42):
 
     now = sim.net.clock.now_micros()
     dates = tuple(now + (i + 1) * 1_000_000 for i in range(2))
-    oracle_node.services.rate_oracle = RateOracleService(
-        oracle_node.services,
-        {("LIBOR-3M", d): 500 + i for i, d in enumerate(dates)},
+    oracle_node.services.cordapp_service(RateOracleService).configure(
+        {("LIBOR-3M", d): 500 + i for i, d in enumerate(dates)}
     )
     swap = InterestRateSwapState(
         bank_a.party, bank_b.party, oracle_node.party,

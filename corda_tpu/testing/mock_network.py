@@ -99,6 +99,9 @@ class MockNode:
                 batch_verifier=network.batch_verifier,
             )
             uniqueness = InMemoryUniquenessProvider
+        from ..node.cordapp import install_cordapp_services
+
+        install_cordapp_services(self.services)
         self.messaging = network.fabric.endpoint(name)
         self.smm = StateMachineManager(
             self.services,

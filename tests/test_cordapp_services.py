@@ -1,0 +1,76 @@
+"""@corda_service discovery + installation (AbstractNode.kt:226-279,427).
+
+The cordapp module decorates a class; every node constructed after the
+module imported gets its own instance via ServiceHub.cordapp_service.
+"""
+
+import pytest
+
+from corda_tpu.node.cordapp import (
+    _SERVICE_REGISTRY,
+    corda_service,
+    install_cordapp_services,
+    registered_services,
+)
+from corda_tpu.testing.mock_network import MockNetwork
+
+
+@pytest.fixture
+def scratch_registry():
+    """Isolate registry mutations so test services don't leak into
+    every other node constructed by the suite."""
+    before = list(_SERVICE_REGISTRY)
+    yield
+    _SERVICE_REGISTRY[:] = before
+
+
+def test_decorated_service_installed_per_node(scratch_registry):
+    @corda_service
+    class CounterService:
+        def __init__(self, services):
+            self.services = services
+            self.count = 0
+
+    assert CounterService in registered_services()
+    net = MockNetwork(seed=41)
+    a = net.create_node("A")
+    b = net.create_node("B")
+    sa = a.services.cordapp_service(CounterService)
+    sb = b.services.cordapp_service(CounterService)
+    assert sa is not sb                      # one instance PER node
+    assert sa.services is a.services
+    sa.count += 1
+    assert sb.count == 0
+
+
+def test_unknown_service_lookup_raises(scratch_registry):
+    class NeverRegistered:
+        pass
+
+    net = MockNetwork(seed=42)
+    a = net.create_node("A")
+    with pytest.raises(KeyError, match="NeverRegistered"):
+        a.services.cordapp_service(NeverRegistered)
+
+
+def test_failing_constructor_aborts_node_start(scratch_registry):
+    @corda_service
+    class BrokenService:
+        def __init__(self, services):
+            raise RuntimeError("boom")
+
+    net = MockNetwork(seed=43)
+    with pytest.raises(RuntimeError, match="BrokenService"):
+        net.create_node("A")
+
+
+def test_irs_oracle_is_a_corda_service():
+    from corda_tpu.samples.irs_demo import RateOracleService
+
+    assert RateOracleService in registered_services()
+    net = MockNetwork(seed=44)
+    node = net.create_node("Oracle")
+    svc = node.services.cordapp_service(RateOracleService)
+    assert not svc.configured
+    svc.configure({("LIBOR-3M", 1): 500})
+    assert svc.configured

@@ -81,7 +81,8 @@ def test_oracle_refuses_wrong_rate_and_extra_reveals():
     b = net.create_node("B")
     oracle_node = net.create_node("Oracle")
     fix_of = FixOf("LIBOR-3M", 1_000)
-    oracle = RateOracleService(oracle_node.services, {("LIBOR-3M", 1_000): 500})
+    oracle = oracle_node.services.cordapp_service(RateOracleService)
+    oracle.configure({("LIBOR-3M", 1_000): 500})
 
     swap = InterestRateSwapState(
         a.party, b.party, oracle_node.party, 1_000_000, 450,

@@ -349,3 +349,16 @@ def test_attachment_code_gate(monkeypatch):
     with pytest.raises(ContractViolation) as exc:
         contract_from_attachments(MAGIC, [magic_attachment()])
     assert "disabled" in str(exc.value)
+
+
+def test_two_arg_iter_bypass_blocked():
+    """iter(callable, sentinel) + C-level drain must not evade the
+    budget (review finding): the two-arg form is rejected outright."""
+    src = """
+    class SpinContract:
+        def verify(self, ltx):
+            return any(x == 1 for x in iter(int, 1))
+    """
+    c = load_contract_source(src, "SpinContract", op_budget=100)
+    with pytest.raises(TypeError):
+        c.verify(None)

@@ -149,3 +149,38 @@ def test_unknown_column_rejected():
     crit = CustomColumnCriteria("cash.v1", "nope", ColumnPredicate("==", 1))
     with pytest.raises(ValueError):
         crit.sql()
+
+
+def test_schema_registered_after_states_backfills(tmp_path):
+    """A cordapp installed onto an existing node registers its schema
+    late: already-recorded states must backfill into the new table so
+    SQL and in-memory answers stay identical (review finding)."""
+    from corda_tpu.node.schemas import _SCHEMA_REGISTRY
+
+    net = MockNetwork(seed=35, db_dir=str(tmp_path))
+    notary = net.create_notary("Notary")
+    bank = net.create_node("Bank")
+    alice = net.create_node("Alice")
+    _issue_mixed(net, bank, alice, notary)
+
+    late = MappedSchema(
+        name="cash.late",
+        version=1,
+        table="cash_late",
+        columns=(("currency", "TEXT"),),
+        applies_to=CashState,
+        project=lambda s: {"currency": str(s.amount.token.product)},
+    )
+    register_schema(late)
+    try:
+        # restart: the reopened vault creates + backfills the new table
+        alice2 = net.restart_node(alice)
+        crit = CustomColumnCriteria(
+            "cash.late", "currency", ColumnPredicate("==", "USD")
+        )
+        page = alice2.vault.query_by(crit)
+        assert sorted(
+            s.state.data.amount.quantity for s in page.states
+        ) == [300, 500]
+    finally:
+        _SCHEMA_REGISTRY.pop("cash.late", None)
