@@ -77,9 +77,18 @@ def ecdsa_verify_batch(
     # as a Pallas kernel with the whole loop VMEM-resident (pallas_ec)
     qx_m, qy_m = to_mont(fp, qx), to_mont(fp, qy)
     if _use_pallas_ladder(use_pallas):
-        from .pallas_ec import wei_ladder_pallas
+        from .pallas_ec import (
+            use_windowed_ladder,
+            wei_ladder_pallas,
+            wei_ladder_windowed_pallas,
+        )
 
-        R = wei_ladder_pallas(curve, u1, u2, qx_m, qy_m)
+        ladder = (
+            wei_ladder_windowed_pallas
+            if use_windowed_ladder()
+            else wei_ladder_pallas
+        )
+        R = ladder(curve, u1, u2, qx_m, qy_m)
     else:
         Q = wei_affine_to_proj(fp, qx_m, qy_m)
         R = wei_double_scalar_mul(curve, u1, u2, Q, nbits=256)

@@ -56,9 +56,18 @@ def ed25519_verify_batch(
     from .pallas_ec import use_pallas_ladder
 
     if use_pallas_ladder(use_pallas):
-        from .pallas_ec import ed_ladder_pallas
+        from .pallas_ec import (
+            ed_ladder_pallas,
+            ed_ladder_windowed_pallas,
+            use_windowed_ladder,
+        )
 
-        R = ed_ladder_pallas(ED25519, s, k, nax_m, nay_m)
+        ladder = (
+            ed_ladder_windowed_pallas
+            if use_windowed_ladder()
+            else ed_ladder_pallas
+        )
+        R = ladder(ED25519, s, k, nax_m, nay_m)
     else:
         A = ed_affine_to_ext(fp, nax_m, nay_m)
         R = ed_double_scalar_mul(ED25519, s, k, A, nbits=256)

@@ -32,14 +32,27 @@ def registered_services() -> tuple[type, ...]:
     return tuple(_SERVICE_REGISTRY)
 
 
-def install_cordapp_services(services) -> dict[type, Any]:
-    """Construct every registered service against this node's hub and
-    expose them via `services.cordapp_service(Cls)`. A service whose
-    constructor raises aborts node start with the class named — silent
-    half-installed CorDapps are worse than a crash (the reference logs
-    and rethrows the same way)."""
+def install_cordapp_services(
+    services, cordapps: Any = None
+) -> dict[type, Any]:
+    """Construct registered services against this node's hub and expose
+    them via `services.cordapp_service(Cls)`.
+
+    `cordapps`: this node's configured cordapp module list — only
+    services defined inside those modules install (the reference scans
+    the node's OWN plugin jars, AbstractNode.kt:427). None installs
+    everything registered in the process (MockNetwork's stance: the
+    classpath is shared, so every node gets every cordapp, matching
+    MockNode). A service whose constructor raises aborts node start
+    with the class named — silent half-installed CorDapps are worse
+    than a crash (the reference logs and rethrows the same way)."""
     installed: dict[type, Any] = {}
     for cls in _SERVICE_REGISTRY:
+        if cordapps is not None and not any(
+            cls.__module__ == m or cls.__module__.startswith(m + ".")
+            for m in cordapps
+        ):
+            continue
         try:
             installed[cls] = cls(services)
         except Exception as e:

@@ -204,7 +204,7 @@ class Node:
         # any flow can run (installCordaServices, AbstractNode.kt:226)
         from .cordapp import install_cordapp_services
 
-        install_cordapp_services(self.services)
+        install_cordapp_services(self.services, config.cordapps)
         self.smm = StateMachineManager(
             self.services, self.messaging,
             rng=random.Random(self._dev_seed("smm")),

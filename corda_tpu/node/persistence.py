@@ -434,7 +434,10 @@ class PersistentVaultService(VaultService):
                         f" ({marks})",
                         (ref.txhash.bytes_, ref.index, *values),
                     )
-                self._ensured_schemas.add(schema.name)
+        # memoize only after the transaction committed: a rolled-back
+        # CREATE TABLE must not leave the schema marked as ensured
+        for schema in missing:
+            self._ensured_schemas.add(schema.name)
 
     def query_by(self, criteria, paging=None, sorting=None):
         """Same criteria AST as the in-memory vault, compiled to SQL

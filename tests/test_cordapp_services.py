@@ -74,3 +74,32 @@ def test_irs_oracle_is_a_corda_service():
     assert not svc.configured
     svc.configure({("LIBOR-3M", 1): 500})
     assert svc.configured
+
+
+def test_install_filters_by_node_cordapp_list(scratch_registry):
+    """A real node installs only services defined inside ITS configured
+    cordapp modules (review finding): co-hosted nodes must not inherit
+    each other's services from the process-global registry."""
+    from corda_tpu.node.cordapp import install_cordapp_services
+
+    @corda_service
+    class HereService:
+        __module__ = "corda_tpu.finance.cash"
+
+        def __init__(self, services):
+            self.services = services
+
+    @corda_service
+    class ElsewhereService:
+        __module__ = "some.other.cordapp"
+
+        def __init__(self, services):
+            raise RuntimeError("must not be constructed")
+
+    class Hub:
+        pass
+
+    hub = Hub()
+    installed = install_cordapp_services(hub, cordapps=("corda_tpu.finance",))
+    assert any(c.__name__ == "HereService" for c in installed)
+    assert not any(c.__name__ == "ElsewhereService" for c in installed)

@@ -174,3 +174,78 @@ def test_ed_double_scalar_mul():
             refmath.ed_mul(c, ks[i], apts[i]),
         )
         assert (gx[i], gy[i]) == want, f"case {i}"
+
+
+def test_windowed_double_scalar_mul_matches_plain():
+    """w=4 fixed-window Shamir (ec.wei_double_scalar_mul_windowed) must
+    agree with the plain ladder for full-width scalars on both curves —
+    same affine result, any projective representative."""
+    import random
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from corda_tpu.crypto import ec, limbs as L, modmath as mm
+    from corda_tpu.crypto.curves import SECP256K1, SECP256R1
+
+    rng = random.Random(23)
+    for curve in (SECP256R1, SECP256K1):
+        from corda_tpu.crypto import refmath
+
+        B = 3
+        u1s = [rng.randrange(1, curve.n) for _ in range(B)]
+        u2s = [rng.randrange(1, curve.n) for _ in range(B)]
+        qs = [
+            refmath.wei_mul(curve, rng.randrange(1, curve.n), (curve.gx, curve.gy))
+            for _ in range(B)
+        ]
+        u1 = jnp.asarray(L.ints_to_batch(u1s))
+        u2 = jnp.asarray(L.ints_to_batch(u2s))
+        qx = mm.to_mont(curve.fp, jnp.asarray(L.ints_to_batch([q[0] for q in qs])))
+        qy = mm.to_mont(curve.fp, jnp.asarray(L.ints_to_batch([q[1] for q in qs])))
+        Q = ec.wei_affine_to_proj(curve.fp, qx, qy)
+        Xw, _, Zw = ec.wei_double_scalar_mul_windowed(curve, u1, u2, Q)
+        Xp, _, Zp = ec.wei_double_scalar_mul(curve, u1, u2, Q)
+        xw = L.batch_to_ints(np.asarray(Xw))
+        zw = L.batch_to_ints(np.asarray(Zw))
+        xp = L.batch_to_ints(np.asarray(Xp))
+        zp = L.batch_to_ints(np.asarray(Zp))
+        for i in range(B):
+            aff_w = (xw[i] * pow(zw[i], -1, curve.p)) % curve.p
+            aff_p = (xp[i] * pow(zp[i], -1, curve.p)) % curve.p
+            assert aff_w == aff_p
+
+
+def test_ed_windowed_double_scalar_mul_matches_plain():
+    import random
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from corda_tpu.crypto import ec, limbs as L, modmath as mm, refmath
+    from corda_tpu.crypto.curves import ED25519
+
+    curve = ED25519
+    rng = random.Random(29)
+    B = 3
+    ss = [rng.randrange(1, curve.L) for _ in range(B)]
+    ks = [rng.randrange(1, curve.L) for _ in range(B)]
+    As = [
+        refmath.ed_mul(curve, rng.randrange(1, curve.L), (curve.gx, curve.gy))
+        for _ in range(B)
+    ]
+    s = jnp.asarray(L.ints_to_batch(ss))
+    k = jnp.asarray(L.ints_to_batch(ks))
+    ax = mm.to_mont(curve.fp, jnp.asarray(L.ints_to_batch([a[0] for a in As])))
+    ay = mm.to_mont(curve.fp, jnp.asarray(L.ints_to_batch([a[1] for a in As])))
+    A = ec.ed_affine_to_ext(curve.fp, ax, ay)
+    Xw, Yw, Zw, _ = ec.ed_double_scalar_mul_windowed(curve, s, k, A)
+    Xp, Yp, Zp, _ = ec.ed_double_scalar_mul(curve, s, k, A)
+    for i in range(B):
+        xw = L.batch_to_ints(np.asarray(Xw))[i]
+        zw = L.batch_to_ints(np.asarray(Zw))[i]
+        xp = L.batch_to_ints(np.asarray(Xp))[i]
+        zp = L.batch_to_ints(np.asarray(Zp))[i]
+        assert (xw * pow(zw, -1, curve.p)) % curve.p == (
+            xp * pow(zp, -1, curve.p)
+        ) % curve.p

@@ -143,3 +143,15 @@ def test_network_simulation_trace():
     assert any("OracleSignHandler" in line for line in trace)
     kinds = {e.kind for e in sim.events}
     assert {"flow-added", "flow-removed"} <= kinds
+
+
+def test_trader_demo_via_rpc():
+    """The RPC-driven arc (TraderDemoClientApi shape): buyer and seller
+    act through CordaRPCOps only; the report comes from vault queries
+    over RPC."""
+    from corda_tpu.samples.trader_demo import run_via_rpc
+
+    report = run_via_rpc(face=50_000, price=46_000)
+    assert report["buyer_paper"] == 1
+    assert report["seller_cash"] == 46_000
+    assert report["buyer_cash"] == 8_000
