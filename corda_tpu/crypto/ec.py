@@ -190,6 +190,25 @@ def _g_table_mont(curve: WeierstrassCurve, size: int):
     return pts
 
 
+def wei_window_tables(curve: WeierstrassCurve, Q, batch: int, w: int = 4):
+    """(g_tab, q_tab) for the w-bit windowed double-scalar-mult: entry
+    0 of both is the point at infinity (absorbed by the complete
+    formulas), G entries are host constants, Q entries a complete-add
+    chain. ONE definition shared by the XLA function and the Pallas
+    kernels — the table conventions are crypto-sensitive."""
+    ctx = curve.fp
+    inf = wei_infinity(ctx, batch)
+    one = mont_one(ctx, batch)
+    g_tab = [inf] + [
+        (const_batch(gx_i, batch), const_batch(gy_i, batch), one)
+        for gx_i, gy_i in _g_table_mont(curve, 1 << w)
+    ]
+    q_tab = [inf, Q]
+    for _ in range(2, 1 << w):
+        q_tab.append(wei_add(curve, q_tab[-1], Q))
+    return g_tab, q_tab
+
+
 def wei_double_scalar_mul_windowed(
     curve: WeierstrassCurve, u1, u2, Q, nbits: int = 256, w: int = 4
 ):
@@ -207,17 +226,7 @@ def wei_double_scalar_mul_windowed(
     ctx = curve.fp
     batch = u1.shape[1]
     inf = wei_infinity(ctx, batch)
-    one = mont_one(ctx, batch)
-
-    g_tab = [inf]
-    for gx_i, gy_i in _g_table_mont(curve, 1 << w):
-        g_tab.append(
-            (const_batch(gx_i, batch), const_batch(gy_i, batch), one)
-        )
-
-    q_tab = [inf, Q]
-    for _ in range(2, 1 << w):
-        q_tab.append(wei_add(curve, q_tab[-1], Q))
+    g_tab, q_tab = wei_window_tables(curve, Q, batch, w)
 
     nwin = nbits // w
 
@@ -341,6 +350,28 @@ def _b_table_mont(curve: EdwardsCurve, size: int):
     return pts
 
 
+def ed_window_tables(curve: EdwardsCurve, A, batch: int, w: int = 4):
+    """(b_tab, a_tab) for the windowed Edwards double-scalar-mult;
+    shared by the XLA function and the Pallas kernel (see
+    wei_window_tables)."""
+    ctx = curve.fp
+    ident = ed_identity(ctx, batch)
+    one = mont_one(ctx, batch)
+    b_tab = [ident] + [
+        (
+            const_batch(bx_i, batch),
+            const_batch(by_i, batch),
+            one,
+            const_batch(bt_i, batch),
+        )
+        for bx_i, by_i, bt_i in _b_table_mont(curve, 1 << w)
+    ]
+    a_tab = [ident, A]
+    for _ in range(2, 1 << w):
+        a_tab.append(ed_add(curve, a_tab[-1], A))
+    return b_tab, a_tab
+
+
 def ed_double_scalar_mul_windowed(
     curve: EdwardsCurve, s, k, A, nbits: int = 256, w: int = 4
 ):
@@ -351,22 +382,7 @@ def ed_double_scalar_mul_windowed(
     ctx = curve.fp
     batch = s.shape[1]
     ident = ed_identity(ctx, batch)
-    one = mont_one(ctx, batch)
-
-    b_tab = [ident]
-    for bx_i, by_i, bt_i in _b_table_mont(curve, 1 << w):
-        b_tab.append(
-            (
-                const_batch(bx_i, batch),
-                const_batch(by_i, batch),
-                one,
-                const_batch(bt_i, batch),
-            )
-        )
-
-    a_tab = [ident, A]
-    for _ in range(2, 1 << w):
-        a_tab.append(ed_add(curve, a_tab[-1], A))
+    b_tab, a_tab = ed_window_tables(curve, A, batch, w)
 
     nwin = nbits // w
 

@@ -185,21 +185,12 @@ def wei_ladder_windowed_pallas(
     batch = u1.shape[1]
     block = _fit_block(batch, _block_or_default(block))
 
-    g_ints = ec._g_table_mont(curve, 16)
-
     def kernel(u1_ref, u2_ref, qx_ref, qy_ref, x_ref, y_ref, z_ref):
         with scalar_consts_mode():
             ctx = curve.fp
             Q = ec.wei_affine_to_proj(ctx, qx_ref[:], qy_ref[:])
             inf = ec.wei_infinity(ctx, block)
-            one = mont_one(ctx, block)
-            g_tab = [inf] + [
-                (const_batch(gx_i, block), const_batch(gy_i, block), one)
-                for gx_i, gy_i in g_ints
-            ]
-            q_tab = [inf, Q]
-            for _ in range(2, 16):
-                q_tab.append(ec.wei_add(curve, q_tab[-1], Q))
+            g_tab, q_tab = ec.wei_window_tables(curve, Q, block, w=4)
 
             acc = inf
             for limb in range(limbs - 1, -1, -1):
@@ -255,26 +246,12 @@ def ed_ladder_windowed_pallas(
     batch = s.shape[1]
     block = _fit_block(batch, _block_or_default(block))
 
-    b_ints = ec._b_table_mont(curve, 16)
-
     def kernel(s_ref, k_ref, ax_ref, ay_ref, x_ref, y_ref, z_ref, t_ref):
         with scalar_consts_mode():
             ctx = curve.fp
             A = ec.ed_affine_to_ext(ctx, ax_ref[:], ay_ref[:])
             ident = ec.ed_identity(ctx, block)
-            one = mont_one(ctx, block)
-            b_tab = [ident] + [
-                (
-                    const_batch(bx_i, block),
-                    const_batch(by_i, block),
-                    one,
-                    const_batch(bt_i, block),
-                )
-                for bx_i, by_i, bt_i in b_ints
-            ]
-            a_tab = [ident, A]
-            for _ in range(2, 16):
-                a_tab.append(ec.ed_add(curve, a_tab[-1], A))
+            b_tab, a_tab = ec.ed_window_tables(curve, A, block, w=4)
 
             acc = ident
             for limb in range(limbs - 1, -1, -1):

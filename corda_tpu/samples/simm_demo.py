@@ -2,10 +2,13 @@
 
 Reference: samples/simm-valuation-demo/ — two parties value their
 shared IRS portfolio under the ISDA SIMM (OpenGamma does the maths
-there), then AGREE the valuation on ledger. The heavy quant library is
-out of scope; the demo keeps the structure: a deterministic margin
-function both sides compute independently and must agree on, recorded
-as a mutually-signed state.
+there), then AGREE the valuation on ledger. Here the margin comes from
+corda_tpu/samples/simm.py — a SIMM-structured IR-delta calculator
+(tenor-bucketed PV01 ladders, risk weights, correlated intra-/cross-
+bucket aggregation, the quadratic form as one TPU matmul) with openly
+parameterised weights (ISDA's exact tables are versioned/licensed).
+Both sides compute it independently and must agree bit-for-bit before
+the mutually-signed valuation records.
 """
 
 from __future__ import annotations
@@ -20,19 +23,25 @@ from .irs_demo import InterestRateSwapState
 SIMM_CONTRACT = "corda_tpu.samples.PortfolioValuation"
 
 
-def initial_margin(swaps: list[InterestRateSwapState]) -> int:
-    """A stylised SIMM stand-in: deterministic integer margin from the
-    portfolio's notionals and rates (the reference delegates to
-    OpenGamma; the ledger only cares both sides compute the SAME
-    number)."""
-    margin = 0
+def initial_margin(
+    swaps: list[InterestRateSwapState], now_micros: int = 0
+) -> int:
+    """ISDA-SIMM-structured IR-delta margin for the portfolio (the
+    reference delegates to OpenGamma; corda_tpu/samples/simm.py carries
+    the SIMM structure: tenor-bucketed PV01 ladders, risk weights,
+    correlation-weighted intra- and cross-bucket aggregation, with the
+    quadratic form as one TPU matmul). Deterministic: both parties run
+    the same float64 op order and agree bit-for-bit."""
+    from . import simm
+
+    buckets: dict = {}
     for s in swaps:
-        # weight by residual fixings: more unfixed dates, more risk
-        unfixed = len(s.fixing_dates) - len(s.fixings)
-        margin += (s.notional * (100 + s.fixed_rate_bps) // 10_000) * (
-            1 + unfixed
-        ) // 25
-    return margin
+        last = max(s.fixing_dates) if s.fixing_dates else now_micros
+        years = max((last - now_micros) / (365.25 * 24 * 3600 * 1e6), 0.0)
+        ladder = simm.bucket_pv01(s.notional, years)
+        ccy = s.index_name.split("-")[0]   # index family as the bucket
+        buckets[ccy] = buckets.get(ccy, 0) + ladder
+    return simm.simm_im(buckets)
 
 
 @ser.serializable
@@ -101,7 +110,9 @@ def run(seed: int = 42, n_swaps: int = 3):
             notional=1_000_000 * (i + 1),
             fixed_rate_bps=400 + 25 * i,
             index_name="LIBOR-3M",
-            fixing_dates=(now + (i + 2) * 10**7,),
+            # fixings out at (i+1) years: gives the portfolio real
+            # PV01 mass on the SIMM tenor ladder
+            fixing_dates=(now + (i + 1) * 31_557_600 * 10**6,),
         )
         fsm = a.start_flow(StartSwapFlow(swap, notary.party))
         net.run()
@@ -114,8 +125,8 @@ def run(seed: int = 42, n_swaps: int = 3):
     portfolio_b = [
         s.state.data for s in b.vault.unconsumed_states(InterestRateSwapState)
     ]
-    margin_a = initial_margin(portfolio_a)
-    margin_b = initial_margin(portfolio_b)
+    margin_a = initial_margin(portfolio_a, now)
+    margin_b = initial_margin(portfolio_b, now)
     assert margin_a == margin_b, "valuations must agree before signing"
 
     valuation = PortfolioValuationState(

@@ -155,3 +155,37 @@ def test_trader_demo_via_rpc():
     assert report["buyer_paper"] == 1
     assert report["seller_cash"] == 46_000
     assert report["buyer_cash"] == 8_000
+
+
+def test_simm_calculator_properties():
+    """The SIMM calculator behaves like SIMM: sub-additive under
+    netting, monotone in notional, symmetric in sign, and equal on
+    both backends (TPU matmul vs numpy)."""
+    import numpy as np
+
+    from corda_tpu.samples import simm
+
+    lad = simm.bucket_pv01(10_000_000, 5.0)
+    assert lad.sum() > 0 and np.count_nonzero(lad) <= 2
+
+    im_one = simm.simm_im({"LIBOR": lad})
+    assert im_one > 0
+    # doubling the notional doubles the margin (homogeneous of deg 1)
+    assert abs(simm.simm_im({"LIBOR": 2 * lad}) - 2 * im_one) <= 1
+    # exactly offsetting positions net to ~zero margin
+    assert simm.simm_im({"LIBOR": lad - lad}) == 0
+    # two currencies with gamma < 1 give diversification benefit
+    both = simm.simm_im({"LIBOR": lad, "EURIBOR": lad})
+    assert im_one < both < 2 * im_one
+    # the analytics batch estimate tracks the consensus number (it may
+    # run float32 on device, so close-but-not-bit-equal is the contract)
+    est = simm.estimate_margins_batch(lad[None, :])[0]
+    k, _ = simm.bucket_margins(lad[None, :])
+    assert abs(est - k[0]) / k[0] < 1e-5
+
+
+def test_simm_demo_portfolio_margin_positive():
+    from corda_tpu.samples import simm_demo
+
+    v = simm_demo.run(n_swaps=2)
+    assert v.margin > 0
