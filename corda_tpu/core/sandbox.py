@@ -29,6 +29,7 @@ from __future__ import annotations
 import ast
 import json
 import textwrap
+from collections import OrderedDict
 from typing import Any, Optional
 
 from .contracts import Attachment, ContractViolation
@@ -54,10 +55,14 @@ ALLOWED_MODULES = (
 )
 
 _SAFE_BUILTIN_NAMES = (
+    # NB deliberately absent: `pow` (unmetered big-int exponentiation is
+    # an op-budget bypass) and `format`/str.format (format-string
+    # attribute traversal — '{0.__class__}' — is invisible to the
+    # static underscore-attribute audit because it is a string constant)
     "abs", "all", "any", "bool", "bytes", "callable", "chr", "dict",
-    "divmod", "enumerate", "filter", "float", "format", "frozenset",
+    "divmod", "enumerate", "filter", "float", "frozenset",
     "int", "isinstance", "issubclass", "len", "list", "map",
-    "max", "min", "next", "ord", "pow", "property", "repr", "reversed",
+    "max", "min", "next", "ord", "property", "repr", "reversed",
     "round", "set", "slice", "sorted", "staticmethod", "classmethod",
     "str", "sum", "super", "tuple", "type", "zip",
     # exception types contract code raises/catches
@@ -91,8 +96,20 @@ class CostLimitExceeded(ContractViolation):
 
 class _Instrument(ast.NodeTransformer):
     """Inject `__corda_tick__()` at every function entry and loop-body
-    iteration — the AST analogue of the reference's bytecode
-    instrumentation (costing/RuntimeCostAccounter.java)."""
+    iteration, and route growth-capable binary operators (`*`, `+`,
+    `<<`) through the size-guarded `__corda_binop__` — the AST analogue
+    of the reference's bytecode instrumentation
+    (costing/RuntimeCostAccounter.java). The binop guard closes the
+    "single unmetered expression" budget bypass ('a' * 10**9,
+    s = s + s doubling, 1 << huge): each guarded op ticks AND bounds
+    the result size before computing it."""
+
+    # operators that can grow data superlinearly per evaluation; `**`
+    # is audit-rejected outright in sandbox mode but lands on the
+    # guard's refusal branch if a caller runs with audit=False
+    _GUARDED_OPS = {
+        ast.Mult: "*", ast.Add: "+", ast.LShift: "<<", ast.Pow: "**",
+    }
 
     @staticmethod
     def _tick() -> ast.stmt:
@@ -123,6 +140,117 @@ class _Instrument(ast.NodeTransformer):
         # case a caller runs with audit=False
         return self._with_tick(node)
 
+    def _guard_call(self, sym: str, left, right, at):
+        return ast.copy_location(
+            ast.Call(
+                func=ast.Name("__corda_binop__", ast.Load()),
+                args=[ast.copy_location(ast.Constant(sym), at), left, right],
+                keywords=[],
+            ),
+            at,
+        )
+
+    def visit_BinOp(self, node):
+        self.generic_visit(node)
+        sym = self._GUARDED_OPS.get(type(node.op))
+        if sym is None:
+            return node
+        return self._guard_call(sym, node.left, node.right, node)
+
+    def visit_AugAssign(self, node):
+        self.generic_visit(node)
+        sym = self._GUARDED_OPS.get(type(node.op))
+        if sym is None:
+            return node
+        # desugar `target op= value` into `target = guard(target, value)`
+        # (in-place list aliasing semantics are not preserved, an
+        # accepted sandbox deviation). Attribute/Subscript targets
+        # evaluate their object/index subexpressions into temps FIRST —
+        # naively re-evaluating the target as a Load would run a
+        # side-effecting index (xs[next(it)] += 1) twice.
+        import copy as _copy
+
+        def assign_tmp(name: str, value) -> ast.stmt:
+            return ast.copy_location(
+                ast.Assign(
+                    targets=[ast.copy_location(
+                        ast.Name(name, ast.Store()), node)],
+                    value=value,
+                ),
+                node,
+            )
+
+        if isinstance(node.target, ast.Name):
+            load = ast.copy_location(
+                ast.Name(node.target.id, ast.Load()), node
+            )
+            return ast.copy_location(
+                ast.Assign(
+                    targets=[node.target],
+                    value=self._guard_call(sym, load, node.value, node),
+                ),
+                node,
+            )
+        if isinstance(node.target, ast.Attribute):
+            pre = assign_tmp("__corda_aug_obj__", node.target.value)
+            obj = ast.copy_location(
+                ast.Name("__corda_aug_obj__", ast.Load()), node
+            )
+            load = ast.copy_location(
+                ast.Attribute(obj, node.target.attr, ast.Load()), node
+            )
+            store = ast.copy_location(
+                ast.Attribute(
+                    _copy.deepcopy(obj), node.target.attr, ast.Store()
+                ),
+                node,
+            )
+        elif isinstance(node.target, ast.Subscript):
+            obj = ast.copy_location(
+                ast.Name("__corda_aug_obj__", ast.Load()), node
+            )
+            if isinstance(node.target.slice, ast.Slice):
+                # a Slice node cannot be hoisted into a temp; its
+                # bounds are re-evaluated (plain names/constants in
+                # practice — slice-assignment with side-effecting
+                # bounds keeps the (documented) re-evaluation caveat)
+                pre = [assign_tmp("__corda_aug_obj__", node.target.value)]
+                key = node.target.slice
+            else:
+                pre = [
+                    assign_tmp("__corda_aug_obj__", node.target.value),
+                    assign_tmp("__corda_aug_key__", node.target.slice),
+                ]
+                key = ast.copy_location(
+                    ast.Name("__corda_aug_key__", ast.Load()), node
+                )
+            load = ast.copy_location(ast.Subscript(obj, key, ast.Load()), node)
+            store = ast.copy_location(
+                ast.Subscript(
+                    _copy.deepcopy(obj), _copy.deepcopy(key), ast.Store()
+                ),
+                node,
+            )
+        else:   # pragma: no cover - not reachable via augassign grammar
+            raise SandboxViolation("unsupported augmented-assignment target")
+        assign = ast.copy_location(
+            ast.Assign(
+                targets=[store],
+                value=self._guard_call(sym, load, node.value, node),
+            ),
+            node,
+        )
+        out = pre if isinstance(pre, list) else [pre]
+        return out + [assign]
+
+
+# growth bounds enforced by __corda_binop__: generous for legitimate
+# contract math (crypto-sized ints, component lists), far below DoS size
+MAX_INT_BITS = 8192
+MAX_SEQ_LEN = 1_000_000
+
+_SIZED = (str, bytes, list, tuple)
+
 
 def _sandbox_env(budget_cell: list[int]) -> dict[str, Any]:
     import builtins as _b
@@ -133,6 +261,47 @@ def _sandbox_env(budget_cell: list[int]) -> dict[str, Any]:
             raise CostLimitExceeded(
                 "contract exceeded its operation budget"
             )
+
+    def __corda_binop__(sym: str, a, b):
+        __corda_tick__()
+        if sym == "*":
+            if isinstance(a, int) and isinstance(b, _SIZED):
+                a, b = b, a
+            if isinstance(a, _SIZED) and isinstance(b, int):
+                if b > 0 and len(a) * b > MAX_SEQ_LEN:
+                    raise CostLimitExceeded(
+                        f"sequence repetition of {len(a) * b} elements "
+                        f"exceeds the {MAX_SEQ_LEN}-element cap"
+                    )
+            elif isinstance(a, int) and isinstance(b, int):
+                if a.bit_length() + b.bit_length() > MAX_INT_BITS:
+                    raise CostLimitExceeded(
+                        f"integer product exceeds {MAX_INT_BITS} bits"
+                    )
+            return a * b
+        if sym == "+":
+            if (
+                isinstance(a, _SIZED)
+                and isinstance(b, _SIZED)
+                and len(a) + len(b) > MAX_SEQ_LEN
+            ):
+                raise CostLimitExceeded(
+                    f"concatenation of {len(a) + len(b)} elements "
+                    f"exceeds the {MAX_SEQ_LEN}-element cap"
+                )
+            return a + b
+        if sym == "<<":
+            if isinstance(a, int) and isinstance(b, int):
+                if b > MAX_INT_BITS or a.bit_length() + b > MAX_INT_BITS:
+                    raise CostLimitExceeded(
+                        f"left shift result exceeds {MAX_INT_BITS} bits"
+                    )
+            return a << b
+        # `**`: audit-rejected in sandbox mode; refuse even with
+        # audit=False — unmetered exponentiation is the budget bypass
+        raise SandboxViolation(
+            f"operator {sym!r} is not permitted in sandboxed contract code"
+        )
 
     def _range(*args):
         r = range(*args)
@@ -183,6 +352,7 @@ def _sandbox_env(budget_cell: list[int]) -> dict[str, Any]:
     return {
         "__builtins__": safe,
         "__corda_tick__": __corda_tick__,
+        "__corda_binop__": __corda_binop__,
         "__name__": "corda_contract_sandbox",
     }
 
@@ -306,23 +476,56 @@ def parse_contract_attachment(
         return None
 
 
-_loaded_cache: dict[bytes, tuple[str, SandboxedContract]] = {}
-_upgrade_cache: dict[bytes, Any] = {}
+class OverlappingAttachments(ContractViolation):
+    """Two attachments with different hashes both claim to provide the
+    same contract — ambiguous code identity the verifier must refuse
+    (AttachmentsClassLoader.kt:28,43-47 `OverlappingAttachments`)."""
+
+
+# bounded LRU caches keyed by attachment hash: a long-running notary
+# seeing unique attachments (attacker or churn) must not grow compiled
+# SandboxedContract objects without eviction
+_CACHE_CAP = 128
+_loaded_cache: OrderedDict = OrderedDict()
+_upgrade_cache: OrderedDict = OrderedDict()
+
+
+def _cache_get(cache, key):
+    val = cache.get(key)
+    if val is not None:
+        cache.move_to_end(key)
+    return val
+
+
+def _cache_put(cache, key, val) -> None:
+    cache[key] = val
+    cache.move_to_end(key)
+    while len(cache) > _CACHE_CAP:
+        cache.popitem(last=False)
 
 
 def contract_from_attachments(name: str, attachments) -> SandboxedContract:
     """Resolve contract `name` from a transaction's attachments
     (AttachmentsClassLoader.kt:23 analogue). The attachment hash is
     referenced by the transaction, so the loaded code is exactly what
-    the signers signed over. Cached by attachment id."""
+    the signers signed over. Cached by attachment id.
+
+    Scans ALL attachments: two distinct attachments claiming the same
+    contract raise OverlappingAttachments rather than silently running
+    whichever sorts first (AttachmentsClassLoader.kt:43-47)."""
     _check_enabled()
+    matches: list[tuple[Any, str, str]] = []   # (att, class_name, source)
+    seen_ids: set[bytes] = set()
     for att in attachments:
         if not isinstance(att, Attachment):
             continue
-        cached = _loaded_cache.get(att.id.bytes_)
+        if att.id.bytes_ in seen_ids:
+            continue   # the same attachment listed twice is not ambiguous
+        cached = _cache_get(_loaded_cache, att.id.bytes_)
         if cached is not None:
             if cached[0] == name:
-                return cached[1]
+                seen_ids.add(att.id.bytes_)
+                matches.append((att, "", ""))
             continue
         parsed = parse_contract_attachment(att)
         if parsed is None:
@@ -330,13 +533,26 @@ def contract_from_attachments(name: str, attachments) -> SandboxedContract:
         att_name, class_name, source = parsed
         if att_name != name:
             continue
-        contract = load_contract_source(source, class_name)
-        _loaded_cache[att.id.bytes_] = (att_name, contract)
-        return contract
-    raise ContractViolation(
-        f"unknown contract {name!r}: not installed and no attachment "
-        "carries it"
-    )
+        seen_ids.add(att.id.bytes_)
+        matches.append((att, class_name, source))
+    if not matches:
+        raise ContractViolation(
+            f"unknown contract {name!r}: not installed and no attachment "
+            "carries it"
+        )
+    if len(matches) > 1:
+        hashes = ", ".join(m[0].id.bytes_.hex()[:16] for m in matches)
+        raise OverlappingAttachments(
+            f"{len(matches)} attachments declare contract {name!r} "
+            f"({hashes}): ambiguous contract code identity"
+        )
+    att, class_name, source = matches[0]
+    cached = _cache_get(_loaded_cache, att.id.bytes_)
+    if cached is not None:
+        return cached[1]
+    contract = load_contract_source(source, class_name)
+    _cache_put(_loaded_cache, att.id.bytes_, (name, contract))
+    return contract
 
 
 def upgrade_from_attachments(
@@ -360,7 +576,7 @@ def upgrade_from_attachments(
         ):
             continue
         _check_enabled()
-        cached = _upgrade_cache.get(att.id.bytes_)
+        cached = _cache_get(_upgrade_cache, att.id.bytes_)
         if cached is not None:
             return cached
         env, budget_cell = _exec_sandboxed(
@@ -381,6 +597,6 @@ def upgrade_from_attachments(
                     "conversion exceeded the recursion limit (cost budget)"
                 ) from e
 
-        _upgrade_cache[att.id.bytes_] = budgeted_convert
+        _cache_put(_upgrade_cache, att.id.bytes_, budgeted_convert)
         return budgeted_convert
     return None

@@ -39,6 +39,11 @@ FORBIDDEN_ATTRS = {
     "now", "today", "urandom", "getrandbits", "random", "randint",
     "choice", "shuffle", "time", "time_ns", "monotonic", "perf_counter",
 }
+# names additionally unavailable to UNREVIEWED attachment code (sandbox
+# mode): pow is an unmetered-exponentiation budget bypass, format is a
+# format-string attribute-traversal leak (core/sandbox.py removes both
+# from the runtime builtins; the audit makes the failure a load-time one)
+SANDBOX_FORBIDDEN_NAMES = {"pow", "format"}
 
 
 @dataclass(frozen=True)
@@ -86,6 +91,12 @@ class _Auditor(ast.NodeVisitor):
                 self._flag(node, f"uses forbidden builtin {node.id!r}")
             if node.id in FORBIDDEN_MODULES:
                 self._flag(node, f"references module {node.id!r}")
+            if self.sandbox and node.id in SANDBOX_FORBIDDEN_NAMES:
+                self._flag(
+                    node,
+                    f"{node.id!r} is not available in sandboxed contract "
+                    "code",
+                )
         self.generic_visit(node)
 
     def visit_Attribute(self, node: ast.Attribute) -> None:
@@ -98,6 +109,29 @@ class _Auditor(ast.NodeVisitor):
             self._flag(
                 node, f"underscore attribute access .{node.attr} is forbidden"
             )
+        if self.sandbox and node.attr in ("format", "format_map"):
+            # '{0.__class__.__init__.__globals__}'.format(x) traverses
+            # attributes via a string constant the static underscore
+            # audit cannot see
+            self._flag(
+                node,
+                f".{node.attr} format-string methods are forbidden in "
+                "sandboxed contract code",
+            )
+        self.generic_visit(node)
+
+    def visit_BinOp(self, node: ast.BinOp) -> None:
+        if self.sandbox and isinstance(node.op, ast.Pow):
+            # unmetered exponentiation (10**10**8) bypasses the tick
+            # budget in a single expression
+            self._flag(node, "the ** operator is forbidden in sandboxed "
+                             "contract code")
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        if self.sandbox and isinstance(node.op, ast.Pow):
+            self._flag(node, "the **= operator is forbidden in sandboxed "
+                             "contract code")
         self.generic_visit(node)
 
     def visit_While(self, node: ast.While) -> None:

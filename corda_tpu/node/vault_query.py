@@ -349,9 +349,18 @@ class CustomColumnCriteria(QueryCriteria):
         return schema_by_name(self.schema_name)
 
     def matches(self, row: VaultRow) -> bool:
+        schema = self._schema()
+        # keep backend parity: the SQL path raises on an unknown
+        # column, so the in-memory path must too (not return False).
+        # Validated once per criteria (matches runs per vault row).
+        if not self.__dict__.get("_column_ok"):
+            if self.column not in {c for c, _ in schema.columns}:
+                raise ValueError(
+                    f"schema {schema.name!r} has no column {self.column!r}"
+                )
+            object.__setattr__(self, "_column_ok", True)
         if not _status_match(self.status, row):
             return False
-        schema = self._schema()
         data = row.state_and_ref.state.data
         if not isinstance(data, schema.applies_to):
             return False

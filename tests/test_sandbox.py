@@ -170,7 +170,7 @@ def test_huge_range_hits_cost_budget():
     class RangeContract:
         def verify(self, ltx):
             total = 0
-            for i in range(10 ** 12):
+            for i in range(1000000000000):
                 total += i
     """
     c = load_contract_source(src, "RangeContract", op_budget=10_000)
@@ -186,7 +186,8 @@ def test_budget_resets_between_verifies():
             for i in range(900):
                 total += i
     """
-    c = load_contract_source(src, "OkContract", op_budget=1_000)
+    # ~1801 ticks per verify (function entry + 900 loop + 900 guarded +=)
+    c = load_contract_source(src, "OkContract", op_budget=2_000)
     for _ in range(5):   # would exhaust a non-resetting budget
         c.verify(None)
 
@@ -223,7 +224,7 @@ def test_verifier_pool_rejects_evil_attachment_contracts():
     class EvilContract:
         def verify(self, ltx):
             n = 0
-            for i in range(10 ** 12):
+            for i in range(1000000000000):
                 n += i
     """
     net = MockNetwork(seed=23)
@@ -362,3 +363,208 @@ def test_two_arg_iter_bypass_blocked():
     c = load_contract_source(src, "SpinContract", op_budget=100)
     with pytest.raises(TypeError):
         c.verify(None)
+
+
+# -- op-budget bypass hardening (round-3 advisor findings) -------------------
+
+
+def test_pow_rejected_by_sandbox_audit():
+    """`**` and the `pow` builtin burn unbounded CPU in one unmetered
+    expression (10**10**8); both are load-time audit failures now."""
+    for body in ("return 10 ** 100000000", "return pow(2, 1000000000)"):
+        src = f"""
+        class PowContract:
+            def verify(self, ltx):
+                {body}
+        """
+        with pytest.raises(SandboxViolation):
+            load_contract_source(src, "PowContract")
+
+
+def test_pow_refused_at_runtime_without_audit():
+    src = """
+    class PowContract:
+        def verify(self, ltx):
+            return 2 ** 64
+    """
+    c = load_contract_source(src, "PowContract", audit=False)
+    with pytest.raises(SandboxViolation):
+        c.verify(None)
+
+
+def test_sequence_repetition_capped():
+    src = """
+    class RepContract:
+        def verify(self, ltx):
+            return 'a' * 1000000000
+    """
+    c = load_contract_source(src, "RepContract")
+    with pytest.raises(CostLimitExceeded):
+        c.verify(None)
+
+
+def test_concat_doubling_capped():
+    """s = s + s doubles per iteration: 40 loop ticks would build a
+    TB-sized string without the + size guard."""
+    src = """
+    class DoubleContract:
+        def verify(self, ltx):
+            s = 'x' * 1024
+            for _ in range(40):
+                s = s + s
+    """
+    c = load_contract_source(src, "DoubleContract")
+    with pytest.raises(CostLimitExceeded):
+        c.verify(None)
+
+
+def test_huge_shift_capped():
+    src = """
+    class ShiftContract:
+        def verify(self, ltx):
+            return 1 << 100000000
+    """
+    c = load_contract_source(src, "ShiftContract")
+    with pytest.raises(CostLimitExceeded):
+        c.verify(None)
+
+
+def test_big_int_product_capped():
+    """Repeated squaring via * (augmented assignment included) must hit
+    the bit-length cap, not the allocator."""
+    src = """
+    class SquareContract:
+        def verify(self, ltx):
+            n = 1 << 1000
+            for _ in range(30):
+                n *= n
+    """
+    c = load_contract_source(src, "SquareContract")
+    with pytest.raises(CostLimitExceeded):
+        c.verify(None)
+
+
+def test_legitimate_arithmetic_still_works():
+    src = """
+    class MathContract:
+        def verify(self, ltx):
+            total = 0
+            for i in range(100):
+                total += i * 3
+            parts = [1, 2] + [3]
+            label = 'ab' * 2
+            shifted = 1 << 16
+            if (total, parts, label, shifted) != (
+                14850, [1, 2, 3], 'abab', 65536
+            ):
+                raise ContractViolation('arithmetic broke')
+    """
+    c = load_contract_source(src, "MathContract")
+    c.verify(None)   # must not raise
+
+
+def test_format_rejected_in_sandbox():
+    """'{0.__class__}'.format(x) traverses attributes via a string
+    constant the underscore audit cannot see; format/.format are
+    load-time audit failures and absent from the runtime builtins."""
+    for body in (
+        "return '{0.__class__}'.format(ltx)",
+        "return format(ltx)",
+    ):
+        src = f"""
+        class FmtContract:
+            def verify(self, ltx):
+                {body}
+        """
+        with pytest.raises(SandboxViolation):
+            load_contract_source(src, "FmtContract")
+    # runtime: without the audit, format is simply not a name
+    src = """
+    class FmtContract:
+        def verify(self, ltx):
+            return format(ltx)
+    """
+    c = load_contract_source(src, "FmtContract", audit=False)
+    with pytest.raises(NameError):
+        c.verify(None)
+
+
+# -- overlapping attachments (AttachmentsClassLoader.kt:43-47) ---------------
+
+
+def test_overlapping_attachments_rejected():
+    """Two DIFFERENT attachments both claiming the same contract name
+    is ambiguous code identity: the verifier must refuse, not run
+    whichever sorts first."""
+    from corda_tpu.core.sandbox import OverlappingAttachments
+
+    att_a = magic_attachment()
+    att_b = make_contract_attachment(
+        MAGIC, "MagicContract", MAGIC_SOURCE + "\n# variant"
+    )
+    assert att_a.id != att_b.id
+    with pytest.raises(OverlappingAttachments):
+        contract_from_attachments(MAGIC, [att_a, att_b])
+
+
+def test_same_attachment_listed_twice_is_not_overlapping():
+    att = magic_attachment()
+    c = contract_from_attachments(MAGIC, [att, att])
+    assert c is not None
+
+
+def test_loaded_cache_is_bounded():
+    from corda_tpu.core import sandbox as sb
+
+    src_tmpl = """
+    class C:
+        def verify(self, ltx):
+            return {i}
+    """
+    for i in range(sb._CACHE_CAP + 20):
+        att = make_contract_attachment(f"demo.c{i}", "C",
+                                       src_tmpl.format(i=i))
+        contract_from_attachments(f"demo.c{i}", [att])
+    assert len(sb._loaded_cache) <= sb._CACHE_CAP
+
+
+def test_augassign_subscript_index_evaluated_once():
+    """xs[next(it)] += 1 must advance the iterator ONCE (the guarded
+    desugar hoists object/index into temps; naive re-evaluation would
+    increment a different slot than it read)."""
+    src = """
+    class AugContract:
+        def verify(self, ltx):
+            xs = [0, 10, 20]
+            it = iter([1, 2])
+            xs[next(it)] += 5
+            if xs != [0, 15, 20]:
+                raise ContractViolation(f-less check failed) if False else None
+            if xs[1] != 15 or next(it) != 2:
+                raise ContractViolation('index evaluated twice')
+    """
+    src = src.replace(
+        "raise ContractViolation(f-less check failed) if False else None",
+        "pass",
+    )
+    c = load_contract_source(src, "AugContract")
+    c.verify(None)
+
+
+def test_augassign_attribute_and_slice_targets():
+    src = """
+    class Box:
+        def __init__(self):
+            self.v = 3
+
+    class AugContract:
+        def verify(self, ltx):
+            b = Box()
+            b.v += 4
+            xs = [1, 2, 3, 4]
+            xs[1:3] += [9]
+            if b.v != 7 or xs != [1, 2, 3, 9, 4]:
+                raise ContractViolation('augassign broke')
+    """
+    c = load_contract_source(src, "AugContract")
+    c.verify(None)

@@ -184,3 +184,22 @@ def test_schema_registered_after_states_backfills(tmp_path):
         ) == [300, 500]
     finally:
         _SCHEMA_REGISTRY.pop("cash.late", None)
+
+
+def test_unknown_custom_column_raises_on_both_backends():
+    """Backend parity (round-3 advisor finding): a misspelled column
+    must raise on the in-memory path exactly as the SQL path does, not
+    silently match nothing."""
+    import pytest
+
+    from corda_tpu.node.vault_query import ColumnPredicate, CustomColumnCriteria
+
+    crit = CustomColumnCriteria(
+        schema_name="cash.v1",
+        column="no_such_column",
+        predicate=ColumnPredicate("==", "USD"),
+    )
+    with pytest.raises(ValueError, match="no column"):
+        crit.sql()
+    with pytest.raises(ValueError, match="no column"):
+        crit.matches(object())
