@@ -4,13 +4,19 @@ North star (BASELINE.md): >= 50,000 ECDSA-p256 verifies/sec on one TPU
 v5e chip through the BatchSignatureVerifier SPI, bit-exact
 accept/reject vs the CPU reference semantics.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints one JSON line per metric: {"metric", "value", "unit",
+"vs_baseline"}. The DEFAULT run (no BENCH_METRIC) measures the whole
+BASELINE.md table — mixed, merkle, notary — and prints the headline
+p256 line LAST, so a driver that parses the final line still records
+the headline while the full table lands in the same capture.
 
-BENCH_METRIC selects the measurement (BASELINE.md's table):
-  p256  (default) — the headline ECDSA-p256 batch
+BENCH_METRIC restricts to one measurement:
+  p256            — the headline ECDSA-p256 batch
   mixed           — even thirds ed25519 / secp256k1 / p256 in one call
   merkle          — FilteredTransaction shape: partial Merkle proof
                     (native host SHA-256) + p256 signature per item
+  notary          — BatchingNotaryService serving rate
+  all  (default)  — everything, p256 last
 """
 
 import json
@@ -223,26 +229,7 @@ def _requests(batch: int, metric: str):
     return reqs
 
 
-def main() -> None:
-    # On a remote-attached TPU the host<->device link latency (~50-100
-    # ms/transfer) dominates small batches; 32k records (5 MB packed)
-    # amortise it. Device compute is ~7M verifies/s — far from the
-    # bottleneck at any of these sizes.
-    batch = int(os.environ.get("BENCH_BATCH", "32768"))
-    iters = int(os.environ.get("BENCH_ITERS", "3"))
-    metric = os.environ.get("BENCH_METRIC", "p256")
-    if metric not in ("p256", "mixed", "merkle", "notary"):
-        # a typo must not record a p256-only rate under another name
-        raise SystemExit(
-            f"unknown BENCH_METRIC {metric!r}: p256 | mixed | merkle | notary"
-        )
-    if metric == "merkle":
-        print(json.dumps(_merkle_metric(min(batch, 32768), iters)))
-        return
-    if metric == "notary":
-        print(json.dumps(_notary_metric(min(batch, 4096), iters)))
-        return
-
+def _spi_metric(metric: str, batch: int, iters: int) -> dict:
     from corda_tpu.crypto.batch_verifier import (
         CpuBatchVerifier,
         TpuBatchVerifier,
@@ -283,16 +270,50 @@ def main() -> None:
         if metric == "p256"
         else "mixed_scheme_verifies_per_sec_via_spi"
     )
-    print(
-        json.dumps(
-            {
-                "metric": name,
-                "value": round(rate, 1),
-                "unit": "verifies/s",
-                "vs_baseline": round(rate / BASELINE, 3),
-            }
+    return {
+        "metric": name,
+        "value": round(rate, 1),
+        "unit": "verifies/s",
+        "vs_baseline": round(rate / BASELINE, 3),
+    }
+
+
+def _run_metric(metric: str, batch: int, iters: int) -> dict:
+    if metric == "merkle":
+        return _merkle_metric(min(batch, 32768), iters)
+    if metric == "notary":
+        return _notary_metric(min(batch, 4096), iters)
+    return _spi_metric(metric, batch, iters)
+
+
+def main() -> None:
+    # On a remote-attached TPU the host<->device link latency (~50-100
+    # ms/transfer) dominates small batches; 32k records (5 MB packed)
+    # amortise it. Device compute is ~7M verifies/s — far from the
+    # bottleneck at any of these sizes.
+    batch = int(os.environ.get("BENCH_BATCH", "32768"))
+    iters = int(os.environ.get("BENCH_ITERS", "3"))
+    metric = os.environ.get("BENCH_METRIC", "all")
+    if metric not in ("all", "p256", "mixed", "merkle", "notary"):
+        # a typo must not record a p256-only rate under another name
+        raise SystemExit(
+            "unknown BENCH_METRIC "
+            f"{metric!r}: all | p256 | mixed | merkle | notary"
         )
-    )
+    if metric != "all":
+        print(json.dumps(_run_metric(metric, batch, iters)))
+        return
+    # full table: secondary metrics first (a secondary failure must not
+    # cost the driver the headline — report it on stderr and move on),
+    # headline p256 LAST so tail-line parsers record it
+    for secondary in ("mixed", "merkle", "notary"):
+        try:
+            print(json.dumps(_run_metric(secondary, batch, iters)),
+                  flush=True)
+        except Exception as e:   # noqa: BLE001 - keep the headline alive
+            print(f"bench metric {secondary!r} failed: {e}",
+                  file=sys.stderr)
+    print(json.dumps(_spi_metric("p256", batch, iters)))
 
 
 if __name__ == "__main__":

@@ -146,12 +146,18 @@ class WireTransaction:
 
     @property
     def required_signing_keys(self) -> set:
-        keys: set = set()
-        for c in self.commands:
-            keys.update(c.signers)
-        if self.notary is not None and self.inputs:
-            keys.add(self.notary.owning_key)
-        return keys
+        # memoised like `id`: recomputed on every signature-sufficiency
+        # check otherwise, and the instance is frozen
+        cached = getattr(self, "_rsk_cache", None)
+        if cached is None:
+            keys: set = set()
+            for c in self.commands:
+                keys.update(c.signers)
+            if self.notary is not None and self.inputs:
+                keys.add(self.notary.owning_key)
+            cached = frozenset(keys)
+            object.__setattr__(self, "_rsk_cache", cached)
+        return cached
 
     # -- filtering (tear-offs) --------------------------------------------
 
@@ -289,6 +295,8 @@ class SignedTransaction:
         InvalidSignature naming the bad ones. Shared by the in-process
         check above and the out-of-process verifier worker, which stages
         many transactions' signatures into one batch dispatch."""
+        if all(results):
+            return
         bad = [s for s, ok in zip(self.sigs, results) if not ok]
         if bad:
             raise InvalidSignature(

@@ -82,6 +82,30 @@ def verify_proofs(
     return [pmt.verify(root, leaves) for pmt, root, leaves in items]
 
 
+def single_leaf_proofs(
+    leaves: list[SecureHash],
+) -> tuple[SecureHash, list["PartialMerkleTree"]]:
+    """(root, one single-leaf inclusion proof per input leaf).
+
+    The batch-signing shape (notary flush): the tree levels are built
+    ONCE — O(n) hashing — then each leaf's proof is just its sibling
+    path, O(log n) lookups with no further hashing. Calling
+    PartialMerkleTree.build per leaf would rebuild the levels each
+    time, O(n^2) for a batch."""
+    levels = merkle_levels(leaves)
+    size = len(levels[0])
+    root = levels[-1][0]
+    proofs = []
+    for i0 in range(len(leaves)):
+        path = []
+        i = i0
+        for level in levels[:-1]:
+            path.append(level[i ^ 1])
+            i //= 2
+        proofs.append(PartialMerkleTree(size, (i0,), tuple(path)))
+    return root, proofs
+
+
 @ser.serializable
 @dataclass(frozen=True)
 class PartialMerkleTree:

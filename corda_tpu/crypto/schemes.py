@@ -22,6 +22,7 @@ reference's entropyToKeyPair (test-utils/.../TestConstants.kt).
 
 from __future__ import annotations
 
+import functools
 import hashlib
 from dataclasses import dataclass
 from typing import Optional
@@ -88,6 +89,16 @@ class PublicKey:
 
     scheme_id: int
     data: bytes
+
+    def __hash__(self) -> int:
+        # keys live in hot sets/dicts (required-signer math, key
+        # management, vault owners) and `data` is 32-65+ bytes:
+        # memoise instead of rehashing per lookup
+        h = self.__dict__.get("_hash")
+        if h is None:
+            h = hash((self.scheme_id, self.data))
+            object.__setattr__(self, "_hash", h)
+        return h
 
     def fingerprint(self) -> bytes:
         return hashlib.sha256(bytes([self.scheme_id]) + self.data).digest()
@@ -192,21 +203,40 @@ def keypair_from_private(scheme_id: int, data: bytes) -> KeyPair:
     raise UnsupportedScheme(f"scheme {scheme_id}")
 
 
+# backend private-key objects are expensive to build (derive_private_key
+# is an EC scalar mult; from_private_bytes/load_der re-parse) and a
+# signer — above all a batching notary — signs with the SAME key for
+# every transaction: memoise them, bounded for long-lived processes
+@functools.lru_cache(maxsize=256)
+def _backend_sk_cached(scheme_id: int, data: bytes):
+    if scheme_id in _WCURVE:
+        return cec.derive_private_key(
+            int.from_bytes(data, "big"), _CCURVE[scheme_id]
+        )
+    if scheme_id == EDDSA_ED25519_SHA512:
+        return ced.Ed25519PrivateKey.from_private_bytes(data)
+    if scheme_id == RSA_SHA256:
+        return serialization.load_der_private_key(data, password=None)
+    raise UnsupportedScheme(f"scheme {scheme_id}")
+
+
+def _backend_sk(priv: "PrivateKey"):
+    return _backend_sk_cached(priv.scheme_id, priv.data)
+
+
 def sign(priv: PrivateKey, message: bytes) -> bytes:
     """Host-side signing; signature formats match the verify kernels."""
     sid = priv.scheme_id
     if sid in _WCURVE:
-        d = int.from_bytes(priv.data, "big")
-        sk = cec.derive_private_key(d, _CCURVE[sid])
-        der = sk.sign(message, cec.ECDSA(hashes.SHA256()))
+        der = _backend_sk(priv).sign(message, cec.ECDSA(hashes.SHA256()))
         r, s = decode_dss_signature(der)
         return encodings.encode_der_ecdsa(r, s)
     if sid == EDDSA_ED25519_SHA512:
-        sk = ced.Ed25519PrivateKey.from_private_bytes(priv.data)
-        return sk.sign(message)
+        return _backend_sk(priv).sign(message)
     if sid == RSA_SHA256:
-        sk = serialization.load_der_private_key(priv.data, password=None)
-        return sk.sign(message, cpad.PKCS1v15(), hashes.SHA256())
+        return _backend_sk(priv).sign(
+            message, cpad.PKCS1v15(), hashes.SHA256()
+        )
     if sid == SPHINCS256_SHA256:
         from . import sphincs
 

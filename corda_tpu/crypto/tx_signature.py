@@ -9,10 +9,14 @@ platform version and scheme id into every signature.
 from __future__ import annotations
 
 from dataclasses import dataclass
+from typing import TYPE_CHECKING, Optional
 
 from ..core import serialization as ser
 from .hashes import SecureHash
 from .schemes import PrivateKey, PublicKey
+
+if TYPE_CHECKING:   # pragma: no cover
+    from .merkle import PartialMerkleTree
 
 PLATFORM_VERSION = 1
 
@@ -33,20 +37,71 @@ class SignableData:
     metadata: SignatureMetadata
 
     def to_bytes(self) -> bytes:
-        return ser.encode(self)
+        return signable_bytes(self.tx_id, self.metadata)
+
+
+# Template-spliced payload encoding. The canonical encoding of
+# SignableData(tx_id, meta) is byte-identical for every tx except the
+# 32 hash bytes, and the staging/signing hot paths (notary flush,
+# signature_requests) build it once per signature: encode a probe once
+# per metadata value, locate the probe hash, and splice thereafter.
+# Falls back to the generic encoder if the probe is not found exactly
+# once (can only happen if the wire format changes shape).
+_PROBE = SecureHash(
+    bytes.fromhex(
+        "f1d2c3b4a5968778695a4b3c2d1e0ff0e1d2c3b4a5968778695a4b3c2d1e0f01"
+    )
+)
+_TEMPLATES: dict = {}
+
+
+def signable_bytes(tx_id: SecureHash, meta: SignatureMetadata) -> bytes:
+    tpl = _TEMPLATES.get(meta)
+    if tpl is None:
+        enc = ser.encode(SignableData(_PROBE, meta))
+        if enc.count(_PROBE.bytes_) == 1:
+            i = enc.index(_PROBE.bytes_)
+            tpl = (enc[:i], enc[i + 32:])
+        else:   # pragma: no cover - generic-encoder fallback
+            tpl = ()
+        _TEMPLATES[meta] = tpl
+    if tpl:
+        return tpl[0] + tx_id.bytes_ + tpl[1]
+    return ser.encode(SignableData(tx_id, meta))   # pragma: no cover
 
 
 @ser.serializable
 @dataclass(frozen=True)
 class TransactionSignature:
-    """Signature bytes + signer key + metadata."""
+    """Signature bytes + signer key + metadata.
+
+    `partial_merkle` marks a BATCH signature: the signature bytes cover
+    the root of a Merkle tree over many transaction ids signed in one
+    pass, and the proof ties THIS transaction's id to that root. One
+    device-floor-cost host sign then serves a whole notary batch —
+    verifiers recompute the root from (tx_id, proof) and check the
+    signature over SignableData(root, metadata). Same design as the
+    reference lineage's HA-notary batch signing
+    (core/crypto/TransactionSignature.kt `partialMerkleTree`); a plain
+    per-tx signature is the degenerate None case (and a 1-leaf batch
+    tree's root IS the tx id, so both forms verify identically)."""
 
     signature: bytes
     by: PublicKey
     metadata: SignatureMetadata
+    partial_merkle: Optional["PartialMerkleTree"] = None
 
     def signable_payload(self, tx_id: SecureHash) -> bytes:
-        return SignableData(tx_id, self.metadata).to_bytes()
+        if self.partial_merkle is not None:
+            # an invalid/malformed proof must fail verification, not
+            # crash staging: sign over an empty payload no honest
+            # signer ever produced
+            try:
+                root = self.partial_merkle._root_for([tx_id])
+            except (ValueError, IndexError):
+                return b""
+            return signable_bytes(root, self.metadata)
+        return signable_bytes(tx_id, self.metadata)
 
     def is_valid(self, tx_id: SecureHash) -> bool:
         """Host-path single verification (CPU reference semantics)."""
@@ -67,5 +122,29 @@ class InvalidSignature(Exception):
 
 def sign_tx_id(private: PrivateKey, tx_id: SecureHash) -> TransactionSignature:
     meta = SignatureMetadata(PLATFORM_VERSION, private.scheme_id)
-    payload = SignableData(tx_id, meta).to_bytes()
-    return TransactionSignature(private.sign(payload), private.public, meta)
+    return TransactionSignature(
+        private.sign(signable_bytes(tx_id, meta)), private.public, meta
+    )
+
+
+def sign_tx_ids(
+    private: PrivateKey, tx_ids: list[SecureHash]
+) -> list[TransactionSignature]:
+    """ONE signature over the Merkle root of `tx_ids`, fanned out as a
+    per-transaction TransactionSignature carrying its inclusion proof.
+
+    The batching notary's signing path: host signing costs a fixed
+    ~70 µs per signature regardless of scheme backend, so per-tx
+    signing caps a served batch at ~14k tx/s on one core — batch
+    signing amortises it to one sign + O(log n) hash lookups per tx."""
+    from .merkle import single_leaf_proofs
+
+    if not tx_ids:
+        return []
+    meta = SignatureMetadata(PLATFORM_VERSION, private.scheme_id)
+    root, proofs = single_leaf_proofs(tx_ids)
+    sig = private.sign(signable_bytes(root, meta))
+    pub = private.public
+    return [
+        TransactionSignature(sig, pub, meta, pmt) for pmt in proofs
+    ]

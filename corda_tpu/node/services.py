@@ -37,7 +37,11 @@ from ..crypto.batch_verifier import (
     default_verifier,
 )
 from ..crypto.hashes import SecureHash
-from ..crypto.tx_signature import TransactionSignature, sign_tx_id
+from ..crypto.tx_signature import (
+    TransactionSignature,
+    sign_tx_id,
+    sign_tx_ids,
+)
 
 
 # ---------------------------------------------------------------------------
@@ -207,6 +211,17 @@ class KeyManagementService:
         if priv is None:
             raise KeyError(f"no private key for {key}")
         return sign_tx_id(priv, tx_id)
+
+    def sign_batch(
+        self, tx_ids: list[SecureHash], key: schemes.PublicKey
+    ) -> list[TransactionSignature]:
+        """One Merkle-batch signature fanned out per tx id (the
+        batching notary's reply-signing path — see
+        tx_signature.sign_tx_ids)."""
+        priv = self._keys.get(key)
+        if priv is None:
+            raise KeyError(f"no private key for {key}")
+        return sign_tx_ids(priv, tx_ids)
 
     def sign_bytes(self, data: bytes, key: schemes.PublicKey) -> bytes:
         """Raw scheme signature over arbitrary bytes (identity binds,

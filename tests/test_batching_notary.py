@@ -210,3 +210,48 @@ def test_max_batch_triggers_inline_flush():
     before = svc.batches_dispatched
     alice.run_flow(CashPaymentFlow(40, "USD", bank.party))
     assert svc.batches_dispatched > before
+
+
+def test_malformed_tx_in_batch_fails_alone():
+    """One transaction whose signature staging raises must answer ITS
+    future with an error while the rest of the batch proceeds —
+    aborting flush after the queue swap would strand every requester
+    (round-3 advisor finding)."""
+    from corda_tpu.flows.api import FlowFuture
+    from corda_tpu.node.notary import NotaryError, _PendingNotarisation
+
+    net, spy, notary, bank, clients = make_net(1)
+    svc = notary.services.notary_service
+    alice = clients[0]
+    bank.run_flow(CashIssueFlow(100, "USD", alice.party, notary.party))
+    st = alice.vault.unconsumed_states(CashState)[0]
+    b = TransactionBuilder(notary.party)
+    b.add_input_state(st)
+    b.add_output_state(
+        st.state.data.with_owner(bank.party.owning_key),
+        CASH_CONTRACT,
+        notary.party,
+    )
+    b.add_command(CashMove(), alice.party.owning_key)
+    good_stx = alice.services.sign_initial_transaction(b)
+    # flush() is driven directly (no notary-client flow), so hand the
+    # notary the backchain it would otherwise have resolved in-session
+    issue_stx = alice.services.validated_transactions.get(st.ref.txhash)
+    notary.services.record_transactions([issue_stx])
+
+    class MalformedStx:
+        def signature_requests(self):
+            raise ValueError("unsupported signature scheme")
+
+    bad_fut, good_fut = FlowFuture(), FlowFuture()
+    svc._pending = [
+        _PendingNotarisation(MalformedStx(), alice.party, bad_fut),
+        _PendingNotarisation(good_stx, alice.party, good_fut),
+    ]
+    svc.flush()   # must not raise
+    err = bad_fut.result()
+    assert isinstance(err, NotaryError)
+    assert err.kind == "invalid-transaction"
+    # the good transaction still got a notary signature from the batch
+    sig = good_fut.result()
+    assert not isinstance(sig, NotaryError)

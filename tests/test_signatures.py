@@ -158,3 +158,103 @@ def test_ed25519_wycheproof_style_edges():
     assert tpu == cpu
     assert cpu[0] is True
     assert cpu[1] is False and cpu[2] is False
+
+
+# -- Merkle-batch transaction signatures -------------------------------------
+# One notary signature over the root of a tx-id tree, fanned out with
+# per-tx inclusion proofs (tx_signature.sign_tx_ids; the batching
+# notary's reply-signing path — BASELINE.md round-3 profile note).
+
+
+def _ids(n, seed=9):
+    import random as _r
+
+    from corda_tpu.crypto.hashes import SecureHash
+
+    rng = _r.Random(seed)
+    return [SecureHash.sha256(rng.randbytes(32)) for _ in range(n)]
+
+
+def test_batch_signature_verifies_per_tx():
+    from corda_tpu.crypto.batch_verifier import (
+        CpuBatchVerifier,
+        VerificationRequest,
+    )
+    from corda_tpu.crypto.tx_signature import sign_tx_ids
+
+    kp = schemes.generate_keypair(schemes.EDDSA_ED25519_SHA512, seed=4)
+    for n in (1, 2, 5, 8):   # incl. non-power-of-two and 1-leaf trees
+        ids = _ids(n)
+        sigs = sign_tx_ids(kp.private, ids)
+        assert len(sigs) == n
+        # all share ONE signature blob...
+        assert len({s.signature for s in sigs}) == 1
+        # ...but each verifies against ITS OWN tx id, on the host path
+        for tx_id, sig in zip(ids, sigs):
+            assert sig.is_valid(tx_id)
+        # and through the batch SPI
+        reqs = [
+            VerificationRequest(s.by, s.signature, s.signable_payload(i))
+            for i, s in zip(ids, sigs)
+        ]
+        assert CpuBatchVerifier().verify_batch(reqs) == [True] * n
+
+
+def test_batch_signature_rejects_wrong_tx():
+    from corda_tpu.crypto.tx_signature import sign_tx_ids
+
+    kp = schemes.generate_keypair(schemes.EDDSA_ED25519_SHA512, seed=5)
+    ids = _ids(4)
+    sigs = sign_tx_ids(kp.private, ids)
+    other = _ids(1, seed=77)[0]
+    # a proof for id[0] does not validate some other tx id
+    assert not sigs[0].is_valid(other)
+    # swapped proofs fail too: tx 1's signature object vs tx 0's id
+    assert not sigs[1].is_valid(ids[0])
+
+
+def test_single_leaf_batch_equals_plain_signature_payload():
+    """A 1-leaf batch tree's root IS the tx id, so the signed payload
+    (and thus the signature bytes' meaning) matches a plain per-tx
+    signature — old signatures and batch signatures are one scheme."""
+    from corda_tpu.crypto.tx_signature import sign_tx_id, sign_tx_ids
+
+    kp = schemes.generate_keypair(schemes.EDDSA_ED25519_SHA512, seed=6)
+    tx = _ids(1)[0]
+    [batch_sig] = sign_tx_ids(kp.private, [tx])
+    plain_sig = sign_tx_id(kp.private, tx)
+    assert batch_sig.signable_payload(tx) == plain_sig.signable_payload(tx)
+    assert batch_sig.is_valid(tx) and plain_sig.is_valid(tx)
+
+
+def test_malformed_proof_fails_not_crashes():
+    from corda_tpu.crypto.merkle import PartialMerkleTree
+    from corda_tpu.crypto.hashes import SecureHash
+    from corda_tpu.crypto.tx_signature import sign_tx_ids
+
+    kp = schemes.generate_keypair(schemes.EDDSA_ED25519_SHA512, seed=7)
+    ids = _ids(4)
+    sigs = sign_tx_ids(kp.private, ids)
+    import dataclasses
+
+    broken = dataclasses.replace(
+        sigs[0],
+        partial_merkle=PartialMerkleTree(
+            8, (0,), (SecureHash.zero(),)   # proof too short for size 8
+        ),
+    )
+    assert broken.is_valid(ids[0]) is False
+    assert broken.signable_payload(ids[0]) == b""
+
+
+def test_batch_signature_roundtrips_serialization():
+    import corda_tpu.core.identity  # noqa: F401 - registers PublicKey codec
+    from corda_tpu.core import serialization as ser
+    from corda_tpu.crypto.tx_signature import sign_tx_ids
+
+    kp = schemes.generate_keypair(schemes.EDDSA_ED25519_SHA512, seed=8)
+    ids = _ids(3)
+    for tx_id, sig in zip(ids, sign_tx_ids(kp.private, ids)):
+        back = ser.decode(ser.encode(sig))
+        assert back == sig
+        assert back.is_valid(tx_id)
