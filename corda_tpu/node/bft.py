@@ -12,13 +12,21 @@ over the replica keys, so the ordinary signature-check path proves
 byzantine agreement.
 
 Here the library's role is played by an in-tree PBFT normal case
-(pre-prepare → 2f prepares → 2f+1 commits → in-order execution) plus a
-simplified view change (authenticated channels — the fabric's signed
-handshake — carry each replica's prepared set to the new primary, which
-re-proposes; full PBFT new-view proofs are descoped like the
-reference descopes them to the library). Liveness needs n-f live
-replicas; safety holds with ≤f byzantine ones because every quorum is
-2f+1 and replies only count with f+1 agreement.
+(pre-prepare → 2f prepares → 2f+1 commits → in-order execution), a
+view change completed by a NEW-VIEW message (the new primary merges
+the prepared sets from its 2f+1 view-change certificate and
+re-proposes them, so requests caught mid-prepare by a primary failure
+still commit in view+1), periodic checkpoints (2f+1 matching
+state digests make a checkpoint stable and garbage-collect protocol
+state below it), and catch-up state transfer (a lagging or restarted
+replica installs a checkpoint attested by f+1 peers and replays the
+agreed tail — the BFTSMaRt getSnapshot/installSnapshot surface,
+BFTSMaRt.kt:193,219). Per-message signatures inside view-change and
+checkpoint certificates are descoped to the fabric's authenticated
+channels (the fabric's signed handshake), as the reference descopes
+them to the library. Liveness needs n-f live replicas; safety holds
+with ≤f byzantine ones because every quorum is 2f+1 and replies only
+count with f+1 agreement.
 """
 
 from __future__ import annotations
@@ -99,7 +107,57 @@ class ViewChange:
     prepared: tuple
 
 
-for _cls in (BftRequest, PrePrepare, BftPrepare, BftCommitMsg, BftReply, ViewChange):
+@dataclass(frozen=True)
+class NewView:
+    """The new primary's completion of a view change (PBFT NEW-VIEW):
+    carries the 2f+1 view-change certificate it collected and the
+    pre-prepares (re-proposals of the merged prepared set) it issues in
+    the new view, so every replica adopts the view and the in-flight
+    requests atomically — a replica that reached the vote quorum late
+    would otherwise drop the new primary's pre-prepares as
+    wrong-view."""
+
+    view: int
+    primary: str
+    votes: tuple       # ((replica, prepared), ...) — the certificate
+    preprepares: tuple  # ((seq, cmd_id, origin, command, timestamp), ...)
+
+
+@dataclass(frozen=True)
+class BftCheckpoint:
+    """Periodic state attestation (PBFT checkpoint): 2f+1 matching
+    digests at `seq` make the checkpoint stable — protocol state below
+    it is garbage-collected and the snapshot becomes the catch-up
+    transfer unit (reference surface: BFTSMaRt.kt:193,219
+    getStateManager/getSnapshot/installSnapshot)."""
+
+    seq: int
+    digest: bytes
+    replica: str
+
+
+@dataclass(frozen=True)
+class CatchUpRequest:
+    """A lagging/restarted replica asking peers for state transfer."""
+
+    have_seq: int       # highest executed seq the requester holds
+    replica: str
+
+
+@dataclass(frozen=True)
+class CatchUpReply:
+    checkpoint_seq: int
+    checkpoint_state: Any   # snapshot_fn() output at checkpoint_seq
+    # executed tail above the checkpoint:
+    # ((seq, cmd_id, origin, command, timestamp), ...)
+    entries: tuple
+    replica: str
+
+
+for _cls in (
+    BftRequest, PrePrepare, BftPrepare, BftCommitMsg, BftReply,
+    ViewChange, NewView, BftCheckpoint, CatchUpRequest, CatchUpReply,
+):
     ser.serializable(_cls)
 
 
@@ -108,6 +166,8 @@ class BftConfig:
     request_timeout_micros: int = 2_000_000    # before suspecting primary
     client_deadline_micros: int = 10_000_000
     timestamp_skew_micros: int = 60_000_000    # primary clock sanity bound
+    checkpoint_interval: int = 16              # executions per checkpoint
+    catchup_cooldown_micros: int = 1_000_000   # between catch-up asks
 
 
 def quorum_2f1(n: int) -> int:
@@ -124,6 +184,14 @@ def _digest(command: Any) -> bytes:
     import hashlib
 
     return hashlib.sha256(ser.encode(command)).digest()
+
+
+def _canon(command: Any) -> Any:
+    """Canonicalise a command for digesting/re-proposal: CTS decode
+    yields lists where local construction may hold tuples. ONE helper —
+    digest agreement is consensus-critical, so every site must
+    normalise identically."""
+    return list(command) if isinstance(command, tuple) else command
 
 
 class BftReplica:
@@ -186,6 +254,27 @@ class BftReplica:
         # request watchdog: (origin, cmd_id) -> first-seen micros
         self._watch: dict[tuple, int] = {}
         self._view_votes: dict[int, dict[str, tuple]] = {}
+        # NEW-VIEW messages parked until our own vote quorum arrives
+        self._pending_new_view: dict[int, NewView] = {}
+        # state-transfer hooks (installed by the notary service):
+        # snapshot_fn() -> canonical state, restore_fn(state, seq)
+        self.snapshot_fn: Optional[Callable[[], Any]] = None
+        self.restore_fn: Optional[Callable[[Any, int], None]] = None
+        # checkpoints: seq -> digest -> {replica}; stable = 2f+1 match
+        self._ckpt_votes: dict[int, dict[bytes, set[str]]] = {}
+        self._ckpt_snapshots: dict[int, Any] = {}   # our own, by seq
+        self.stable_checkpoint = 0
+        self.stable_state: Any = None
+        # catch-up: per-replica highest claimed seq — a seq only counts
+        # as evidence of lag when f+1 DISTINCT replicas claim it (one
+        # byzantine peer advertising seq=10**9 must not trigger
+        # perpetual full-state transfers) + buckets of peer replies
+        # awaiting f+1 agreement
+        self._seq_claims: dict[str, int] = {}
+        self._stuck_since: Optional[int] = None
+        self._last_catchup_ask = -(10**12)
+        self._catchup_replies: dict[str, CatchUpReply] = {}
+        self._catchup_served: dict[str, int] = {}   # per-requester limit
         self.stopped = False
 
         self.topic = f"{TOPIC_BFT}.{cluster}"
@@ -272,7 +361,7 @@ class BftReplica:
             pp.view, pp.cmd_id, pp.origin, pp.command, pp.timestamp,
         )
         self.seen_requests[(pp.origin, pp.cmd_id)] = pp.seq
-        d = _digest(list(pp.command) if isinstance(pp.command, tuple) else pp.command)
+        d = _digest(_canon(pp.command))
         prep = BftPrepare(pp.view, pp.seq, d, self.name)
         self._record_prepare(prep)
         self._broadcast(prep)
@@ -286,12 +375,18 @@ class BftReplica:
         key = (p.view, p.seq, bytes(p.digest))
         group = self.prepares.setdefault(key, set())
         group.add(p.replica)
-        # prepared = pre-prepare accepted + 2f prepares (incl. our own)
+        # prepared = pre-prepare accepted + 2f prepares (incl. our own).
+        # A seq prepared in an OLD view prepares again in the new one
+        # (the NEW-VIEW re-proposal path): commit quorums are per-view,
+        # so the view-0 prepared state must not gag the view-1 commit.
         if (
             p.seq in self.accepted
             and self.accepted[p.seq][0] == p.view
             and len(group) >= quorum_2f1(self.n) - 1
-            and p.seq not in self.prepared
+            and (
+                p.seq not in self.prepared
+                or self.prepared[p.seq][0] < p.view
+            )
         ):
             self.prepared[p.seq] = self.accepted[p.seq]
             c = BftCommitMsg(p.view, p.seq, bytes(p.digest), self.name)
@@ -319,13 +414,194 @@ class BftReplica:
             self.exec_seq += 1
             _view, cmd_id, origin, command, timestamp = self.accepted[seq]
             outcome, signature = self.execute_fn(
-                list(command) if isinstance(command, tuple) else command,
-                timestamp,
+                _canon(command), timestamp,
             )
             self.executed[seq] = (cmd_id, origin, outcome, signature)
             self._watch.pop((origin, cmd_id), None)
             self.pending_requests.pop((origin, cmd_id), None)
             self._reply(seq)
+            self._maybe_checkpoint(seq)
+
+    # -- checkpoints ---------------------------------------------------------
+
+    def _maybe_checkpoint(self, seq: int) -> None:
+        if (
+            self.snapshot_fn is None
+            or seq % self.config.checkpoint_interval != 0
+        ):
+            return
+        state = self.snapshot_fn()
+        self._ckpt_snapshots[seq] = state
+        ck = BftCheckpoint(seq, _digest(state), self.name)
+        self._record_checkpoint(ck)
+        self._broadcast(ck)
+
+    def _record_checkpoint(self, ck: BftCheckpoint) -> None:
+        if ck.seq <= self.stable_checkpoint:
+            return
+        by_digest = self._ckpt_votes.setdefault(ck.seq, {})
+        group = by_digest.setdefault(bytes(ck.digest), set())
+        group.add(ck.replica)
+        own = self._ckpt_snapshots.get(ck.seq)
+        if (
+            len(group) >= quorum_2f1(self.n)
+            and own is not None
+            and _digest(own) == bytes(ck.digest)
+        ):
+            self._stabilise(ck.seq, own)
+
+    def _stabilise(self, seq: int, state: Any) -> None:
+        """2f+1 replicas attested the same state at `seq`: protocol
+        bookkeeping below it can never be needed again."""
+        self.stable_checkpoint = seq
+        self.stable_state = state
+        for d in (self.accepted, self.prepared, self.executed):
+            for s in [s for s in d if s <= seq]:
+                del d[s]
+        for d in (self.prepares, self.commits):
+            for k in [k for k in d if k[1] <= seq]:
+                del d[k]
+        self.committed = {s for s in self.committed if s > seq}
+        for s in [s for s in self._ckpt_votes if s <= seq]:
+            del self._ckpt_votes[s]
+        for s in [s for s in self._ckpt_snapshots if s <= seq]:
+            del self._ckpt_snapshots[s]
+
+    # -- catch-up (state transfer) -------------------------------------------
+
+    def _note_seq(self, seq: int, replica: str) -> None:
+        if seq > self._seq_claims.get(replica, 0):
+            self._seq_claims[replica] = seq
+
+    @property
+    def credible_seq(self) -> int:
+        """Highest seq at least f+1 distinct replicas have claimed —
+        guaranteed to include one honest claim."""
+        claims = sorted(self._seq_claims.values(), reverse=True)
+        f = self.f
+        return claims[f] if len(claims) > f else 0
+
+    def _maybe_request_catchup(self, now: int) -> int:
+        """A replica that sees credible protocol traffic above what it
+        can execute — and holds no pre-prepare for its next slot —
+        missed messages while down/partitioned. Normal retransmission
+        cannot help (PBFT has none for executed history); ask for
+        transfer. The condition must PERSIST for a full cooldown before
+        asking: during normal operation the in-flight slot's own
+        prepare traffic briefly looks like lag when messages arrive
+        out of order."""
+        if self.snapshot_fn is None:
+            return 0
+        behind = self.credible_seq > self.exec_seq - 1
+        stuck = self.exec_seq not in self.accepted
+        if not (behind and stuck):
+            self._stuck_since = None
+            return 0
+        if self._stuck_since is None:
+            self._stuck_since = now
+            return 0
+        if now - self._stuck_since < self.config.catchup_cooldown_micros:
+            return 0
+        if now - self._last_catchup_ask < self.config.catchup_cooldown_micros:
+            return 0
+        self._last_catchup_ask = now
+        self._catchup_replies.clear()
+        self._broadcast(CatchUpRequest(self.exec_seq - 1, self.name))
+        return self.n - 1
+
+    def _on_catchup_request(self, m: CatchUpRequest) -> None:
+        if m.replica == self.name:
+            return
+        # server-side rate limit: a byzantine peer spamming requests
+        # must not make every honest replica re-serialize the full
+        # state map per message (asymmetric CPU/bandwidth DoS)
+        now = self.clock.now_micros()
+        last = self._catchup_served.get(m.replica, -(10**12))
+        if now - last < self.config.catchup_cooldown_micros:
+            return
+        self._catchup_served[m.replica] = now
+        # the executed tail above our stable checkpoint that the
+        # requester does not already hold, oldest first
+        entries = tuple(
+            (
+                seq,
+                self.accepted[seq][1],
+                self.accepted[seq][2],
+                _canon(self.accepted[seq][3]),
+                self.accepted[seq][4],
+            )
+            for seq in sorted(self.executed)
+            if seq in self.accepted and seq > m.have_seq
+        )
+        if m.have_seq >= self.stable_checkpoint:
+            # requester already holds our checkpoint: ship only the tail
+            reply = CatchUpReply(0, None, entries, self.name)
+        else:
+            reply = CatchUpReply(
+                self.stable_checkpoint, self.stable_state, entries,
+                self.name,
+            )
+        self.messaging.send(self.topic, ser.encode(reply), m.replica)
+
+    def _on_catchup_reply(self, m: CatchUpReply) -> None:
+        """Install once f+1 peers agree (digest match) on a checkpoint
+        ahead of us — at most f replicas are byzantine, so f+1 matching
+        attestations contain at least one honest one. Tail entries
+        above the installed checkpoint are replayed only with f+1
+        per-entry agreement; anything newer arrives via the normal
+        protocol once we are back inside the window."""
+        if m.replica not in self.peers or self.restore_fn is None:
+            return
+        self._catchup_replies[m.replica] = m
+        progressed = False
+        # phase 1 — install the highest checkpoint ahead of us that
+        # f+1 peers attest with matching digests
+        groups: dict[tuple, list[CatchUpReply]] = {}
+        for r in self._catchup_replies.values():
+            if r.checkpoint_state is None:
+                continue   # tail-only reply (we already held their ckpt)
+            key = (r.checkpoint_seq, _digest(r.checkpoint_state))
+            groups.setdefault(key, []).append(r)
+        for (ck_seq, _d), replies in sorted(groups.items(), reverse=True):
+            if (
+                len(replies) >= weak_quorum(self.n)
+                and ck_seq > self.exec_seq - 1
+            ):
+                self.restore_fn(replies[0].checkpoint_state, ck_seq)
+                self.stable_checkpoint = ck_seq
+                self.stable_state = replies[0].checkpoint_state
+                self.exec_seq = ck_seq + 1
+                self.next_seq = max(self.next_seq, self.exec_seq)
+                progressed = True
+                break
+        # phase 2 — replay the tail with f+1 per-entry agreement
+        # across ALL replies (peers may disagree on checkpoint ages
+        # while still agreeing on the executed entries)
+        by_seq: dict[int, dict[bytes, list[tuple]]] = {}
+        for r in self._catchup_replies.values():
+            for e in r.entries:
+                by_seq.setdefault(e[0], {}).setdefault(
+                    _digest(list(e)), []
+                ).append(tuple(e))
+        for seq in sorted(by_seq):
+            if seq != self.exec_seq:
+                continue
+            agreed = [
+                es for es in by_seq[seq].values()
+                if len(es) >= weak_quorum(self.n)
+            ]
+            if not agreed:
+                break
+            _seq, cmd_id, origin, command, ts = agreed[0][0]
+            outcome, signature = self.execute_fn(_canon(command), ts)
+            self.exec_seq = seq + 1
+            self.next_seq = max(self.next_seq, self.exec_seq)
+            self.executed[seq] = (cmd_id, origin, outcome, signature)
+            self.seen_requests[(origin, cmd_id)] = seq
+            self._maybe_checkpoint(seq)
+            progressed = True
+        if progressed:
+            self._catchup_replies.clear()
 
     def _reply(self, seq: int) -> None:
         cmd_id, origin, outcome, signature = self.executed[seq]
@@ -364,12 +640,12 @@ class BftReplica:
                 fut.set_exception(
                     BftUnavailable("no f+1 agreement within deadline")
                 )
+        sent += self._maybe_request_catchup(now)
         return sent
 
     def _vote_view_change(self, new_view: int) -> int:
         prepared = tuple(
-            (seq, v, cmd_id, origin,
-             list(cmd) if isinstance(cmd, tuple) else cmd, ts)
+            (seq, v, cmd_id, origin, _canon(cmd), ts)
             for seq, (v, cmd_id, origin, cmd, ts) in sorted(
                 self.prepared.items()
             )
@@ -386,35 +662,122 @@ class BftReplica:
         votes = self._view_votes.setdefault(vc.new_view, {})
         votes[vc.replica] = vc.prepared
         if len(votes) >= quorum_2f1(self.n):
-            self.view = vc.new_view
+            new_view = vc.new_view
+            self.view = new_view
+            # keep the CURRENT view's vote set: NEW-VIEW validation
+            # replays it (votes are broadcast to everyone, so each
+            # replica holds its own copy of the certificate evidence)
             self._view_votes = {
-                v: m for v, m in self._view_votes.items() if v > self.view
+                v: m for v, m in self._view_votes.items() if v >= self.view
+            }
+            self._pending_new_view = {
+                v: nv
+                for v, nv in self._pending_new_view.items()
+                if v >= self.view
             }
             if self.is_primary:
-                self._adopt_prepared(votes)
+                self._send_new_view(new_view, votes)
+            else:
+                pending = self._pending_new_view.pop(new_view, None)
+                if pending is not None:
+                    self._on_new_view(pending, pending.primary)
 
-    def _adopt_prepared(self, votes: dict[str, tuple]) -> None:
-        """New primary re-proposes every prepared-but-unexecuted entry
-        it learned from the view-change quorum (highest view wins), then
-        orders requests the failed primary never got to — every replica
-        saw the original broadcast, so the new primary has them in
-        pending_requests."""
+    @staticmethod
+    def _merge_prepared(prepared_sets) -> dict[int, tuple]:
+        """Merge view-change prepared sets: highest view wins per seq.
+        Deterministic — replicas recompute it from the NEW-VIEW
+        certificate to validate the primary's re-proposals."""
         best: dict[int, tuple] = {}
-        for prepared in votes.values():
+        for prepared in prepared_sets:
             for seq, v, cmd_id, origin, command, ts in prepared:
                 if seq not in best or best[seq][0] < v:
                     best[seq] = (v, cmd_id, origin, command, ts)
-        for seq, (_v, cmd_id, origin, command, ts) in sorted(best.items()):
-            if seq in self.executed:
-                continue
-            self.next_seq = max(self.next_seq, seq + 1)
-            pp = PrePrepare(self.view, seq, cmd_id, origin, command, ts)
-            self._accept_preprepare(pp)
-            self._broadcast(pp)
+        return best
+
+    def _send_new_view(self, view: int, votes: dict[str, tuple]) -> None:
+        """New primary: merge the prepared sets from the view-change
+        certificate (highest view wins per seq), broadcast ONE NewView
+        carrying certificate + re-proposals, apply locally, then order
+        any broadcast-but-never-ordered requests."""
+        best = self._merge_prepared(votes.values())
+        pps = tuple(
+            (seq, cmd_id, origin, _canon(command), ts)
+            for seq, (_v, cmd_id, origin, command, ts) in sorted(best.items())
+            if seq not in self.executed
+        )
+        # fresh ordering must start ABOVE every seq this cluster has
+        # ever used: our own executed/accepted history AND the
+        # certificate's prepared seqs — reusing an executed seq would
+        # overwrite history and stall the new request forever (its
+        # commit can never re-execute)
+        top = self.exec_seq - 1
+        if self.accepted:
+            top = max(top, max(self.accepted))
+        if best:
+            top = max(top, max(best))
+        self.next_seq = max(self.next_seq, top + 1)
+        cert = tuple((r, p) for r, p in sorted(votes.items()))
+        self._broadcast(NewView(view, self.name, cert, pps))
+        for seq, cmd_id, origin, command, ts in pps:
+            self._accept_preprepare(
+                PrePrepare(view, seq, cmd_id, origin, command, ts)
+            )
         for (origin, cmd_id), command in list(self.pending_requests.items()):
             if (origin, cmd_id) in self.seen_requests:
                 continue   # already ordered (possibly re-proposed above)
             self._order(cmd_id, origin, command)
+
+    def _on_new_view(self, m: NewView, sender: str) -> None:
+        """Adopt the new view on the primary's NEW-VIEW: late replicas
+        (that had not yet reached the vote quorum themselves) jump
+        views WITH the re-proposals instead of dropping them as
+        wrong-view pre-prepares.
+
+        The embedded certificate is NOT trusted: the channel
+        authenticates only the relaying primary, so a byzantine
+        primary could author a fake 2f+1 certificate. ViewChange votes
+        are broadcast to every replica, so each replica validates the
+        NEW-VIEW against the votes IT received (buffering the message
+        until its own quorum arrives). A re-proposal a replica cannot
+        back with its own votes is rejected — worst case the request
+        re-times-out into the next view (liveness deferred), never an
+        unbacked command executing (safety kept)."""
+        if sender != m.primary or m.primary not in self.peers:
+            return
+        if m.view < self.view:
+            return
+        if self.peers[m.view % self.n] != m.primary:
+            return   # not the rightful primary for that view
+        own_votes = self._view_votes.get(m.view, {})
+        if len(own_votes) < quorum_2f1(self.n):
+            # our own evidence hasn't arrived yet: park, re-checked on
+            # every vote (votes are broadcast, so they do arrive)
+            self._pending_new_view[m.view] = m
+            return
+        # recompute the merge from OUR OWN received votes: a
+        # byzantine-but-rightful primary must not smuggle a DIFFERENT
+        # command under a prepared seq (that would overwrite an entry
+        # another replica already executed)
+        merged = self._merge_prepared(own_votes.values())
+        for seq, cmd_id, origin, command, ts in m.preprepares:
+            ref = merged.get(seq)
+            if ref is None:
+                return   # re-proposal not backed by our evidence
+            _v, r_cmd_id, r_origin, r_command, r_ts = ref
+            if (r_cmd_id, r_origin, r_ts) != (cmd_id, origin, ts) or (
+                _digest(_canon(command)) != _digest(_canon(r_command))
+            ):
+                return   # tampered re-proposal: reject the whole NEW-VIEW
+        if m.view > self.view:
+            self.view = m.view
+            self._view_votes = {
+                v: vm for v, vm in self._view_votes.items() if v >= self.view
+            }
+        for seq, cmd_id, origin, command, ts in m.preprepares:
+            self._note_seq(seq, m.primary)
+            self._accept_preprepare(
+                PrePrepare(m.view, seq, cmd_id, origin, command, ts)
+            )
 
     # -- dispatch ------------------------------------------------------------
 
@@ -430,12 +793,15 @@ class BftReplica:
             if sender == m.origin or sender == self.name:
                 self._on_request(m)
         elif isinstance(m, PrePrepare):
+            self._note_seq(m.seq, sender)
             self._on_preprepare(m, sender)
         elif isinstance(m, BftPrepare):
             if sender == m.replica and sender in self.peers:
+                self._note_seq(m.seq, sender)
                 self._record_prepare(m)
         elif isinstance(m, BftCommitMsg):
             if sender == m.replica and sender in self.peers:
+                self._note_seq(m.seq, sender)
                 self._record_commit(m)
         elif isinstance(m, BftReply):
             if sender == m.replica:
@@ -443,6 +809,18 @@ class BftReplica:
         elif isinstance(m, ViewChange):
             if sender == m.replica and sender in self.peers:
                 self._record_view_change(m)
+        elif isinstance(m, NewView):
+            self._on_new_view(m, sender)
+        elif isinstance(m, BftCheckpoint):
+            if sender == m.replica and sender in self.peers:
+                self._note_seq(m.seq, sender)
+                self._record_checkpoint(m)
+        elif isinstance(m, CatchUpRequest):
+            if sender == m.replica and sender in self.peers:
+                self._on_catchup_request(m)
+        elif isinstance(m, CatchUpReply):
+            if sender == m.replica and sender in self.peers:
+                self._on_catchup_reply(m)
 
     def _broadcast(self, message) -> None:
         payload = ser.encode(message)
@@ -509,6 +887,23 @@ class BFTNotaryService:
         self._member_keys = member_keys or {}
         replica.execute_fn = self._execute
         replica.validate_reply = self._validate_reply
+        replica.snapshot_fn = self._snapshot
+        replica.restore_fn = self._restore
+
+    # -- state transfer (BFTSMaRt.kt:219 getSnapshot/installSnapshot) --------
+
+    def _snapshot(self) -> list:
+        """Canonical dump of the uniqueness map — the digest of this
+        value is what checkpoints attest (shared with the Raft
+        provider: notary.snapshot_uniqueness_map)."""
+        from .notary import snapshot_uniqueness_map
+
+        return snapshot_uniqueness_map(self.committed)
+
+    def _restore(self, state, seq: int) -> None:
+        from .notary import restore_uniqueness_map
+
+        self.committed = restore_uniqueness_map(state)
 
     def _validate_reply(self, outcome, replica_name: str, signature) -> bool:
         outcome = list(outcome)

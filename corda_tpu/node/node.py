@@ -365,7 +365,7 @@ class Node:
                 self._cluster_keypair
             )
 
-            def factory(apply_fn):
+            def factory(apply_fn, **raft_kw):
                 return RaftNode(
                     self.config.name,
                     list(self.config.cluster_peers),
@@ -375,6 +375,7 @@ class Node:
                     cluster=self.config.cluster_name,
                     db=self.db,
                     rng=random.Random(self._dev_seed("raft")),
+                    **raft_kw,
                 )
 
             provider = RaftUniquenessProvider(factory)

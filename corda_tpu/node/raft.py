@@ -79,6 +79,21 @@ class AppendReply:
 
 
 @dataclass(frozen=True)
+class InstallSnapshot:
+    """Leader→lagging-follower state transfer (Raft §7): the follower's
+    next entry was compacted away, so ship the state machine snapshot
+    instead of replaying from genesis. Copycat does the same for the
+    reference's RaftUniquenessProvider (RaftUniquenessProvider.kt:41
+    delegates storage/compaction to Copycat)."""
+
+    term: int
+    leader: str
+    last_included_index: int
+    last_included_term: int
+    state: Any              # snapshot_fn() output, ser-encodable
+
+
+@dataclass(frozen=True)
 class ClientCommand:
     """A command forwarded to the (believed) leader by any member."""
 
@@ -96,7 +111,7 @@ class ClientResult:
 
 for _cls in (
     RequestVote, VoteReply, AppendEntries, AppendReply,
-    ClientCommand, ClientResult,
+    InstallSnapshot, ClientCommand, ClientResult,
 ):
     ser.serializable(_cls)
 
@@ -110,6 +125,9 @@ class RaftConfig:
     election_min_micros: int = 150_000
     election_max_micros: int = 300_000
     command_deadline_micros: int = 10_000_000
+    # take a state-machine snapshot and truncate the log every N
+    # applied entries (0 disables; requires snapshot_fn/restore_fn)
+    snapshot_interval: int = 1024
 
 
 _RAFT_SCHEMA = """
@@ -124,6 +142,12 @@ CREATE TABLE IF NOT EXISTS raft_meta (
     cluster  TEXT PRIMARY KEY,
     term     INTEGER NOT NULL,
     voted_for TEXT
+);
+CREATE TABLE IF NOT EXISTS raft_snapshot (
+    cluster TEXT PRIMARY KEY,
+    idx     INTEGER NOT NULL,
+    term    INTEGER NOT NULL,
+    state   BLOB NOT NULL
 );
 """
 
@@ -148,6 +172,8 @@ class RaftNode:
         db=None,
         rng=None,
         config: RaftConfig = RaftConfig(),
+        snapshot_fn: Optional[Callable[[], Any]] = None,
+        restore_fn: Optional[Callable[[Any], None]] = None,
     ):
         import random as _random
 
@@ -157,6 +183,8 @@ class RaftNode:
         self.others = [p for p in peers if p != name]
         self.messaging = messaging
         self.apply_fn = apply_fn
+        self.snapshot_fn = snapshot_fn
+        self.restore_fn = restore_fn
         self.clock = clock
         self.cluster = cluster
         self.config = config
@@ -165,17 +193,26 @@ class RaftNode:
         if db is not None:
             db.execute_script(_RAFT_SCHEMA)
 
-        # persistent state (reloaded from db)
+        # persistent state (reloaded from db). The log is logically
+        # 1-indexed but physically holds only entries ABOVE the last
+        # snapshot: self.log[k] is entry snap_index+1+k. A snapshot
+        # (state-machine dump at snap_index) replaces the compacted
+        # prefix — restart restores it and replays only the tail,
+        # bounding both disk and restart time (Copycat's storage
+        # semantics for the reference, RaftUniquenessProvider.kt:41).
         self.term = 0
         self.voted_for: Optional[str] = None
+        self.snap_index = 0
+        self.snap_term = 0
+        self._snap_state: Any = None   # last snapshot payload (for IS)
         self.log: list[tuple[int, Any]] = []   # [(term, command)]
         self._load()
 
         # volatile
         self.role = FOLLOWER
         self.leader: Optional[str] = None
-        self.commit_index = 0
-        self.last_applied = 0
+        self.commit_index = self.snap_index
+        self.last_applied = self.snap_index
         self.next_index: dict[str, int] = {}
         self.match_index: dict[str, int] = {}
         self.votes: set[str] = set()
@@ -200,13 +237,13 @@ class RaftNode:
         messaging.add_handler(self.topic, self._on_message)
         self.stopped = False
 
-        # Re-apply the committed prefix? No: commit_index is volatile and
-        # rediscovered from the leader; the state machine must therefore
-        # be rebuilt by re-applying from the log — done lazily as
-        # commit_index advances past last_applied after restart, which
-        # re-runs apply_fn for every previously-committed entry. apply_fn
-        # must be deterministic AND rebuildable (the uniqueness provider
-        # rebuilds its map this way; reference: Copycat snapshot+replay).
+        # Restart semantics: the snapshot (restored in _load) covers
+        # everything up to snap_index; commit_index above that is
+        # volatile and rediscovered from the leader, so the tail is
+        # re-applied lazily as commit_index advances past last_applied.
+        # apply_fn must be deterministic AND rebuildable (the
+        # uniqueness provider's map is; reference: Copycat
+        # snapshot+replay).
 
     # -- persistence ---------------------------------------------------------
 
@@ -219,12 +256,26 @@ class RaftNode:
         )
         if rows:
             self.term, self.voted_for = rows[0][0], rows[0][1]
+        snap = self._db.query(
+            "SELECT idx, term, state FROM raft_snapshot WHERE cluster=?",
+            (self.cluster,),
+        )
+        if snap:
+            self.snap_index, self.snap_term = snap[0][0], snap[0][1]
+            self._snap_state = ser.decode(bytes(snap[0][2]))
+            if self.restore_fn is None:
+                raise RuntimeError(
+                    "raft snapshot on disk but no restore_fn configured"
+                )
+            self.restore_fn(self._snap_state)
         for idx, term, blob in self._db.query(
             "SELECT idx, term, command FROM raft_log WHERE cluster=?"
-            " ORDER BY idx",
-            (self.cluster,),
+            " AND idx>? ORDER BY idx",
+            (self.cluster, self.snap_index),
         ):
-            assert idx == len(self.log) + 1, "raft log has holes"
+            assert idx == self.snap_index + len(self.log) + 1, (
+                "raft log has holes"
+            )
             self.log.append((term, ser.decode(bytes(blob))))
 
     def _persist_meta(self) -> None:
@@ -245,26 +296,51 @@ class RaftNode:
                 "DELETE FROM raft_log WHERE cluster=? AND idx>=?",
                 (self.cluster, start_idx),
             )
-            for i in range(start_idx, len(self.log) + 1):
-                term, command = self.log[i - 1]
+            for i in range(start_idx, self.last_log_index + 1):
+                term, command = self._entry(i)
                 self._db.execute(
                     "INSERT INTO raft_log (cluster, idx, term, command)"
                     " VALUES (?,?,?,?)",
                     (self.cluster, i, term, ser.encode(command)),
                 )
 
+    def _persist_snapshot(self) -> None:
+        if self._db is None:
+            return
+        with self._db.transaction():
+            self._db.execute(
+                "INSERT OR REPLACE INTO raft_snapshot"
+                " (cluster, idx, term, state) VALUES (?,?,?,?)",
+                (
+                    self.cluster, self.snap_index, self.snap_term,
+                    ser.encode(self._snap_state),
+                ),
+            )
+            self._db.execute(
+                "DELETE FROM raft_log WHERE cluster=? AND idx<=?",
+                (self.cluster, self.snap_index),
+            )
+
     # -- log helpers ---------------------------------------------------------
 
     @property
     def last_log_index(self) -> int:
-        return len(self.log)
+        return self.snap_index + len(self.log)
 
     @property
     def last_log_term(self) -> int:
-        return self.log[-1][0] if self.log else 0
+        return self.log[-1][0] if self.log else self.snap_term
+
+    def _entry(self, idx: int) -> tuple[int, Any]:
+        """Entry at 1-indexed log position `idx` (> snap_index)."""
+        return self.log[idx - self.snap_index - 1]
 
     def _term_at(self, idx: int) -> int:
-        return self.log[idx - 1][0] if 1 <= idx <= len(self.log) else 0
+        if idx == self.snap_index:
+            return self.snap_term
+        if self.snap_index < idx <= self.last_log_index:
+            return self._entry(idx)[0]
+        return 0
 
     # -- timers --------------------------------------------------------------
 
@@ -372,8 +448,20 @@ class RaftNode:
     def _send_append(self, peer: str) -> None:
         nxt = self.next_index.get(peer, self.last_log_index + 1)
         prev = nxt - 1
+        if prev < self.snap_index:
+            # the follower needs entries the log no longer holds:
+            # transfer the snapshot instead (Raft §7)
+            self._send(
+                peer,
+                InstallSnapshot(
+                    self.term, self.name,
+                    self.snap_index, self.snap_term, self._snap_state,
+                ),
+            )
+            return
+        off = prev - self.snap_index
         entries = tuple(
-            (t, c) for t, c in self.log[prev : prev + 64]
+            (t, c) for t, c in self.log[off : off + 64]
         )
         self._send(
             peer,
@@ -432,6 +520,8 @@ class RaftNode:
             self._on_vote_reply(m)
         elif isinstance(m, AppendEntries):
             self._on_append(m, msg.sender)
+        elif isinstance(m, InstallSnapshot):
+            self._on_install_snapshot(m, msg.sender)
         elif isinstance(m, AppendReply):
             self._on_append_reply(m)
         elif isinstance(m, ClientCommand):
@@ -482,9 +572,10 @@ class RaftNode:
         self.votes = set()
         self._election_deadline = self._fresh_election_deadline()
         self._flush_parked()
-        # log consistency check
+        # log consistency check (prev below our snapshot is committed
+        # state — consistent by definition, term no longer checkable)
         if m.prev_log_index > self.last_log_index or (
-            m.prev_log_index >= 1
+            m.prev_log_index >= max(1, self.snap_index)
             and self._term_at(m.prev_log_index) != m.prev_log_term
         ):
             self._send(
@@ -497,10 +588,12 @@ class RaftNode:
         changed_from = None
         for i, (term, command) in enumerate(m.entries):
             idx = insert_at + i + 1
+            if idx <= self.snap_index:
+                continue   # compacted == committed: matches by definition
             if idx <= self.last_log_index:
                 if self._term_at(idx) == term:
                     continue
-                del self.log[idx - 1 :]
+                del self.log[idx - self.snap_index - 1 :]
             self.log.append((term, list(command) if isinstance(command, tuple) else command))
             if changed_from is None:
                 changed_from = idx
@@ -544,6 +637,12 @@ class RaftNode:
             self.next_index[m.follower] = max(
                 1, self.next_index.get(m.follower, 1) - 1
             )
+            if self.next_index[m.follower] - 1 < self.snap_index:
+                # next step is an InstallSnapshot; a follower that
+                # rejects it (e.g. no restore_fn) would otherwise
+                # ping-pong the full snapshot in a tight reply loop —
+                # let the heartbeat timer pace the retry instead
+                return
             self._send_append(m.follower)
 
     def _maybe_advance_commit(self) -> None:
@@ -561,7 +660,7 @@ class RaftNode:
     def _apply_committed(self) -> None:
         while self.last_applied < self.commit_index:
             self.last_applied += 1
-            term, command = self.log[self.last_applied - 1]
+            term, command = self._entry(self.last_applied)
             result = (
                 None if command == ["noop"] else self.apply_fn(command)
             )
@@ -601,6 +700,79 @@ class RaftNode:
         # a deposed leader's outstanding futures must not hang forever:
         # indexes at/below commit that resolved above are gone; the rest
         # expire via the client-deadline path or on overwrite
+        self._maybe_snapshot()
+
+    def _maybe_snapshot(self) -> None:
+        """Compact: dump the state machine at last_applied, drop the
+        log prefix it covers. Disk stays bounded and restart replays
+        only the post-snapshot tail."""
+        interval = self.config.snapshot_interval
+        if (
+            self.snapshot_fn is None
+            or interval <= 0
+            or self.last_applied - self.snap_index < interval
+        ):
+            return
+        new_term = self._term_at(self.last_applied)
+        self._snap_state = self.snapshot_fn()
+        del self.log[: self.last_applied - self.snap_index]
+        self.snap_index = self.last_applied
+        self.snap_term = new_term
+        self._persist_snapshot()
+
+    def _on_install_snapshot(self, m: InstallSnapshot, sender: str) -> None:
+        if sender != m.leader or m.leader not in self.peers:
+            return
+        self._maybe_step_down(m.term)
+        if m.term < self.term:
+            self._send(
+                m.leader, AppendReply(self.term, self.name, False, 0)
+            )
+            return
+        self.role = FOLLOWER
+        self.leader = m.leader
+        self.votes = set()
+        self._election_deadline = self._fresh_election_deadline()
+        self._flush_parked()
+        if m.last_included_index > self.last_applied:
+            if self.restore_fn is None:
+                # cannot install: answer failure rather than hang the
+                # leader's retry loop silently
+                self._send(
+                    m.leader, AppendReply(self.term, self.name, False, 0)
+                )
+                return
+            self.restore_fn(m.state)
+            keep_suffix = (
+                m.last_included_index <= self.last_log_index
+                and self._term_at(m.last_included_index)
+                == m.last_included_term
+            )
+            if keep_suffix:
+                del self.log[: m.last_included_index - self.snap_index]
+            else:
+                self.log = []
+            self.snap_index = m.last_included_index
+            self.snap_term = m.last_included_term
+            self._snap_state = m.state
+            self.last_applied = self.snap_index
+            self.commit_index = max(self.commit_index, self.snap_index)
+            if self._db is not None:
+                if not keep_suffix:
+                    self._db.execute(
+                        "DELETE FROM raft_log WHERE cluster=?",
+                        (self.cluster,),
+                    )
+                self._persist_snapshot()
+        # entries up to the snapshot point are committed on the leader,
+        # so they "match" regardless of whether we installed or were
+        # already past it
+        self._send(
+            m.leader,
+            AppendReply(
+                self.term, self.name, True, m.last_included_index
+            ),
+        )
 
     def _on_client_command(self, m: ClientCommand) -> None:
         if m.origin not in self.peers:
@@ -654,11 +826,31 @@ class RaftUniquenessProvider:
     resolves with the conflict set (or None) once the entry commits.
     """
 
-    def __init__(self, raft_factory: Callable[[Callable], RaftNode]):
-        """raft_factory(apply_fn) -> RaftNode — the provider owns the
-        state machine, the caller owns transport/cluster wiring."""
+    def __init__(self, raft_factory: Callable[..., RaftNode]):
+        """raft_factory(apply_fn, snapshot_fn=..., restore_fn=...) ->
+        RaftNode — the provider owns the state machine, the caller owns
+        transport/cluster wiring."""
         self.committed: dict = {}   # StateRef -> SecureHash
-        self.raft = raft_factory(self._apply)
+        # factories MUST forward the snapshot hooks (accept **kwargs):
+        # silently dropping them would disable compaction — unbounded
+        # log growth — so a non-conforming factory fails loudly here
+        self.raft = raft_factory(
+            self._apply,
+            snapshot_fn=self._snapshot,
+            restore_fn=self._restore,
+        )
+
+    # snapshot hooks: the whole uniqueness map, deterministic order ----------
+
+    def _snapshot(self) -> list:
+        from .notary import snapshot_uniqueness_map
+
+        return snapshot_uniqueness_map(self.committed)
+
+    def _restore(self, state) -> None:
+        from .notary import restore_uniqueness_map
+
+        self.committed = restore_uniqueness_map(state)
 
     # the replicated state machine ------------------------------------------
 

@@ -223,7 +223,7 @@ class MockNetwork:
             )
             node.services.my_info = node.info
 
-            def factory(apply_fn, _node=node, _mname=mname):
+            def factory(apply_fn, _node=node, _mname=mname, **raft_kw):
                 raft = RaftNode(
                     _mname,
                     member_names,
@@ -232,6 +232,7 @@ class MockNetwork:
                     self.clock,
                     db=getattr(_node.services, "db", None),
                     rng=_random.Random(self.rng.getrandbits(32)),
+                    **raft_kw,
                 )
                 _node.raft = raft
                 _node.ticks.append(raft.tick)
