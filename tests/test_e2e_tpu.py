@@ -100,3 +100,59 @@ def test_tampered_signature_rejected_on_tpu_verifier(net):
     with pytest.raises(Exception) as exc:
         bob.run_flow(FinalityFlow(stx_bad))
     assert "invalid" in str(exc.value).lower()
+
+
+def test_dvp_arc_on_mesh_sharded_verifier():
+    """The SAME full-pipeline arc with the mesh-sharded SPI branch
+    (TpuBatchVerifier(mesh=...) over the conftest 8-virtual-CPU mesh):
+    staging, padding, shard_map dispatch, scatter and error mapping run
+    through MockNetwork + batching notary, not just verify_batch unit
+    tests (VERDICT round-2 #10). Reference shape: the horizontally
+    scaled worker pool, OutOfProcessTransactionVerifierService.kt:19-73."""
+    import jax
+
+    from corda_tpu.parallel import mesh as meshlib
+
+    devices = jax.devices()
+    assert len(devices) >= 8, "conftest must provision the 8-CPU mesh"
+    mesh = meshlib.make_mesh(devices[:8])
+    network = MockNetwork(
+        seed=13,
+        batch_verifier=TpuBatchVerifier(batch_sizes=(8, 32), mesh=mesh),
+    )
+    notary = network.create_notary("Notary", batching=True)
+    bank = network.create_node("Bank")
+    alice = network.create_node("Alice")
+    bob = network.create_node("Bob")
+
+    bank.run_flow(CashIssueFlow(900, "USD", alice.party, notary.party))
+    alice.run_flow(CashPaymentFlow(300, "USD", bob.party))
+    bob.run_flow(CashPaymentFlow(100, "USD", bank.party))
+
+    def balance(node):
+        return sum(
+            s.state.data.amount.quantity
+            for s in node.vault.unconsumed_states(CashState)
+            if s.state.data.owner == node.party.owning_key
+        )
+
+    assert (balance(alice), balance(bob), balance(bank)) == (600, 200, 100)
+
+    # double spend through the mesh-sharded path still conflicts
+    st = alice.vault.unconsumed_states(CashState)[0]
+
+    def spend_to(dest):
+        b = TransactionBuilder(notary.party)
+        b.add_input_state(st)
+        b.add_output_state(
+            st.state.data.with_owner(dest.party.owning_key),
+            CASH_CONTRACT,
+            notary.party,
+        )
+        b.add_command(CashMove(), alice.party.owning_key)
+        return alice.services.sign_initial_transaction(b)
+
+    alice.run_flow(FinalityFlow(spend_to(bob)))
+    with pytest.raises(NotaryException) as exc:
+        alice.run_flow(FinalityFlow(spend_to(bank)))
+    assert exc.value.error.kind == "conflict"
