@@ -80,6 +80,13 @@ class TpuBatchVerifier(BatchSignatureVerifier):
         self._cpu = CpuBatchVerifier()
         self._kernels = {}
         del donate  # reserved
+        # the EC ladder kernels cost 20-350 s to compile per (scheme,
+        # batch, backend); every process constructing this verifier
+        # (nodes, verifier workers, driver children) must share the
+        # persistent cache or pay that per boot
+        from ..utils import jaxenv
+
+        jaxenv.enable_compile_cache()
 
     # -- kernel plumbing ----------------------------------------------------
 

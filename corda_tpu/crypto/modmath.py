@@ -266,6 +266,49 @@ def _diag_mul_const(a, const_limbs: tuple[int, ...]):
     )
 
 
+def _diag_mul_mxu(a, b):
+    """Variable x variable column sums as ONE batched int8 MXU matmul —
+    the round-3 "MXU Montgomery multiply" experiment (VERDICT r2 #5).
+
+    Per element, U[k] = sum_i b[i] * a[k-i] is a Toeplitz matvec in a's
+    digits: materialise T[B, 44, 22] with T[:, k, i] = a[k-i] (gather),
+    split both sides into 7-bit int8 halves (bounded limbs < 4200 fit
+    13 bits; halves <= 127 and <= 32), and run one batched dot_general
+      lhs [B, 88, 22] = [T0; T1],  rhs [B, 22, 2] = [b0, b1]
+    recombining the four partial products with shifts. Accumulator max
+    127*127*22 < 2^19 — exact in s32; recombined columns < 2^29, same
+    bound the carry rounds already assume.
+
+    Measured on the v5e (BENCH_METRIC=montmul, BASELINE.md round 3):
+    the batched matvec shape (contraction 22, output width 2 per
+    element) cannot tile the 128x128 systolic array, and the [B,44,22]
+    Toeplitz gather adds HBM traffic the shifted-accumulate VPU form
+    never materialises — kept for the record + A/B rig, NOT wired into
+    mont_mul.
+    """
+    batch = a.shape[1]
+    k = np.arange(2 * NLIMB)[:, None]
+    i = np.arange(NLIMB)[None, :]
+    idx = k - i
+    valid = jnp.asarray((0 <= idx) & (idx < NLIMB))
+    t = a.T[:, np.clip(idx, 0, NLIMB - 1)] * valid    # [B, 44, 22]
+    lhs = jnp.concatenate(
+        [(t & 127).astype(jnp.int8), (t >> 7).astype(jnp.int8)], axis=1
+    )                                                  # [B, 88, 22]
+    bt = b.T
+    rhs = jnp.stack(
+        [(bt & 127).astype(jnp.int8), (bt >> 7).astype(jnp.int8)], axis=2
+    )                                                  # [B, 22, 2]
+    prod = lax.dot_general(
+        lhs, rhs,
+        (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.int32,
+    )                                                  # [B, 88, 2]
+    lo, hi = prod[:, : 2 * NLIMB], prod[:, 2 * NLIMB :]
+    u = lo[:, :, 0] + ((hi[:, :, 0] + lo[:, :, 1]) << 7) + (hi[:, :, 1] << 14)
+    return u.T                                         # [44, B]
+
+
 def _mont_reduce(ctx: MontCtx, t_cols):
     """Montgomery reduction of raw columns T (< 144 p^2) -> T/R mod p.
 

@@ -3,18 +3,22 @@
 Reference: samples/simm-valuation-demo/ delegates the maths to
 OpenGamma's implementation of the ISDA Standard Initial Margin Model.
 This module implements the published SIMM *structure* for the interest
--rate delta risk class (the demo portfolio's only exposure) instead of
-a toy heuristic:
+-rate risk class — delta, vega AND curvature layers — instead of a toy
+heuristic:
 
-  1. per-trade PV01 sensitivities bucketed onto the SIMM tenor
-     vertices;
-  2. weighted sensitivities WS_k = RW_k * s_k (risk weight per tenor);
+  1. per-trade sensitivities bucketed onto the SIMM tenor vertices
+     (curve-priced ladders come from samples/pricing.py);
+  2. weighted sensitivities WS_k = RW_k * s_k (risk weight per tenor;
+     vega uses the scalar IR VRW);
   3. intra-bucket (per-currency) aggregation
      K_b = sqrt( WS^T . rho . WS ) with a tenor-tenor correlation
      matrix;
   4. cross-bucket aggregation
-     IM = sqrt( sum_b K_b^2 + sum_{b!=c} gamma * S_b * S_c ),
-     S_b = clamp(sum_k WS_bk, -K_b, K_b).
+     M = sqrt( sum_b K_b^2 + sum_{b!=c} gamma * S_b * S_c ),
+     S_b = clamp(sum_k WS_bk, -K_b, K_b);
+  5. curvature from scaled vega (CVR = SF(t) * vega) through the
+     squared-correlation aggregation with the lambda/theta tail factor
+     (`curvature_margin`); risk-class IM = delta + vega + curvature.
 
 Weights/correlations are representative of SIMM calibrations
 (risk weights in bp, correlation decaying with tenor distance with the
@@ -48,6 +52,15 @@ RISK_WEIGHTS_BP = (
 )
 
 CROSS_CCY_GAMMA = 0.32      # cross-bucket (currency) correlation
+
+# representative IR vega risk weight (SIMM publishes one scalar VRW
+# for the whole IR vega risk class)
+VEGA_RISK_WEIGHT = 0.21
+
+# Phi^-1(0.995) — the 99.5% normal quantile in the SIMM curvature
+# lambda; a fixed constant so both parties share one literal rather
+# than each inverting the normal CDF
+PHI_INV_995 = 2.5758293035489004
 
 
 def tenor_correlation() -> np.ndarray:
@@ -85,18 +98,70 @@ def bucket_pv01(
     return s
 
 
+def _ks(ws: np.ndarray, rho: np.ndarray):
+    """Weighted sensitivities [P, K] -> ([P] K_b, [P] S_b) under the
+    given tenor correlation: K_b = sqrt(WS^T rho WS),
+    S_b = clamp(sum WS, -K_b, K_b). Shared quadratic core of the
+    delta, vega and curvature layers."""
+    q = np.einsum("pk,kl,pl->p", ws, rho, ws)
+    k = np.sqrt(np.maximum(q, 0.0))
+    s = np.clip(ws.sum(axis=1), -k, k)
+    return k, s
+
+
 def bucket_margins(sensitivities: np.ndarray):
-    """[P, K] per-bucket sensitivity ladders -> ([P] K_b, [P] S_b).
+    """[P, K] per-bucket DELTA sensitivity ladders -> (K_b, S_b).
 
     CONSENSUS PATH: float64 numpy with a fixed op order — both parties
     must reproduce the margin bit-for-bit, and jax without x64 would
     silently compute in float32. The TPU belongs to analytics-scale
     estimation (estimate_margins_batch), never to the agreed number."""
-    ws = sensitivities * _RW[None, :]
-    q = np.einsum("pk,kl,pl->p", ws, _RHO, ws)
-    k = np.sqrt(np.maximum(q, 0.0))
-    s = np.clip(ws.sum(axis=1), -k, k)
-    return k, s
+    return _ks(sensitivities * _RW[None, :], _RHO)
+
+
+def vega_bucket_margins(vegas: np.ndarray):
+    """[P, K] per-bucket VEGA ladders -> (K_b, S_b): same correlation
+    structure as delta with the scalar IR vega risk weight."""
+    return _ks(vegas * VEGA_RISK_WEIGHT, _RHO)
+
+
+def scaling_function(t_years: float) -> float:
+    """SIMM curvature scaling SF(t) = 0.5 * min(1, 14 days / t)."""
+    return 0.5 * min(1.0, 14.0 / (365.0 * max(t_years, 1e-12)))
+
+
+_SF = np.asarray([scaling_function(t) for t in TENORS_Y], dtype=np.float64)
+
+
+def curvature_ladders(vegas: np.ndarray) -> np.ndarray:
+    """[P, K] vega ladders -> [P, K] curvature exposures
+    CVR_k = SF(t_k) * vega_k (the SIMM vega-derived gamma proxy)."""
+    return vegas * _SF[None, :]
+
+
+def curvature_margin(cvr: np.ndarray) -> float:
+    """Published SIMM curvature aggregation over [P, K] CVR ladders:
+
+      K_b   = sqrt( CVR^T rho^2 CVR )          (correlations squared)
+      S_b   = clamp(sum CVR, -K_b, K_b)
+      theta = min( sum CVR / sum |CVR|, 0 )
+      lam   = (Phi^-1(0.995)^2 - 1) * (1 + theta) - theta
+      CM    = max( sum CVR + lam * sqrt( sum K_b^2
+                   + sum_{b!=c} gamma^2 S_b S_c ), 0 )
+    """
+    abs_total = float(np.abs(cvr).sum())
+    if abs_total == 0.0:
+        return 0.0
+    total = float(cvr.sum())
+    k, s = _ks(cvr, _RHO * _RHO)
+    theta = min(total / abs_total, 0.0)
+    lam = (PHI_INV_995 * PHI_INV_995 - 1.0) * (1.0 + theta) - theta
+    inner = float(np.dot(k, k))
+    cross = float(s.sum() ** 2 - np.dot(s, s))
+    agg = math.sqrt(
+        max(inner + (CROSS_CCY_GAMMA * CROSS_CCY_GAMMA) * cross, 0.0)
+    )
+    return max(total + lam * agg, 0.0)
 
 
 def estimate_margins_batch(sensitivities: np.ndarray) -> np.ndarray:
@@ -122,13 +187,33 @@ def aggregate_margin(k: np.ndarray, s: np.ndarray) -> float:
     return math.sqrt(max(total + CROSS_CCY_GAMMA * cross, 0.0))
 
 
-def simm_im(buckets: dict[str, np.ndarray]) -> int:
-    """Initial margin for {currency: [K] sensitivity ladder}, rounded
+def simm_breakdown(
+    delta_buckets: dict[str, np.ndarray],
+    vega_buckets: dict[str, np.ndarray] | None = None,
+) -> dict[str, float]:
+    """Per-layer margins for {currency: [K] ladder} inputs. The IR
+    risk-class margin is DeltaMargin + VegaMargin + CurvatureMargin
+    (the published SIMM sums the three within a risk class); curvature
+    derives from the vega ladders via the scaling function."""
+    out = {"delta": 0.0, "vega": 0.0, "curvature": 0.0}
+    if delta_buckets:
+        mat = np.stack([delta_buckets[c] for c in sorted(delta_buckets)])
+        out["delta"] = aggregate_margin(*bucket_margins(mat))
+    if vega_buckets:
+        mat = np.stack([vega_buckets[c] for c in sorted(vega_buckets)])
+        out["vega"] = aggregate_margin(*vega_bucket_margins(mat))
+        out["curvature"] = curvature_margin(curvature_ladders(mat))
+    return out
+
+
+def simm_im(
+    delta_buckets: dict[str, np.ndarray],
+    vega_buckets: dict[str, np.ndarray] | None = None,
+) -> int:
+    """Initial margin for {currency: [K] sensitivity ladder} inputs
+    (delta, and optionally vega — curvature follows from vega), rounded
     to an integer ledger amount (both parties must agree bit-for-bit;
     every float op above has a fixed order, so IEEE-754 doubles give
     one answer on any host)."""
-    if not buckets:
-        return 0
-    mat = np.stack([buckets[c] for c in sorted(buckets)])
-    k, s = bucket_margins(mat)
-    return int(round(aggregate_margin(k, s)))
+    parts = simm_breakdown(delta_buckets, vega_buckets)
+    return int(round(parts["delta"] + parts["vega"] + parts["curvature"]))

@@ -1,14 +1,15 @@
 """simm-valuation-demo: portfolio margin valuation agreed bilaterally.
 
 Reference: samples/simm-valuation-demo/ — two parties value their
-shared IRS portfolio under the ISDA SIMM (OpenGamma does the maths
-there), then AGREE the valuation on ledger. Here the margin comes from
-corda_tpu/samples/simm.py — a SIMM-structured IR-delta calculator
-(tenor-bucketed PV01 ladders, risk weights, correlated intra-/cross-
-bucket aggregation, the quadratic form as one TPU matmul) with openly
-parameterised weights (ISDA's exact tables are versioned/licensed).
-Both sides compute it independently and must agree bit-for-bit before
-the mutually-signed valuation records.
+shared IRS portfolio under the ISDA SIMM (OpenGamma prices the trades
+and produces bucketed delta/vega sensitivities there), then AGREE the
+valuation on ledger. Here pricing comes from
+`corda_tpu/samples/pricing.py` (zero curve + Black-76, bump-and-revalue
+ladders on the SIMM vertices) and the margin from
+`corda_tpu/samples/simm.py` — delta, vega AND curvature layers with
+openly parameterised weights (ISDA's exact tables are versioned/
+licensed). Both sides compute independently and must agree bit-for-bit
+before the mutually-signed valuation records.
 """
 
 from __future__ import annotations
@@ -21,27 +22,94 @@ from ..core.identity import Party
 from .irs_demo import InterestRateSwapState
 
 SIMM_CONTRACT = "corda_tpu.samples.PortfolioValuation"
+SWAPTION_CONTRACT = "corda_tpu.samples.Swaption"
+
+_YEAR_MICROS = 365.25 * 24 * 3600 * 1e6
+
+
+@ser.serializable
+@dataclass(frozen=True)
+class SwaptionState:
+    """A European payer/receiver swaption between two parties — the
+    portfolio's vega carrier (an IRS alone has no vol exposure, so the
+    reference demo's vega sensitivities come from optionality like
+    this)."""
+
+    buyer: Party
+    seller: Party
+    notional: int
+    strike_bps: int
+    expiry_micros: int
+    tenor_years: int
+    index_name: str
+    is_payer: bool = True
+
+    @property
+    def participants(self):
+        return (self.buyer, self.seller)
+
+
+class Swaption:
+    def verify(self, ltx) -> None:
+        outs = ltx.outputs_of_type(SwaptionState)
+        require_that("one swaption output", len(outs) == 1)
+        o = outs[0]
+        require_that("positive notional", o.notional > 0)
+        require_that("positive strike", o.strike_bps > 0)
+        require_that("tenor at least a year", o.tenor_years >= 1)
+
+
+register_contract(SWAPTION_CONTRACT, Swaption())
 
 
 def initial_margin(
-    swaps: list[InterestRateSwapState], now_micros: int = 0
+    swaps: list[InterestRateSwapState],
+    now_micros: int = 0,
+    swaptions: list[SwaptionState] = (),
+    market=None,
 ) -> int:
-    """ISDA-SIMM-structured IR-delta margin for the portfolio (the
-    reference delegates to OpenGamma; corda_tpu/samples/simm.py carries
-    the SIMM structure: tenor-bucketed PV01 ladders, risk weights,
-    correlation-weighted intra- and cross-bucket aggregation, with the
-    quadratic form as one TPU matmul). Deterministic: both parties run
-    the same float64 op order and agree bit-for-bit."""
-    from . import simm
+    """SIMM margin for the mixed portfolio, priced from the shared
+    market curve: per-trade bump-and-revalue delta ladders (swaps and
+    swaptions) plus swaption vega ladders feed the delta + vega +
+    curvature layers of `simm.simm_im`. Deterministic: both parties run
+    the same fixed float64 op order and agree bit-for-bit."""
+    from . import pricing, simm
 
-    buckets: dict = {}
+    curve, vols = market if market is not None else pricing.demo_market()
+    delta: dict = {}
+    vega: dict = {}
+
+    def add(buckets, ccy, ladder):
+        buckets[ccy] = buckets.get(ccy, 0) + ladder
+
     for s in swaps:
         last = max(s.fixing_dates) if s.fixing_dates else now_micros
-        years = max((last - now_micros) / (365.25 * 24 * 3600 * 1e6), 0.0)
-        ladder = simm.bucket_pv01(s.notional, years)
+        years = max((last - now_micros) / _YEAR_MICROS, 0.0)
         ccy = s.index_name.split("-")[0]   # index family as the bucket
-        buckets[ccy] = buckets.get(ccy, 0) + ladder
-    return simm.simm_im(buckets)
+        add(
+            delta, ccy,
+            pricing.swap_delta_ladder(
+                s.notional, s.fixed_rate_bps, years, curve
+            ),
+        )
+    for o in swaptions:
+        expiry = max((o.expiry_micros - now_micros) / _YEAR_MICROS, 0.0)
+        ccy = o.index_name.split("-")[0]
+        add(
+            delta, ccy,
+            pricing.swaption_delta_ladder(
+                o.notional, o.strike_bps, expiry, o.tenor_years,
+                curve, vols, o.is_payer,
+            ),
+        )
+        add(
+            vega, ccy,
+            pricing.swaption_vega_ladder(
+                o.notional, o.strike_bps, expiry, o.tenor_years,
+                curve, vols, o.is_payer,
+            ),
+        )
+    return simm.simm_im(delta, vega)
 
 
 @ser.serializable
@@ -88,9 +156,11 @@ class PortfolioValuation:
 register_contract(SIMM_CONTRACT, PortfolioValuation())
 
 
-def run(seed: int = 42, n_swaps: int = 3):
-    """Build a small IRS portfolio, have both sides value it, agree it
-    on ledger. Returns the recorded valuation state."""
+def run(seed: int = 42, n_swaps: int = 3, n_swaptions: int = 2):
+    """Build a mixed IRS + swaption portfolio, have both sides price it
+    off the shared demo market and value it under SIMM (delta + vega +
+    curvature), agree the margin on ledger. Returns the recorded
+    valuation state."""
     from ..finance.trade_flows import DealInstigatorFlow
     from ..samples.irs_demo import StartSwapFlow
     from ..testing.mock_network import MockNetwork
@@ -117,20 +187,42 @@ def run(seed: int = 42, n_swaps: int = 3):
         fsm = a.start_flow(StartSwapFlow(swap, notary.party))
         net.run()
         fsm.result_or_throw()
+    for i in range(n_swaptions):
+        swaption = SwaptionState(
+            buyer=a.party,
+            seller=b.party,
+            notional=2_000_000 * (i + 1),
+            strike_bps=300 + 50 * i,
+            expiry_micros=now + (i + 2) * 31_557_600 * 10**6,
+            tenor_years=5,
+            index_name="LIBOR-3M",
+        )
+        fsm = a.start_flow(
+            DealInstigatorFlow(b.party, swaption, SWAPTION_CONTRACT, notary.party)
+        )
+        net.run()
+        fsm.result_or_throw()
 
-    # both sides independently value their view of the shared portfolio
-    portfolio_a = [
-        s.state.data for s in a.vault.unconsumed_states(InterestRateSwapState)
-    ]
-    portfolio_b = [
-        s.state.data for s in b.vault.unconsumed_states(InterestRateSwapState)
-    ]
-    margin_a = initial_margin(portfolio_a, now)
-    margin_b = initial_margin(portfolio_b, now)
+    # both sides independently price + value their view of the shared
+    # portfolio against the shared market data
+    def gather(node):
+        swaps = [
+            s.state.data
+            for s in node.vault.unconsumed_states(InterestRateSwapState)
+        ]
+        opts = [
+            s.state.data for s in node.vault.unconsumed_states(SwaptionState)
+        ]
+        return swaps, opts
+
+    swaps_a, opts_a = gather(a)
+    swaps_b, opts_b = gather(b)
+    margin_a = initial_margin(swaps_a, now, opts_a)
+    margin_b = initial_margin(swaps_b, now, opts_b)
     assert margin_a == margin_b, "valuations must agree before signing"
 
     valuation = PortfolioValuationState(
-        a.party, b.party, now, len(portfolio_a), margin_a
+        a.party, b.party, now, len(swaps_a) + len(opts_a), margin_a
     )
     fsm = a.start_flow(
         DealInstigatorFlow(b.party, valuation, SIMM_CONTRACT, notary.party)
@@ -145,7 +237,7 @@ def run(seed: int = 42, n_swaps: int = 3):
 def main():
     v = run()
     print(
-        f"portfolio of {v.portfolio_size} swaps valued: margin {v.margin}"
+        f"portfolio of {v.portfolio_size} trades valued: margin {v.margin}"
     )
 
 

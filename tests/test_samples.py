@@ -125,13 +125,84 @@ def test_oracle_refuses_wrong_rate_and_extra_reveals():
 
 
 def test_simm_demo():
+    """Two-node agreement on a MIXED delta+vega portfolio: 3 swaps +
+    2 swaptions recorded on ledger, both parties reprice off the shared
+    demo market, margin carries delta, vega and curvature layers."""
     from corda_tpu.samples import simm_demo
 
     v = simm_demo.run()
-    assert v.portfolio_size == 3
+    assert v.portfolio_size == 5
     assert v.margin > 0
     # determinism: both sides' valuation function is pure
     assert v.margin == simm_demo.run(seed=42).margin
+    # the vega layers genuinely contribute: dropping the swaptions from
+    # the valuation must LOWER the margin
+    delta_only = simm_demo.run(n_swaptions=0)
+    assert delta_only.portfolio_size == 3
+    assert delta_only.margin < v.margin
+
+
+def test_simm_vega_curvature_layers():
+    """The vega/curvature layers follow the published SIMM shapes:
+    curvature derives from vega via the scaling function, long vol has
+    zero-floored curvature, and each layer is deterministic."""
+    import numpy as np
+
+    from corda_tpu.samples import pricing, simm
+
+    curve, vols = pricing.demo_market()
+    vega = pricing.swaption_vega_ladder(
+        5_000_000, 350, 2.0, 5, curve, vols
+    )
+    assert vega.sum() > 0          # long an option => positive vega
+    parts = simm.simm_breakdown({"LIBOR": np.zeros(simm.N_TENORS)},
+                                {"LIBOR": vega})
+    assert parts["delta"] == 0.0
+    assert parts["vega"] > 0.0
+    assert parts["curvature"] >= 0.0
+    # vega margin scales linearly in the ladder
+    parts2 = simm.simm_breakdown({}, {"LIBOR": 2 * vega})
+    assert abs(parts2["vega"] - 2 * parts["vega"]) < 1e-6
+    # short-vol portfolio: theta < 0 shrinks lambda but curvature still
+    # floors at zero
+    short = simm.simm_breakdown({}, {"LIBOR": -vega})
+    assert short["curvature"] >= 0.0
+    assert short["vega"] == parts["vega"]   # |.| symmetric quadratic
+
+
+def test_pricing_curve_sensitivities():
+    """Bump-and-revalue ladders off the zero curve behave like PV01s:
+    a payer swap loses value as rates fall... (receiver symmetric), the
+    ladder mass sits at pillars framing the cashflows, and pricing is
+    bit-for-bit reproducible."""
+    import numpy as np
+
+    from corda_tpu.samples import pricing
+
+    curve, vols = pricing.demo_market()
+    lad = pricing.swap_delta_ladder(10_000_000, 400, 5.0, curve)
+    # paying fixed: PV rises when rates rise => positive DV01 ladder sum
+    assert lad.sum() > 0
+    # no sensitivity beyond maturity pillars
+    assert abs(lad[-1]) < 1e-9
+    lad2 = pricing.swap_delta_ladder(10_000_000, 400, 5.0, curve)
+    assert np.array_equal(lad, lad2)
+    # swaption delta exists and is smaller than the underlying swap's
+    opt = pricing.swaption_delta_ladder(10_000_000, 400, 2.0, 5, curve, vols)
+    assert 0 < opt.sum() < pricing.swap_delta_ladder(
+        10_000_000, 400, 7.0, curve
+    ).sum()
+    # a RECEIVER swaption's rate delta is negative (it nets against
+    # payer swaps in the margin) while its vega stays positive — the
+    # is_payer flag must reach the pricer
+    rcv = pricing.swaption_delta_ladder(
+        10_000_000, 400, 2.0, 5, curve, vols, is_payer=False
+    )
+    assert rcv.sum() < 0
+    rcv_vega = pricing.swaption_vega_ladder(
+        10_000_000, 400, 2.0, 5, curve, vols, is_payer=False
+    )
+    assert rcv_vega.sum() > 0
 
 
 def test_network_simulation_trace():
