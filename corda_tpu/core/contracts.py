@@ -31,6 +31,18 @@ class Issued:
     issuer: PartyAndReference
     product: str
 
+    def __hash__(self) -> int:
+        # the token is the state-grouping key of every fungible-asset
+        # clause (group_states on the notary's flush path hashes it
+        # several times per transaction); the nested dataclass hash
+        # chain (Issued -> PartyAndReference -> Party -> PublicKey) is
+        # worth memoising
+        h = self.__dict__.get("_hash")
+        if h is None:
+            h = hash((self.issuer, self.product))
+            object.__setattr__(self, "_hash", h)
+        return h
+
 
 @ser.serializable
 @dataclass(frozen=True, order=True)

@@ -153,19 +153,30 @@ def _verify_contract_upgrade(ltx, cmd) -> None:
         _signed_by_participants(sar.state.data, signers)
 
 
+_REPLACEMENT_COMMANDS = (NotaryChangeCommand, ContractUpgradeCommand)
+
+
 def replacement_verifier(ltx):
     """Dispatch hook (installed by core/__init__): a tx carrying exactly
     one replacement command is verified by the replacement rules;
-    mixing replacement commands with anything else is rejected."""
+    mixing replacement commands with anything else is rejected.
+
+    The no-replacement early-out is the notary flush hot path (every
+    ordinary transaction passes through here once per contract verify):
+    no list is built and nothing is imported unless a replacement
+    command is actually present."""
+    for c in ltx.commands:
+        if isinstance(c.value, _REPLACEMENT_COMMANDS):
+            break
+    else:
+        return None   # ordinary transaction: run contracts
     from .transactions import TransactionVerificationError
 
     special = [
         c
         for c in ltx.commands
-        if isinstance(c.value, (NotaryChangeCommand, ContractUpgradeCommand))
+        if isinstance(c.value, _REPLACEMENT_COMMANDS)
     ]
-    if not special:
-        return None   # ordinary transaction: run contracts
     if len(special) != 1 or len(ltx.commands) != 1:
         raise TransactionVerificationError(
             "a replacement transaction carries exactly one command"

@@ -77,6 +77,22 @@ class Node:
         os.makedirs(config.base_dir, exist_ok=True)
         self.db = NodeDatabase(os.path.join(config.base_dir, "node.db"))
 
+        # persistent boot counter: per-boot RNG streams (flow/session
+        # ids, fresh confidential keys) must NEVER repeat across
+        # restarts — a restarted dev node that re-seeded identically
+        # would mint the exact session ids of its previous life, and
+        # peers silently route them into old, ended sessions (found by
+        # the notary kill-restart soak: the post-restart notarisation
+        # hung forever with no error anywhere)
+        from .persistence import PersistentKVStore
+
+        _meta = PersistentKVStore(self.db, "node_meta")
+        _prev = _meta.get(b"boot_count")
+        self.boot_count = (
+            int.from_bytes(_prev, "big") if _prev else 0
+        ) + 1
+        _meta.put(b"boot_count", self.boot_count.to_bytes(8, "big"))
+
         # -- identity (persisted across restarts; AbstractNode obtains
         # it from the node CA keystore, KeyStoreUtilities.kt) ---------
         self.keypair = self._load_or_create_identity()
@@ -162,7 +178,7 @@ class Node:
             self.keypair,
             clock=clock,
             batch_verifier=batch_verifier,
-            rng=random.Random(self._dev_seed("kms")),
+            rng=random.Random(self._dev_seed("kms", per_boot=True)),
             db=self.db,
         )
 
@@ -207,7 +223,7 @@ class Node:
         install_cordapp_services(self.services, config.cordapps)
         self.smm = StateMachineManager(
             self.services, self.messaging,
-            rng=random.Random(self._dev_seed("smm")),
+            rng=random.Random(self._dev_seed("smm", per_boot=True)),
         )
         self._install_notary()
         self.scheduler = NodeSchedulerService(self.services, self.smm.start_flow)
@@ -265,16 +281,25 @@ class Node:
             f"{cfg.cluster_name}:{cfg.cluster_key_seed}:{member}"
         )
 
-    def _dev_seed(self, purpose: str):
+    def _dev_seed(self, purpose: str, per_boot: bool = False):
         """Deterministic per-(node, purpose) RNG seed in dev mode, None
         (OS entropy) otherwise. The node name is mixed in: two dev nodes
         must never share a fresh-key stream, or each would hold the
-        other's 'anonymous' private keys."""
+        other's 'anonymous' private keys.
+
+        per_boot additionally mixes the persistent boot counter: id/key
+        streams that must not repeat across restarts (session ids, flow
+        ids, fresh confidential keys) get a new stream every boot while
+        staying reproducible for a given (node, boot) pair. Identity and
+        cluster keys stay boot-independent — they must re-derive the
+        SAME key after a restart."""
         if not self.config.dev_mode:
             return None
         import hashlib
 
         material = f"{self.config.name}:{self.config.key_seed}:{purpose}"
+        if per_boot:
+            material += f":boot{self.boot_count}"
         return int.from_bytes(
             hashlib.sha256(material.encode()).digest()[:8], "big"
         )

@@ -62,18 +62,18 @@ class Swaption:
 register_contract(SWAPTION_CONTRACT, Swaption())
 
 
-def initial_margin(
+def portfolio_ladders(
     swaps: list[InterestRateSwapState],
     now_micros: int = 0,
     swaptions: list[SwaptionState] = (),
     market=None,
-) -> int:
-    """SIMM margin for the mixed portfolio, priced from the shared
-    market curve: per-trade bump-and-revalue delta ladders (swaps and
-    swaptions) plus swaption vega ladders feed the delta + vega +
-    curvature layers of `simm.simm_im`. Deterministic: both parties run
-    the same fixed float64 op order and agree bit-for-bit."""
-    from . import pricing, simm
+) -> tuple[dict, dict]:
+    """Price the mixed portfolio into per-currency (delta, vega)
+    sensitivity ladders off the shared market curve: per-trade
+    bump-and-revalue delta ladders (swaps and swaptions) plus swaption
+    vega ladders. The ONE pricing pass every margin consumer (demo,
+    web API) shares."""
+    from . import pricing
 
     curve, vols = market if market is not None else pricing.demo_market()
     delta: dict = {}
@@ -109,6 +109,22 @@ def initial_margin(
                 curve, vols, o.is_payer,
             ),
         )
+    return delta, vega
+
+
+def initial_margin(
+    swaps: list[InterestRateSwapState],
+    now_micros: int = 0,
+    swaptions: list[SwaptionState] = (),
+    market=None,
+) -> int:
+    """SIMM margin for the mixed portfolio: the priced ladders feed the
+    delta + vega + curvature layers of `simm.simm_im`. Deterministic:
+    both parties run the same fixed float64 op order and agree
+    bit-for-bit."""
+    from . import simm
+
+    delta, vega = portfolio_ladders(swaps, now_micros, swaptions, market)
     return simm.simm_im(delta, vega)
 
 

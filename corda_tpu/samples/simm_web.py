@@ -1,0 +1,201 @@
+"""SIMM valuation demo web API (reference: the simm-valuation-demo's
+REST surface, samples/simm-valuation-demo/src/main/kotlin/net/corda/
+vega/api/PortfolioApi.kt — whoami :252, {party}/trades :119,
+portfolio/summary :198, portfolio/valuations :181,
+portfolio/valuations/calculate :275 — served to a TS frontend by the
+reference webserver; here the same surface mounts on the terminal-first
+NodeWebServer gateway).
+
+Mounted at /api/simm:
+  GET  /api/simm/whoami                 own identity + known peers
+  GET  /api/simm/trades                 swap + swaption trade summaries
+  GET  /api/simm/portfolio/summary      counts and notional aggregates
+  GET  /api/simm/portfolio/margin       SIMM breakdown (delta/vega/
+                                        curvature/total) priced off the
+                                        shared demo market; ?t=<micros>
+                                        sets the valuation time
+  GET  /api/simm/portfolio/valuations   recorded on-ledger valuations
+  POST /api/simm/portfolio/valuations/calculate
+        {"counterparty", "valuation_micros"?} -> price, agree and
+        record the margin with the counterparty (both sign)
+"""
+
+from __future__ import annotations
+
+from ..client.webserver import WebApiPlugin, register_web_api
+from ..node.vault_query import VaultQueryCriteria
+from .irs_demo import InterestRateSwapState
+from .simm_demo import (
+    SIMM_CONTRACT,
+    PortfolioValuationState,
+    SwaptionState,
+)
+
+
+def _states(ctx, cls):
+    page = ctx.wait(
+        ctx.client.vault_query_by(
+            VaultQueryCriteria(contract_state_types=(cls,))
+        )
+    )
+    return [sar.state.data for sar in page.states]
+
+
+def _whoami(ctx, query, body):
+    me = ctx.wait(ctx.client.node_identity()).legal_identity
+    peers = [
+        info.legal_identity.name
+        for info in ctx.wait(ctx.client.network_map_snapshot())
+    ]
+    return 200, {"me": me.name, "peers": sorted(peers)}
+
+
+def _trades(ctx, query, body):
+    swaps = [
+        {
+            "type": "swap",
+            "fixed_payer": s.fixed_payer.name,
+            "floating_payer": s.floating_payer.name,
+            "notional": s.notional,
+            "fixed_rate_bps": s.fixed_rate_bps,
+            "index": s.index_name,
+            "fixings": len(s.fixings),
+        }
+        for s in _states(ctx, InterestRateSwapState)
+    ]
+    swaptions = [
+        {
+            "type": "swaption",
+            "buyer": o.buyer.name,
+            "seller": o.seller.name,
+            "notional": o.notional,
+            "strike_bps": o.strike_bps,
+            "tenor_years": o.tenor_years,
+            "payer": o.is_payer,
+            "index": o.index_name,
+        }
+        for o in _states(ctx, SwaptionState)
+    ]
+    return 200, {"trades": swaps + swaptions}
+
+
+def _summary(ctx, query, body):
+    swaps = _states(ctx, InterestRateSwapState)
+    swaptions = _states(ctx, SwaptionState)
+    return 200, {
+        "swaps": len(swaps),
+        "swaptions": len(swaptions),
+        "swap_notional": sum(s.notional for s in swaps),
+        "swaption_notional": sum(o.notional for o in swaptions),
+    }
+
+
+def _parse_t(query) -> int:
+    try:
+        return int(query.get("t", ["0"])[0])
+    except (TypeError, ValueError):
+        return 0
+
+
+def _margin(ctx, query, body):
+    from .simm_demo import portfolio_ladders
+    from . import simm
+
+    now = _parse_t(query)
+    swaps = _states(ctx, InterestRateSwapState)
+    swaptions = _states(ctx, SwaptionState)
+    delta, vega = portfolio_ladders(swaps, now, swaptions)
+    parts = simm.simm_breakdown(delta, vega)
+    # the total IS the sum of the layers (simm.simm_im's definition) —
+    # one pricing pass, no second computation to drift from the parts
+    total = int(
+        round(parts["delta"] + parts["vega"] + parts["curvature"])
+    )
+    return 200, {
+        "delta": round(parts["delta"], 2),
+        "vega": round(parts["vega"], 2),
+        "curvature": round(parts["curvature"], 2),
+        "margin": total,
+        "trades": len(swaps) + len(swaptions),
+    }
+
+
+def _valuations(ctx, query, body):
+    vals = [
+        {
+            "party_a": v.party_a.name,
+            "party_b": v.party_b.name,
+            "valuation_micros": v.valuation_micros,
+            "portfolio_size": v.portfolio_size,
+            "margin": v.margin,
+        }
+        for v in _states(ctx, PortfolioValuationState)
+    ]
+    return 200, {"valuations": vals}
+
+
+def _calculate(ctx, query, body):
+    from .simm_demo import initial_margin
+
+    if not isinstance(body, dict):
+        return 400, {"error": "JSON object body required"}
+    counterparty = body.get("counterparty")
+    if not isinstance(counterparty, str):
+        return 400, {"error": "counterparty (party name) required"}
+    raw_t = body.get("valuation_micros", 0)
+    if not isinstance(raw_t, int) or isinstance(raw_t, bool):
+        return 400, {"error": "valuation_micros must be an integer"}
+    now = raw_t
+    parties = {
+        info.legal_identity.name: info.legal_identity
+        for info in ctx.wait(ctx.client.network_map_snapshot())
+    }
+    if counterparty not in parties:
+        return 400, {"error": f"unknown counterparty {counterparty!r}"}
+    notaries = ctx.wait(ctx.client.notary_identities())
+    if not notaries:
+        return 400, {"error": "no notary on the network"}
+    me = ctx.wait(ctx.client.node_identity()).legal_identity
+    swaps = _states(ctx, InterestRateSwapState)
+    swaptions = _states(ctx, SwaptionState)
+    margin = initial_margin(swaps, now, swaptions)
+    valuation = PortfolioValuationState(
+        me, parties[counterparty], now, len(swaps) + len(swaptions), margin
+    )
+    handle = ctx.wait(
+        ctx.client.start_flow(
+            "corda_tpu.finance.trade_flows.DealInstigatorFlow",
+            other=parties[counterparty],
+            deal_state=valuation,
+            contract=SIMM_CONTRACT,
+            notary=notaries[0],
+        )
+    )
+    stx = ctx.wait(handle.result)
+    return 200, {"tx_id": stx.id.bytes_.hex(), "margin": margin}
+
+
+_INDEX = b"""<!doctype html>
+<title>corda_tpu simm-valuation-demo</title>
+<h1>SIMM portfolio valuation</h1>
+<p>GET <a href="/api/simm/portfolio/summary">summary</a> |
+<a href="/api/simm/portfolio/margin">margin</a> |
+<a href="/api/simm/portfolio/valuations">valuations</a> |
+<a href="/api/simm/trades">trades</a> |
+POST /api/simm/portfolio/valuations/calculate</p>
+"""
+
+SIMM_WEB_API = WebApiPlugin(
+    prefix="simm",
+    routes=(
+        ("GET", "whoami", _whoami),
+        ("GET", "trades", _trades),
+        ("GET", "portfolio/summary", _summary),
+        ("GET", "portfolio/margin", _margin),
+        ("GET", "portfolio/valuations", _valuations),
+        ("POST", "portfolio/valuations/calculate", _calculate),
+    ),
+    static=(("index.html", "text/html", _INDEX),),
+)
+
+register_web_api(SIMM_WEB_API)

@@ -42,18 +42,26 @@ class LoadResult:
 @dataclass
 class Disruption:
     """One fault injected mid-run (Disruption.kt). `action(d, handle)`
-    runs at `at_fraction` of the way through the command stream."""
+    runs at `at_fraction` of the way through the command stream.
+
+    `target` pins the victim (e.g. the notary — Disruption.kt's
+    `isNetworkMap`/notary-targeted variants pick specific nodes); None
+    picks a random traffic node."""
 
     name: str
     at_fraction: float
     action: Callable[[Driver, NodeHandle], Optional[NodeHandle]]
+    target: Optional[NodeHandle] = None
 
 
 def kill_and_restart(d: Driver, handle: NodeHandle) -> NodeHandle:
     """SIGKILL, then boot a replacement over the same state dir
-    (Disruption.kt 'restart' + StabilityTest crash-restart)."""
+    (Disruption.kt 'restart' + StabilityTest crash-restart). The spawn
+    timeout matches the slow-boot budget soak targets use (a notary
+    child with a cold XLA compile cache needs minutes, not the default
+    120 s)."""
     handle.kill()
-    return d.restart_node(handle)
+    return d.restart_node(handle, timeout=600.0)
 
 
 def sigstop_for(seconds: float):
@@ -132,7 +140,7 @@ class CrossCashLoadTest:
                 and i >= pending_disruptions[0].at_fraction * count
             ):
                 di = pending_disruptions.pop(0)
-                target = self.rng.choice(self.nodes)
+                target = di.target or self.rng.choice(self.nodes)
                 replacement = di.action(self.d, target)
                 if replacement is not None:
                     self.nodes = [

@@ -241,6 +241,62 @@ def test_start_flow_instance_with_mismatched_ctor_raises():
         _ctor_kwargs_of(Odd(5))
 
 
+def test_simm_web_api(web):
+    """The SIMM demo's REST surface (PortfolioApi.kt analogue): trade
+    listing, portfolio summary, on-demand margin, and a calculate POST
+    that agrees + records the valuation with the counterparty."""
+    import corda_tpu.samples.simm_web  # noqa: F401 - registers /api/simm
+
+    from corda_tpu.finance.trade_flows import DealInstigatorFlow
+    from corda_tpu.samples.simm_demo import SWAPTION_CONTRACT, SwaptionState
+
+    net, server, alice, bob = web
+    notary_party = next(n.party for n in net.nodes if n.party.name == "Notary")
+
+    # seed the shared portfolio with one swaption (vega + delta carrier)
+    swaption = SwaptionState(
+        buyer=alice.party,
+        seller=bob.party,
+        notional=5_000_000,
+        strike_bps=350,
+        expiry_micros=2 * 31_557_600 * 10**6,
+        tenor_years=5,
+        index_name="LIBOR-3M",
+    )
+    fsm = alice.start_flow(
+        DealInstigatorFlow(bob.party, swaption, SWAPTION_CONTRACT, notary_party)
+    )
+    net.run()
+    fsm.result_or_throw()
+
+    status, body = _get(server, "/api/simm/whoami")
+    assert status == 200 and body["me"] == "Alice"
+
+    status, body = _get(server, "/api/simm/trades")
+    assert status == 200 and len(body["trades"]) == 1
+    assert body["trades"][0]["type"] == "swaption"
+
+    status, body = _get(server, "/api/simm/portfolio/summary")
+    assert status == 200
+    assert body["swaptions"] == 1 and body["swaption_notional"] == 5_000_000
+
+    status, margin = _get(server, "/api/simm/portfolio/margin")
+    assert status == 200
+    assert margin["vega"] > 0 and margin["margin"] > 0
+
+    status, body = _post(
+        server,
+        "/api/simm/portfolio/valuations/calculate",
+        {"counterparty": "Bob"},
+    )
+    assert status == 200 and body["margin"] == margin["margin"]
+
+    status, body = _get(server, "/api/simm/portfolio/valuations")
+    assert status == 200 and len(body["valuations"]) == 1
+    assert body["valuations"][0]["margin"] == margin["margin"]
+    assert body["valuations"][0]["portfolio_size"] == 1
+
+
 def test_webserver_metrics_endpoint(web):
     from corda_tpu.client.webserver import NodeWebServer
     from corda_tpu.utils.metrics import MetricRegistry

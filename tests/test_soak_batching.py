@@ -1,0 +1,89 @@
+"""Disruption soak: batching notary + TPU-SPI verifier + loadtest, together.
+
+The three subsystems each have their own suites (test_batching_notary,
+test_mesh_verifier/test_e2e_tpu, test_driver) but had never been
+exercised in one arc. This is the CrossCashTest + Disruption.kt
+combination (tools/loadtest/.../tests/CrossCashTest.kt, Disruption.kt:
+17-73, StabilityTest.kt crash-restart) pointed at a `batching` notary
+whose signature checks drain through the TpuBatchVerifier SPI (CPU
+backend in CI; same code path the real chip runs).
+
+Ring-4: every node is a separate OS process. Slow-marked — boots real
+processes and the notary child compiles/loads jitted kernels.
+"""
+
+import pytest
+
+from corda_tpu.node.vault_query import VaultQueryCriteria
+from corda_tpu.testing.driver import driver
+from corda_tpu.testing.loadtest import (
+    CrossCashLoadTest,
+    Disruption,
+    kill_and_restart,
+)
+
+
+def _prewarm_compile_cache() -> None:
+    """Compile the TpuBatchVerifier's smallest-bucket kernels in THIS
+    process (conftest pins the cpu backend + persistent compile cache)
+    so the spawned notary child loads them from the shared cache
+    instead of spending many minutes of its flow-timeout budget
+    compiling them from scratch."""
+    from corda_tpu.crypto import schemes
+    from corda_tpu.crypto.batch_verifier import (
+        TpuBatchVerifier,
+        VerificationRequest,
+    )
+
+    v = TpuBatchVerifier(batch_sizes=(128,))
+    kp = schemes.generate_keypair(seed=0x50AC)
+    msg = b"prewarm"
+    assert v.verify_batch(
+        [VerificationRequest(kp.public, kp.private.sign(msg), msg)]
+    ) == [True]
+
+
+@pytest.mark.slow
+def test_batching_notary_survives_disruptions(tmp_path):
+    """Cross-cash traffic with a kill -9 + restart of BOTH a traffic
+    node and the batching notary itself still reconciles: in-flight
+    notarisation requests survive via fabric redelivery + journal-replay
+    checkpoint restore, and the uniqueness map is durable across the
+    notary crash (no double-spend window opens)."""
+    _prewarm_compile_cache()
+    with driver(str(tmp_path)) as d:
+        hub = d.start_node(
+            "Hub", notary="batching", verifier_backend="tpu", timeout=600.0
+        )
+        alice = d.start_node("Alice")
+        bob = d.start_node("Bob")
+        d.wait_for_network(3)
+
+        lt = CrossCashLoadTest(d, [alice, bob], d.notary_identity(), seed=31)
+        result = lt.run(
+            count=16,
+            disruptions=(
+                Disruption("kill+restart traffic node", 0.35, kill_and_restart),
+                Disruption(
+                    "kill+restart notary", 0.65, kill_and_restart, target=hub
+                ),
+            ),
+            timeout_per_flow=600.0,
+        )
+        assert result.failed == 0, (
+            result.expected,
+            result.actual,
+            d.nodes["Hub"].stderr_tail(),
+        )
+        assert result.reconciled, (result.expected, result.actual)
+        assert result.throughput > 0
+
+        # the restarted notary must still refuse a double spend: replay
+        # an already-consumed state through a fresh payment attempt is
+        # covered by reconciliation; here assert the vault totals agree
+        # with the model on every node, including states notarised
+        # before the crash
+        for node in (alice, bob):
+            page = d.wait(d.rpc(node).vault_query_by(VaultQueryCriteria()))
+            total = sum(s.state.data.amount.quantity for s in page.states)
+            assert total == result.expected[node.name]
