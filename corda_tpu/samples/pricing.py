@@ -115,6 +115,22 @@ def demo_market() -> tuple[ZeroCurve, VolCurve]:
     return ZeroCurve(zeros), VolCurve(vols)
 
 
+# fixture FX market: foreign discount curves + spot rates into the
+# demo's valuation currency, keyed by foreign currency code
+DEMO_FX_SPOTS = {"EUR": 1.09, "GBP": 1.27}
+
+
+def demo_foreign_curve(ccy: str) -> ZeroCurve:
+    """Foreign zero curve for a demo currency: the domestic shape with
+    a fixed per-currency basis so forwards carry real rate differential
+    risk on both curves."""
+    basis = {"EUR": -0.007, "GBP": 0.004}.get(ccy, 0.0)
+    domestic, _ = demo_market()
+    return ZeroCurve(
+        tuple(max(z + basis, 1e-4) for z in domestic.rates)
+    )
+
+
 # -- instruments -------------------------------------------------------------
 
 
@@ -184,6 +200,25 @@ def swaption_pv(
     )
 
 
+def fx_forward_pv(
+    notional_fgn: float,
+    strike: float,
+    maturity_y: float,
+    dom_curve: ZeroCurve,
+    fgn_curve: ZeroCurve,
+    spot: float,
+) -> float:
+    """PV in domestic currency to the BUYER of `notional_fgn` units of
+    foreign currency at rate `strike` (domestic per foreign) in
+    `maturity_y` years:  PV = N * (spot * df_f(T) - strike * df_d(T)).
+    The covered-interest-parity form OpenGamma's FX analytics reduce to
+    for a deliverable forward."""
+    t = max(maturity_y, TENORS_Y[0])
+    return notional_fgn * (
+        spot * fgn_curve.df(t) - strike * dom_curve.df(t)
+    )
+
+
 # -- sensitivity ladders (bump and revalue) ----------------------------------
 
 
@@ -229,6 +264,62 @@ def swaption_delta_ladder(
             - base
         )
     return s
+
+
+def fx_forward_spot_delta(
+    notional_fgn: float,
+    strike: float,
+    maturity_y: float,
+    dom_curve: ZeroCurve,
+    fgn_curve: ZeroCurve,
+    spot: float,
+) -> float:
+    """SIMM FX sensitivity: PV change for a +1% RELATIVE spot move
+    (the published FX delta definition), by bump-and-revalue so the
+    number stays consistent with the PV function above."""
+    base = fx_forward_pv(
+        notional_fgn, strike, maturity_y, dom_curve, fgn_curve, spot
+    )
+    return (
+        fx_forward_pv(
+            notional_fgn, strike, maturity_y, dom_curve, fgn_curve,
+            spot * 1.01,
+        )
+        - base
+    )
+
+
+def fx_forward_rate_ladders(
+    notional_fgn: float,
+    strike: float,
+    maturity_y: float,
+    dom_curve: ZeroCurve,
+    fgn_curve: ZeroCurve,
+    spot: float,
+) -> tuple[np.ndarray, np.ndarray]:
+    """([K] domestic, [K] foreign) IR delta ladders of the forward: +1bp
+    bump of each zero pillar on each curve, fixed pillar order."""
+    base = fx_forward_pv(
+        notional_fgn, strike, maturity_y, dom_curve, fgn_curve, spot
+    )
+    dom = np.zeros(N_TENORS, dtype=np.float64)
+    fgn = np.zeros(N_TENORS, dtype=np.float64)
+    for k in range(N_TENORS):
+        dom[k] = (
+            fx_forward_pv(
+                notional_fgn, strike, maturity_y, dom_curve.bumped(k),
+                fgn_curve, spot,
+            )
+            - base
+        )
+        fgn[k] = (
+            fx_forward_pv(
+                notional_fgn, strike, maturity_y, dom_curve,
+                fgn_curve.bumped(k), spot,
+            )
+            - base
+        )
+    return dom, fgn
 
 
 def swaption_vega_ladder(

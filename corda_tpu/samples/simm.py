@@ -1,10 +1,11 @@
-"""ISDA-SIMM-style initial margin for IR portfolios.
+"""ISDA-SIMM-style initial margin for IR + FX portfolios.
 
 Reference: samples/simm-valuation-demo/ delegates the maths to
 OpenGamma's implementation of the ISDA Standard Initial Margin Model.
-This module implements the published SIMM *structure* for the interest
--rate risk class — delta, vega AND curvature layers — instead of a toy
-heuristic:
+This module implements the published SIMM *structure* — the interest
+-rate risk class with delta, vega AND curvature layers, the FX delta
+risk class, and the cross-risk-class psi aggregation — instead of a
+toy heuristic:
 
   1. per-trade sensitivities bucketed onto the SIMM tenor vertices
      (curve-priced ladders come from samples/pricing.py);
@@ -18,7 +19,13 @@ heuristic:
      S_b = clamp(sum_k WS_bk, -K_b, K_b);
   5. curvature from scaled vega (CVR = SF(t) * vega) through the
      squared-correlation aggregation with the lambda/theta tail factor
-     (`curvature_margin`); risk-class IM = delta + vega + curvature.
+     (`curvature_margin`); risk-class IM = delta + vega + curvature;
+  6. FX delta: one bucket, per-currency sensitivities to a 1% spot
+     move, scalar risk weight, uniform 0.5 FX-FX correlation
+     (`fx_margin`);
+  7. cross-risk-class aggregation over the six published risk classes
+     SIMM = sqrt( sum_r IM_r^2 + sum_{r!=s} psi_rs IM_r IM_s )
+     (`product_margin` with the representative `RISK_CLASS_PSI`).
 
 Weights/correlations are representative of SIMM calibrations
 (risk weights in bp, correlation decaying with tenor distance with the
@@ -61,6 +68,31 @@ VEGA_RISK_WEIGHT = 0.21
 # lambda; a fixed constant so both parties share one literal rather
 # than each inverting the normal CDF
 PHI_INV_995 = 2.5758293035489004
+
+# FX delta risk class: ONE bucket, a scalar risk weight applied to the
+# per-currency sensitivity to a 1% relative spot move, and the
+# published uniform 0.5 correlation between currency pairs
+FX_RISK_WEIGHT = 8.1
+FX_CORR = 0.5
+
+# the six published SIMM risk classes, in the fixed aggregation order
+RISK_CLASSES = ("IR", "CreditQ", "CreditNonQ", "Equity", "Commodity", "FX")
+
+# representative cross-risk-class correlations psi_rs (the published
+# SIMM tables carry exact, versioned values; the structure — a fixed
+# symmetric PSD matrix over the six classes — is what consensus needs)
+RISK_CLASS_PSI = np.array(
+    [
+        # IR    CrQ   CrNQ  Eq    Comm  FX
+        [1.00, 0.29, 0.13, 0.28, 0.46, 0.32],   # IR
+        [0.29, 1.00, 0.54, 0.71, 0.52, 0.38],   # CreditQ
+        [0.13, 0.54, 1.00, 0.46, 0.41, 0.12],   # CreditNonQ
+        [0.28, 0.71, 0.46, 1.00, 0.49, 0.35],   # Equity
+        [0.46, 0.52, 0.41, 0.49, 1.00, 0.41],   # Commodity
+        [0.32, 0.38, 0.12, 0.35, 0.41, 1.00],   # FX
+    ],
+    dtype=np.float64,
+)
 
 
 def tenor_correlation() -> np.ndarray:
@@ -187,15 +219,53 @@ def aggregate_margin(k: np.ndarray, s: np.ndarray) -> float:
     return math.sqrt(max(total + CROSS_CCY_GAMMA * cross, 0.0))
 
 
+def fx_margin(fx_deltas: dict[str, float]) -> float:
+    """FX delta margin over {currency: PV change per +1% spot move}
+    sensitivities: single bucket, WS_i = FX_RISK_WEIGHT * s_i,
+    K = sqrt( sum_i WS_i^2 + FX_CORR * sum_{i!=j} WS_i WS_j ).
+    Fixed currency order (sorted) keeps the float64 op order shared."""
+    if not fx_deltas:
+        return 0.0
+    ws = (
+        np.asarray(
+            [fx_deltas[c] for c in sorted(fx_deltas)], dtype=np.float64
+        )
+        * FX_RISK_WEIGHT
+    )
+    own = float(np.dot(ws, ws))
+    cross = float(ws.sum() ** 2 - own)
+    return math.sqrt(max(own + FX_CORR * cross, 0.0))
+
+
+def product_margin(class_margins: dict[str, float]) -> float:
+    """Cross-risk-class SIMM aggregation:
+    SIMM = sqrt( sum_r IM_r^2 + sum_{r!=s} psi_rs IM_r IM_s ) over the
+    six published risk classes (unknown class names raise — a typo must
+    not silently drop a margin contribution)."""
+    unknown = set(class_margins) - set(RISK_CLASSES)
+    if unknown:
+        raise ValueError(f"unknown SIMM risk class(es): {sorted(unknown)}")
+    im = np.asarray(
+        [float(class_margins.get(c, 0.0)) for c in RISK_CLASSES],
+        dtype=np.float64,
+    )
+    q = float(im @ RISK_CLASS_PSI @ im)
+    return math.sqrt(max(q, 0.0))
+
+
 def simm_breakdown(
     delta_buckets: dict[str, np.ndarray],
     vega_buckets: dict[str, np.ndarray] | None = None,
+    fx_deltas: dict[str, float] | None = None,
 ) -> dict[str, float]:
-    """Per-layer margins for {currency: [K] ladder} inputs. The IR
-    risk-class margin is DeltaMargin + VegaMargin + CurvatureMargin
-    (the published SIMM sums the three within a risk class); curvature
-    derives from the vega ladders via the scaling function."""
-    out = {"delta": 0.0, "vega": 0.0, "curvature": 0.0}
+    """Per-layer margins for {currency: [K] ladder} inputs plus the
+    optional FX class. The IR risk-class margin is DeltaMargin +
+    VegaMargin + CurvatureMargin (the published SIMM sums the three
+    within a risk class); `total` is the cross-risk-class psi
+    aggregation of the IR and FX class margins — with no FX exposure it
+    equals the IR margin, so IR-only callers see the same number as
+    before the FX class landed."""
+    out = {"delta": 0.0, "vega": 0.0, "curvature": 0.0, "fx": 0.0}
     if delta_buckets:
         mat = np.stack([delta_buckets[c] for c in sorted(delta_buckets)])
         out["delta"] = aggregate_margin(*bucket_margins(mat))
@@ -203,17 +273,23 @@ def simm_breakdown(
         mat = np.stack([vega_buckets[c] for c in sorted(vega_buckets)])
         out["vega"] = aggregate_margin(*vega_bucket_margins(mat))
         out["curvature"] = curvature_margin(curvature_ladders(mat))
+    if fx_deltas:
+        out["fx"] = fx_margin(fx_deltas)
+    ir = out["delta"] + out["vega"] + out["curvature"]
+    out["total"] = product_margin({"IR": ir, "FX": out["fx"]})
     return out
 
 
 def simm_im(
     delta_buckets: dict[str, np.ndarray],
     vega_buckets: dict[str, np.ndarray] | None = None,
+    fx_deltas: dict[str, float] | None = None,
 ) -> int:
     """Initial margin for {currency: [K] sensitivity ladder} inputs
-    (delta, and optionally vega — curvature follows from vega), rounded
-    to an integer ledger amount (both parties must agree bit-for-bit;
-    every float op above has a fixed order, so IEEE-754 doubles give
-    one answer on any host)."""
-    parts = simm_breakdown(delta_buckets, vega_buckets)
-    return int(round(parts["delta"] + parts["vega"] + parts["curvature"]))
+    (delta, optionally vega — curvature follows from vega — and
+    optionally per-currency FX spot sensitivities), rounded to an
+    integer ledger amount (both parties must agree bit-for-bit; every
+    float op above has a fixed order, so IEEE-754 doubles give one
+    answer on any host)."""
+    return int(round(simm_breakdown(delta_buckets, vega_buckets,
+                                    fx_deltas)["total"]))

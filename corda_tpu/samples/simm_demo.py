@@ -23,8 +23,14 @@ from .irs_demo import InterestRateSwapState
 
 SIMM_CONTRACT = "corda_tpu.samples.PortfolioValuation"
 SWAPTION_CONTRACT = "corda_tpu.samples.Swaption"
+FX_FORWARD_CONTRACT = "corda_tpu.samples.FxForward"
 
 _YEAR_MICROS = 365.25 * 24 * 3600 * 1e6
+
+# the demo's domestic IR bucket: swaps/swaptions key their ladders by
+# index family (index_name.split("-")[0]) and every demo trade quotes
+# the LIBOR family, which prices off the shared domestic curve
+DOMESTIC_BUCKET = "LIBOR"
 
 
 @ser.serializable
@@ -62,22 +68,65 @@ class Swaption:
 register_contract(SWAPTION_CONTRACT, Swaption())
 
 
+@ser.serializable
+@dataclass(frozen=True)
+class FxForwardState:
+    """A deliverable FX forward: at maturity the buyer receives
+    `notional_fgn` units of `foreign_ccy` against paying
+    `notional_fgn * strike_milli / 1000` in the valuation currency.
+    The portfolio's FX-risk-class carrier (an IRS book alone has no
+    spot exposure, so the SIMM FX margin would be degenerate without
+    cross-currency trades)."""
+
+    buyer: Party
+    seller: Party
+    notional_fgn: int
+    strike_milli: int          # domestic per foreign, in 1/1000ths
+    maturity_micros: int
+    foreign_ccy: str
+
+    @property
+    def participants(self):
+        return (self.buyer, self.seller)
+
+
+class FxForward:
+    def verify(self, ltx) -> None:
+        from . import pricing
+
+        outs = ltx.outputs_of_type(FxForwardState)
+        require_that("one forward output", len(outs) == 1)
+        o = outs[0]
+        require_that("positive foreign notional", o.notional_fgn > 0)
+        require_that("positive strike", o.strike_milli > 0)
+        require_that(
+            "a known demo currency",
+            o.foreign_ccy in pricing.DEMO_FX_SPOTS,
+        )
+
+
+register_contract(FX_FORWARD_CONTRACT, FxForward())
+
+
 def portfolio_ladders(
     swaps: list[InterestRateSwapState],
     now_micros: int = 0,
     swaptions: list[SwaptionState] = (),
     market=None,
-) -> tuple[dict, dict]:
-    """Price the mixed portfolio into per-currency (delta, vega)
-    sensitivity ladders off the shared market curve: per-trade
-    bump-and-revalue delta ladders (swaps and swaptions) plus swaption
-    vega ladders. The ONE pricing pass every margin consumer (demo,
+    fx_forwards: list[FxForwardState] = (),
+) -> tuple[dict, dict, dict]:
+    """Price the mixed portfolio into per-currency (delta, vega,
+    fx-spot) sensitivities off the shared market curves: per-trade
+    bump-and-revalue delta ladders (swaps, swaptions and both legs of
+    FX forwards), swaption vega ladders, and per-currency FX spot
+    sensitivities. The ONE pricing pass every margin consumer (demo,
     web API) shares."""
     from . import pricing
 
     curve, vols = market if market is not None else pricing.demo_market()
     delta: dict = {}
     vega: dict = {}
+    fx: dict = {}
 
     def add(buckets, ccy, ladder):
         buckets[ccy] = buckets.get(ccy, 0) + ladder
@@ -109,7 +158,28 @@ def portfolio_ladders(
                 curve, vols, o.is_payer,
             ),
         )
-    return delta, vega
+    for f in fx_forwards:
+        years = max((f.maturity_micros - now_micros) / _YEAR_MICROS, 0.0)
+        fgn_curve = pricing.demo_foreign_curve(f.foreign_ccy)
+        spot = pricing.DEMO_FX_SPOTS[f.foreign_ccy]
+        strike = f.strike_milli / 1000.0
+        add(
+            fx, f.foreign_ccy,
+            pricing.fx_forward_spot_delta(
+                f.notional_fgn, strike, years, curve, fgn_curve, spot
+            ),
+        )
+        dom_ladder, fgn_ladder = pricing.fx_forward_rate_ladders(
+            f.notional_fgn, strike, years, curve, fgn_curve, spot
+        )
+        # the forward's domestic pay leg prices off the SAME curve as
+        # the swaps/swaptions, so its delta must land in the same
+        # bucket (DOMESTIC_BUCKET) to net intra-bucket — a separate
+        # "USD" bucket would correlate identical-curve risk at the
+        # 0.32 cross-bucket gamma instead of netting it
+        add(delta, DOMESTIC_BUCKET, dom_ladder)
+        add(delta, f.foreign_ccy, fgn_ladder)
+    return delta, vega, fx
 
 
 def initial_margin(
@@ -117,15 +187,18 @@ def initial_margin(
     now_micros: int = 0,
     swaptions: list[SwaptionState] = (),
     market=None,
+    fx_forwards: list[FxForwardState] = (),
 ) -> int:
-    """SIMM margin for the mixed portfolio: the priced ladders feed the
-    delta + vega + curvature layers of `simm.simm_im`. Deterministic:
-    both parties run the same fixed float64 op order and agree
-    bit-for-bit."""
+    """SIMM margin for the mixed portfolio: the priced sensitivities
+    feed the IR (delta + vega + curvature) and FX risk classes of
+    `simm.simm_im`, psi-aggregated across classes. Deterministic: both
+    parties run the same fixed float64 op order and agree bit-for-bit."""
     from . import simm
 
-    delta, vega = portfolio_ladders(swaps, now_micros, swaptions, market)
-    return simm.simm_im(delta, vega)
+    delta, vega, fx = portfolio_ladders(
+        swaps, now_micros, swaptions, market, fx_forwards
+    )
+    return simm.simm_im(delta, vega, fx)
 
 
 @ser.serializable
@@ -172,10 +245,14 @@ class PortfolioValuation:
 register_contract(SIMM_CONTRACT, PortfolioValuation())
 
 
-def run(seed: int = 42, n_swaps: int = 3, n_swaptions: int = 2):
-    """Build a mixed IRS + swaption portfolio, have both sides price it
-    off the shared demo market and value it under SIMM (delta + vega +
-    curvature), agree the margin on ledger. Returns the recorded
+def run(
+    seed: int = 42, n_swaps: int = 3, n_swaptions: int = 2,
+    n_fx_forwards: int = 2,
+):
+    """Build a mixed IRS + swaption + FX-forward portfolio, have both
+    sides price it off the shared demo market and value it under SIMM
+    (IR delta + vega + curvature, FX delta, psi cross-class
+    aggregation), agree the margin on ledger. Returns the recorded
     valuation state."""
     from ..finance.trade_flows import DealInstigatorFlow
     from ..samples.irs_demo import StartSwapFlow
@@ -218,6 +295,21 @@ def run(seed: int = 42, n_swaps: int = 3, n_swaptions: int = 2):
         )
         net.run()
         fsm.result_or_throw()
+    fx_ccys = ("EUR", "GBP")
+    for i in range(n_fx_forwards):
+        fwd = FxForwardState(
+            buyer=a.party,
+            seller=b.party,
+            notional_fgn=3_000_000 * (i + 1),
+            strike_milli=1_100 + 120 * i,
+            maturity_micros=now + (i + 1) * 31_557_600 * 10**6,
+            foreign_ccy=fx_ccys[i % len(fx_ccys)],
+        )
+        fsm = a.start_flow(
+            DealInstigatorFlow(b.party, fwd, FX_FORWARD_CONTRACT, notary.party)
+        )
+        net.run()
+        fsm.result_or_throw()
 
     # both sides independently price + value their view of the shared
     # portfolio against the shared market data
@@ -229,16 +321,20 @@ def run(seed: int = 42, n_swaps: int = 3, n_swaptions: int = 2):
         opts = [
             s.state.data for s in node.vault.unconsumed_states(SwaptionState)
         ]
-        return swaps, opts
+        fwds = [
+            s.state.data for s in node.vault.unconsumed_states(FxForwardState)
+        ]
+        return swaps, opts, fwds
 
-    swaps_a, opts_a = gather(a)
-    swaps_b, opts_b = gather(b)
-    margin_a = initial_margin(swaps_a, now, opts_a)
-    margin_b = initial_margin(swaps_b, now, opts_b)
+    swaps_a, opts_a, fwds_a = gather(a)
+    swaps_b, opts_b, fwds_b = gather(b)
+    margin_a = initial_margin(swaps_a, now, opts_a, fx_forwards=fwds_a)
+    margin_b = initial_margin(swaps_b, now, opts_b, fx_forwards=fwds_b)
     assert margin_a == margin_b, "valuations must agree before signing"
 
     valuation = PortfolioValuationState(
-        a.party, b.party, now, len(swaps_a) + len(opts_a), margin_a
+        a.party, b.party, now,
+        len(swaps_a) + len(opts_a) + len(fwds_a), margin_a,
     )
     fsm = a.start_flow(
         DealInstigatorFlow(b.party, valuation, SIMM_CONTRACT, notary.party)

@@ -8,12 +8,15 @@ NodeWebServer gateway).
 
 Mounted at /api/simm:
   GET  /api/simm/whoami                 own identity + known peers
-  GET  /api/simm/trades                 swap + swaption trade summaries
+  GET  /api/simm/trades                 swap + swaption + FX forward
+                                        trade summaries
   GET  /api/simm/portfolio/summary      counts and notional aggregates
   GET  /api/simm/portfolio/margin       SIMM breakdown (delta/vega/
-                                        curvature/total) priced off the
-                                        shared demo market; ?t=<micros>
-                                        sets the valuation time
+                                        curvature/fx/total, psi
+                                        cross-class aggregate) priced
+                                        off the shared demo market;
+                                        ?t=<micros> sets the valuation
+                                        time
   GET  /api/simm/portfolio/valuations   recorded on-ledger valuations
   POST /api/simm/portfolio/valuations/calculate
         {"counterparty", "valuation_micros"?} -> price, agree and
@@ -27,6 +30,7 @@ from ..node.vault_query import VaultQueryCriteria
 from .irs_demo import InterestRateSwapState
 from .simm_demo import (
     SIMM_CONTRACT,
+    FxForwardState,
     PortfolioValuationState,
     SwaptionState,
 )
@@ -76,17 +80,31 @@ def _trades(ctx, query, body):
         }
         for o in _states(ctx, SwaptionState)
     ]
-    return 200, {"trades": swaps + swaptions}
+    forwards = [
+        {
+            "type": "fx_forward",
+            "buyer": f.buyer.name,
+            "seller": f.seller.name,
+            "notional_fgn": f.notional_fgn,
+            "strike_milli": f.strike_milli,
+            "foreign_ccy": f.foreign_ccy,
+        }
+        for f in _states(ctx, FxForwardState)
+    ]
+    return 200, {"trades": swaps + swaptions + forwards}
 
 
 def _summary(ctx, query, body):
     swaps = _states(ctx, InterestRateSwapState)
     swaptions = _states(ctx, SwaptionState)
+    forwards = _states(ctx, FxForwardState)
     return 200, {
         "swaps": len(swaps),
         "swaptions": len(swaptions),
+        "fx_forwards": len(forwards),
         "swap_notional": sum(s.notional for s in swaps),
         "swaption_notional": sum(o.notional for o in swaptions),
+        "fx_forward_notional": sum(f.notional_fgn for f in forwards),
     }
 
 
@@ -104,19 +122,21 @@ def _margin(ctx, query, body):
     now = _parse_t(query)
     swaps = _states(ctx, InterestRateSwapState)
     swaptions = _states(ctx, SwaptionState)
-    delta, vega = portfolio_ladders(swaps, now, swaptions)
-    parts = simm.simm_breakdown(delta, vega)
-    # the total IS the sum of the layers (simm.simm_im's definition) —
-    # one pricing pass, no second computation to drift from the parts
-    total = int(
-        round(parts["delta"] + parts["vega"] + parts["curvature"])
+    forwards = _states(ctx, FxForwardState)
+    delta, vega, fx = portfolio_ladders(
+        swaps, now, swaptions, fx_forwards=forwards
     )
+    parts = simm.simm_breakdown(delta, vega, fx)
+    # the total IS the psi cross-class aggregate (simm.simm_im's
+    # definition) — one pricing pass, no second computation to drift
+    # from the parts
     return 200, {
         "delta": round(parts["delta"], 2),
         "vega": round(parts["vega"], 2),
         "curvature": round(parts["curvature"], 2),
-        "margin": total,
-        "trades": len(swaps) + len(swaptions),
+        "fx": round(parts["fx"], 2),
+        "margin": int(round(parts["total"])),
+        "trades": len(swaps) + len(swaptions) + len(forwards),
     }
 
 
@@ -158,9 +178,11 @@ def _calculate(ctx, query, body):
     me = ctx.wait(ctx.client.node_identity()).legal_identity
     swaps = _states(ctx, InterestRateSwapState)
     swaptions = _states(ctx, SwaptionState)
-    margin = initial_margin(swaps, now, swaptions)
+    forwards = _states(ctx, FxForwardState)
+    margin = initial_margin(swaps, now, swaptions, fx_forwards=forwards)
     valuation = PortfolioValuationState(
-        me, parties[counterparty], now, len(swaps) + len(swaptions), margin
+        me, parties[counterparty], now,
+        len(swaps) + len(swaptions) + len(forwards), margin,
     )
     handle = ctx.wait(
         ctx.client.start_flow(

@@ -125,21 +125,26 @@ def test_oracle_refuses_wrong_rate_and_extra_reveals():
 
 
 def test_simm_demo():
-    """Two-node agreement on a MIXED delta+vega portfolio: 3 swaps +
-    2 swaptions recorded on ledger, both parties reprice off the shared
-    demo market, margin carries delta, vega and curvature layers."""
+    """Two-node agreement on a MIXED multi-risk-class portfolio:
+    3 swaps + 2 swaptions + 2 FX forwards recorded on ledger, both
+    parties reprice off the shared demo market, margin carries the IR
+    (delta/vega/curvature) and FX risk classes psi-aggregated."""
     from corda_tpu.samples import simm_demo
 
     v = simm_demo.run()
-    assert v.portfolio_size == 5
+    assert v.portfolio_size == 7
     assert v.margin > 0
     # determinism: both sides' valuation function is pure
     assert v.margin == simm_demo.run(seed=42).margin
     # the vega layers genuinely contribute: dropping the swaptions from
     # the valuation must LOWER the margin
     delta_only = simm_demo.run(n_swaptions=0)
-    assert delta_only.portfolio_size == 3
+    assert delta_only.portfolio_size == 5
     assert delta_only.margin < v.margin
+    # the FX class genuinely contributes too
+    no_fx = simm_demo.run(n_fx_forwards=0)
+    assert no_fx.portfolio_size == 5
+    assert no_fx.margin < v.margin
 
 
 def test_simm_vega_curvature_layers():
@@ -168,6 +173,127 @@ def test_simm_vega_curvature_layers():
     short = simm.simm_breakdown({}, {"LIBOR": -vega})
     assert short["curvature"] >= 0.0
     assert short["vega"] == parts["vega"]   # |.| symmetric quadratic
+
+
+def test_simm_fx_class_and_psi_aggregation():
+    """FX delta margin follows the published single-bucket shape and
+    the cross-risk-class psi aggregation is sub-additive: strictly
+    between max(IM_r) and sum(IM_r) for two active classes."""
+    import math
+
+    import numpy as np
+
+    from corda_tpu.samples import simm
+
+    # single currency: K = RW * |s|, sign-symmetric
+    one = simm.fx_margin({"EUR": 1000.0})
+    assert abs(one - simm.FX_RISK_WEIGHT * 1000.0) < 1e-9
+    assert simm.fx_margin({"EUR": -1000.0}) == one
+    # two currencies at 0.5 correlation: sqrt(w1^2 + w2^2 + w1*w2)
+    two = simm.fx_margin({"EUR": 1000.0, "GBP": 1000.0})
+    w = simm.FX_RISK_WEIGHT * 1000.0
+    assert abs(two - math.sqrt(3.0 * w * w)) < 1e-9
+    # opposite exposures net: margin strictly below one-sided
+    assert simm.fx_margin({"EUR": 1000.0, "GBP": -1000.0}) < two
+
+    # psi aggregation: with one class it degenerates to that margin...
+    lad = simm.bucket_pv01(10_000_000, 5.0)
+    ir_only = simm.simm_breakdown({"USD": lad})
+    assert abs(
+        ir_only["total"]
+        - (ir_only["delta"] + ir_only["vega"] + ir_only["curvature"])
+    ) < 1e-9
+    # ...with two active classes it is sub-additive but more than max
+    both = simm.simm_breakdown({"USD": lad}, fx_deltas={"EUR": 50_000.0})
+    ir = both["delta"] + both["vega"] + both["curvature"]
+    assert both["fx"] > 0.0 and ir > 0.0
+    assert max(ir, both["fx"]) < both["total"] < ir + both["fx"]
+    # unknown class names must raise, not silently drop margin
+    try:
+        simm.product_margin({"Equities": 1.0})
+        raise AssertionError("unknown risk class accepted")
+    except ValueError:
+        pass
+    # psi matrix sanity: symmetric PSD with unit diagonal
+    psi = simm.RISK_CLASS_PSI
+    assert np.allclose(psi, psi.T)
+    assert np.all(np.diag(psi) == 1.0)
+    assert np.linalg.eigvalsh(psi).min() > 0.0
+
+
+def test_fx_forward_pricing():
+    """The FX forward pricer obeys covered interest parity: zero PV at
+    the fair forward rate, positive spot delta for a long-foreign
+    position, and rate ladders with opposite-signed legs."""
+    from corda_tpu.samples import pricing
+
+    dom, _ = pricing.demo_market()
+    fgn = pricing.demo_foreign_curve("EUR")
+    spot = pricing.DEMO_FX_SPOTS["EUR"]
+    t = 2.0
+    fair = spot * fgn.df(t) / dom.df(t)
+    assert abs(
+        pricing.fx_forward_pv(1_000_000, fair, t, dom, fgn, spot)
+    ) < 1e-6
+    # long foreign currency gains when spot rises
+    d = pricing.fx_forward_spot_delta(1_000_000, fair, t, dom, fgn, spot)
+    assert d > 0
+    # ~1% of the discounted foreign notional
+    assert abs(d - 0.01 * spot * fgn.df(t) * 1_000_000) < 1e-6
+    dom_lad, fgn_lad = pricing.fx_forward_rate_ladders(
+        1_000_000, fair, t, dom, fgn, spot
+    )
+    # paying domestic at T: rates up => pay leg discounts harder => PV up
+    assert dom_lad.sum() > 0
+    # receiving foreign at T: foreign rates up => receive leg worth less
+    assert fgn_lad.sum() < 0
+
+
+def test_fx_forward_domestic_delta_nets_with_swaps():
+    """The forward's domestic pay leg prices off the same curve as the
+    swaps, so its IR delta must land in the swaps' bucket and net
+    intra-bucket — not sit in a separate bucket correlated at the
+    cross-bucket gamma."""
+    from corda_tpu.core.identity import Party
+    from corda_tpu.crypto import schemes
+    from corda_tpu.samples import simm_demo
+    from corda_tpu.samples.irs_demo import InterestRateSwapState
+    from corda_tpu.samples.simm_demo import FxForwardState
+
+    def party(name, seed):
+        kp = schemes.generate_keypair(
+            schemes.EDDSA_ED25519_SHA512, seed=seed
+        )
+        return Party(name, kp.public)
+
+    a, b, o = party("A", 1), party("B", 2), party("O", 3)
+    year = 31_557_600 * 10**6
+    swap = InterestRateSwapState(
+        fixed_payer=a, floating_payer=b, oracle=o,
+        notional=1_000_000, fixed_rate_bps=400,
+        index_name="LIBOR-3M", fixing_dates=(2 * year,),
+    )
+    fwd = FxForwardState(
+        buyer=a, seller=b, notional_fgn=1_000_000,
+        strike_milli=1_100, maturity_micros=2 * year,
+        foreign_ccy="EUR",
+    )
+    delta, _, fx = simm_demo.portfolio_ladders(
+        [swap], 0, fx_forwards=[fwd]
+    )
+    assert "USD" not in delta            # no phantom separate bucket
+    assert simm_demo.DOMESTIC_BUCKET in delta and "EUR" in delta
+    assert fx["EUR"] > 0
+    # and the combined domestic ladder is genuinely the sum of legs
+    d_swap, _, _ = simm_demo.portfolio_ladders([swap], 0)
+    d_fwd, _, _ = simm_demo.portfolio_ladders([], 0, fx_forwards=[fwd])
+    import numpy as np
+
+    np.testing.assert_allclose(
+        delta[simm_demo.DOMESTIC_BUCKET],
+        d_swap[simm_demo.DOMESTIC_BUCKET]
+        + d_fwd[simm_demo.DOMESTIC_BUCKET],
+    )
 
 
 def test_pricing_curve_sensitivities():
