@@ -388,9 +388,7 @@ class LedgerTransaction:
         if special is not None:
             special()
             return
-        names = {ts.contract for ts in self.outputs}
-        names.update(sar.state.contract for sar in self.inputs)
-        for name in sorted(names):
+        for name in self.contract_names():
             try:
                 contract = contract_by_name(name)
             except ContractViolation:
@@ -402,6 +400,15 @@ class LedgerTransaction:
 
                 contract = contract_from_attachments(name, self.attachments)
             contract.verify(self)
+
+    def contract_names(self) -> list[str]:
+        """Every contract this transaction touches, in the (sorted)
+        order `verify` runs them. ONE implementation shared with the
+        batch path (core/batch_verify.py) — two copies that drift would
+        let the batch path run fewer contracts than per-tx verify."""
+        names = {ts.contract for ts in self.outputs}
+        names.update(sar.state.contract for sar in self.inputs)
+        return sorted(names)
 
     # -- state grouping (LedgerTransaction.groupStates:142) ----------------
 

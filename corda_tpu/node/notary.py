@@ -390,24 +390,27 @@ class BatchingNotaryService(NotaryService):
                 results = verifier.verify_batch(reqs)
             # overlap: contract execution (host Python) runs while the
             # device computes the signature batch and the collector
-            # thread drains the result transfer. The in-memory verifier
-            # is called without its per-tx future wrap — the SPI seam
-            # stays for out-of-process verifiers.
-            from .services import InMemoryTransactionVerifierService
-
+            # thread drains the result transfer. Contracts run through
+            # the SPI's BATCH entry point: one grouped-by-contract pass
+            # for the in-memory service (asset contracts verify the
+            # whole flush in a specialized sweep, core/batch_verify.py),
+            # per-tx futures for out-of-process pools.
             tv = self.services.transaction_verifier
-            inline = isinstance(tv, InMemoryTransactionVerifierService)
             contract_errs: list[Optional[Exception]] = []
-            for p in pending:
+            ltxs: list = []
+            ltx_idx: list[int] = []
+            for i, p in enumerate(pending):
                 try:
-                    ltx = p.stx.to_ledger_transaction(self.services)
-                    if inline:
-                        ltx.verify()
-                    else:
-                        tv.verify(ltx).result()
+                    ltxs.append(p.stx.to_ledger_transaction(self.services))
+                    ltx_idx.append(i)
                     contract_errs.append(None)
                 except Exception as e:
                     contract_errs.append(e)
+            for i, fut in zip(ltx_idx, tv.verify_many(ltxs)):
+                try:
+                    fut.result()
+                except Exception as e:
+                    contract_errs[i] = e
             if collector is not None:
                 collector.join()
                 if "error" in box:

@@ -780,6 +780,13 @@ class TransactionVerifierService:
     def verify(self, ltx: LedgerTransaction) -> _Future:
         raise NotImplementedError
 
+    def verify_many(self, ltxs: list[LedgerTransaction]) -> list[_Future]:
+        """Batch entry point (no reference analogue — its verification
+        is per-tx on thread pools). Implementations that can check a
+        whole batch in one pass override this; the default preserves
+        per-tx dispatch semantics."""
+        return [self.verify(ltx) for ltx in ltxs]
+
 
 class InMemoryTransactionVerifierService(TransactionVerifierService):
     """Runs contract verification inline (reference: InMemoryTransaction-
@@ -794,6 +801,21 @@ class InMemoryTransactionVerifierService(TransactionVerifierService):
         except Exception as e:
             f.set_exception(e)
         return f
+
+    def verify_many(self, ltxs: list[LedgerTransaction]) -> list[_Future]:
+        """One grouped-by-contract pass over the whole batch
+        (core/batch_verify.py) — the notary flush's contract phase."""
+        from ..core.batch_verify import verify_ledger_batch
+
+        futs = []
+        for err in verify_ledger_batch(ltxs):
+            f = _Future()
+            if err is None:
+                f.set_result()
+            else:
+                f.set_exception(err)
+            futs.append(f)
+        return futs
 
 
 # ---------------------------------------------------------------------------

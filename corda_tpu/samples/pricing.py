@@ -120,12 +120,18 @@ def demo_market() -> tuple[ZeroCurve, VolCurve]:
 DEMO_FX_SPOTS = {"EUR": 1.09, "GBP": 1.27}
 
 
-def demo_foreign_curve(ccy: str) -> ZeroCurve:
-    """Foreign zero curve for a demo currency: the domestic shape with
+def demo_foreign_curve(
+    ccy: str, domestic: ZeroCurve | None = None
+) -> ZeroCurve:
+    """Foreign zero curve for a demo currency: the DOMESTIC curve with
     a fixed per-currency basis so forwards carry real rate differential
-    risk on both curves."""
+    risk on both curves. Pass the domestic curve actually being priced
+    against (e.g. a scenario-bumped one) so both legs of the
+    covered-interest-parity formula move together; default is the
+    fixture market."""
     basis = {"EUR": -0.007, "GBP": 0.004}.get(ccy, 0.0)
-    domestic, _ = demo_market()
+    if domestic is None:
+        domestic, _ = demo_market()
     return ZeroCurve(
         tuple(max(z + basis, 1e-4) for z in domestic.rates)
     )

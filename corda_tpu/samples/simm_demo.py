@@ -158,9 +158,17 @@ def portfolio_ladders(
                 curve, vols, o.is_payer,
             ),
         )
+    # foreign curves derive from the CALLER's domestic curve (basis
+    # spread), built once per currency — a scenario-bumped market
+    # moves both legs of every forward consistently
+    fgn_curves: dict = {}
     for f in fx_forwards:
         years = max((f.maturity_micros - now_micros) / _YEAR_MICROS, 0.0)
-        fgn_curve = pricing.demo_foreign_curve(f.foreign_ccy)
+        fgn_curve = fgn_curves.get(f.foreign_ccy)
+        if fgn_curve is None:
+            fgn_curve = fgn_curves[f.foreign_ccy] = (
+                pricing.demo_foreign_curve(f.foreign_ccy, curve)
+            )
         spot = pricing.DEMO_FX_SPOTS[f.foreign_ccy]
         strike = f.strike_milli / 1000.0
         add(

@@ -1,0 +1,308 @@
+"""Batched contract execution (core/batch_verify.py).
+
+The batch path must be decision-identical to per-transaction
+`ltx.verify()` — same accept/reject, same exception type and message —
+because the notary flush answers requesters from it. The fuzzer below
+drives the specialized OnLedgerAsset sweep against the clause stack
+over thousands of randomly corrupted asset transactions (the
+GeneratedLedger idea from the reference's verifier tests,
+verifier/src/integration-test/.../GeneratedLedger.kt, aimed at the two
+implementations instead of two processes).
+"""
+
+import random
+
+import pytest
+
+from corda_tpu.core.batch_verify import verify_ledger_batch
+from corda_tpu.core.contracts import (
+    Amount,
+    CommandWithParties,
+    ContractViolation,
+    Issued,
+    StateAndRef,
+    StateRef,
+    TransactionState,
+    contract_by_name,
+    register_contract,
+)
+from corda_tpu.core.identity import Party, PartyAndReference
+from corda_tpu.core.transactions import LedgerTransaction
+from corda_tpu.crypto import schemes
+from corda_tpu.crypto.composite import CompositeKey
+from corda_tpu.crypto.hashes import SecureHash
+from corda_tpu.finance.cash import (
+    CASH_CONTRACT,
+    CashExit,
+    CashIssue,
+    CashMove,
+    CashState,
+)
+
+KPS = [schemes.generate_keypair(seed=200 + i) for i in range(6)]
+ISSUER_A = Party("IssuerA", KPS[0].public)
+ISSUER_B = Party("IssuerB", KPS[1].public)
+NOTARY = Party("Notary", KPS[5].public)
+OWNERS = [kp.public for kp in KPS[2:5]]
+
+TOKENS = [
+    Issued(PartyAndReference(ISSUER_A, b"\x01"), "USD"),
+    Issued(PartyAndReference(ISSUER_A, b"\x02"), "EUR"),
+    Issued(PartyAndReference(ISSUER_B, b"\x01"), "USD"),
+]
+
+CASH = contract_by_name(CASH_CONTRACT)
+
+
+def ltx(inputs=(), outputs=(), commands=(), contract=CASH_CONTRACT):
+    ins = tuple(
+        StateAndRef(
+            TransactionState(s, contract, NOTARY),
+            StateRef(SecureHash.sha256(bytes([i])), i),
+        )
+        for i, s in enumerate(inputs)
+    )
+    outs = tuple(TransactionState(s, contract, NOTARY) for s in outputs)
+    cmds = tuple(
+        CommandWithParties(tuple(signers), (), value)
+        for value, signers in commands
+    )
+    return LedgerTransaction(
+        ins, outs, cmds, (), NOTARY, None, SecureHash.sha256(b"batch-test")
+    )
+
+
+def outcome(fn):
+    try:
+        fn()
+        return None
+    except Exception as e:  # noqa: BLE001 - comparing outcomes
+        return (type(e).__name__, str(e))
+
+
+def norm(err):
+    return None if err is None else (type(err).__name__, str(err))
+
+
+def assert_equivalent(l):
+    """Clause stack vs specialized batch sweep: identical outcome."""
+    expected = outcome(lambda: CASH.verify(l))
+    got = norm(CASH.verify_batch([l])[0])
+    assert got == expected, f"batch diverged: {got} != {expected}"
+    return expected
+
+
+def random_cash_tx(rng: random.Random):
+    """One randomly-shaped (and randomly corrupted) cash transaction."""
+    inputs, outputs, commands = [], [], []
+    for token in rng.sample(TOKENS, rng.randint(1, len(TOKENS))):
+        kind = rng.choice(("issue", "move", "exit"))
+        issuer_kp = KPS[0] if token.issuer.party is ISSUER_A else KPS[1]
+        owner = rng.choice(OWNERS)
+        owner_kp = next(kp for kp in KPS if kp.public == owner)
+        if kind == "issue":
+            amounts = [rng.randint(0, 500) for _ in range(rng.randint(1, 3))]
+            outputs += [CashState(Amount(a, token), owner) for a in amounts]
+            signer = rng.choice((issuer_kp.public, owner))   # maybe wrong
+            commands.append((CashIssue(rng.randint(0, 9)), [signer]))
+        elif kind == "move":
+            total = rng.randint(2, 1000)
+            inputs.append(CashState(Amount(total, token), owner))
+            out_total = rng.choice((total, total + 1, total - 1))  # maybe bad
+            split = rng.randint(0, out_total - 1)
+            outs = [split, out_total - split] if split else [out_total]
+            outputs += [
+                CashState(Amount(a, token), rng.choice(OWNERS))
+                for a in outs
+                if a != 0 or rng.random() < 0.3   # keep some zero outputs
+            ]
+            signer = rng.choice((owner, rng.choice(OWNERS)))  # maybe wrong
+            commands.append((CashMove(), [signer]))
+        else:
+            held = rng.randint(2, 1000)
+            inputs.append(CashState(Amount(held, token), owner))
+            exited = rng.choice((held, held // 2, held + 1))
+            if exited < held:
+                outputs.append(CashState(Amount(held - exited, token), owner))
+            signers = [owner_kp.public]
+            if rng.random() < 0.8:
+                signers.append(issuer_kp.public)   # sometimes missing
+            commands.append((CashExit(Amount(exited, token)), signers))
+    if rng.random() < 0.15:   # extra command that may go unprocessed
+        commands.append((CashMove(), [rng.choice(OWNERS)]))
+    rng.shuffle(commands)
+    return ltx(inputs, outputs, commands)
+
+
+def test_fuzz_batch_equals_clause_stack():
+    rng = random.Random(20260731)
+    accepts = rejects = 0
+    for _ in range(2000):
+        l = random_cash_tx(rng)
+        if assert_equivalent(l) is None:
+            accepts += 1
+        else:
+            rejects += 1
+    # the fuzzer must genuinely exercise both sides of the decision
+    assert accepts > 200 and rejects > 200
+
+
+def test_batch_composite_owner_equivalence():
+    """signed_by's composite-key path: a 1-of-2 composite owner moved
+    with one leaf signing is valid through both implementations."""
+    comp = CompositeKey.build([OWNERS[0], OWNERS[1]], threshold=1)
+    token = TOKENS[0]
+    good = ltx(
+        [CashState(Amount(100, token), comp)],
+        [CashState(Amount(100, token), OWNERS[2])],
+        [(CashMove(), [OWNERS[1]])],
+    )
+    bad = ltx(
+        [CashState(Amount(100, token), comp)],
+        [CashState(Amount(100, token), OWNERS[2])],
+        [(CashMove(), [OWNERS[2]])],
+    )
+    assert assert_equivalent(good) is None
+    assert assert_equivalent(bad) is not None
+
+
+def test_verify_ledger_batch_mixed_list():
+    """verify_ledger_batch over a mixed batch equals per-tx verify —
+    including a transaction whose contract has NO verify_batch (falls
+    back) and the error-reporting order for failures."""
+    token = TOKENS[0]
+    valid = ltx(
+        [CashState(Amount(50, token), OWNERS[0])],
+        [CashState(Amount(50, token), OWNERS[1])],
+        [(CashMove(), [OWNERS[0]])],
+    )
+    bad_conservation = ltx(
+        [CashState(Amount(50, token), OWNERS[0])],
+        [CashState(Amount(60, token), OWNERS[1])],
+        [(CashMove(), [OWNERS[0]])],
+    )
+
+    class _PlainContract:        # no verify_batch: per-tx fallback
+        def verify(self, l) -> None:
+            if len(l.outputs) != 1:
+                raise ContractViolation("plain contract wants one output")
+
+    register_contract("test.batch.Plain", _PlainContract())
+    plain_ok = ltx(outputs=[CashState(Amount(1, token), OWNERS[0])],
+                   commands=[], contract="test.batch.Plain")
+    plain_bad = ltx(
+        outputs=[CashState(Amount(1, token), OWNERS[0]),
+                 CashState(Amount(2, token), OWNERS[0])],
+        commands=[], contract="test.batch.Plain",
+    )
+    batch = [valid, bad_conservation, plain_ok, plain_bad]
+    got = [norm(e) for e in verify_ledger_batch(batch)]
+    expected = [outcome(l.verify) for l in batch]
+    assert got == expected
+    assert got[0] is None and got[2] is None
+    assert got[1] is not None and "conserved" in got[1][1]
+    assert got[3] is not None and "one output" in got[3][1]
+
+
+def test_verify_many_spi_batches():
+    """The in-memory SPI's verify_many answers through the batch layer
+    with per-future semantics identical to verify()."""
+    from corda_tpu.node.services import InMemoryTransactionVerifierService
+
+    token = TOKENS[1]
+    txs = [
+        ltx(
+            [CashState(Amount(10 + i, token), OWNERS[0])],
+            [CashState(Amount(10 + i + (i % 2), token), OWNERS[1])],
+            [(CashMove(), [OWNERS[0]])],
+        )
+        for i in range(6)
+    ]
+    svc = InMemoryTransactionVerifierService()
+    futs = svc.verify_many(txs)
+    for l, fut in zip(txs, futs):
+        assert outcome(fut.result) == outcome(l.verify)
+
+
+def test_multi_contract_tx_error_order():
+    """A transaction touching two contracts reports the first failing
+    contract in sorted-name order — the per-tx verify order."""
+
+    class _AlwaysFails:
+        def verify(self, l) -> None:
+            raise ContractViolation("aaa contract always fails")
+
+        def verify_batch(self, ltxs):
+            return [ContractViolation("aaa contract always fails")
+                    for _ in ltxs]
+
+    register_contract("aaa.test.First", _AlwaysFails())
+    token = TOKENS[0]
+    ins = (
+        StateAndRef(
+            TransactionState(
+                CashState(Amount(50, token), OWNERS[0]), CASH_CONTRACT,
+                NOTARY,
+            ),
+            StateRef(SecureHash.sha256(b"\x07"), 0),
+        ),
+    )
+    outs = (
+        TransactionState(
+            CashState(Amount(60, token), OWNERS[1]), "aaa.test.First",
+            NOTARY,
+        ),
+    )
+    cmds = (CommandWithParties((OWNERS[0],), (), CashMove()),)
+    l = LedgerTransaction(
+        ins, outs, cmds, (), NOTARY, None, SecureHash.sha256(b"mc")
+    )
+    per_tx = outcome(l.verify)
+    batch = norm(verify_ledger_batch([l])[0])
+    assert batch == per_tx
+    assert "aaa contract always fails" in batch[1]
+
+
+def test_faulty_verify_batch_is_confined():
+    """A broken verify_batch (wrong arity, or raising outright) falls
+    back to per-tx verify for ITS transactions — it must not fail the
+    thousands of unrelated requesters sharing the notary flush."""
+    token = TOKENS[0]
+
+    class _WrongArity:
+        def verify(self, l) -> None:
+            if len(l.outputs) > 1:
+                raise ContractViolation("wrong-arity contract: one output")
+
+        def verify_batch(self, ltxs):
+            return []   # wrong arity
+
+    class _Raises:
+        def verify(self, l) -> None:
+            pass
+
+        def verify_batch(self, ltxs):
+            raise RuntimeError("batch impl exploded")
+
+    register_contract("test.batch.WrongArity", _WrongArity())
+    register_contract("test.batch.Raises", _Raises())
+    cash_ok = ltx(
+        [CashState(Amount(50, token), OWNERS[0])],
+        [CashState(Amount(50, token), OWNERS[1])],
+        [(CashMove(), [OWNERS[0]])],
+    )
+    arity_ok = ltx(outputs=[CashState(Amount(1, token), OWNERS[0])],
+                   commands=[], contract="test.batch.WrongArity")
+    arity_bad = ltx(
+        outputs=[CashState(Amount(1, token), OWNERS[0]),
+                 CashState(Amount(2, token), OWNERS[0])],
+        commands=[], contract="test.batch.WrongArity",
+    )
+    raises_ok = ltx(outputs=[CashState(Amount(1, token), OWNERS[0])],
+                    commands=[], contract="test.batch.Raises")
+    batch = [cash_ok, arity_ok, arity_bad, raises_ok]
+    got = [norm(e) for e in verify_ledger_batch(batch)]
+    expected = [outcome(l.verify) for l in batch]
+    assert got == expected
+    assert got[0] is None and got[1] is None and got[3] is None
+    assert got[2] is not None and "one output" in got[2][1]
