@@ -53,9 +53,17 @@ def verify_ledger_batch(
     by_contract: dict[str, list[int]] = {}
     contracts: dict[str, object] = {}
     for i, ltx in enumerate(ltxs):
-        if _repl.replacement_verifier(ltx) is not None:
-            continue  # per-tx fallback (special replacement rules)
-        names = ltx.contract_names()
+        # classification itself can raise on a malformed transaction
+        # (e.g. a replacement command mixed with others raises in
+        # replacement_verifier) — route it to the per-tx fallback,
+        # whose ltx.verify() reproduces the same error into errs[i]
+        # instead of letting it escape and strand the whole batch
+        try:
+            if _repl.replacement_verifier(ltx) is not None:
+                continue  # per-tx fallback (special replacement rules)
+            names = ltx.contract_names()
+        except Exception:  # noqa: BLE001 - fault isolation
+            continue
         batchable = True
         for name in names:
             contract = contracts.get(name)

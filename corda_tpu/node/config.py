@@ -62,6 +62,11 @@ class NodeConfig:
     network_map_port: int = 0
     network_map_fingerprint: Optional[bytes] = None
     notary: str = ""
+    # batching-notary deadline, microseconds: 0 flushes every pump
+    # tick; positive holds arrivals until the oldest has waited this
+    # long (or the batch fills), trading bounded latency for deeper —
+    # faster — flushes (notary.py BatchingNotaryService)
+    notary_batch_wait_micros: int = 0
     verifier_type: str = "in_memory"
     # which BatchSignatureVerifier backs signature checks: "tpu" (the
     # production batch kernels) or "cpu" (the bit-exact reference —
@@ -192,6 +197,8 @@ def write_config(cfg: NodeConfig, path: str) -> None:
     if cfg.network_map_fingerprint is not None:
         emit("network_map_fingerprint", cfg.network_map_fingerprint.hex())
     emit("notary", cfg.notary)
+    if cfg.notary_batch_wait_micros:
+        emit("notary_batch_wait_micros", cfg.notary_batch_wait_micros)
     emit("verifier_type", cfg.verifier_type)
     emit("verifier_backend", cfg.verifier_backend)
     emit("dev_mode", cfg.dev_mode)

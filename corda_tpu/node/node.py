@@ -376,10 +376,16 @@ class Node:
             return
         if kind in ("simple", "validating", "batching"):
             uniqueness = PersistentUniquenessProvider(self.db)
+            if kind == "batching":
+                self.services.notary_service = BatchingNotaryService(
+                    self.services,
+                    uniqueness,
+                    max_wait_micros=self.config.notary_batch_wait_micros,
+                )
+                return
             cls = {
                 "simple": SimpleNotaryService,
                 "validating": ValidatingNotaryService,
-                "batching": BatchingNotaryService,
             }[kind]
             self.services.notary_service = cls(self.services, uniqueness)
             return

@@ -306,12 +306,22 @@ class BatchingNotaryService(NotaryService):
         tolerance_micros: int = 30_000_000,
         service_identity: Optional[Party] = None,
         max_batch: int = 512,
+        max_wait_micros: int = 0,
     ):
+        """`max_wait_micros` is the batching DEADLINE (SURVEY §7 hard
+        part 4 — latency vs throughput): 0 (default) flushes every pump
+        tick; positive, the tick HOLDS arrivals until the oldest one
+        has waited that long (or `max_batch` fills), so a lightly
+        loaded notary still forms deep batches — throughput rides the
+        flush depth (BASELINE.md round-3 sweep), at a bounded latency
+        cost the operator chooses."""
         super().__init__(
             services, uniqueness, tolerance_micros, service_identity
         )
         self.max_batch = max_batch
+        self.max_wait_micros = max_wait_micros
         self._pending: list[_PendingNotarisation] = []
+        self._oldest_arrival: Optional[int] = None
         # metrics: dispatches vs requests shows the batching ratio
         self.batches_dispatched = 0
         self.requests_batched = 0
@@ -325,6 +335,8 @@ class BatchingNotaryService(NotaryService):
                 f"tx names notary {stx.wtx.notary}, I am {self.identity}",
             )
         fut = FlowFuture()
+        if not self._pending:
+            self._oldest_arrival = self.services.clock.now_micros()
         self._pending.append(_PendingNotarisation(stx, requester, fut))
         if len(self._pending) >= self.max_batch:
             self.flush()
@@ -333,15 +345,26 @@ class BatchingNotaryService(NotaryService):
 
     def tick(self) -> int:
         """Pump hook (MockNetwork `node.ticks` / Node._tick_services):
-        flush whatever accumulated during the last delivery round.
-        Returns the number of requests answered (0 = quiescent)."""
+        flush whatever accumulated during the last delivery round —
+        unless a batching deadline is set and neither it nor max_batch
+        has been reached yet. Returns requests answered (0 = held or
+        quiescent)."""
         n = len(self._pending)
-        if n:
-            self.flush()
+        if not n:
+            return 0
+        if self.max_wait_micros and n < self.max_batch:
+            age = (
+                self.services.clock.now_micros()
+                - (self._oldest_arrival or 0)
+            )
+            if age < self.max_wait_micros:
+                return 0
+        self.flush()
         return n
 
     def flush(self) -> None:
         pending, self._pending = self._pending, []
+        self._oldest_arrival = None
         if not pending:
             return
         # phase 1 — ONE SPI dispatch across all pending transactions.

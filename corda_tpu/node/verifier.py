@@ -312,14 +312,45 @@ class VerifierWorker:
             # still answer every queued request — silence would leave
             # all node-side futures hanging forever
             batch_error = f"VerifierDispatchError: {type(e).__name__}: {e}"
+        # the signature gate runs FIRST (sig results are already on
+        # the host here): a request with invalid signatures must not
+        # reach contract execution at all — the contract phase can run
+        # attachment-carried sandboxed code, and executing it for a
+        # transaction nobody signed is free attack surface
+        sig_errs: list[Optional[Exception]] = []
         for req, (off, n) in zip(pending, spans):
+            err: Optional[Exception] = None
+            if batch_error is None and req.stx is not None:
+                try:
+                    req.stx.raise_on_invalid(sig_ok[off : off + n])
+                except Exception as e:  # noqa: BLE001 - reported per req
+                    err = e
+            sig_errs.append(err)
+        # contract phase: grouped-by-contract across the sig-valid
+        # requests (core/batch_verify.py) — the same sweep the
+        # batching notary uses. Guarded: pending is already detached
+        # from self._queue, so an escaping exception would strand
+        # every node-side future.
+        contract_errs: list[Optional[Exception]] = [None] * len(pending)
+        live = [
+            i for i, e in enumerate(sig_errs)
+            if batch_error is None and e is None
+        ]
+        if live:
+            from ..core.batch_verify import verify_ledger_batch
+
+            try:
+                batch = verify_ledger_batch([pending[i].ltx for i in live])
+                for i, cerr in zip(live, batch):
+                    contract_errs[i] = cerr
+            except Exception as e:  # noqa: BLE001 - answer, don't strand
+                for i in live:
+                    contract_errs[i] = e
+        for req, serr, cerr in zip(pending, sig_errs, contract_errs):
             error = batch_error
             if error is None:
-                try:
-                    if req.stx is not None:
-                        req.stx.raise_on_invalid(sig_ok[off : off + n])
-                    req.ltx.verify()
-                except Exception as e:
+                e = serr or cerr
+                if e is not None:
                     error = f"{type(e).__name__}: {e}"
             if error is None:
                 self._verified.mark()

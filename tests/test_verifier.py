@@ -156,3 +156,86 @@ def test_prometheus_export_has_verifier_metrics():
     assert "TransactionVerifierService_Verification_Success_total 1" in text
     assert "TransactionVerifierService_VerificationsInFlight 0" in text
     assert "TransactionVerifierService_Verification_Duration_total 1" in text
+
+
+def test_malformed_tx_in_batch_answers_every_request():
+    """A transaction whose CLASSIFICATION raises (replacement command
+    mixed with another command) must fail only itself — the queue was
+    already detached, so an escaping exception would strand every
+    node-side future forever."""
+    from corda_tpu.core.replacement import NotaryChangeCommand
+
+    net, alice, stx, ltx = issue_and_resolve()
+    notary2 = alice.services.network_map_cache.notary_identities()[0]
+    # malformed: a replacement command alongside the tx's own commands
+    bad_ltx = type(ltx)(
+        ltx.inputs,
+        ltx.outputs,
+        ltx.commands
+        + (
+            type(ltx.commands[0])(
+                ltx.commands[0].signers, (), NotaryChangeCommand(notary2)
+            ),
+        ),
+        ltx.attachments,
+        ltx.notary,
+        ltx.time_window,
+        ltx.id,
+    )
+    svc = OutOfProcessTransactionVerifierService(alice.messaging)
+    worker = attach_worker(net, "Alice", "worker-1", batch_window=100)
+    net.fabric.run()
+    good_fut = svc.verify(ltx, stx)
+    bad_fut = svc.verify(bad_ltx, stx)
+    net.fabric.run()
+    # window not reached: both requests queued; drain them in ONE batch
+    assert not good_fut.done
+    assert worker.drain() == 2
+    net.fabric.run()
+    assert good_fut.done and bad_fut.done
+    good_fut.result()                       # the good tx verified fine
+    with pytest.raises(VerificationFailedError):
+        bad_fut.result()                    # the bad one failed alone
+
+
+def test_invalid_signature_gates_contract_execution():
+    """A request with bad signatures never reaches contract execution:
+    contract code (possibly attachment-carried sandboxed code) must not
+    run for a transaction nobody validly signed."""
+    from corda_tpu.core.contracts import register_contract
+
+    ran = []
+
+    class _SpyContract:
+        def verify(self, l) -> None:
+            ran.append(l.id)
+
+    register_contract("test.verifier.Spy", _SpyContract())
+    net, alice, stx, ltx = issue_and_resolve()
+    spy_ltx = type(ltx)(
+        (),
+        tuple(
+            type(ts)(ts.data, "test.verifier.Spy", ts.notary)
+            for ts in ltx.outputs
+        ),
+        ltx.commands,
+        (),
+        ltx.notary,
+        None,
+        ltx.id,
+    )
+    notary = alice.services.network_map_cache.notary_identities()[0]
+    other = alice.run_flow(CashIssueFlow(5, "EUR", alice.party, notary))
+    wrong_sig = alice.services.key_management.sign(
+        other.id, alice.party.owning_key
+    )
+    forged = SignedTransaction(stx.wtx, (wrong_sig,))
+
+    svc = OutOfProcessTransactionVerifierService(alice.messaging)
+    attach_worker(net, "Alice", "worker-1")
+    net.fabric.run()
+    fut = svc.verify(spy_ltx, forged)
+    net.fabric.run()
+    with pytest.raises(VerificationFailedError, match="[Ii]nvalid signature"):
+        fut.result()
+    assert ran == []      # the contract never executed
