@@ -389,6 +389,8 @@ def main() -> None:
     # full table: secondary metrics first (a secondary failure must not
     # cost the driver the headline — report it on stderr and move on),
     # headline p256 LAST so tail-line parsers record it
+    import gc
+
     for secondary in ("mixed", "merkle", "notary"):
         try:
             print(json.dumps(_run_metric(secondary, batch, iters)),
@@ -396,6 +398,9 @@ def main() -> None:
         except Exception as e:   # noqa: BLE001 - keep the headline alive
             print(f"bench metric {secondary!r} failed: {e}",
                   file=sys.stderr)
+        # the host is a single core: the next metric must not pay GC
+        # sweeps over the previous metric's dead object graph
+        gc.collect()
     print(json.dumps(_spi_metric("p256", batch, iters)))
 
 

@@ -40,6 +40,26 @@ from .contracts import ContractViolation, contract_by_name
 from .transactions import LedgerTransaction
 
 
+def uses_attachment_code(ltx: LedgerTransaction) -> bool:
+    """True when verifying this transaction would execute code loaded
+    from its own attachments (a contract name with no local
+    registration — the AttachmentsClassLoader path). Callers that
+    OVERLAP contract execution with signature verification (the notary
+    flush) use this to defer sandboxed code until the signatures are
+    known-good: registered contracts are operator-installed and safe
+    to run speculatively, attachment-carried code is peer-supplied."""
+    try:
+        names = ltx.contract_names()
+    except Exception:  # noqa: BLE001 - malformed: resolved per-tx later
+        return False
+    for name in names:
+        try:
+            contract_by_name(name)
+        except ContractViolation:
+            return True
+    return False
+
+
 def verify_ledger_batch(
     ltxs: list[LedgerTransaction],
 ) -> list[Optional[Exception]]:

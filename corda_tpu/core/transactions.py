@@ -405,10 +405,16 @@ class LedgerTransaction:
         """Every contract this transaction touches, in the (sorted)
         order `verify` runs them. ONE implementation shared with the
         batch path (core/batch_verify.py) — two copies that drift would
-        let the batch path run fewer contracts than per-tx verify."""
-        names = {ts.contract for ts in self.outputs}
-        names.update(sar.state.contract for sar in self.inputs)
-        return sorted(names)
+        let the batch path run fewer contracts than per-tx verify.
+        Memoised: the notary flush classifies each transaction twice
+        (attachment-code deferral, then batch grouping)."""
+        names = self.__dict__.get("_contract_names")
+        if names is None:
+            s = {ts.contract for ts in self.outputs}
+            s.update(sar.state.contract for sar in self.inputs)
+            names = sorted(s)
+            object.__setattr__(self, "_contract_names", names)
+        return names
 
     # -- state grouping (LedgerTransaction.groupStates:142) ----------------
 

@@ -110,8 +110,9 @@ class TpuBatchVerifier(BatchSignatureVerifier):
                 # per-shard, so each device keeps the fast Pallas
                 # ladder instead of regressing to the XLA one. The
                 # whole verify program is elementwise over the batch
-                # axis — every operand shards on it, no collectives.
-                B = meshlib.BATCH_AXIS
+                # axis — every operand shards on it (over EVERY mesh
+                # axis: 1-D ICI or 2-D dcn×ici), no collectives.
+                B = meshlib.batch_spec_axes(self.mesh)
                 if ed:
                     in_specs = (P(B, None), P(B), P(B), P(B))
                     arg_order = ("packed", "a_sign", "exp_sign", "valid_in")

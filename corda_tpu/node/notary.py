@@ -417,23 +417,47 @@ class BatchingNotaryService(NotaryService):
             # the SPI's BATCH entry point: one grouped-by-contract pass
             # for the in-memory service (asset contracts verify the
             # whole flush in a specialized sweep, core/batch_verify.py),
-            # per-tx futures for out-of-process pools.
+            # ONLY registered (operator-installed) contracts run
+            # speculatively here — attachment-carried sandboxed code is
+            # peer-supplied, so it DEFERS until the transaction's
+            # signatures are known-good (phase 2 below), matching the
+            # verifier worker's gate. The SPI seam is honoured only for
+            # SYNCHRONOUS verifier services: an async (out-of-process)
+            # pool resolves its futures via the message pump this flush
+            # is running ON, so blocking on it here would deadlock —
+            # the batching notary then verifies in-process instead.
+            from ..core.batch_verify import (
+                uses_attachment_code,
+                verify_ledger_batch,
+            )
+
             tv = self.services.transaction_verifier
+            tv_sync = getattr(tv, "synchronous", False)
             contract_errs: list[Optional[Exception]] = []
+            deferred_ltx: dict[int, Any] = {}
             ltxs: list = []
             ltx_idx: list[int] = []
             for i, p in enumerate(pending):
                 try:
-                    ltxs.append(p.stx.to_ledger_transaction(self.services))
-                    ltx_idx.append(i)
-                    contract_errs.append(None)
+                    ltx = p.stx.to_ledger_transaction(self.services)
                 except Exception as e:
                     contract_errs.append(e)
-            for i, fut in zip(ltx_idx, tv.verify_many(ltxs)):
-                try:
-                    fut.result()
-                except Exception as e:
-                    contract_errs[i] = e
+                    continue
+                contract_errs.append(None)
+                if uses_attachment_code(ltx):
+                    deferred_ltx[i] = ltx
+                else:
+                    ltxs.append(ltx)
+                    ltx_idx.append(i)
+            if tv_sync:
+                for i, fut in zip(ltx_idx, tv.verify_many(ltxs)):
+                    try:
+                        fut.result()
+                    except Exception as e:
+                        contract_errs[i] = e
+            else:
+                for i, err in zip(ltx_idx, verify_ledger_batch(ltxs)):
+                    contract_errs[i] = err
             if collector is not None:
                 collector.join()
                 if "error" in box:
@@ -452,16 +476,35 @@ class BatchingNotaryService(NotaryService):
         self.requests_batched += len(pending)
         # phase 2 — per-tx validation + commit dispatch in arrival order
         to_commit: list[tuple[_PendingNotarisation, Any]] = []
-        for p, (off, n), cerr in zip(pending, spans, contract_errs):
-            if self._validate_one(p, results[off : off + n], cerr):
-                to_commit.append(
-                    (
-                        p,
-                        self.uniqueness.commit_async(
-                            list(p.stx.wtx.inputs), p.stx.id, p.requester
-                        ),
+        for i, (p, (off, n), cerr) in enumerate(
+            zip(pending, spans, contract_errs)
+        ):
+            if not self._validate_one(p, results[off : off + n], cerr):
+                continue
+            dltx = deferred_ltx.get(i)
+            if dltx is not None:
+                # signatures just validated: NOW the peer-supplied
+                # attachment code may run (sandboxed) — through the SPI
+                # when it resolves inline, in-process otherwise (an
+                # async pool cannot complete inside this pump tick)
+                try:
+                    if tv_sync:
+                        tv.verify(dltx).result()
+                    else:
+                        dltx.verify()
+                except Exception as e:
+                    p.future.set_result(
+                        NotaryError("invalid-transaction", str(e))
                     )
+                    continue
+            to_commit.append(
+                (
+                    p,
+                    self.uniqueness.commit_async(
+                        list(p.stx.wtx.inputs), p.stx.id, p.requester
+                    ),
                 )
+            )
         if not to_commit:
             return
         # phase 3 — once every commit resolves, ONE Merkle-batch notary

@@ -777,6 +777,12 @@ class TransactionVerifierService:
     """SPI: verify(ltx) -> future (reference: core/.../node/services/
     TransactionVerifierService.kt:9-15)."""
 
+    # True when verify()'s future is already resolved on return (the
+    # in-memory service). Async implementations (the out-of-process
+    # pool) resolve via the message pump — a caller ON the pump thread
+    # (the batching notary's flush) must not block on them.
+    synchronous = False
+
     def verify(self, ltx: LedgerTransaction) -> _Future:
         raise NotImplementedError
 
@@ -792,6 +798,8 @@ class InMemoryTransactionVerifierService(TransactionVerifierService):
     """Runs contract verification inline (reference: InMemoryTransaction-
     VerifierService.kt:10-14 — thread pool there; synchronous here, the
     fabric pump provides concurrency)."""
+
+    synchronous = True
 
     def verify(self, ltx: LedgerTransaction) -> _Future:
         f = _Future()

@@ -18,6 +18,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 BATCH_AXIS = "batch"
+DCN_AXIS = "dcn"
+ICI_AXIS = "ici"
 
 
 def make_mesh(devices: Optional[list] = None) -> Mesh:
@@ -26,10 +28,41 @@ def make_mesh(devices: Optional[list] = None) -> Mesh:
     return Mesh(np.array(devices, dtype=object).reshape(-1), (BATCH_AXIS,))
 
 
+def make_mesh_2d(
+    dcn: int, ici: int, devices: Optional[list] = None
+) -> Mesh:
+    """2-D (dcn × ici) mesh for multi-host deployments: the leading
+    axis spans host groups (DCN), the trailing axis each group's chips
+    (ICI). The verify program still shards its batch over BOTH axes
+    with zero collectives — the 2-D shape exists so the batch lays out
+    host-contiguously: each host stages and feeds ITS shard locally
+    (jax.make_array_from_process_local_data in a real multi-host run),
+    and no verification byte ever crosses DCN. Device order follows
+    jax.devices(), which sorts by (process_index, local id) — hence
+    reshape(dcn, ici) groups each host's chips on one 'dcn' row."""
+    devices = list(devices) if devices is not None else jax.devices()
+    if len(devices) != dcn * ici:
+        raise ValueError(
+            f"mesh {dcn}x{ici} needs {dcn * ici} devices, "
+            f"have {len(devices)}"
+        )
+    arr = np.array(devices, dtype=object).reshape(dcn, ici)
+    return Mesh(arr, (DCN_AXIS, ICI_AXIS))
+
+
+def batch_spec_axes(mesh: Mesh):
+    """The PartitionSpec entry sharding a batch dimension over EVERY
+    mesh axis — a bare axis name on the 1-D mesh, the axis tuple on
+    multi-axis meshes."""
+    names = tuple(mesh.axis_names)
+    return names[0] if len(names) == 1 else names
+
+
 def shard_operand(mesh: Mesh, x, batch_axis: int = -1):
-    """Place a host array on the mesh with its batch axis sharded
-    (last dim for [limbs, B] operands; axis 0 for [B, bytes] packed
-    records)."""
+    """Place a host array on the mesh with its batch axis sharded over
+    every mesh axis (last dim for [limbs, B] operands; axis 0 for
+    [B, bytes] packed records)."""
     axis = batch_axis % x.ndim
-    spec = P(*[BATCH_AXIS if d == axis else None for d in range(x.ndim)])
+    b = batch_spec_axes(mesh)
+    spec = P(*[b if d == axis else None for d in range(x.ndim)])
     return jax.device_put(x, NamedSharding(mesh, spec))

@@ -303,3 +303,115 @@ def test_batch_deadline_holds_then_flushes():
     net.run()
     for f in fsms:
         f.result_or_throw()
+
+
+def test_attachment_code_gated_on_valid_signatures():
+    """Peer-supplied (attachment-carried, sandboxed) contract code must
+    not execute during the speculative overlap phase: a transaction
+    with forged signatures is rejected WITHOUT its attachment code ever
+    loading; the honestly-signed transaction loads and runs it."""
+    from corda_tpu.core import sandbox
+    from corda_tpu.core.transactions import SignedTransaction
+    from corda_tpu.core.contracts import StateRef
+    from corda_tpu.flows.api import FlowFuture
+    from corda_tpu.node.notary import _PendingNotarisation, NotaryError
+
+    source = '''
+from corda_tpu.core.contracts import ContractViolation
+
+class GateContract:
+    def verify(self, ltx):
+        if not ltx.outputs:
+            raise ContractViolation("no outputs")
+'''
+    att = sandbox.make_contract_attachment(
+        "test.gated.Contract", "GateContract", source
+    )
+
+    net, spy, notary, bank, clients = make_net(1)
+    alice = clients[0]
+    svc = notary.services.notary_service
+    bank.run_flow(CashIssueFlow(500, "USD", alice.party, notary.party))
+    st = alice.vault.unconsumed_states(CashState)[0]
+    issue_stx = alice.services.validated_transactions.get(st.ref.txhash)
+    notary.services.record_transactions([issue_stx])
+    notary.services.attachments.import_attachment(att.data)
+    alice.services.attachments.import_attachment(att.data)
+
+    b = TransactionBuilder(notary.party)
+    b.add_input_state(st)
+    b.add_output_state(
+        st.state.data.with_owner(bank.party.owning_key),
+        "test.gated.Contract",
+        notary.party,
+    )
+    b.add_command(CashMove(), alice.party.owning_key)
+    b.add_attachment(att.id)
+    good_stx = alice.services.sign_initial_transaction(b)
+
+    # forge: signature over a DIFFERENT tx id
+    other = bank.run_flow(CashIssueFlow(5, "EUR", alice.party, notary.party))
+    wrong_sig = alice.services.key_management.sign(
+        other.id, alice.party.owning_key
+    )
+    forged = SignedTransaction(good_stx.wtx, (wrong_sig,))
+
+    sandbox._loaded_cache.clear()
+    fut = FlowFuture()
+    svc._pending.append(_PendingNotarisation(forged, alice.party, fut))
+    svc.flush()
+    err = fut.result()
+    assert isinstance(err, NotaryError) and err.kind == "invalid-transaction"
+    assert "signature" in err.message.lower()
+    # the forged tx's attachment code never loaded, let alone ran
+    assert att.id.bytes_ not in sandbox._loaded_cache
+
+    fut = FlowFuture()
+    svc._pending.append(_PendingNotarisation(good_stx, alice.party, fut))
+    svc.flush()
+    sig = fut.result()
+    assert not isinstance(sig, NotaryError)
+    # now it did: the honest transaction ran the attachment contract
+    assert att.id.bytes_ in sandbox._loaded_cache
+
+
+def test_flush_with_async_verifier_verifies_in_process():
+    """A batching notary configured with an ASYNC (out-of-process
+    style) verifier service must not block on futures that resolve via
+    the pump it is running on — it verifies in-process instead, for
+    both registered and (signature-gated) attachment contracts."""
+    from corda_tpu.flows.api import FlowFuture
+    from corda_tpu.node.notary import NotaryError, _PendingNotarisation
+    from corda_tpu.node.services import TransactionVerifierService
+
+    class NeverResolves(TransactionVerifierService):
+        synchronous = False
+
+        def verify(self, ltx):
+            from corda_tpu.node.services import _Future
+
+            return _Future()   # pending forever (pump-resolved IRL)
+
+    net, spy, notary, bank, clients = make_net(1)
+    alice = clients[0]
+    svc = notary.services.notary_service
+    bank.run_flow(CashIssueFlow(500, "USD", alice.party, notary.party))
+    st = alice.vault.unconsumed_states(CashState)[0]
+    issue_stx = alice.services.validated_transactions.get(st.ref.txhash)
+    notary.services.record_transactions([issue_stx])
+    b = TransactionBuilder(notary.party)
+    b.add_input_state(st)
+    b.add_output_state(
+        st.state.data.with_owner(bank.party.owning_key),
+        CASH_CONTRACT,
+        notary.party,
+    )
+    b.add_command(CashMove(), alice.party.owning_key)
+    stx = alice.services.sign_initial_transaction(b)
+
+    notary.services.transaction_verifier = NeverResolves()
+    fut = FlowFuture()
+    svc._pending.append(_PendingNotarisation(stx, alice.party, fut))
+    svc.flush()
+    sig = fut.result()
+    assert not isinstance(sig, NotaryError), f"rejected: {sig}"
