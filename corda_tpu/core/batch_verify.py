@@ -48,9 +48,19 @@ def uses_attachment_code(ltx: LedgerTransaction) -> bool:
     flush) use this to defer sandboxed code until the signatures are
     known-good: registered contracts are operator-installed and safe
     to run speculatively, attachment-carried code is peer-supplied."""
+    from . import replacement as _repl
+
     try:
+        if _repl.replacement_verifier(ltx) is not None:
+            # replacement rules can load attachment-shipped code too —
+            # a contract UPGRADE's conversion function may arrive only
+            # as an attachment (replacement.py upgrade_from_attachments)
+            # — so every replacement transaction defers
+            return True
         names = ltx.contract_names()
     except Exception:  # noqa: BLE001 - malformed: resolved per-tx later
+        # classification raises again inside ltx.verify() BEFORE any
+        # attachment code would load, so speculative fallback is safe
         return False
     for name in names:
         try:

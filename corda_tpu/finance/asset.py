@@ -254,17 +254,31 @@ class OnLedgerAsset:
                 if g is None:
                     g = groups[k] = ([], [])
                 g[1].append(s)
-        issue_cmds = [c for c in cmds if type(c.value) is self.issue_cmd]
-        move_cmds = [c for c in cmds if type(c.value) is self.move_cmd]
-        exit_cmds = [c for c in cmds if type(c.value) is self.exit_cmd]
+        # commands are tracked by their INDEX in cmds (not object
+        # identity — id() is banned by the determinism audit), which
+        # preserves the clause stack's duplicate-command semantics
+        issue_cmds = [
+            (i, c) for i, c in enumerate(cmds)
+            if type(c.value) is self.issue_cmd
+        ]
+        move_cmds = [
+            (i, c) for i, c in enumerate(cmds)
+            if type(c.value) is self.move_cmd
+        ]
+        exit_cmds = [
+            (i, c) for i, c in enumerate(cmds)
+            if type(c.value) is self.exit_cmd
+        ]
         all_signers = {k for c in cmds for k in c.signers}
-        processed: set = set()
+        processed: set[int] = set()
         for token, (inputs, outputs) in groups.items():
             processed |= self._verify_group_fast(
                 token, inputs, outputs,
                 issue_cmds, move_cmds, exit_cmds, all_signers,
             )
-        unprocessed = [c.value for c in cmds if id(c.value) not in processed]
+        unprocessed = [
+            c.value for i, c in enumerate(cmds) if i not in processed
+        ]
         if unprocessed:
             raise ContractViolation(
                 "commands not processed by any clause: "
@@ -285,14 +299,14 @@ class OnLedgerAsset:
                 all(s.amount.quantity > 0 for s in outputs),
             )
             issuer_key = token.issuer.party.owning_key
-            issue_signers = {k for c in issue_cmds for k in c.signers}
+            issue_signers = {k for _, c in issue_cmds for k in c.signers}
             require_that(
                 "issue is signed by the issuer",
                 signed_by(issuer_key, issue_signers),
             )
-            return {id(c.value) for c in issue_cmds}
+            return {i for i, _ in issue_cmds}
         group_exits = [
-            c for c in exit_cmds if c.value.amount.token == token
+            (i, c) for i, c in exit_cmds if c.value.amount.token == token
         ]
         if group_exits:                                  # ExitClause
             require_that(
@@ -301,9 +315,9 @@ class OnLedgerAsset:
             )
             in_sum = sum(s.amount.quantity for s in inputs)
             out_sum = sum(s.amount.quantity for s in outputs)
-            exited = sum(c.value.amount.quantity for c in group_exits)
+            exited = sum(c.value.amount.quantity for _, c in group_exits)
             require_that("exit conserves value", in_sum - out_sum == exited)
-            exit_signers = {k for c in group_exits for k in c.signers}
+            exit_signers = {k for _, c in group_exits for k in c.signers}
             issuer_key = token.issuer.party.owning_key
             require_that(
                 "exit is signed by the issuer",
@@ -314,7 +328,7 @@ class OnLedgerAsset:
                     "exit is signed by every input owner",
                     signed_by(owner, all_signers),
                 )
-            return {id(c.value) for c in group_exits}
+            return {i for i, _ in group_exits}
         # MoveClause (unconditional fallthrough, as in the group clause)
         in_sum = sum(s.amount.quantity for s in inputs)
         out_sum = sum(s.amount.quantity for s in outputs)
@@ -331,4 +345,4 @@ class OnLedgerAsset:
                 "move is signed by every input owner",
                 signed_by(owner, all_signers),
             )
-        return {id(c.value) for c in move_cmds}
+        return {i for i, _ in move_cmds}

@@ -415,3 +415,73 @@ def test_flush_with_async_verifier_verifies_in_process():
     svc.flush()
     sig = fut.result()
     assert not isinstance(sig, NotaryError), f"rejected: {sig}"
+
+
+def test_upgrade_attachment_code_also_gated_on_signatures():
+    """A contract-UPGRADE transaction's conversion can ship as an
+    attachment too; a forged-signature upgrade must be rejected without
+    that peer-supplied code ever loading (the gate defers ALL
+    replacement transactions)."""
+    from corda_tpu.core import sandbox
+    from corda_tpu.core.replacement import ContractUpgradeCommand
+    from corda_tpu.core.transactions import SignedTransaction
+    from corda_tpu.flows.api import FlowFuture
+    from corda_tpu.node.notary import _PendingNotarisation, NotaryError
+
+    upgrade_src = '''
+from corda_tpu.finance.cash import CashState
+
+class GatedUpgrade:
+    def verify(self, ltx):
+        return
+
+def convert(old_state):
+    return CashState(old_state.amount, old_state.owner)
+'''
+    att = sandbox.make_contract_attachment(
+        "test.gated.Upgrade", "GatedUpgrade", upgrade_src,
+        upgrades_from=CASH_CONTRACT,
+    )
+
+    net, spy, notary, bank, clients = make_net(1)
+    alice = clients[0]
+    svc = notary.services.notary_service
+    bank.run_flow(CashIssueFlow(500, "USD", alice.party, notary.party))
+    st = alice.vault.unconsumed_states(CashState)[0]
+    issue_stx = alice.services.validated_transactions.get(st.ref.txhash)
+    notary.services.record_transactions([issue_stx])
+    notary.services.attachments.import_attachment(att.data)
+    alice.services.attachments.import_attachment(att.data)
+
+    b = TransactionBuilder(notary.party)
+    b.add_input_state(st)
+    b.add_output_state(st.state.data, "test.gated.Upgrade", notary.party)
+    b.add_command(
+        ContractUpgradeCommand(CASH_CONTRACT, "test.gated.Upgrade"),
+        st.state.data.owner,
+    )
+    b.add_attachment(att.id)
+    good_stx = alice.services.sign_initial_transaction(b)
+
+    other = bank.run_flow(CashIssueFlow(5, "EUR", alice.party, notary.party))
+    wrong_sig = alice.services.key_management.sign(
+        other.id, alice.party.owning_key
+    )
+    forged = SignedTransaction(good_stx.wtx, (wrong_sig,))
+
+    sandbox._upgrade_cache.clear()
+    fut = FlowFuture()
+    svc._pending.append(_PendingNotarisation(forged, alice.party, fut))
+    svc.flush()
+    err = fut.result()
+    assert isinstance(err, NotaryError) and err.kind == "invalid-transaction"
+    assert "signature" in err.message.lower()
+    # the forged upgrade's conversion code never loaded
+    assert att.id.bytes_ not in sandbox._upgrade_cache
+
+    fut = FlowFuture()
+    svc._pending.append(_PendingNotarisation(good_stx, alice.party, fut))
+    svc.flush()
+    sig = fut.result()
+    assert not isinstance(sig, NotaryError), f"rejected: {sig}"
+    assert att.id.bytes_ in sandbox._upgrade_cache
