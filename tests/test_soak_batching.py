@@ -53,7 +53,12 @@ def test_batching_notary_survives_disruptions(tmp_path):
     _prewarm_compile_cache()
     with driver(str(tmp_path)) as d:
         hub = d.start_node(
-            "Hub", notary="batching", verifier_backend="tpu", timeout=600.0
+            "Hub", notary="batching", verifier_backend="tpu",
+            # a real batching deadline (50 ms): flushes form under the
+            # wall clock while disruptions hit, so the soak also covers
+            # held-batch recovery across a notary kill -9
+            notary_batch_wait_micros=50_000,
+            timeout=600.0,
         )
         alice = d.start_node("Alice")
         bob = d.start_node("Bob")
