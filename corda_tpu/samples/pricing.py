@@ -22,18 +22,25 @@ from dataclasses import dataclass
 
 import numpy as np
 
-from .simm import N_TENORS, TENORS_Y
+from .simm import (
+    CREDIT_TENORS_Y,
+    N_CREDIT_TENORS,
+    N_TENORS,
+    TENORS_Y,
+)
 
 BUMP = 1e-4          # 1bp zero-rate bump for delta ladders
 VOL_BUMP = 1e-2      # 1 vol-point bump for vega ladders
 
 
-def _interp_pillars(values: tuple[float, ...], t: float) -> float:
-    """Linear interpolation over the SIMM tenor pillars, flat beyond
-    the ends. ONE implementation for every pillar curve: this loop is
+def _interp_pillars(
+    values: tuple[float, ...], t: float, ts: tuple[float, ...] = TENORS_Y
+) -> float:
+    """Linear interpolation over a pillar grid (SIMM tenor vertices by
+    default, credit vertices for `CreditCurve`), flat beyond the ends.
+    ONE implementation for every pillar curve: this loop is
     consensus-critical, and two copies that drift apart would silently
     break cross-party agreement between delta and vega repricing."""
-    ts = TENORS_Y
     if t <= ts[0]:
         return values[0]
     if t >= ts[-1]:
@@ -225,6 +232,118 @@ def fx_forward_pv(
     )
 
 
+def equity_option_pv(
+    n_shares: float,
+    strike: float,
+    expiry_y: float,
+    curve: ZeroCurve,
+    spot: float,
+    vol: float,
+    is_call: bool = True,
+) -> float:
+    """European equity option: Black on the dividend-free forward
+    F = spot / df(T), discounted — PV = n * df(T) * Black(F, K, v, T)."""
+    t = max(expiry_y, TENORS_Y[0])
+    f = spot / curve.df(t)
+    return n_shares * curve.df(t) * black_price(f, strike, t, vol, is_call)
+
+
+def commodity_forward_pv(
+    units: float,
+    strike: float,
+    maturity_y: float,
+    curve: ZeroCurve,
+    spot: float,
+    carry: float = 0.0,
+) -> float:
+    """PV to the BUYER of `units` of a commodity at `strike` in
+    `maturity_y` years: F = spot * exp(carry * T) (cost-of-carry
+    forward), PV = units * df(T) * (F - strike)."""
+    t = max(maturity_y, TENORS_Y[0])
+    f = spot * math.exp(carry * t)
+    return units * curve.df(t) * (f - strike)
+
+
+@dataclass(frozen=True)
+class CreditCurve:
+    """Flat-forward par CDS spreads (decimal) on the five SIMM credit
+    vertices, linearly interpolated; `recovery` feeds the standard
+    spread/(1-R) flat-hazard reduction."""
+
+    spreads: tuple[float, ...]
+    recovery: float = 0.4
+
+    def __post_init__(self):
+        if len(self.spreads) != N_CREDIT_TENORS:
+            raise ValueError(
+                f"need {N_CREDIT_TENORS} credit pillar spreads, "
+                f"got {len(self.spreads)}"
+            )
+
+    def spread(self, t: float) -> float:
+        return _interp_pillars(self.spreads, t, CREDIT_TENORS_Y)
+
+    def survival(self, t: float) -> float:
+        lam = self.spread(t) / max(1.0 - self.recovery, 1e-9)
+        return math.exp(-lam * t)
+
+    def bumped(self, pillar: int, size: float = BUMP) -> "CreditCurve":
+        spreads = list(self.spreads)
+        spreads[pillar] += size
+        return CreditCurve(tuple(spreads), self.recovery)
+
+
+def cds_pv(
+    notional: float,
+    contract_spread_bps: float,
+    maturity_y: float,
+    curve: ZeroCurve,
+    credit: CreditCurve,
+) -> float:
+    """PV to the PROTECTION BUYER of a single-name CDS paying
+    `contract_spread_bps` annually: (s_market(T) - s_contract) * risky
+    annuity, risky annuity = sum_i df(t_i) * surv(t_i) on the annual
+    grid — the standard flat-hazard credit-triangle reduction the
+    reference's OpenGamma ISDA-model pricer collapses to for a flat
+    quote."""
+    t = max(maturity_y, CREDIT_TENORS_Y[0])
+    n = max(int(round(t)), 1)
+    risky_annuity = 0.0
+    for i in range(1, n + 1):
+        risky_annuity += curve.df(float(i)) * credit.survival(float(i))
+    s_mkt = credit.spread(t)
+    s_con = contract_spread_bps / 10_000.0
+    return notional * (s_mkt - s_con) * risky_annuity
+
+
+# fixture single-name credit market: issuer -> (bucket, CreditCurve).
+# CreditQ buckets are quality x region in the published model; the two
+# demo issuers land in representative investment-grade buckets.
+DEMO_CREDIT_CURVES = {
+    "ACME-INDUSTRIAL": (
+        2, CreditCurve((0.006, 0.0065, 0.007, 0.008, 0.0095)),
+    ),
+    "GLOBEX-FINANCIAL": (
+        1, CreditCurve((0.009, 0.0097, 0.0105, 0.012, 0.014)),
+    ),
+}
+
+# fixture equity market: name -> (SIMM equity bucket, spot, flat vol)
+DEMO_EQUITY_MARKET = {
+    "ACME-INDUSTRIAL": (5, 120.0, 0.28),
+    "GLOBEX-FINANCIAL": (7, 45.0, 0.35),
+    "DEMO-INDEX": (11, 4_800.0, 0.18),
+}
+
+# fixture commodity market: name -> (SIMM commodity bucket, spot,
+# cost-of-carry). Bucket 2 = crude, 11 = base metals, 12 = precious.
+DEMO_COMMODITY_MARKET = {
+    "CRUDE": (2, 82.0, 0.01),
+    "COPPER": (11, 9_400.0, 0.005),
+    "GOLD": (12, 1_950.0, -0.002),
+}
+
+
 # -- sensitivity ladders (bump and revalue) ----------------------------------
 
 
@@ -326,6 +445,141 @@ def fx_forward_rate_ladders(
             - base
         )
     return dom, fgn
+
+
+def equity_spot_delta(
+    n_shares: float,
+    strike: float,
+    expiry_y: float,
+    curve: ZeroCurve,
+    spot: float,
+    vol: float,
+    is_call: bool = True,
+) -> float:
+    """SIMM equity sensitivity: PV change for a +1% RELATIVE spot move
+    (the published equity delta definition), bump-and-revalue."""
+    base = equity_option_pv(
+        n_shares, strike, expiry_y, curve, spot, vol, is_call
+    )
+    return (
+        equity_option_pv(
+            n_shares, strike, expiry_y, curve, spot * 1.01, vol, is_call
+        )
+        - base
+    )
+
+
+def equity_option_rate_ladder(
+    n_shares: float,
+    strike: float,
+    expiry_y: float,
+    curve: ZeroCurve,
+    spot: float,
+    vol: float,
+    is_call: bool = True,
+) -> np.ndarray:
+    """[K] IR delta ladder of the equity option (discounting + forward
+    both move with the zero curve), +1bp pillar bumps in fixed order."""
+    base = equity_option_pv(
+        n_shares, strike, expiry_y, curve, spot, vol, is_call
+    )
+    s = np.zeros(N_TENORS, dtype=np.float64)
+    for k in range(N_TENORS):
+        s[k] = (
+            equity_option_pv(
+                n_shares, strike, expiry_y, curve.bumped(k), spot, vol,
+                is_call,
+            )
+            - base
+        )
+    return s
+
+
+def commodity_spot_delta(
+    units: float,
+    strike: float,
+    maturity_y: float,
+    curve: ZeroCurve,
+    spot: float,
+    carry: float = 0.0,
+) -> float:
+    """SIMM commodity sensitivity: PV change for a +1% relative spot
+    move, bump-and-revalue."""
+    base = commodity_forward_pv(units, strike, maturity_y, curve, spot, carry)
+    return (
+        commodity_forward_pv(
+            units, strike, maturity_y, curve, spot * 1.01, carry
+        )
+        - base
+    )
+
+
+def commodity_forward_rate_ladder(
+    units: float,
+    strike: float,
+    maturity_y: float,
+    curve: ZeroCurve,
+    spot: float,
+    carry: float = 0.0,
+) -> np.ndarray:
+    """[K] IR delta ladder of the commodity forward (discounting
+    risk), +1bp pillar bumps in fixed order."""
+    base = commodity_forward_pv(units, strike, maturity_y, curve, spot, carry)
+    s = np.zeros(N_TENORS, dtype=np.float64)
+    for k in range(N_TENORS):
+        s[k] = (
+            commodity_forward_pv(
+                units, strike, maturity_y, curve.bumped(k), spot, carry
+            )
+            - base
+        )
+    return s
+
+
+def cds_cs01_ladder(
+    notional: float,
+    contract_spread_bps: float,
+    maturity_y: float,
+    curve: ZeroCurve,
+    credit: CreditCurve,
+) -> np.ndarray:
+    """[5] CS01 ladder on the SIMM credit vertices: CDS PV under a
+    +1bp bump of each credit pillar minus base PV, fixed pillar order —
+    the curve-priced replacement for `simm.credit_cs01_ladder`'s vertex
+    split when a real credit curve is in play."""
+    base = cds_pv(notional, contract_spread_bps, maturity_y, curve, credit)
+    s = np.zeros(N_CREDIT_TENORS, dtype=np.float64)
+    for k in range(N_CREDIT_TENORS):
+        s[k] = (
+            cds_pv(
+                notional, contract_spread_bps, maturity_y, curve,
+                credit.bumped(k),
+            )
+            - base
+        )
+    return s
+
+
+def cds_rate_ladder(
+    notional: float,
+    contract_spread_bps: float,
+    maturity_y: float,
+    curve: ZeroCurve,
+    credit: CreditCurve,
+) -> np.ndarray:
+    """[K] IR delta ladder of the CDS (the risky annuity discounts on
+    the zero curve), +1bp pillar bumps in fixed order."""
+    base = cds_pv(notional, contract_spread_bps, maturity_y, curve, credit)
+    s = np.zeros(N_TENORS, dtype=np.float64)
+    for k in range(N_TENORS):
+        s[k] = (
+            cds_pv(
+                notional, contract_spread_bps, maturity_y,
+                curve.bumped(k), credit,
+            )
+            - base
+        )
+    return s
 
 
 def swaption_vega_ladder(

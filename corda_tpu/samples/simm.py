@@ -1,11 +1,13 @@
-"""ISDA-SIMM-style initial margin for IR + FX portfolios.
+"""ISDA-SIMM-style initial margin across all six published risk classes.
 
 Reference: samples/simm-valuation-demo/ delegates the maths to
 OpenGamma's implementation of the ISDA Standard Initial Margin Model.
 This module implements the published SIMM *structure* — the interest
 -rate risk class with delta, vega AND curvature layers, the FX delta
-risk class, and the cross-risk-class psi aggregation — instead of a
-toy heuristic:
+risk class, Equity/Commodity bucketed delta classes, the two Credit
+(qualifying / non-qualifying) CS01 classes with same-vs-different
+issuer correlation and residual buckets, and the cross-risk-class psi
+aggregation — instead of a toy heuristic:
 
   1. per-trade sensitivities bucketed onto the SIMM tenor vertices
      (curve-priced ladders come from samples/pricing.py);
@@ -23,7 +25,18 @@ toy heuristic:
   6. FX delta: one bucket, per-currency sensitivities to a 1% spot
      move, scalar risk weight, uniform 0.5 FX-FX correlation
      (`fx_margin`);
-  7. cross-risk-class aggregation over the six published risk classes
+  7. Equity and Commodity delta: per-bucket scalar risk weights and
+     intra-bucket correlations over per-name sensitivities to a 1%
+     relative move, a flat cross-bucket gamma (representative of the
+     published per-pair tables), and — for equity — a RESIDUAL bucket
+     whose K adds OUTSIDE the cross-bucket square root
+     (`equity_margin`, `commodity_margin`);
+  8. CreditQ / CreditNonQ delta: per-(issuer, tenor) CS01 ladders on
+     the five published credit vertices, intra-bucket correlation
+     split into same-issuer rho and different-issuer rho, bucketed
+     risk weights + gamma, residual bucket (`credit_q_margin`,
+     `credit_nonq_margin`);
+  9. cross-risk-class aggregation over the six published risk classes
      SIMM = sqrt( sum_r IM_r^2 + sum_{r!=s} psi_rs IM_r IM_s )
      (`product_margin` with the representative `RISK_CLASS_PSI`).
 
@@ -110,24 +123,36 @@ _RHO = tenor_correlation()
 _RW = np.asarray(RISK_WEIGHTS_BP, dtype=np.float64)
 
 
+def vertex_split(
+    vertices: tuple, t: float, value: float
+) -> np.ndarray:
+    """[len(vertices)] ladder placing `value` linearly between the two
+    vertices framing `t` (standard vertex interpolation, clamped to
+    the vertex range) — shared by the IR tenor and credit vertex
+    grids."""
+    s = np.zeros(len(vertices), dtype=np.float64)
+    t = max(min(t, vertices[-1]), vertices[0])
+    hi = next(i for i, v in enumerate(vertices) if v >= t)
+    if vertices[hi] == t:
+        s[hi] = value
+        return s
+    lo = hi - 1
+    frac = (t - vertices[lo]) / (vertices[hi] - vertices[lo])
+    s[lo] = value * (1.0 - frac)
+    s[hi] = value * frac
+    return s
+
+
 def bucket_pv01(
     notional: int, years_to_maturity: float
 ) -> np.ndarray:
     """[K] PV01-style delta ladder for a vanilla swap: DV01 of the
     fixed leg, split linearly between the two tenor vertices framing
     maturity (standard vertex interpolation)."""
-    dv01 = notional * years_to_maturity / 10_000.0
-    s = np.zeros(N_TENORS, dtype=np.float64)
-    t = max(min(years_to_maturity, TENORS_Y[-1]), TENORS_Y[0])
-    hi = next(i for i, v in enumerate(TENORS_Y) if v >= t)
-    if TENORS_Y[hi] == t or hi == 0:
-        s[hi] = dv01
-        return s
-    lo = hi - 1
-    frac = (t - TENORS_Y[lo]) / (TENORS_Y[hi] - TENORS_Y[lo])
-    s[lo] = dv01 * (1.0 - frac)
-    s[hi] = dv01 * frac
-    return s
+    return vertex_split(
+        TENORS_Y, years_to_maturity,
+        notional * years_to_maturity / 10_000.0,
+    )
 
 
 def _ks(ws: np.ndarray, rho: np.ndarray):
@@ -232,9 +257,243 @@ def fx_margin(fx_deltas: dict[str, float]) -> float:
         )
         * FX_RISK_WEIGHT
     )
+    return _scalar_bucket_k(ws, FX_CORR)
+
+
+# ---------------------------------------------------------------------------
+# Equity / Commodity: bucketed delta classes over per-name sensitivities
+#
+# Published structure: sensitivities are per-name PV changes for a 1%
+# relative move, assigned to numbered buckets (equity: market-cap x
+# region x sector, 12 buckets; commodity: 17 product buckets). Within a
+# bucket every distinct name correlates at one scalar rho_b; across
+# buckets the S_b totals correlate through a gamma matrix; names that
+# fit no bucket go to the RESIDUAL bucket, whose K adds OUTSIDE the
+# cross-bucket square root (no diversification against classified
+# risk). Weights/correlations below are representative of the
+# published calibrations (exact tables are versioned + licensed).
+
+RESIDUAL = "Residual"
+
+EQUITY_RISK_WEIGHTS = (
+    25.0, 32.0, 29.0, 27.0, 18.0, 21.0, 24.0, 21.0, 33.0, 34.0, 17.0, 17.0
+)
+EQUITY_INTRA_RHO = (
+    0.14, 0.20, 0.19, 0.21, 0.24, 0.35, 0.34, 0.34, 0.20, 0.24, 0.62, 0.62
+)
+EQUITY_CROSS_GAMMA = 0.15
+EQUITY_RESIDUAL_RW = max(EQUITY_RISK_WEIGHTS)
+
+COMMODITY_RISK_WEIGHTS = (
+    19.0, 20.0, 17.0, 18.0, 24.0, 20.0, 24.0, 41.0, 25.0, 91.0,
+    20.0, 19.0, 16.0, 15.0, 10.0, 74.0, 16.0
+)
+COMMODITY_INTRA_RHO = (
+    0.30, 0.97, 0.93, 0.97, 0.98, 0.90, 0.98, 0.60, 0.65, 0.55,
+    0.93, 0.91, 0.89, 0.97, 0.21, 0.19, 0.99
+)
+COMMODITY_CROSS_GAMMA = 0.20
+
+
+def _scalar_bucket_k(ws: np.ndarray, rho: float) -> float:
+    """K_b for one bucket of weighted per-name sensitivities under a
+    single intra-bucket correlation:
+    K^2 = sum WS_i^2 + rho * sum_{i!=j} WS_i WS_j."""
     own = float(np.dot(ws, ws))
     cross = float(ws.sum() ** 2 - own)
-    return math.sqrt(max(own + FX_CORR * cross, 0.0))
+    return math.sqrt(max(own + rho * cross, 0.0))
+
+
+def _classed_margin(
+    sensitivities: dict,
+    n_buckets: int,
+    bucket_ks,
+    cross_gamma: float,
+    residual_ks,
+) -> float:
+    """Shared bucket-walk + tail aggregation for every classed risk
+    family (Equity/Commodity scalar buckets AND the credit CS01
+    classes): per bucket `bucket_ks(bucket, entries) -> (K_b, S_b)`,
+    then M = sqrt( sum_b K_b^2 + gamma * sum_{b!=c} S_b S_c )
+    + K_residual. Fixed iteration order (sorted buckets; callees sort
+    names) keeps the float64 op order shared between the agreeing
+    parties. Unknown bucket numbers raise — a misfiled name must not
+    silently drop; classes without a residual bucket pass
+    residual_ks=None and RESIDUAL raises too."""
+    ks: list[float] = []
+    ss: list[float] = []
+    k_residual = 0.0
+    for bucket in sorted(
+        sensitivities, key=lambda b: (isinstance(b, str), b)
+    ):
+        entries = sensitivities[bucket]
+        if not entries:
+            continue
+        if bucket == RESIDUAL and residual_ks is not None:
+            k_residual, _ = residual_ks(entries)
+            continue
+        if not isinstance(bucket, int) or not (1 <= bucket <= n_buckets):
+            raise ValueError(f"unknown bucket {bucket!r}")
+        k, s = bucket_ks(bucket, entries)
+        ks.append(k)
+        ss.append(s)
+    if not ks and k_residual == 0.0:
+        return 0.0
+    kv = np.asarray(ks, dtype=np.float64)
+    sv = np.asarray(ss, dtype=np.float64)
+    inner = float(np.dot(kv, kv))
+    cross = float(sv.sum() ** 2 - np.dot(sv, sv))
+    return math.sqrt(max(inner + cross_gamma * cross, 0.0)) + k_residual
+
+
+def _scalar_bucket_ks(names: dict, rw: float, rho: float):
+    """(K_b, S_b) for one Equity/Commodity bucket of {name: s}."""
+    s = np.asarray(
+        [float(names[n]) for n in sorted(names)], dtype=np.float64
+    )
+    ws = s * rw
+    k = _scalar_bucket_k(ws, rho)
+    return k, max(min(float(ws.sum()), k), -k)
+
+
+def equity_margin(sensitivities: dict) -> float:
+    """Equity delta margin over {bucket: {issuer: PV change per +1%
+    relative equity move}}; buckets 1-12 (market cap x region x
+    sector; 11 = indexes/funds, 12 = volatility indexes) plus
+    RESIDUAL."""
+    return _classed_margin(
+        sensitivities,
+        len(EQUITY_RISK_WEIGHTS),
+        lambda b, names: _scalar_bucket_ks(
+            names, EQUITY_RISK_WEIGHTS[b - 1], EQUITY_INTRA_RHO[b - 1]
+        ),
+        EQUITY_CROSS_GAMMA,
+        lambda names: _scalar_bucket_ks(names, EQUITY_RESIDUAL_RW, 0.0),
+    )
+
+
+def commodity_margin(sensitivities: dict) -> float:
+    """Commodity delta margin over {bucket: {commodity: PV change per
+    +1% relative price move}}; 17 published product buckets (16 =
+    "other" — the published model has NO commodity residual bucket, so
+    RESIDUAL raises here like any other unknown bucket)."""
+    return _classed_margin(
+        sensitivities,
+        len(COMMODITY_RISK_WEIGHTS),
+        lambda b, names: _scalar_bucket_ks(
+            names, COMMODITY_RISK_WEIGHTS[b - 1], COMMODITY_INTRA_RHO[b - 1]
+        ),
+        COMMODITY_CROSS_GAMMA,
+        None,
+    )
+
+
+# ---------------------------------------------------------------------------
+# CreditQ / CreditNonQ: per-(issuer, tenor) CS01 classes
+#
+# Sensitivities are CS01 ladders on the five published credit vertices
+# per issuer, bucketed by quality x region (CreditQ, 12 buckets) or
+# rating band (CreditNonQ, 2 buckets). Correlation between entries of
+# one bucket: 1 for the same (issuer, tenor), rho_same for the same
+# issuer at different tenors, rho_diff across issuers; cross-bucket
+# gamma is flat; residual bucket adds outside the square root.
+
+CREDIT_TENORS_Y = (1.0, 2.0, 3.0, 5.0, 10.0)
+N_CREDIT_TENORS = len(CREDIT_TENORS_Y)
+
+CREDITQ_RISK_WEIGHTS_BP = (
+    97.0, 110.0, 73.0, 65.0, 52.0, 39.0, 198.0, 187.0, 110.0, 66.0,
+    67.0, 74.0
+)
+CREDITQ_RHO_SAME = 0.93
+CREDITQ_RHO_DIFF = 0.42
+CREDITQ_CROSS_GAMMA = 0.42
+CREDITQ_RESIDUAL_RW = max(CREDITQ_RISK_WEIGHTS_BP)
+
+CREDITNONQ_RISK_WEIGHTS_BP = (169.0, 646.0)
+CREDITNONQ_RHO_SAME = 0.60
+CREDITNONQ_RHO_DIFF = 0.21
+CREDITNONQ_CROSS_GAMMA = 0.05
+CREDITNONQ_RESIDUAL_RW = max(CREDITNONQ_RISK_WEIGHTS_BP)
+
+
+def credit_cs01_ladder(notional: int, years_to_maturity: float) -> np.ndarray:
+    """[5] CS01-style ladder for a single-name CDS: spread DV01 split
+    between the two credit vertices framing maturity (the credit
+    analogue of `bucket_pv01`)."""
+    return vertex_split(
+        CREDIT_TENORS_Y, years_to_maturity,
+        notional * years_to_maturity / 10_000.0,
+    )
+
+
+def _credit_bucket_k(
+    ladders: dict, rw: float, rho_same: float, rho_diff: float
+) -> tuple[float, float]:
+    """(K_b, S_b) for one credit bucket of {issuer: [5] CS01 ladder}:
+    K^2 = sum_i ( sum_t WS_it^2 + rho_same (S_i^2 - sum_t WS_it^2) )
+          + rho_diff * sum_{i!=j} S_i S_j."""
+    k2 = 0.0
+    issuer_sums: list[float] = []
+    for issuer in sorted(ladders):
+        ws = np.asarray(ladders[issuer], dtype=np.float64) * rw
+        if ws.shape != (N_CREDIT_TENORS,):
+            raise ValueError(
+                f"credit ladder for {issuer!r} must have "
+                f"{N_CREDIT_TENORS} vertices, got {ws.shape}"
+            )
+        own = float(np.dot(ws, ws))
+        si = float(ws.sum())
+        k2 += own + rho_same * (si * si - own)
+        issuer_sums.append(si)
+    sv = np.asarray(issuer_sums, dtype=np.float64)
+    k2 += rho_diff * float(sv.sum() ** 2 - np.dot(sv, sv))
+    k = math.sqrt(max(k2, 0.0))
+    s = max(min(float(sv.sum()), k), -k)
+    return k, s
+
+
+def _credit_margin(
+    sensitivities: dict,
+    risk_weights: tuple,
+    rho_same: float,
+    rho_diff: float,
+    cross_gamma: float,
+    residual_rw: float,
+) -> float:
+    """Shared CreditQ/CreditNonQ aggregation over
+    {bucket_number_or_RESIDUAL: {issuer: [5] CS01 ladder}}."""
+    return _classed_margin(
+        sensitivities,
+        len(risk_weights),
+        lambda b, ladders: _credit_bucket_k(
+            ladders, risk_weights[b - 1], rho_same, rho_diff
+        ),
+        cross_gamma,
+        lambda ladders: _credit_bucket_k(
+            ladders, residual_rw, rho_same, rho_diff
+        ),
+    )
+
+
+def credit_q_margin(sensitivities: dict) -> float:
+    """Qualifying-credit delta margin over
+    {bucket: {issuer: [5] CS01 ladder}} (12 quality x region buckets
+    plus RESIDUAL)."""
+    return _credit_margin(
+        sensitivities, CREDITQ_RISK_WEIGHTS_BP, CREDITQ_RHO_SAME,
+        CREDITQ_RHO_DIFF, CREDITQ_CROSS_GAMMA, CREDITQ_RESIDUAL_RW,
+    )
+
+
+def credit_nonq_margin(sensitivities: dict) -> float:
+    """Non-qualifying-credit delta margin (2 rating-band buckets plus
+    RESIDUAL)."""
+    return _credit_margin(
+        sensitivities, CREDITNONQ_RISK_WEIGHTS_BP, CREDITNONQ_RHO_SAME,
+        CREDITNONQ_RHO_DIFF, CREDITNONQ_CROSS_GAMMA,
+        CREDITNONQ_RESIDUAL_RW,
+    )
 
 
 def product_margin(class_margins: dict[str, float]) -> float:
@@ -257,15 +516,24 @@ def simm_breakdown(
     delta_buckets: dict[str, np.ndarray],
     vega_buckets: dict[str, np.ndarray] | None = None,
     fx_deltas: dict[str, float] | None = None,
+    equity: dict | None = None,
+    commodity: dict | None = None,
+    credit_q: dict | None = None,
+    credit_nonq: dict | None = None,
 ) -> dict[str, float]:
-    """Per-layer margins for {currency: [K] ladder} inputs plus the
-    optional FX class. The IR risk-class margin is DeltaMargin +
-    VegaMargin + CurvatureMargin (the published SIMM sums the three
-    within a risk class); `total` is the cross-risk-class psi
-    aggregation of the IR and FX class margins — with no FX exposure it
-    equals the IR margin, so IR-only callers see the same number as
-    before the FX class landed."""
-    out = {"delta": 0.0, "vega": 0.0, "curvature": 0.0, "fx": 0.0}
+    """Per-layer margins for {currency: [K] ladder} IR inputs plus the
+    optional FX / Equity / Commodity / CreditQ / CreditNonQ classes.
+    The IR risk-class margin is DeltaMargin + VegaMargin +
+    CurvatureMargin (the published SIMM sums the three within a risk
+    class); `total` is the cross-risk-class psi aggregation over every
+    class with exposure — with IR-only input it equals the IR margin,
+    so IR-only callers see the same number as before the other classes
+    landed."""
+    out = {
+        "delta": 0.0, "vega": 0.0, "curvature": 0.0, "fx": 0.0,
+        "equity": 0.0, "commodity": 0.0, "credit_q": 0.0,
+        "credit_nonq": 0.0,
+    }
     if delta_buckets:
         mat = np.stack([delta_buckets[c] for c in sorted(delta_buckets)])
         out["delta"] = aggregate_margin(*bucket_margins(mat))
@@ -275,8 +543,23 @@ def simm_breakdown(
         out["curvature"] = curvature_margin(curvature_ladders(mat))
     if fx_deltas:
         out["fx"] = fx_margin(fx_deltas)
+    if equity:
+        out["equity"] = equity_margin(equity)
+    if commodity:
+        out["commodity"] = commodity_margin(commodity)
+    if credit_q:
+        out["credit_q"] = credit_q_margin(credit_q)
+    if credit_nonq:
+        out["credit_nonq"] = credit_nonq_margin(credit_nonq)
     ir = out["delta"] + out["vega"] + out["curvature"]
-    out["total"] = product_margin({"IR": ir, "FX": out["fx"]})
+    out["total"] = product_margin({
+        "IR": ir,
+        "FX": out["fx"],
+        "Equity": out["equity"],
+        "Commodity": out["commodity"],
+        "CreditQ": out["credit_q"],
+        "CreditNonQ": out["credit_nonq"],
+    })
     return out
 
 
@@ -284,12 +567,18 @@ def simm_im(
     delta_buckets: dict[str, np.ndarray],
     vega_buckets: dict[str, np.ndarray] | None = None,
     fx_deltas: dict[str, float] | None = None,
+    equity: dict | None = None,
+    commodity: dict | None = None,
+    credit_q: dict | None = None,
+    credit_nonq: dict | None = None,
 ) -> int:
-    """Initial margin for {currency: [K] sensitivity ladder} inputs
+    """Initial margin for {currency: [K] sensitivity ladder} IR inputs
     (delta, optionally vega — curvature follows from vega — and
-    optionally per-currency FX spot sensitivities), rounded to an
-    integer ledger amount (both parties must agree bit-for-bit; every
-    float op above has a fixed order, so IEEE-754 doubles give one
-    answer on any host)."""
-    return int(round(simm_breakdown(delta_buckets, vega_buckets,
-                                    fx_deltas)["total"]))
+    optionally FX spot / equity / commodity / credit sensitivities),
+    rounded to an integer ledger amount (both parties must agree
+    bit-for-bit; every float op above has a fixed order, so IEEE-754
+    doubles give one answer on any host)."""
+    return int(round(simm_breakdown(
+        delta_buckets, vega_buckets, fx_deltas, equity, commodity,
+        credit_q, credit_nonq,
+    )["total"]))

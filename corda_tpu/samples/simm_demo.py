@@ -24,6 +24,9 @@ from .irs_demo import InterestRateSwapState
 SIMM_CONTRACT = "corda_tpu.samples.PortfolioValuation"
 SWAPTION_CONTRACT = "corda_tpu.samples.Swaption"
 FX_FORWARD_CONTRACT = "corda_tpu.samples.FxForward"
+CDS_CONTRACT = "corda_tpu.samples.CreditDefaultSwap"
+EQUITY_OPTION_CONTRACT = "corda_tpu.samples.EquityOption"
+COMMODITY_FORWARD_CONTRACT = "corda_tpu.samples.CommodityForward"
 
 _YEAR_MICROS = 365.25 * 24 * 3600 * 1e6
 
@@ -108,28 +111,164 @@ class FxForward:
 register_contract(FX_FORWARD_CONTRACT, FxForward())
 
 
+@ser.serializable
+@dataclass(frozen=True)
+class CdsState:
+    """Single-name CDS: `buyer` pays `spread_bps` annually on
+    `notional` for protection on `issuer` until maturity — the
+    portfolio's CreditQ carrier (CS01 ladders on the five SIMM credit
+    vertices price off the issuer's demo credit curve)."""
+
+    buyer: Party
+    seller: Party
+    notional: int
+    spread_bps: int
+    maturity_micros: int
+    issuer: str
+
+    @property
+    def participants(self):
+        return (self.buyer, self.seller)
+
+
+class CreditDefaultSwap:
+    def verify(self, ltx) -> None:
+        from . import pricing
+
+        outs = ltx.outputs_of_type(CdsState)
+        require_that("one cds output", len(outs) == 1)
+        o = outs[0]
+        require_that("positive notional", o.notional > 0)
+        require_that("positive spread", o.spread_bps > 0)
+        require_that(
+            "a known reference issuer",
+            o.issuer in pricing.DEMO_CREDIT_CURVES,
+        )
+
+
+register_contract(CDS_CONTRACT, CreditDefaultSwap())
+
+
+@ser.serializable
+@dataclass(frozen=True)
+class EquityOptionState:
+    """European equity option on `n_shares` of `name` — the Equity
+    risk-class carrier (a rates book has no equity spot exposure)."""
+
+    buyer: Party
+    seller: Party
+    n_shares: int
+    strike_cents: int
+    expiry_micros: int
+    name: str
+    is_call: bool = True
+
+    @property
+    def participants(self):
+        return (self.buyer, self.seller)
+
+
+class EquityOption:
+    def verify(self, ltx) -> None:
+        from . import pricing
+
+        outs = ltx.outputs_of_type(EquityOptionState)
+        require_that("one option output", len(outs) == 1)
+        o = outs[0]
+        require_that("positive share count", o.n_shares > 0)
+        require_that("positive strike", o.strike_cents > 0)
+        require_that(
+            "a known equity name", o.name in pricing.DEMO_EQUITY_MARKET
+        )
+
+
+register_contract(EQUITY_OPTION_CONTRACT, EquityOption())
+
+
+@ser.serializable
+@dataclass(frozen=True)
+class CommodityForwardState:
+    """Deliverable commodity forward: buyer takes `units` of `name` at
+    `strike_cents` per unit at maturity — the Commodity risk-class
+    carrier."""
+
+    buyer: Party
+    seller: Party
+    units: int
+    strike_cents: int
+    maturity_micros: int
+    name: str
+
+    @property
+    def participants(self):
+        return (self.buyer, self.seller)
+
+
+class CommodityForward:
+    def verify(self, ltx) -> None:
+        from . import pricing
+
+        outs = ltx.outputs_of_type(CommodityForwardState)
+        require_that("one forward output", len(outs) == 1)
+        o = outs[0]
+        require_that("positive units", o.units > 0)
+        require_that("positive strike", o.strike_cents > 0)
+        require_that(
+            "a known commodity", o.name in pricing.DEMO_COMMODITY_MARKET
+        )
+
+
+register_contract(COMMODITY_FORWARD_CONTRACT, CommodityForward())
+
+
+@dataclass
+class PortfolioSensitivities:
+    """Every SIMM input family one pricing pass produces: IR delta /
+    vega ladders and FX spot deltas keyed by currency, plus the
+    bucketed equity / commodity spot deltas and per-issuer CreditQ
+    CS01 ladders the round-3 carriers contribute."""
+
+    delta: dict
+    vega: dict
+    fx: dict
+    equity: dict
+    commodity: dict
+    credit_q: dict
+
+
 def portfolio_ladders(
     swaps: list[InterestRateSwapState],
     now_micros: int = 0,
     swaptions: list[SwaptionState] = (),
     market=None,
     fx_forwards: list[FxForwardState] = (),
-) -> tuple[dict, dict, dict]:
-    """Price the mixed portfolio into per-currency (delta, vega,
-    fx-spot) sensitivities off the shared market curves: per-trade
-    bump-and-revalue delta ladders (swaps, swaptions and both legs of
-    FX forwards), swaption vega ladders, and per-currency FX spot
-    sensitivities. The ONE pricing pass every margin consumer (demo,
-    web API) shares."""
+    cds: list[CdsState] = (),
+    equity_options: list[EquityOptionState] = (),
+    commodity_forwards: list[CommodityForwardState] = (),
+) -> PortfolioSensitivities:
+    """Price the mixed portfolio into every SIMM sensitivity family
+    off the shared market curves: per-trade bump-and-revalue IR delta
+    ladders (swaps, swaptions, both legs of FX forwards, and the
+    discounting legs of CDS / equity options / commodity forwards),
+    swaption vega ladders, FX spot sensitivities, bucketed equity and
+    commodity spot deltas, and per-issuer CreditQ CS01 ladders. The
+    ONE pricing pass every margin consumer (demo, web API) shares."""
     from . import pricing
 
     curve, vols = market if market is not None else pricing.demo_market()
     delta: dict = {}
     vega: dict = {}
     fx: dict = {}
+    equity: dict = {}
+    commodity: dict = {}
+    credit_q: dict = {}
 
     def add(buckets, ccy, ladder):
         buckets[ccy] = buckets.get(ccy, 0) + ladder
+
+    def add_name(classed, bucket, name, value):
+        classed.setdefault(bucket, {})
+        classed[bucket][name] = classed[bucket].get(name, 0) + value
 
     for s in swaps:
         last = max(s.fixing_dates) if s.fixing_dates else now_micros
@@ -187,7 +326,54 @@ def portfolio_ladders(
         # 0.32 cross-bucket gamma instead of netting it
         add(delta, DOMESTIC_BUCKET, dom_ladder)
         add(delta, f.foreign_ccy, fgn_ladder)
-    return delta, vega, fx
+    for c in cds:
+        years = max((c.maturity_micros - now_micros) / _YEAR_MICROS, 0.0)
+        bucket, credit_curve = pricing.DEMO_CREDIT_CURVES[c.issuer]
+        add_name(
+            credit_q, bucket, c.issuer,
+            pricing.cds_cs01_ladder(
+                c.notional, c.spread_bps, years, curve, credit_curve
+            ),
+        )
+        add(
+            delta, DOMESTIC_BUCKET,
+            pricing.cds_rate_ladder(
+                c.notional, c.spread_bps, years, curve, credit_curve
+            ),
+        )
+    for e in equity_options:
+        expiry = max((e.expiry_micros - now_micros) / _YEAR_MICROS, 0.0)
+        bucket, spot, vol = pricing.DEMO_EQUITY_MARKET[e.name]
+        strike = e.strike_cents / 100.0
+        add_name(
+            equity, bucket, e.name,
+            pricing.equity_spot_delta(
+                e.n_shares, strike, expiry, curve, spot, vol, e.is_call
+            ),
+        )
+        add(
+            delta, DOMESTIC_BUCKET,
+            pricing.equity_option_rate_ladder(
+                e.n_shares, strike, expiry, curve, spot, vol, e.is_call
+            ),
+        )
+    for m in commodity_forwards:
+        years = max((m.maturity_micros - now_micros) / _YEAR_MICROS, 0.0)
+        bucket, spot, carry = pricing.DEMO_COMMODITY_MARKET[m.name]
+        strike = m.strike_cents / 100.0
+        add_name(
+            commodity, bucket, m.name,
+            pricing.commodity_spot_delta(
+                m.units, strike, years, curve, spot, carry
+            ),
+        )
+        add(
+            delta, DOMESTIC_BUCKET,
+            pricing.commodity_forward_rate_ladder(
+                m.units, strike, years, curve, spot, carry
+            ),
+        )
+    return PortfolioSensitivities(delta, vega, fx, equity, commodity, credit_q)
 
 
 def initial_margin(
@@ -196,17 +382,25 @@ def initial_margin(
     swaptions: list[SwaptionState] = (),
     market=None,
     fx_forwards: list[FxForwardState] = (),
+    cds: list[CdsState] = (),
+    equity_options: list[EquityOptionState] = (),
+    commodity_forwards: list[CommodityForwardState] = (),
 ) -> int:
     """SIMM margin for the mixed portfolio: the priced sensitivities
-    feed the IR (delta + vega + curvature) and FX risk classes of
-    `simm.simm_im`, psi-aggregated across classes. Deterministic: both
-    parties run the same fixed float64 op order and agree bit-for-bit."""
+    feed the IR (delta + vega + curvature), FX, Equity, Commodity and
+    CreditQ risk classes of `simm.simm_im`, psi-aggregated across
+    classes. Deterministic: both parties run the same fixed float64 op
+    order and agree bit-for-bit."""
     from . import simm
 
-    delta, vega, fx = portfolio_ladders(
-        swaps, now_micros, swaptions, market, fx_forwards
+    s = portfolio_ladders(
+        swaps, now_micros, swaptions, market, fx_forwards,
+        cds, equity_options, commodity_forwards,
     )
-    return simm.simm_im(delta, vega, fx)
+    return simm.simm_im(
+        s.delta, s.vega, s.fx,
+        equity=s.equity, commodity=s.commodity, credit_q=s.credit_q,
+    )
 
 
 @ser.serializable
@@ -255,13 +449,15 @@ register_contract(SIMM_CONTRACT, PortfolioValuation())
 
 def run(
     seed: int = 42, n_swaps: int = 3, n_swaptions: int = 2,
-    n_fx_forwards: int = 2,
+    n_fx_forwards: int = 2, n_cds: int = 2, n_equity_options: int = 2,
+    n_commodity_forwards: int = 2,
 ):
-    """Build a mixed IRS + swaption + FX-forward portfolio, have both
-    sides price it off the shared demo market and value it under SIMM
-    (IR delta + vega + curvature, FX delta, psi cross-class
-    aggregation), agree the margin on ledger. Returns the recorded
-    valuation state."""
+    """Build a mixed IRS + swaption + FX-forward + CDS + equity-option
+    + commodity-forward portfolio, have both sides price it off the
+    shared demo market and value it under SIMM across all the exposed
+    risk classes (IR delta + vega + curvature, FX, CreditQ, Equity,
+    Commodity; psi cross-class aggregation), agree the margin on
+    ledger. Returns the recorded valuation state."""
     from ..finance.trade_flows import DealInstigatorFlow
     from ..samples.irs_demo import StartSwapFlow
     from ..testing.mock_network import MockNetwork
@@ -318,31 +514,98 @@ def run(
         )
         net.run()
         fsm.result_or_throw()
+    from . import pricing as _pricing
+
+    issuers = tuple(sorted(_pricing.DEMO_CREDIT_CURVES))
+    for i in range(n_cds):
+        swap_cds = CdsState(
+            buyer=a.party,
+            seller=b.party,
+            notional=5_000_000 * (i + 1),
+            spread_bps=80 + 20 * i,
+            maturity_micros=now + (i + 3) * 31_557_600 * 10**6,
+            issuer=issuers[i % len(issuers)],
+        )
+        fsm = a.start_flow(
+            DealInstigatorFlow(b.party, swap_cds, CDS_CONTRACT, notary.party)
+        )
+        net.run()
+        fsm.result_or_throw()
+    eq_names = tuple(sorted(_pricing.DEMO_EQUITY_MARKET))
+    for i in range(n_equity_options):
+        name = eq_names[i % len(eq_names)]
+        _, spot, _ = _pricing.DEMO_EQUITY_MARKET[name]
+        opt = EquityOptionState(
+            buyer=a.party,
+            seller=b.party,
+            n_shares=10_000 * (i + 1),
+            strike_cents=int(spot * 100 * (0.95 + 0.1 * i)),
+            expiry_micros=now + (i + 1) * 31_557_600 * 10**6,
+            name=name,
+            is_call=(i % 2 == 0),
+        )
+        fsm = a.start_flow(
+            DealInstigatorFlow(
+                b.party, opt, EQUITY_OPTION_CONTRACT, notary.party
+            )
+        )
+        net.run()
+        fsm.result_or_throw()
+    cm_names = tuple(sorted(_pricing.DEMO_COMMODITY_MARKET))
+    for i in range(n_commodity_forwards):
+        name = cm_names[i % len(cm_names)]
+        _, spot, _ = _pricing.DEMO_COMMODITY_MARKET[name]
+        cfwd = CommodityForwardState(
+            buyer=a.party,
+            seller=b.party,
+            units=20_000 * (i + 1),
+            strike_cents=int(spot * 100 * (0.98 + 0.05 * i)),
+            maturity_micros=now + (i + 1) * 31_557_600 * 10**6,
+            name=name,
+        )
+        fsm = a.start_flow(
+            DealInstigatorFlow(
+                b.party, cfwd, COMMODITY_FORWARD_CONTRACT, notary.party
+            )
+        )
+        net.run()
+        fsm.result_or_throw()
 
     # both sides independently price + value their view of the shared
     # portfolio against the shared market data
     def gather(node):
-        swaps = [
-            s.state.data
-            for s in node.vault.unconsumed_states(InterestRateSwapState)
-        ]
-        opts = [
-            s.state.data for s in node.vault.unconsumed_states(SwaptionState)
-        ]
-        fwds = [
-            s.state.data for s in node.vault.unconsumed_states(FxForwardState)
-        ]
-        return swaps, opts, fwds
+        def states(cls):
+            return [
+                s.state.data for s in node.vault.unconsumed_states(cls)
+            ]
 
-    swaps_a, opts_a, fwds_a = gather(a)
-    swaps_b, opts_b, fwds_b = gather(b)
-    margin_a = initial_margin(swaps_a, now, opts_a, fx_forwards=fwds_a)
-    margin_b = initial_margin(swaps_b, now, opts_b, fx_forwards=fwds_b)
+        return {
+            "swaps": states(InterestRateSwapState),
+            "swaptions": states(SwaptionState),
+            "fx_forwards": states(FxForwardState),
+            "cds": states(CdsState),
+            "equity_options": states(EquityOptionState),
+            "commodity_forwards": states(CommodityForwardState),
+        }
+
+    book_a = gather(a)
+    book_b = gather(b)
+
+    def margin_of(book):
+        return initial_margin(
+            book["swaps"], now, book["swaptions"],
+            fx_forwards=book["fx_forwards"], cds=book["cds"],
+            equity_options=book["equity_options"],
+            commodity_forwards=book["commodity_forwards"],
+        )
+
+    margin_a = margin_of(book_a)
+    margin_b = margin_of(book_b)
     assert margin_a == margin_b, "valuations must agree before signing"
 
     valuation = PortfolioValuationState(
         a.party, b.party, now,
-        len(swaps_a) + len(opts_a) + len(fwds_a), margin_a,
+        sum(len(v) for v in book_a.values()), margin_a,
     )
     fsm = a.start_flow(
         DealInstigatorFlow(b.party, valuation, SIMM_CONTRACT, notary.party)

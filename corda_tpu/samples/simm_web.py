@@ -8,13 +8,15 @@ NodeWebServer gateway).
 
 Mounted at /api/simm:
   GET  /api/simm/whoami                 own identity + known peers
-  GET  /api/simm/trades                 swap + swaption + FX forward
-                                        trade summaries
+  GET  /api/simm/trades                 swap / swaption / FX forward /
+                                        CDS / equity option / commodity
+                                        forward trade summaries
   GET  /api/simm/portfolio/summary      counts and notional aggregates
   GET  /api/simm/portfolio/margin       SIMM breakdown (delta/vega/
-                                        curvature/fx/total, psi
-                                        cross-class aggregate) priced
-                                        off the shared demo market;
+                                        curvature/fx/equity/commodity/
+                                        credit_q/total, psi cross-class
+                                        aggregate) priced off the
+                                        shared demo market;
                                         ?t=<micros> sets the valuation
                                         time
   GET  /api/simm/portfolio/valuations   recorded on-ledger valuations
@@ -30,6 +32,9 @@ from ..node.vault_query import VaultQueryCriteria
 from .irs_demo import InterestRateSwapState
 from .simm_demo import (
     SIMM_CONTRACT,
+    CdsState,
+    CommodityForwardState,
+    EquityOptionState,
     FxForwardState,
     PortfolioValuationState,
     SwaptionState,
@@ -91,20 +96,63 @@ def _trades(ctx, query, body):
         }
         for f in _states(ctx, FxForwardState)
     ]
-    return 200, {"trades": swaps + swaptions + forwards}
+    cds = [
+        {
+            "type": "cds",
+            "buyer": c.buyer.name,
+            "seller": c.seller.name,
+            "notional": c.notional,
+            "spread_bps": c.spread_bps,
+            "issuer": c.issuer,
+        }
+        for c in _states(ctx, CdsState)
+    ]
+    options = [
+        {
+            "type": "equity_option",
+            "buyer": o.buyer.name,
+            "seller": o.seller.name,
+            "n_shares": o.n_shares,
+            "strike_cents": o.strike_cents,
+            "name": o.name,
+            "call": o.is_call,
+        }
+        for o in _states(ctx, EquityOptionState)
+    ]
+    commodities = [
+        {
+            "type": "commodity_forward",
+            "buyer": m.buyer.name,
+            "seller": m.seller.name,
+            "units": m.units,
+            "strike_cents": m.strike_cents,
+            "name": m.name,
+        }
+        for m in _states(ctx, CommodityForwardState)
+    ]
+    return 200, {
+        "trades": swaps + swaptions + forwards + cds + options + commodities
+    }
 
 
 def _summary(ctx, query, body):
     swaps = _states(ctx, InterestRateSwapState)
     swaptions = _states(ctx, SwaptionState)
     forwards = _states(ctx, FxForwardState)
+    cds = _states(ctx, CdsState)
+    options = _states(ctx, EquityOptionState)
+    commodities = _states(ctx, CommodityForwardState)
     return 200, {
         "swaps": len(swaps),
         "swaptions": len(swaptions),
         "fx_forwards": len(forwards),
+        "cds": len(cds),
+        "equity_options": len(options),
+        "commodity_forwards": len(commodities),
         "swap_notional": sum(s.notional for s in swaps),
         "swaption_notional": sum(o.notional for o in swaptions),
         "fx_forward_notional": sum(f.notional_fgn for f in forwards),
+        "cds_notional": sum(c.notional for c in cds),
     }
 
 
@@ -115,18 +163,34 @@ def _parse_t(query) -> int:
         return 0
 
 
+def _book(ctx):
+    """One vault sweep of every priced trade family."""
+    return {
+        "swaps": _states(ctx, InterestRateSwapState),
+        "swaptions": _states(ctx, SwaptionState),
+        "fx_forwards": _states(ctx, FxForwardState),
+        "cds": _states(ctx, CdsState),
+        "equity_options": _states(ctx, EquityOptionState),
+        "commodity_forwards": _states(ctx, CommodityForwardState),
+    }
+
+
 def _margin(ctx, query, body):
     from .simm_demo import portfolio_ladders
     from . import simm
 
     now = _parse_t(query)
-    swaps = _states(ctx, InterestRateSwapState)
-    swaptions = _states(ctx, SwaptionState)
-    forwards = _states(ctx, FxForwardState)
-    delta, vega, fx = portfolio_ladders(
-        swaps, now, swaptions, fx_forwards=forwards
+    book = _book(ctx)
+    s = portfolio_ladders(
+        book["swaps"], now, book["swaptions"],
+        fx_forwards=book["fx_forwards"], cds=book["cds"],
+        equity_options=book["equity_options"],
+        commodity_forwards=book["commodity_forwards"],
     )
-    parts = simm.simm_breakdown(delta, vega, fx)
+    parts = simm.simm_breakdown(
+        s.delta, s.vega, s.fx,
+        equity=s.equity, commodity=s.commodity, credit_q=s.credit_q,
+    )
     # the total IS the psi cross-class aggregate (simm.simm_im's
     # definition) — one pricing pass, no second computation to drift
     # from the parts
@@ -135,8 +199,11 @@ def _margin(ctx, query, body):
         "vega": round(parts["vega"], 2),
         "curvature": round(parts["curvature"], 2),
         "fx": round(parts["fx"], 2),
+        "equity": round(parts["equity"], 2),
+        "commodity": round(parts["commodity"], 2),
+        "credit_q": round(parts["credit_q"], 2),
         "margin": int(round(parts["total"])),
-        "trades": len(swaps) + len(swaptions) + len(forwards),
+        "trades": sum(len(v) for v in book.values()),
     }
 
 
@@ -176,13 +243,16 @@ def _calculate(ctx, query, body):
     if not notaries:
         return 400, {"error": "no notary on the network"}
     me = ctx.wait(ctx.client.node_identity()).legal_identity
-    swaps = _states(ctx, InterestRateSwapState)
-    swaptions = _states(ctx, SwaptionState)
-    forwards = _states(ctx, FxForwardState)
-    margin = initial_margin(swaps, now, swaptions, fx_forwards=forwards)
+    book = _book(ctx)
+    margin = initial_margin(
+        book["swaps"], now, book["swaptions"],
+        fx_forwards=book["fx_forwards"], cds=book["cds"],
+        equity_options=book["equity_options"],
+        commodity_forwards=book["commodity_forwards"],
+    )
     valuation = PortfolioValuationState(
         me, parties[counterparty], now,
-        len(swaps) + len(swaptions) + len(forwards), margin,
+        sum(len(v) for v in book.values()), margin,
     )
     handle = ctx.wait(
         ctx.client.start_flow(

@@ -126,25 +126,36 @@ def test_oracle_refuses_wrong_rate_and_extra_reveals():
 
 def test_simm_demo():
     """Two-node agreement on a MIXED multi-risk-class portfolio:
-    3 swaps + 2 swaptions + 2 FX forwards recorded on ledger, both
-    parties reprice off the shared demo market, margin carries the IR
-    (delta/vega/curvature) and FX risk classes psi-aggregated."""
+    3 swaps + 2 swaptions + 2 FX forwards + 2 CDS + 2 equity options +
+    2 commodity forwards recorded on ledger, both parties reprice off
+    the shared demo market, margin carries the IR (delta/vega/
+    curvature), FX, CreditQ, Equity and Commodity risk classes
+    psi-aggregated."""
     from corda_tpu.samples import simm_demo
 
     v = simm_demo.run()
-    assert v.portfolio_size == 7
+    assert v.portfolio_size == 13
     assert v.margin > 0
     # determinism: both sides' valuation function is pure
     assert v.margin == simm_demo.run(seed=42).margin
-    # the vega layers genuinely contribute: dropping the swaptions from
-    # the valuation must LOWER the margin
-    delta_only = simm_demo.run(n_swaptions=0)
+    # layer-contribution ordering holds on the rates-only book (in the
+    # full book the new carriers' discounting legs net against swaption
+    # IR delta, so total-margin ordering is not monotone there): vega
+    # and FX each genuinely contribute
+    rates_only = dict(n_cds=0, n_equity_options=0, n_commodity_forwards=0)
+    base = simm_demo.run(**rates_only)
+    assert base.portfolio_size == 7
+    delta_only = simm_demo.run(n_swaptions=0, **rates_only)
     assert delta_only.portfolio_size == 5
-    assert delta_only.margin < v.margin
-    # the FX class genuinely contributes too
-    no_fx = simm_demo.run(n_fx_forwards=0)
+    assert delta_only.margin < base.margin
+    no_fx = simm_demo.run(n_fx_forwards=0, **rates_only)
     assert no_fx.portfolio_size == 5
-    assert no_fx.margin < v.margin
+    assert no_fx.margin < base.margin
+    # each round-3 class carries one-sided risk (no intra-class
+    # netting partner): dropping it lowers the full-book margin
+    assert simm_demo.run(n_cds=0).margin < v.margin
+    assert simm_demo.run(n_equity_options=0).margin < v.margin
+    assert simm_demo.run(n_commodity_forwards=0).margin < v.margin
 
 
 def test_simm_vega_curvature_layers():
@@ -278,15 +289,14 @@ def test_fx_forward_domestic_delta_nets_with_swaps():
         strike_milli=1_100, maturity_micros=2 * year,
         foreign_ccy="EUR",
     )
-    delta, _, fx = simm_demo.portfolio_ladders(
-        [swap], 0, fx_forwards=[fwd]
-    )
+    sens = simm_demo.portfolio_ladders([swap], 0, fx_forwards=[fwd])
+    delta, fx = sens.delta, sens.fx
     assert "USD" not in delta            # no phantom separate bucket
     assert simm_demo.DOMESTIC_BUCKET in delta and "EUR" in delta
     assert fx["EUR"] > 0
     # and the combined domestic ladder is genuinely the sum of legs
-    d_swap, _, _ = simm_demo.portfolio_ladders([swap], 0)
-    d_fwd, _, _ = simm_demo.portfolio_ladders([], 0, fx_forwards=[fwd])
+    d_swap = simm_demo.portfolio_ladders([swap], 0).delta
+    d_fwd = simm_demo.portfolio_ladders([], 0, fx_forwards=[fwd]).delta
     import numpy as np
 
     np.testing.assert_allclose(
@@ -385,4 +395,214 @@ def test_simm_demo_portfolio_margin_positive():
     from corda_tpu.samples import simm_demo
 
     v = simm_demo.run(n_swaps=2)
+    assert v.margin > 0
+
+
+def test_simm_equity_commodity_classes():
+    """Equity/Commodity bucketed delta classes follow the published
+    structure: single-name K = RW * |s|, intra-bucket netting at
+    rho_b, cross-bucket diversification through gamma, residual K adds
+    OUTSIDE the square root, and unknown buckets raise."""
+    import math
+
+    from corda_tpu.samples import simm
+
+    rw1 = simm.EQUITY_RISK_WEIGHTS[0]
+    one = simm.equity_margin({1: {"ACME": 1000.0}})
+    assert abs(one - rw1 * 1000.0) < 1e-9
+    assert simm.equity_margin({1: {"ACME": -1000.0}}) == one
+
+    # two names in one bucket correlate at the bucket rho
+    rho1 = simm.EQUITY_INTRA_RHO[0]
+    w = rw1 * 1000.0
+    two = simm.equity_margin({1: {"ACME": 1000.0, "BETA": 1000.0}})
+    assert abs(two - math.sqrt(2 * w * w + 2 * rho1 * w * w)) < 1e-9
+    # opposite positions net relative to the same-sign pair (at the
+    # low equity intra-bucket rho they do NOT fall below one-sided:
+    # K_opposite = w * sqrt(2 * (1 - rho)) > w)
+    opposite = simm.equity_margin({1: {"ACME": 1000.0, "BETA": -1000.0}})
+    assert abs(opposite - w * math.sqrt(2.0 * (1.0 - rho1))) < 1e-9
+    assert opposite < two
+
+    # cross-bucket: gamma < 1 diversifies (strictly between max and sum)
+    k1 = simm.equity_margin({1: {"A": 1000.0}})
+    k5 = simm.equity_margin({5: {"B": 1000.0}})
+    cross = simm.equity_margin({1: {"A": 1000.0}, 5: {"B": 1000.0}})
+    assert max(k1, k5) < cross < k1 + k5
+
+    # residual adds OUTSIDE the aggregation: exactly linear on top
+    base = simm.equity_margin({1: {"A": 1000.0}})
+    res = simm.equity_margin({simm.RESIDUAL: {"X": 1000.0}})
+    withres = simm.equity_margin(
+        {1: {"A": 1000.0}, simm.RESIDUAL: {"X": 1000.0}}
+    )
+    assert abs(withres - (base + res)) < 1e-9
+    assert abs(res - simm.EQUITY_RESIDUAL_RW * 1000.0) < 1e-9
+
+    # unknown bucket numbers raise rather than silently dropping risk
+    for bad in (0, 13, "emerging"):
+        try:
+            simm.equity_margin({bad: {"A": 1.0}})
+            raise AssertionError(f"bucket {bad!r} accepted")
+        except ValueError:
+            pass
+
+    # commodity mirrors the same structure on its 17 buckets
+    c = simm.commodity_margin({2: {"CRUDE": 500.0}})
+    assert abs(c - simm.COMMODITY_RISK_WEIGHTS[1] * 500.0) < 1e-9
+    pair = simm.commodity_margin({2: {"CRUDE": 500.0}, 12: {"GOLD": 500.0}})
+    g = simm.commodity_margin({12: {"GOLD": 500.0}})
+    assert max(c, g) < pair < c + g
+    # the published commodity model has no residual bucket: RESIDUAL
+    # must raise like any unknown bucket, not silently add margin
+    for bad in (18, simm.RESIDUAL):
+        try:
+            simm.commodity_margin({bad: {"X": 1.0}})
+            raise AssertionError(f"bucket {bad!r} accepted")
+        except ValueError:
+            pass
+
+
+def test_simm_credit_classes():
+    """CreditQ/CreditNonQ follow the published CS01 structure:
+    same-issuer tenors correlate at rho_same, different issuers at
+    rho_diff (same-issuer pairs correlate tighter), ladders must carry
+    the five credit vertices, and the residual bucket adds linearly."""
+    import math
+
+    import numpy as np
+
+    from corda_tpu.samples import simm
+
+    lad = simm.credit_cs01_ladder(1_000_000, 5.0)
+    assert lad.shape == (simm.N_CREDIT_TENORS,)
+    assert lad.sum() > 0 and np.count_nonzero(lad) <= 2
+
+    rw1 = simm.CREDITQ_RISK_WEIGHTS_BP[0]
+    one = simm.credit_q_margin({1: {"ACME": lad}})
+    assert one > 0
+    # homogeneous degree 1 and sign-symmetric
+    twice = simm.credit_q_margin({1: {"ACME": 2 * lad}})
+    assert abs(twice - 2 * one) < 1e-6
+    assert simm.credit_q_margin({1: {"ACME": -lad}}) == one
+
+    # same-issuer exposure at two tenors aggregates TIGHTER (rho_same
+    # 0.93) than the same exposure split across two issuers (rho_diff)
+    lad1 = simm.credit_cs01_ladder(1_000_000, 1.0)
+    lad10 = simm.credit_cs01_ladder(1_000_000, 10.0)
+    same = simm.credit_q_margin({1: {"ACME": lad1 + lad10}})
+    diff = simm.credit_q_margin({1: {"ACME": lad1, "OTHER": lad10}})
+    assert same > diff
+
+    # single point exposure: K = RW * cs01 exactly
+    point = np.zeros(simm.N_CREDIT_TENORS)
+    point[3] = 100.0
+    k = simm.credit_q_margin({1: {"ACME": point}})
+    assert abs(k - rw1 * 100.0) < 1e-9
+
+    # residual adds outside; wrong vertex count and bad buckets raise
+    res = simm.credit_q_margin({simm.RESIDUAL: {"X": point}})
+    both = simm.credit_q_margin(
+        {1: {"ACME": point}, simm.RESIDUAL: {"X": point}}
+    )
+    assert abs(both - (k + res)) < 1e-9
+    try:
+        simm.credit_q_margin({1: {"ACME": np.zeros(3)}})
+        raise AssertionError("3-vertex ladder accepted")
+    except ValueError:
+        pass
+    try:
+        simm.credit_q_margin({13: {"ACME": point}})
+        raise AssertionError("bucket 13 accepted")
+    except ValueError:
+        pass
+
+    # non-qualifying: two buckets, much weaker cross-bucket coupling
+    nq1 = simm.credit_nonq_margin({1: {"A": point}})
+    nq2 = simm.credit_nonq_margin({2: {"B": point}})
+    nq = simm.credit_nonq_margin({1: {"A": point}, 2: {"B": point}})
+    assert max(nq1, nq2) < nq < nq1 + nq2
+    # gamma 0.05 couples far looser than CreditQ's 0.42
+    assert (nq / math.sqrt(nq1**2 + nq2**2)) < 1.05
+
+
+def test_simm_six_class_aggregation_and_carrier_pricing():
+    """The full six-class breakdown: each new carrier contributes to
+    exactly its risk class (plus domestic IR discounting), the psi
+    aggregation spans every active class, and both parties repricing
+    the same book agree bit-for-bit."""
+    from corda_tpu.core.identity import Party
+    from corda_tpu.crypto import schemes
+    from corda_tpu.samples import pricing, simm, simm_demo
+
+    def party(name, seed):
+        kp = schemes.generate_keypair(
+            schemes.EDDSA_ED25519_SHA512, seed=seed
+        )
+        return Party(name, kp.public)
+
+    a, b = party("A", 1), party("B", 2)
+    year = 31_557_600 * 10**6
+    cds = simm_demo.CdsState(
+        buyer=a, seller=b, notional=5_000_000, spread_bps=90,
+        maturity_micros=5 * year, issuer="ACME-INDUSTRIAL",
+    )
+    opt = simm_demo.EquityOptionState(
+        buyer=a, seller=b, n_shares=10_000, strike_cents=12_000,
+        expiry_micros=2 * year, name="ACME-INDUSTRIAL",
+    )
+    fwd = simm_demo.CommodityForwardState(
+        buyer=a, seller=b, units=20_000, strike_cents=8_300,
+        maturity_micros=1 * year, name="CRUDE",
+    )
+    s = simm_demo.portfolio_ladders(
+        [], 0, cds=[cds], equity_options=[opt], commodity_forwards=[fwd]
+    )
+    # each carrier landed in its own class, in the right bucket
+    eq_bucket = pricing.DEMO_EQUITY_MARKET["ACME-INDUSTRIAL"][0]
+    cm_bucket = pricing.DEMO_COMMODITY_MARKET["CRUDE"][0]
+    cq_bucket = pricing.DEMO_CREDIT_CURVES["ACME-INDUSTRIAL"][0]
+    assert list(s.equity) == [eq_bucket]
+    assert list(s.commodity) == [cm_bucket]
+    assert list(s.credit_q) == [cq_bucket]
+    # a long call gains from a +1% spot move; a long forward likewise
+    assert s.equity[eq_bucket]["ACME-INDUSTRIAL"] > 0
+    assert s.commodity[cm_bucket]["CRUDE"] > 0
+    # protection bought above/below par still carries positive CS01
+    assert s.credit_q[cq_bucket]["ACME-INDUSTRIAL"].sum() > 0
+    # discounting legs all fold into the domestic IR bucket
+    assert simm_demo.DOMESTIC_BUCKET in s.delta
+
+    parts = simm.simm_breakdown(
+        s.delta, s.vega, s.fx,
+        equity=s.equity, commodity=s.commodity, credit_q=s.credit_q,
+    )
+    for cls in ("equity", "commodity", "credit_q"):
+        assert parts[cls] > 0.0, cls
+    # psi aggregation strictly between the max class and the plain sum
+    ir = parts["delta"] + parts["vega"] + parts["curvature"]
+    active = [ir, parts["equity"], parts["commodity"], parts["credit_q"]]
+    assert max(active) < parts["total"] < sum(active)
+
+    # bit-for-bit agreement when the counterparty reprices the book
+    s2 = simm_demo.portfolio_ladders(
+        [], 0, cds=[cds], equity_options=[opt], commodity_forwards=[fwd]
+    )
+    m1 = simm.simm_im(s.delta, s.vega, s.fx, equity=s.equity,
+                      commodity=s.commodity, credit_q=s.credit_q)
+    m2 = simm.simm_im(s2.delta, s2.vega, s2.fx, equity=s2.equity,
+                      commodity=s2.commodity, credit_q=s2.credit_q)
+    assert m1 == m2 and m1 > 0
+
+
+def test_simm_demo_six_class_arc():
+    """The demo arc carries all six trade families through the ledger
+    and the agreed margin covers every exposed risk class."""
+    from corda_tpu.samples import simm_demo
+
+    v = simm_demo.run(
+        n_swaps=1, n_swaptions=1, n_fx_forwards=1, n_cds=1,
+        n_equity_options=1, n_commodity_forwards=1,
+    )
+    assert v.portfolio_size == 6
     assert v.margin > 0
