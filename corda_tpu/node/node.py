@@ -563,9 +563,12 @@ class Node:
 
     def webserver(self, username: str, password: str, port: int = 0):
         """Embedded web gateway over the node's own RPC surface, with
-        this node's MetricRegistry at /metrics. The node's pump loop
-        (run()) drives message delivery, so the gateway itself only
-        polls futures (pass a real pump when embedding without run())."""
+        this node's MetricRegistry at /metrics and the ledger explorer
+        UI at /web/explorer/. The node's pump loop (run()) drives
+        message delivery, so the gateway itself only polls futures
+        (pass a real pump when embedding without run())."""
+        import corda_tpu.tools.web_explorer  # noqa: F401 - /api/explorer
+
         from ..client.webserver import NodeWebServer
 
         return NodeWebServer(

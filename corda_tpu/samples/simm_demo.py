@@ -376,6 +376,54 @@ def portfolio_ladders(
     return PortfolioSensitivities(delta, vega, fx, equity, commodity, credit_q)
 
 
+# the one registry of priced trade families: portfolio_ladders kwarg
+# name -> state class. Every book enumerator (demo gather, web API
+# vault sweep) iterates THIS mapping, so adding a seventh family is one
+# entry + one pricing branch — not synchronized edits across call sites
+TRADE_FAMILIES: dict[str, type] = {
+    "swaps": InterestRateSwapState,
+    "swaptions": SwaptionState,
+    "fx_forwards": FxForwardState,
+    "cds": CdsState,
+    "equity_options": EquityOptionState,
+    "commodity_forwards": CommodityForwardState,
+}
+
+
+def portfolio_ladders_book(
+    book: dict, now_micros: int = 0, market=None
+) -> PortfolioSensitivities:
+    """`portfolio_ladders` over a {family_name: [states]} book keyed by
+    `TRADE_FAMILIES` (unknown families raise — a misfiled family must
+    not silently drop from the margin)."""
+    unknown = set(book) - set(TRADE_FAMILIES)
+    if unknown:
+        raise ValueError(f"unknown trade families: {sorted(unknown)}")
+    swaps = book.get("swaps", [])
+    kwargs = {
+        f: book[f] for f in TRADE_FAMILIES
+        if f != "swaps" and f in book
+    }
+    return portfolio_ladders(swaps, now_micros, market=market, **kwargs)
+
+
+def initial_margin_book(
+    book: dict, now_micros: int = 0, market=None
+) -> int:
+    """SIMM margin for a {family_name: [states]} book: the priced
+    sensitivities feed the IR (delta + vega + curvature), FX, Equity,
+    Commodity and CreditQ risk classes of `simm.simm_im`,
+    psi-aggregated across classes. Deterministic: both parties run the
+    same fixed float64 op order and agree bit-for-bit."""
+    from . import simm
+
+    s = portfolio_ladders_book(book, now_micros, market)
+    return simm.simm_im(
+        s.delta, s.vega, s.fx,
+        equity=s.equity, commodity=s.commodity, credit_q=s.credit_q,
+    )
+
+
 def initial_margin(
     swaps: list[InterestRateSwapState],
     now_micros: int = 0,
@@ -386,20 +434,19 @@ def initial_margin(
     equity_options: list[EquityOptionState] = (),
     commodity_forwards: list[CommodityForwardState] = (),
 ) -> int:
-    """SIMM margin for the mixed portfolio: the priced sensitivities
-    feed the IR (delta + vega + curvature), FX, Equity, Commodity and
-    CreditQ risk classes of `simm.simm_im`, psi-aggregated across
-    classes. Deterministic: both parties run the same fixed float64 op
-    order and agree bit-for-bit."""
-    from . import simm
-
-    s = portfolio_ladders(
-        swaps, now_micros, swaptions, market, fx_forwards,
-        cds, equity_options, commodity_forwards,
-    )
-    return simm.simm_im(
-        s.delta, s.vega, s.fx,
-        equity=s.equity, commodity=s.commodity, credit_q=s.credit_q,
+    """`initial_margin_book` with one positional/keyword argument per
+    family (the demo-facing spelling)."""
+    return initial_margin_book(
+        {
+            "swaps": swaps,
+            "swaptions": swaptions,
+            "fx_forwards": fx_forwards,
+            "cds": cds,
+            "equity_options": equity_options,
+            "commodity_forwards": commodity_forwards,
+        },
+        now_micros,
+        market,
     )
 
 
@@ -574,33 +621,17 @@ def run(
     # both sides independently price + value their view of the shared
     # portfolio against the shared market data
     def gather(node):
-        def states(cls):
-            return [
+        return {
+            family: [
                 s.state.data for s in node.vault.unconsumed_states(cls)
             ]
-
-        return {
-            "swaps": states(InterestRateSwapState),
-            "swaptions": states(SwaptionState),
-            "fx_forwards": states(FxForwardState),
-            "cds": states(CdsState),
-            "equity_options": states(EquityOptionState),
-            "commodity_forwards": states(CommodityForwardState),
+            for family, cls in TRADE_FAMILIES.items()
         }
 
     book_a = gather(a)
     book_b = gather(b)
-
-    def margin_of(book):
-        return initial_margin(
-            book["swaps"], now, book["swaptions"],
-            fx_forwards=book["fx_forwards"], cds=book["cds"],
-            equity_options=book["equity_options"],
-            commodity_forwards=book["commodity_forwards"],
-        )
-
-    margin_a = margin_of(book_a)
-    margin_b = margin_of(book_b)
+    margin_a = initial_margin_book(book_a, now)
+    margin_b = initial_margin_book(book_b, now)
     assert margin_a == margin_b, "valuations must agree before signing"
 
     valuation = PortfolioValuationState(

@@ -164,29 +164,22 @@ def _parse_t(query) -> int:
 
 
 def _book(ctx):
-    """One vault sweep of every priced trade family."""
+    """One vault sweep of every priced trade family (keyed by the
+    simm_demo.TRADE_FAMILIES registry)."""
+    from .simm_demo import TRADE_FAMILIES
+
     return {
-        "swaps": _states(ctx, InterestRateSwapState),
-        "swaptions": _states(ctx, SwaptionState),
-        "fx_forwards": _states(ctx, FxForwardState),
-        "cds": _states(ctx, CdsState),
-        "equity_options": _states(ctx, EquityOptionState),
-        "commodity_forwards": _states(ctx, CommodityForwardState),
+        family: _states(ctx, cls) for family, cls in TRADE_FAMILIES.items()
     }
 
 
 def _margin(ctx, query, body):
-    from .simm_demo import portfolio_ladders
+    from .simm_demo import portfolio_ladders_book
     from . import simm
 
     now = _parse_t(query)
     book = _book(ctx)
-    s = portfolio_ladders(
-        book["swaps"], now, book["swaptions"],
-        fx_forwards=book["fx_forwards"], cds=book["cds"],
-        equity_options=book["equity_options"],
-        commodity_forwards=book["commodity_forwards"],
-    )
+    s = portfolio_ladders_book(book, now)
     parts = simm.simm_breakdown(
         s.delta, s.vega, s.fx,
         equity=s.equity, commodity=s.commodity, credit_q=s.credit_q,
@@ -222,7 +215,7 @@ def _valuations(ctx, query, body):
 
 
 def _calculate(ctx, query, body):
-    from .simm_demo import initial_margin
+    from .simm_demo import initial_margin_book
 
     if not isinstance(body, dict):
         return 400, {"error": "JSON object body required"}
@@ -244,12 +237,7 @@ def _calculate(ctx, query, body):
         return 400, {"error": "no notary on the network"}
     me = ctx.wait(ctx.client.node_identity()).legal_identity
     book = _book(ctx)
-    margin = initial_margin(
-        book["swaps"], now, book["swaptions"],
-        fx_forwards=book["fx_forwards"], cds=book["cds"],
-        equity_options=book["equity_options"],
-        commodity_forwards=book["commodity_forwards"],
-    )
+    margin = initial_margin_book(book, now)
     valuation = PortfolioValuationState(
         me, parties[counterparty], now,
         sum(len(v) for v in book.values()), margin,

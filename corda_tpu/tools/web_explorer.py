@@ -1,0 +1,211 @@
+"""Ledger explorer web UI: the browser-rendered counterpart of the
+terminal explorer.
+
+Reference: tools/explorer/ — the JavaFX/TornadoFX ledger GUI
+(tools/explorer/src/main/kotlin/net/corda/explorer/Main.kt) with its
+dashboard / cash / transactions / network views bound to the
+client/jfx models. The TPU build's framework is headless-first, so the
+GUI is a zero-dependency HTML page served by the node's REST gateway
+(`client/webserver.py`) that polls the same JSON the terminal explorer
+renders — dashboard counts, balances, unconsumed states, verified
+transactions and in-flight flows — over the node's RPC feeds.
+
+Mounted at /api/explorer (JSON) and /web/explorer/ (the page):
+  GET /api/explorer/dashboard       identity, peers, notaries, balance
+                                    + count summary
+  GET /api/explorer/states          unconsumed states with contract tag
+  GET /api/explorer/transactions    verified transaction summaries
+                                    (?limit=N, newest last)
+  GET /api/explorer/machines        in-flight flow state machines
+
+Usage: import this module (registers the plugin) before starting the
+gateway — `corda_tpu.node` does it for every node with a webserver
+port, the same way CorDapp web APIs mount:
+
+    import corda_tpu.tools.web_explorer  # registers /api/explorer
+    NodeWebServer(client, ...).start()
+    # browse http://host:port/web/explorer/
+"""
+
+from __future__ import annotations
+
+from ..client import json_support as js
+from ..client.webserver import WebApiPlugin, register_web_api
+from ..node.vault_query import VaultQueryCriteria
+
+
+def _vault_states(ctx):
+    page = ctx.wait(ctx.client.vault_query_by(VaultQueryCriteria()))
+    return page.states
+
+
+def _amount_product(amount) -> str:
+    token = amount.token
+    # Issued tokens carry the product inside; bare tokens are products
+    product = getattr(token, "product", token)
+    return str(product)
+
+
+def _dashboard(ctx, query, body):
+    me = ctx.wait(ctx.client.node_identity()).legal_identity
+    infos = ctx.wait(ctx.client.network_map_snapshot())
+    notaries = [p.name for p in ctx.wait(ctx.client.notary_identities())]
+    states = _vault_states(ctx)
+    txs = ctx.wait(ctx.client.verified_transactions_snapshot())
+    machines = ctx.wait(ctx.client.state_machines_snapshot())
+    flows = ctx.wait(ctx.client.registered_flows())
+    balances: dict[str, int] = {}
+    for sar in states:
+        amount = getattr(sar.state.data, "amount", None)
+        if amount is not None and hasattr(amount, "quantity"):
+            product = _amount_product(amount)
+            balances[product] = (
+                balances.get(product, 0) + int(amount.quantity)
+            )
+    return 200, {
+        "me": me.name,
+        "peers": [
+            {
+                "name": info.legal_identity.name,
+                "services": list(info.advertised_services),
+            }
+            for info in sorted(infos, key=lambda i: i.legal_identity.name)
+        ],
+        "notaries": sorted(notaries),
+        "balances": balances,
+        "states": len(states),
+        "transactions": len(txs),
+        "flows_in_flight": len(machines),
+        "registered_flows": sorted(flows),
+    }
+
+
+def _states(ctx, query, body):
+    states = _vault_states(ctx)
+    return 200, {
+        "states": [
+            {
+                "ref": f"{sar.ref.txhash.prefix_chars()}:{sar.ref.index}",
+                "contract": sar.state.contract,
+                "notary": sar.state.notary.name,
+                "data": js.to_jsonable(sar.state.data),
+            }
+            for sar in states
+        ]
+    }
+
+
+def _transactions(ctx, query, body):
+    try:
+        limit = int(query.get("limit", ["50"])[0])
+    except (TypeError, ValueError):
+        limit = 50
+    txs = ctx.wait(ctx.client.verified_transactions_snapshot())
+    return 200, {
+        "total": len(txs),
+        "transactions": [
+            {
+                "id": stx.id.prefix_chars(12),
+                "inputs": len(stx.wtx.inputs),
+                "outputs": len(stx.wtx.outputs),
+                "commands": [
+                    type(c.value).__name__ for c in stx.wtx.commands
+                ],
+                "notary": stx.wtx.notary.name if stx.wtx.notary else None,
+                "signatures": len(stx.sigs),
+            }
+            for stx in txs[-limit:]
+        ],
+    }
+
+
+def _machines(ctx, query, body):
+    machines = ctx.wait(ctx.client.state_machines_snapshot())
+    return 200, {
+        "machines": [
+            {"flow_id": m.flow_id.hex(), "flow": m.flow_tag}
+            for m in machines
+        ]
+    }
+
+
+_PAGE = b"""<!doctype html>
+<meta charset="utf-8">
+<title>corda_tpu explorer</title>
+<style>
+  body { font-family: ui-monospace, Menlo, Consolas, monospace;
+         margin: 2rem; max-width: 72rem; }
+  h1 { font-size: 1.2rem; } h2 { font-size: 1rem; margin-top: 1.5rem; }
+  table { border-collapse: collapse; width: 100%; }
+  th, td { text-align: left; padding: .25rem .75rem .25rem 0;
+           border-bottom: 1px solid #ddd; font-size: .85rem; }
+  #err { color: #a00; }
+</style>
+<h1>ledger explorer &mdash; <span id="me">&hellip;</span></h1>
+<p id="err"></p>
+<h2>summary</h2>
+<table id="summary"></table>
+<h2>balances</h2>
+<table id="balances"></table>
+<h2>network</h2>
+<table id="network"></table>
+<h2>unconsumed states</h2>
+<table id="states"></table>
+<h2>transactions (newest last)</h2>
+<table id="txs"></table>
+<h2>flows in flight</h2>
+<table id="machines"></table>
+<script>
+const q = id => document.getElementById(id);
+const row = cells => "<tr>" +
+  cells.map(c => "<td>" + String(c) + "</td>").join("") + "</tr>";
+const head = cells => "<tr>" +
+  cells.map(c => "<th>" + c + "</th>").join("") + "</tr>";
+async function refresh() {
+  try {
+    const dash = await (await fetch("/api/explorer/dashboard")).json();
+    q("me").textContent = dash.me;
+    q("summary").innerHTML =
+      row(["unconsumed states", dash.states]) +
+      row(["verified transactions", dash.transactions]) +
+      row(["flows in flight", dash.flows_in_flight]) +
+      row(["registered flows", dash.registered_flows.join(", ")]);
+    q("balances").innerHTML = Object.keys(dash.balances).sort().map(
+      p => row([p, dash.balances[p].toLocaleString()])).join("")
+      || row(["(empty vault)", ""]);
+    q("network").innerHTML = head(["peer", "services"]) + dash.peers.map(
+      p => row([p.name, p.services.join(",")])).join("");
+    const st = await (await fetch("/api/explorer/states")).json();
+    q("states").innerHTML = head(["ref", "contract", "notary"]) +
+      st.states.map(s => row([s.ref, s.contract, s.notary])).join("");
+    const tx = await (await fetch(
+      "/api/explorer/transactions?limit=20")).json();
+    q("txs").innerHTML = head(
+      ["id", "in", "out", "commands", "notary", "sigs"]) +
+      tx.transactions.map(t => row([t.id, t.inputs, t.outputs,
+        t.commands.join(","), t.notary || "-", t.signatures])).join("");
+    const sm = await (await fetch("/api/explorer/machines")).json();
+    q("machines").innerHTML = sm.machines.map(
+      m => row([m.flow_id.slice(0, 12), m.flow])).join("")
+      || row(["(none)", ""]);
+    q("err").textContent = "";
+  } catch (e) { q("err").textContent = "refresh failed: " + e; }
+}
+refresh();
+setInterval(refresh, 2000);
+</script>
+"""
+
+EXPLORER_WEB = WebApiPlugin(
+    prefix="explorer",
+    routes=(
+        ("GET", "dashboard", _dashboard),
+        ("GET", "states", _states),
+        ("GET", "transactions", _transactions),
+        ("GET", "machines", _machines),
+    ),
+    # both spellings: /web/explorer/ and /web/explorer/index.html
+    static=(("", "text/html", _PAGE), ("index.html", "text/html", _PAGE)),
+)
+
+register_web_api(EXPLORER_WEB)

@@ -383,3 +383,60 @@ def test_shell_flow_watch_renders_progress(shell_net):
     # over the RPC feed and painted by utils/progress_render
     assert "verifying" in out, out
     assert "✓" in out or "▶" in out, out
+
+
+def test_web_explorer(web):
+    """The browser ledger explorer (tools/explorer GUI analogue):
+    dashboard counts, balances, states, transactions and in-flight
+    machines over /api/explorer, plus the HTML page at /web/explorer/."""
+    import corda_tpu.tools.web_explorer  # noqa: F401 - registers the routes
+
+    from corda_tpu.finance import CashIssueFlow, CashPaymentFlow
+
+    net, server, alice, bob = web
+    notary_party = next(n.party for n in net.nodes if n.party.name == "Notary")
+    fsm = alice.start_flow(
+        CashIssueFlow(1_000, "USD", alice.party, notary_party)
+    )
+    net.run()
+    fsm.result_or_throw()
+    fsm = alice.start_flow(CashPaymentFlow(250, "USD", bob.party))
+    net.run()
+    fsm.result_or_throw()
+
+    status, dash = _get(server, "/api/explorer/dashboard")
+    assert status == 200
+    assert dash["me"] == "Alice"
+    assert "Bob" in [p["name"] for p in dash["peers"]]
+    assert dash["notaries"] == ["Notary"]
+    assert dash["balances"] == {"USD": 750}
+    assert dash["transactions"] >= 2 and dash["states"] >= 1
+    # registered_flows lists responder protocols (may be empty on a
+    # plain node); the field must be a sorted list of strings
+    assert dash["registered_flows"] == sorted(dash["registered_flows"])
+
+    status, body = _get(server, "/api/explorer/states")
+    assert status == 200
+    assert all(
+        {"ref", "contract", "notary", "data"} <= set(s) for s in body["states"]
+    )
+    assert any("Cash" in s["contract"] for s in body["states"])
+
+    status, body = _get(server, "/api/explorer/transactions?limit=1")
+    assert status == 200
+    assert body["total"] >= 2 and len(body["transactions"]) == 1
+    tx = body["transactions"][0]
+    assert tx["notary"] == "Notary" and tx["signatures"] >= 1
+
+    status, body = _get(server, "/api/explorer/machines")
+    assert status == 200 and body["machines"] == []   # all flows done
+
+    # the page itself serves at both /web/explorer/ and .../index.html
+    for path in ("/web/explorer/", "/web/explorer/index.html"):
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}{path}", timeout=30
+        ) as r:
+            assert r.status == 200
+            assert r.headers["Content-Type"] == "text/html"
+            page = r.read()
+        assert b"ledger explorer" in page and b"/api/explorer/dashboard" in page
