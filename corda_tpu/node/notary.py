@@ -20,7 +20,10 @@ OutOfProcessTransactionVerifierService.kt:19-73).
 
 from __future__ import annotations
 
+import gc
+import os
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
@@ -325,6 +328,11 @@ class BatchingNotaryService(NotaryService):
         # metrics: dispatches vs requests shows the batching ratio
         self.batches_dispatched = 0
         self.requests_batched = 0
+        # CORDA_TPU_NOTARY_PROFILE=1: accumulate per-phase wall seconds
+        # across flushes (BASELINE.md serving-profile methodology)
+        self.phase_seconds: Optional[dict] = (
+            {} if os.environ.get("CORDA_TPU_NOTARY_PROFILE") else None
+        )
 
     def process(self, stx: SignedTransaction, requester: Party):
         from ..flows.api import FlowFuture, wait_future
@@ -362,11 +370,39 @@ class BatchingNotaryService(NotaryService):
         self.flush()
         return n
 
+    def _mark(self, phase: str, t_prev: float) -> float:
+        """Profile hook: charge now - t_prev to `phase` when profiling
+        is on; always returns now so call sites stay one-liners."""
+        now = time.perf_counter()
+        if self.phase_seconds is not None:
+            self.phase_seconds[phase] = (
+                self.phase_seconds.get(phase, 0.0) + (now - t_prev)
+            )
+        return now
+
     def flush(self) -> None:
+        # A flush allocates O(batch) objects (futures, ladder requests,
+        # resolved ltxs) that stay reachable until the scatter at the
+        # end — a generational collection mid-flush walks the whole
+        # staged heap for nothing, and at 16k-deep flushes those gen-2
+        # sweeps were 68% of the serving wall (BASELINE.md round-3
+        # profile). Suspend automatic GC for the bounded flush body;
+        # collection resumes (and catches up) between pump ticks.
+        gc_was_enabled = gc.isenabled()
+        if gc_was_enabled:
+            gc.disable()
+        try:
+            self._flush_inner()
+        finally:
+            if gc_was_enabled:
+                gc.enable()
+
+    def _flush_inner(self) -> None:
         pending, self._pending = self._pending, []
         self._oldest_arrival = None
         if not pending:
             return
+        t = time.perf_counter()
         # phase 1 — ONE SPI dispatch across all pending transactions.
         # Staging is per-tx-protected: one malformed transaction (bad
         # scheme in signature_requests) must answer ITS future with an
@@ -390,6 +426,7 @@ class BatchingNotaryService(NotaryService):
         pending = live
         if not pending:
             return
+        t = self._mark("stage", t)
         verifier = self.services.batch_verifier
         try:
             collector: Optional[threading.Thread] = None
@@ -411,6 +448,7 @@ class BatchingNotaryService(NotaryService):
                 collector.start()
             else:
                 results = verifier.verify_batch(reqs)
+            t = self._mark("dispatch", t)
             # overlap: contract execution (host Python) runs while the
             # device computes the signature batch and the collector
             # thread drains the result transfer. Contracts run through
@@ -449,6 +487,7 @@ class BatchingNotaryService(NotaryService):
                 else:
                     ltxs.append(ltx)
                     ltx_idx.append(i)
+            t = self._mark("resolve", t)
             if tv_sync:
                 for i, fut in zip(ltx_idx, tv.verify_many(ltxs)):
                     try:
@@ -458,11 +497,13 @@ class BatchingNotaryService(NotaryService):
             else:
                 for i, err in zip(ltx_idx, verify_ledger_batch(ltxs)):
                     contract_errs[i] = err
+            t = self._mark("contract", t)
             if collector is not None:
                 collector.join()
                 if "error" in box:
                     raise box["error"]
                 results = box["results"]
+            t = self._mark("link_wait", t)
         except Exception as e:
             # a failed dispatch (unsupported scheme in the batch, device
             # unavailable) must answer every waiting requester, not
@@ -505,6 +546,7 @@ class BatchingNotaryService(NotaryService):
                     ),
                 )
             )
+        t = self._mark("validate_commit", t)
         if not to_commit:
             return
         # phase 3 — once every commit resolves, ONE Merkle-batch notary
@@ -555,6 +597,7 @@ class BatchingNotaryService(NotaryService):
             fut.add_done_callback(
                 lambda f, i=i, p=p: on_commit(f, i, p)
             )
+        self._mark("sign_scatter", t)
 
     def _validate_one(
         self,

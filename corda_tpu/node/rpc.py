@@ -275,6 +275,12 @@ class CordaRPCOpsImpl:
         return list(self.services.validated_transactions.all())
 
     @rpc_method
+    def verified_transactions_count(self) -> int:
+        """Count without copying the store over the wire (the explorer
+        dashboard polls this every refresh)."""
+        return self.services.validated_transactions.count()
+
+    @rpc_method
     def verified_transactions_feed(self) -> DataFeed:
         store = self.services.validated_transactions
         updates = Observable()

@@ -136,6 +136,10 @@ class TransactionStorage:
     def all(self) -> list[SignedTransaction]:
         return list(self._txs.values())
 
+    def count(self) -> int:
+        """O(1) — dashboards must not copy the whole store to count it."""
+        return len(self._txs)
+
 
 class AttachmentStorage:
     """Content-addressed blob store (reference: NodeAttachmentService)."""

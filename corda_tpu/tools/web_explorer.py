@@ -51,7 +51,9 @@ def _dashboard(ctx, query, body):
     infos = ctx.wait(ctx.client.network_map_snapshot())
     notaries = [p.name for p in ctx.wait(ctx.client.notary_identities())]
     states = _vault_states(ctx)
-    txs = ctx.wait(ctx.client.verified_transactions_snapshot())
+    # count-only RPC: the dashboard polls every refresh and must not
+    # copy the whole transaction store over the wire to report len()
+    tx_count = ctx.wait(ctx.client.verified_transactions_count())
     machines = ctx.wait(ctx.client.state_machines_snapshot())
     flows = ctx.wait(ctx.client.registered_flows())
     balances: dict[str, int] = {}
@@ -74,7 +76,7 @@ def _dashboard(ctx, query, body):
         "notaries": sorted(notaries),
         "balances": balances,
         "states": len(states),
-        "transactions": len(txs),
+        "transactions": tx_count,
         "flows_in_flight": len(machines),
         "registered_flows": sorted(flows),
     }
@@ -97,12 +99,13 @@ def _states(ctx, query, body):
 
 def _transactions(ctx, query, body):
     try:
-        limit = int(query.get("limit", ["50"])[0])
+        limit = max(0, int(query.get("limit", ["50"])[0]))
     except (TypeError, ValueError):
         limit = 50
     txs = ctx.wait(ctx.client.verified_transactions_snapshot())
     return 200, {
         "total": len(txs),
+        # NB txs[-0:] would be the WHOLE list — limit=0 means none
         "transactions": [
             {
                 "id": stx.id.prefix_chars(12),
@@ -114,7 +117,7 @@ def _transactions(ctx, query, body):
                 "notary": stx.wtx.notary.name if stx.wtx.notary else None,
                 "signatures": len(stx.sigs),
             }
-            for stx in txs[-limit:]
+            for stx in (txs[-limit:] if limit else [])
         ],
     }
 
@@ -157,10 +160,15 @@ _PAGE = b"""<!doctype html>
 <table id="machines"></table>
 <script>
 const q = id => document.getElementById(id);
+// every cell renders through esc(): contract tags, peer names and
+// flow tags are counterparty-supplied ledger data; unescaped
+// innerHTML would hand a peer stored XSS in the operator's browser
+const esc = s => String(s).replace(/[&<>"']/g, ch => (
+  {"&": "&amp;", "<": "&lt;", ">": "&gt;", '"': "&quot;", "'": "&#39;"}[ch]));
 const row = cells => "<tr>" +
-  cells.map(c => "<td>" + String(c) + "</td>").join("") + "</tr>";
+  cells.map(c => "<td>" + esc(c) + "</td>").join("") + "</tr>";
 const head = cells => "<tr>" +
-  cells.map(c => "<th>" + c + "</th>").join("") + "</tr>";
+  cells.map(c => "<th>" + esc(c) + "</th>").join("") + "</tr>";
 async function refresh() {
   try {
     const dash = await (await fetch("/api/explorer/dashboard")).json();

@@ -427,6 +427,11 @@ def test_web_explorer(web):
     assert body["total"] >= 2 and len(body["transactions"]) == 1
     tx = body["transactions"][0]
     assert tx["notary"] == "Notary" and tx["signatures"] >= 1
+    # limit=0 means NO rows (txs[-0:] would be the whole list) and
+    # negative limits clamp to none rather than slicing the front off
+    for lim in ("0", "-5"):
+        status, body = _get(server, f"/api/explorer/transactions?limit={lim}")
+        assert status == 200 and body["transactions"] == [], lim
 
     status, body = _get(server, "/api/explorer/machines")
     assert status == 200 and body["machines"] == []   # all flows done
