@@ -198,15 +198,17 @@ def _notary_metric(batch: int, iters: int) -> dict:
 
     gc.collect()
     gc.freeze()
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        run_once()
-    dt = time.perf_counter() - t0
+    try:
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            run_once()
+        dt = time.perf_counter() - t0
+    finally:
+        # even on a failed rep: frozen fixture objects are immortal to
+        # the collector, and the default run's later metrics must not
+        # pay the leaked memory
+        gc.unfreeze()
     rate = batch * iters / dt
-    # unfreeze before returning: frozen fixture objects are immortal to
-    # the collector, and the default run's later metrics must not pay
-    # the leaked memory
-    gc.unfreeze()
     if svc.phase_seconds:
         # CORDA_TPU_NOTARY_PROFILE=1: per-phase share of the timed wall
         total = sum(svc.phase_seconds.values())
@@ -413,22 +415,39 @@ def main() -> None:
     if metric != "all":
         print(json.dumps(_run_metric(metric, batch, iters)))
         return
-    # full table: secondary metrics first (a secondary failure must not
-    # cost the driver the headline — report it on stderr and move on),
-    # headline p256 LAST so tail-line parsers record it
-    import gc
+    # Full table: each metric in its OWN subprocess. Co-resident
+    # metrics tax each other — a measured default run read p256 48.3k
+    # after mixed/merkle/notary had run in-process vs 75.7k in a fresh
+    # interpreter (earlier metrics' live jit programs, device buffers
+    # and heap survive into later ones) — and the persistent compile
+    # cache keeps subprocesses warm, so isolation costs only startup.
+    # Secondary metrics first (a secondary failure must not cost the
+    # driver the headline — report it on stderr and move on), headline
+    # p256 LAST so tail-line parsers record it.
+    import subprocess
 
-    for secondary in ("mixed", "merkle", "notary"):
+    for m in ("mixed", "merkle", "notary", "p256"):
+        env = dict(os.environ, BENCH_METRIC=m)
+        out = None
         try:
-            print(json.dumps(_run_metric(secondary, batch, iters)),
-                  flush=True)
+            out = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env, capture_output=True, text=True, timeout=1800,
+            )
+            # pass the child's diagnostics through (the profile lines
+            # docs/serving-notary.md documents arrive on stderr)
+            if out.stderr:
+                sys.stderr.write(out.stderr)
+            line = out.stdout.strip().splitlines()[-1] if out.stdout.strip() else ""
+            json.loads(line)          # a metric line, not stray output
+            print(line, flush=True)
         except Exception as e:   # noqa: BLE001 - keep the headline alive
-            print(f"bench metric {secondary!r} failed: {e}",
-                  file=sys.stderr)
-        # the host is a single core: the next metric must not pay GC
-        # sweeps over the previous metric's dead object graph
-        gc.collect()
-    print(json.dumps(_spi_metric("p256", batch, iters)))
+            if m == "p256":
+                # the headline must come from THIS interpreter if the
+                # subprocess path is unavailable (e.g. sandboxed spawn)
+                print(json.dumps(_spi_metric("p256", batch, iters)))
+                return
+            print(f"bench metric {m!r} failed: {e}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -85,7 +85,9 @@ def ecdsa_verify_batch(
 
         ladder = (
             wei_ladder_windowed_pallas
-            if use_windowed_ladder()
+            if use_windowed_ladder(
+                "p256" if curve.name == "secp256r1" else "k1"
+            )
             else wei_ladder_pallas
         )
         R = ladder(curve, u1, u2, qx_m, qy_m)

@@ -64,7 +64,7 @@ def ed25519_verify_batch(
 
         ladder = (
             ed_ladder_windowed_pallas
-            if use_windowed_ladder()
+            if use_windowed_ladder("ed25519")
             else ed_ladder_pallas
         )
         R = ladder(ED25519, s, k, nax_m, nay_m)

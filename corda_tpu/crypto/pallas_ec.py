@@ -67,11 +67,25 @@ def use_pallas_ladder(use_pallas=None) -> bool:
     return jax.default_backend() == "tpu"
 
 
-def use_windowed_ladder() -> bool:
-    """w=4 fixed-window ladder vs the plain bit ladder for ECDSA.
-    Measured 2.8x device throughput at (4096, block 128) on a v5e;
-    CORDA_TPU_WINDOWED=0 falls back to the plain ladder."""
-    return os.environ.get("CORDA_TPU_WINDOWED", "1") != "0"
+# Default ladder per curve family (round-3 same-link A/B at the
+# production shape, 16384/chunk-4096 through the SPI, BASELINE.md):
+# p256 windowed 55.2k vs plain 48.9k; secp256k1 windowed 50.6k vs
+# plain 54.4k; ed25519 windowed 35.7k vs plain 42.5k. The w=4 tables
+# only pay for themselves on p256 — on k1/ed25519 the per-block
+# Q-table build and VMEM pressure cost more than the saved doublings.
+_WINDOWED_DEFAULT = {"p256": True, "k1": False, "ed25519": False}
+
+
+def use_windowed_ladder(curve_tag: str = "p256") -> bool:
+    """w=4 fixed-window ladder vs the plain bit ladder, chosen per
+    curve family (`curve_tag` in {"p256", "k1", "ed25519"}).
+    CORDA_TPU_WINDOWED=0/1 forces ALL curves off/on (the selfcheck and
+    parity rigs exercise both paths this way); unset uses the measured
+    per-curve defaults above."""
+    forced = os.environ.get("CORDA_TPU_WINDOWED")
+    if forced is not None:
+        return forced != "0"
+    return _WINDOWED_DEFAULT.get(curve_tag, True)
 
 
 def _fit_block(batch: int, block: int) -> int:
