@@ -442,6 +442,13 @@ def main() -> None:
             json.loads(line)          # a metric line, not stray output
             print(line, flush=True)
         except Exception as e:   # noqa: BLE001 - keep the headline alive
+            # a timed-out child still captured diagnostics worth keeping
+            child_err = getattr(e, "stderr", None)
+            if child_err:
+                sys.stderr.write(
+                    child_err if isinstance(child_err, str)
+                    else child_err.decode(errors="replace")
+                )
             if m == "p256":
                 # the headline must come from THIS interpreter if the
                 # subprocess path is unavailable (e.g. sandboxed spawn)

@@ -119,12 +119,26 @@ class TpuBatchVerifier(BatchSignatureVerifier):
                 else:
                     in_specs = (P(B, None), P(B))
                     arg_order = ("packed", "valid_in")
+                # the pallas auto-policy keys on the process-global
+                # default backend — wrong under a mesh in a process
+                # where a TPU backend initialised but THIS mesh lives
+                # on virtual CPU devices (dryrun_multichip after real-
+                # chip work): decide from the mesh's own devices
+                mesh_on_tpu = all(
+                    d.platform == "tpu"
+                    for d in self.mesh.devices.flat
+                )
+                # None (not True) on TPU meshes: the auto policy
+                # resolves to Pallas there AND still honors the
+                # CORDA_TPU_NO_PALLAS kill switch; a hard True would
+                # bypass it
+                mesh_use_pallas = None if mesh_on_tpu else False
                 # check_vma off: the scan carries in modmath start from
                 # replicated constants and become shard-varying, which
                 # the VMA checker rejects; the program is collective-
                 # free so the check buys nothing here
                 smapped = jax.shard_map(
-                    partial(inner, use_pallas=None),
+                    partial(inner, use_pallas=mesh_use_pallas),
                     mesh=self.mesh,
                     in_specs=in_specs,
                     out_specs=P(B),

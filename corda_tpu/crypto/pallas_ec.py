@@ -85,7 +85,10 @@ def use_windowed_ladder(curve_tag: str = "p256") -> bool:
     forced = os.environ.get("CORDA_TPU_WINDOWED")
     if forced is not None:
         return forced != "0"
-    return _WINDOWED_DEFAULT.get(curve_tag, True)
+    # unknown tags get the PLAIN ladder: the A/B showed windowed loses
+    # on every measured curve but p256, so a mistagged or future curve
+    # should land on the safe default, not the p256 special case
+    return _WINDOWED_DEFAULT.get(curve_tag, False)
 
 
 def _fit_block(batch: int, block: int) -> int:
