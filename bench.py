@@ -42,8 +42,10 @@ def _median_rate(run_once, batch: int, iters: int) -> float:
     shows +/-35% run-to-run variance (BASELINE.md) — one congested
     transfer inside a pooled-time loop would drag the whole record,
     while the median of independent iterations reports the sustained
-    rate the hardware actually delivers. ONE implementation for every
-    metric so the timing semantics cannot drift apart."""
+    rate the hardware actually delivers. Shared by the per-item
+    verification metrics (spi, merkle); the notary metric deliberately
+    pools time (a serving rate is sustained throughput) and the
+    montmul A/B reports best-of-reps."""
     times = []
     for _ in range(max(iters, 1)):
         t0 = time.perf_counter()

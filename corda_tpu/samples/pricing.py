@@ -493,6 +493,30 @@ def equity_option_rate_ladder(
     )
 
 
+def equity_vega(
+    n_shares: float,
+    strike: float,
+    expiry_y: float,
+    curve: ZeroCurve,
+    spot: float,
+    vol: float,
+    is_call: bool = True,
+) -> float:
+    """SIMM equity vega sensitivity: PV change per +1 vol-point bump,
+    bump-and-revalue (feeds the equity vega layer and, scaled by
+    `simm.scaling_function(expiry)`, the equity curvature layer)."""
+    base = equity_option_pv(
+        n_shares, strike, expiry_y, curve, spot, vol, is_call
+    )
+    return (
+        equity_option_pv(
+            n_shares, strike, expiry_y, curve, spot, vol + VOL_BUMP,
+            is_call,
+        )
+        - base
+    )
+
+
 def commodity_spot_delta(
     units: float,
     strike: float,

@@ -372,6 +372,59 @@ def equity_margin(sensitivities: dict) -> float:
     )
 
 
+# equity vega: the published SIMM gives every risk class a vega layer
+# with one scalar class VRW over the same bucket structure/correlations
+# as delta, and a curvature layer fed by SF(expiry)-scaled vega
+EQUITY_VEGA_RISK_WEIGHT = 0.28
+
+
+def equity_vega_margin(vega_sensitivities: dict) -> float:
+    """Equity vega margin over {bucket: {issuer: PV change per +1
+    vol-point move}}: delta's bucket structure with the scalar equity
+    VRW (mirrors the IR class, where vega shares the delta
+    correlations under `VEGA_RISK_WEIGHT`)."""
+    return _classed_margin(
+        vega_sensitivities,
+        len(EQUITY_RISK_WEIGHTS),
+        lambda b, names: _scalar_bucket_ks(
+            names, EQUITY_VEGA_RISK_WEIGHT, EQUITY_INTRA_RHO[b - 1]
+        ),
+        EQUITY_CROSS_GAMMA,
+        lambda names: _scalar_bucket_ks(names, EQUITY_VEGA_RISK_WEIGHT, 0.0),
+    )
+
+
+def equity_curvature_margin(cvr_sensitivities: dict) -> float:
+    """Equity curvature over {bucket: {issuer: CVR}} where
+    CVR = SF(expiry) * vega (`scaling_function`): the published
+    curvature aggregation — squared correlations, lambda/theta tail
+    factor, zero floor — applied to the equity bucket structure.
+    Mirrors `curvature_margin` (IR), which runs the same formula over
+    the tenor grid."""
+    total = 0.0
+    abs_total = 0.0
+    for names in cvr_sensitivities.values():
+        for v in names.values():
+            total += float(v)
+            abs_total += abs(float(v))
+    # aggregate FIRST: the bucket walk validates bucket numbers, and a
+    # misfiled name must raise even while its CVR happens to be zero
+    agg = _classed_margin(
+        cvr_sensitivities,
+        len(EQUITY_RISK_WEIGHTS),
+        lambda b, names: _scalar_bucket_ks(
+            names, 1.0, EQUITY_INTRA_RHO[b - 1] ** 2
+        ),
+        EQUITY_CROSS_GAMMA * EQUITY_CROSS_GAMMA,
+        lambda names: _scalar_bucket_ks(names, 1.0, 0.0),
+    )
+    if abs_total == 0.0:
+        return 0.0
+    theta = min(total / abs_total, 0.0)
+    lam = (PHI_INV_995 * PHI_INV_995 - 1.0) * (1.0 + theta) - theta
+    return max(total + lam * agg, 0.0)
+
+
 def commodity_margin(sensitivities: dict) -> float:
     """Commodity delta margin over {bucket: {commodity: PV change per
     +1% relative price move}}; 17 published product buckets (16 =
@@ -520,6 +573,8 @@ def simm_breakdown(
     commodity: dict | None = None,
     credit_q: dict | None = None,
     credit_nonq: dict | None = None,
+    equity_vega: dict | None = None,
+    equity_cvr: dict | None = None,
 ) -> dict[str, float]:
     """Per-layer margins for {currency: [K] ladder} IR inputs plus the
     optional FX / Equity / Commodity / CreditQ / CreditNonQ classes.
@@ -532,7 +587,7 @@ def simm_breakdown(
     out = {
         "delta": 0.0, "vega": 0.0, "curvature": 0.0, "fx": 0.0,
         "equity": 0.0, "commodity": 0.0, "credit_q": 0.0,
-        "credit_nonq": 0.0,
+        "credit_nonq": 0.0, "equity_vega": 0.0, "equity_curvature": 0.0,
     }
     if delta_buckets:
         mat = np.stack([delta_buckets[c] for c in sorted(delta_buckets)])
@@ -545,6 +600,10 @@ def simm_breakdown(
         out["fx"] = fx_margin(fx_deltas)
     if equity:
         out["equity"] = equity_margin(equity)
+    if equity_vega:
+        out["equity_vega"] = equity_vega_margin(equity_vega)
+    if equity_cvr:
+        out["equity_curvature"] = equity_curvature_margin(equity_cvr)
     if commodity:
         out["commodity"] = commodity_margin(commodity)
     if credit_q:
@@ -552,10 +611,12 @@ def simm_breakdown(
     if credit_nonq:
         out["credit_nonq"] = credit_nonq_margin(credit_nonq)
     ir = out["delta"] + out["vega"] + out["curvature"]
+    # a risk class's IM is the sum of its delta/vega/curvature layers
+    eq = out["equity"] + out["equity_vega"] + out["equity_curvature"]
     out["total"] = product_margin({
         "IR": ir,
         "FX": out["fx"],
-        "Equity": out["equity"],
+        "Equity": eq,
         "Commodity": out["commodity"],
         "CreditQ": out["credit_q"],
         "CreditNonQ": out["credit_nonq"],
@@ -571,14 +632,16 @@ def simm_im(
     commodity: dict | None = None,
     credit_q: dict | None = None,
     credit_nonq: dict | None = None,
+    equity_vega: dict | None = None,
+    equity_cvr: dict | None = None,
 ) -> int:
     """Initial margin for {currency: [K] sensitivity ladder} IR inputs
     (delta, optionally vega — curvature follows from vega — and
-    optionally FX spot / equity / commodity / credit sensitivities),
-    rounded to an integer ledger amount (both parties must agree
-    bit-for-bit; every float op above has a fixed order, so IEEE-754
-    doubles give one answer on any host)."""
+    optionally FX spot / equity (delta + vega/curvature) / commodity /
+    credit sensitivities), rounded to an integer ledger amount (both
+    parties must agree bit-for-bit; every float op above has a fixed
+    order, so IEEE-754 doubles give one answer on any host)."""
     return int(round(simm_breakdown(
         delta_buckets, vega_buckets, fx_deltas, equity, commodity,
-        credit_q, credit_nonq,
+        credit_q, credit_nonq, equity_vega, equity_cvr,
     )["total"]))

@@ -234,6 +234,8 @@ class PortfolioSensitivities:
     equity: dict
     commodity: dict
     credit_q: dict
+    equity_vega: dict
+    equity_cvr: dict
 
 
 def portfolio_ladders(
@@ -253,7 +255,7 @@ def portfolio_ladders(
     swaption vega ladders, FX spot sensitivities, bucketed equity and
     commodity spot deltas, and per-issuer CreditQ CS01 ladders. The
     ONE pricing pass every margin consumer (demo, web API) shares."""
-    from . import pricing
+    from . import pricing, simm
 
     curve, vols = market if market is not None else pricing.demo_market()
     delta: dict = {}
@@ -262,6 +264,8 @@ def portfolio_ladders(
     equity: dict = {}
     commodity: dict = {}
     credit_q: dict = {}
+    equity_vega: dict = {}
+    equity_cvr: dict = {}
 
     def add(buckets, ccy, ladder):
         buckets[ccy] = buckets.get(ccy, 0) + ladder
@@ -351,6 +355,14 @@ def portfolio_ladders(
                 e.n_shares, strike, expiry, curve, spot, vol, e.is_call
             ),
         )
+        ev = pricing.equity_vega(
+            e.n_shares, strike, expiry, curve, spot, vol, e.is_call
+        )
+        add_name(equity_vega, bucket, e.name, ev)
+        add_name(
+            equity_cvr, bucket, e.name,
+            simm.scaling_function(expiry) * ev,
+        )
         add(
             delta, DOMESTIC_BUCKET,
             pricing.equity_option_rate_ladder(
@@ -373,7 +385,10 @@ def portfolio_ladders(
                 m.units, strike, years, curve, spot, carry
             ),
         )
-    return PortfolioSensitivities(delta, vega, fx, equity, commodity, credit_q)
+    return PortfolioSensitivities(
+        delta, vega, fx, equity, commodity, credit_q, equity_vega,
+        equity_cvr,
+    )
 
 
 # the one registry of priced trade families: portfolio_ladders kwarg
@@ -421,6 +436,7 @@ def initial_margin_book(
     return simm.simm_im(
         s.delta, s.vega, s.fx,
         equity=s.equity, commodity=s.commodity, credit_q=s.credit_q,
+        equity_vega=s.equity_vega, equity_cvr=s.equity_cvr,
     )
 
 

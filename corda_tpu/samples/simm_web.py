@@ -183,6 +183,7 @@ def _margin(ctx, query, body):
     parts = simm.simm_breakdown(
         s.delta, s.vega, s.fx,
         equity=s.equity, commodity=s.commodity, credit_q=s.credit_q,
+        equity_vega=s.equity_vega, equity_cvr=s.equity_cvr,
     )
     # the total IS the psi cross-class aggregate (simm.simm_im's
     # definition) — one pricing pass, no second computation to drift
@@ -193,6 +194,8 @@ def _margin(ctx, query, body):
         "curvature": round(parts["curvature"], 2),
         "fx": round(parts["fx"], 2),
         "equity": round(parts["equity"], 2),
+        "equity_vega": round(parts["equity_vega"], 2),
+        "equity_curvature": round(parts["equity_curvature"], 2),
         "commodity": round(parts["commodity"], 2),
         "credit_q": round(parts["credit_q"], 2),
         "margin": int(round(parts["total"])),

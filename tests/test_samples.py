@@ -606,3 +606,68 @@ def test_simm_demo_six_class_arc():
     )
     assert v.portfolio_size == 6
     assert v.margin > 0
+
+
+def test_simm_equity_vega_curvature():
+    """The equity class carries the published three-layer structure:
+    vega shares delta's bucket correlations under the scalar equity
+    VRW, curvature floors at zero and penalises short-vol books, and
+    an option carrier feeds all three layers of the class margin."""
+    from corda_tpu.core.identity import Party
+    from corda_tpu.crypto import schemes
+    from corda_tpu.samples import pricing, simm, simm_demo
+
+    # vega layer: single name K = VRW * |v|, homogeneous, sign-symmetric
+    one = simm.equity_vega_margin({1: {"ACME": 1000.0}})
+    assert abs(one - simm.EQUITY_VEGA_RISK_WEIGHT * 1000.0) < 1e-9
+    assert simm.equity_vega_margin({1: {"ACME": -1000.0}}) == one
+    assert abs(
+        simm.equity_vega_margin({1: {"ACME": 2000.0}}) - 2 * one
+    ) < 1e-9
+
+    # curvature: zero on an empty book, positive for long vol,
+    # floored at zero for short vol (theta kicks in)
+    assert simm.equity_curvature_margin({}) == 0.0
+    long_cvr = simm.equity_curvature_margin({1: {"ACME": 500.0}})
+    assert long_cvr > 0.0
+    short_cvr = simm.equity_curvature_margin({1: {"ACME": -500.0}})
+    assert short_cvr >= 0.0
+    assert short_cvr < long_cvr
+
+    # the carrier feeds every layer: a long option has positive vega
+    # and positive scaled curvature in ITS bucket
+    def party(name, seed):
+        kp = schemes.generate_keypair(
+            schemes.EDDSA_ED25519_SHA512, seed=seed
+        )
+        return Party(name, kp.public)
+
+    a, b = party("A", 1), party("B", 2)
+    year = 31_557_600 * 10**6
+    opt = simm_demo.EquityOptionState(
+        buyer=a, seller=b, n_shares=10_000, strike_cents=12_000,
+        expiry_micros=2 * year, name="ACME-INDUSTRIAL",
+    )
+    s = simm_demo.portfolio_ladders([], 0, equity_options=[opt])
+    bucket = pricing.DEMO_EQUITY_MARKET["ACME-INDUSTRIAL"][0]
+    assert s.equity_vega[bucket]["ACME-INDUSTRIAL"] > 0
+    assert s.equity_cvr[bucket]["ACME-INDUSTRIAL"] > 0
+    parts = simm.simm_breakdown(
+        s.delta, s.vega, s.fx, equity=s.equity,
+        equity_vega=s.equity_vega, equity_cvr=s.equity_cvr,
+    )
+    assert parts["equity_vega"] > 0 and parts["equity_curvature"] > 0
+    # the class margin sums the layers before psi aggregation: margins
+    # with and without the vega layers must differ
+    parts_delta_only = simm.simm_breakdown(
+        s.delta, s.vega, s.fx, equity=s.equity
+    )
+    assert parts["total"] > parts_delta_only["total"]
+
+    # both parties agree bit-for-bit on the three-layer class
+    s2 = simm_demo.portfolio_ladders([], 0, equity_options=[opt])
+    m1 = simm.simm_im(s.delta, s.vega, s.fx, equity=s.equity,
+                      equity_vega=s.equity_vega, equity_cvr=s.equity_cvr)
+    m2 = simm.simm_im(s2.delta, s2.vega, s2.fx, equity=s2.equity,
+                      equity_vega=s2.equity_vega, equity_cvr=s2.equity_cvr)
+    assert m1 == m2 and m1 > 0
