@@ -52,6 +52,9 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGINT, shutdown)
     if args.print_port:
         print(f"P2P_PORT={node.messaging.listen_port}", flush=True)
+    web = getattr(node, "web", None)
+    if web is not None:
+        print(f"WEB_PORT={web.port} (/web/explorer/)", flush=True)
     try:
         node.run()
     finally:

@@ -76,6 +76,11 @@ class NodeConfig:
     key_seed: int = 0                       # dev: deterministic identity
     scheme: str = "ed25519"
     use_tls: bool = True
+    # web gateway (REST + /web/explorer/) port: -1 = disabled (default),
+    # 0 = ephemeral. Serving requires an rpc user for the gateway's own
+    # node connection (the reference's standalone webserver
+    # authenticates the same way)
+    web_port: int = -1
     rpc_users: tuple[RpcUserConfig, ...] = field(default_factory=tuple)
     # notary cluster membership (raft/bft): peer names of all members
     cluster_peers: tuple[str, ...] = ()
@@ -107,6 +112,11 @@ class NodeConfig:
         if self.scheme not in _SCHEME_NAMES:
             raise ConfigError(
                 f"unknown scheme {self.scheme!r}; one of {sorted(_SCHEME_NAMES)}"
+            )
+        if self.web_port >= 0 and not self.rpc_users:
+            raise ConfigError(
+                "web_port requires at least one [[rpc.users]] entry "
+                "(the gateway connects over RPC)"
             )
 
     @property
@@ -205,6 +215,8 @@ def write_config(cfg: NodeConfig, path: str) -> None:
     emit("key_seed", cfg.key_seed)
     emit("scheme", cfg.scheme)
     emit("use_tls", cfg.use_tls)
+    if cfg.web_port >= 0:
+        emit("web_port", cfg.web_port)
     emit("cluster_name", cfg.cluster_name)
     emit("cluster_key_seed", cfg.cluster_key_seed)
     if cfg.cluster_peers:

@@ -491,6 +491,19 @@ class Node:
                 "restored %d checkpointed flows", restored
             )
         self.running = True
+        if self.config.web_port >= 0:
+            # gateway over the node's own RPC surface; the pump loop
+            # (run()) delivers, so the gateway only polls futures. A
+            # bind failure (port taken) must not strand a half-started
+            # node: tear everything down and surface the error
+            u = self.config.rpc_users[0]
+            try:
+                self.web = self.webserver(
+                    u.username, u.password, port=self.config.web_port
+                )
+            except Exception:
+                self.stop()
+                raise
         return self
 
     def _tick_services(self) -> None:
@@ -527,9 +540,17 @@ class Node:
     def stop(self) -> None:
         import threading
 
-        if not self.running:
+        # idempotence keys on its own flag, NOT on `running`: the CLI
+        # signal handler clears `running` to break the pump loop, and
+        # the finally-block stop() after it must still tear down (web
+        # gateway, fabric, db) instead of early-returning
+        if getattr(self, "_stopped", False):
             return
+        self._stopped = True
         self.running = False
+        web = getattr(self, "web", None)
+        if web is not None:
+            web.stop()
         # an embedded run() thread must drain its current pump before
         # the database closes under it
         run_thread = getattr(self, "_run_thread", None)

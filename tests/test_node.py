@@ -293,3 +293,71 @@ def test_dev_nodes_have_distinct_fresh_keys(tmp_path):
     finally:
         a.db.close()
         b.db.close()
+
+
+def test_web_gateway_from_config(tmp_path):
+    """web_port in node.toml boots the REST gateway + explorer with the
+    node (the reference runs a webserver process per node the same
+    way); web_port without an rpc user is a config error."""
+    import json
+    import threading
+    import urllib.request
+
+    from corda_tpu.crypto.batch_verifier import CpuBatchVerifier
+
+    with pytest.raises(ConfigError, match="rpc.users"):
+        NodeConfig(name="W", base_dir=str(tmp_path / "w"), web_port=0)
+
+    # web_port survives the generated-config round trip (cordform/
+    # driver emit node.toml through write_config)
+    from corda_tpu.node.config import load_config, write_config
+
+    rt = NodeConfig(
+        name="RT", base_dir=str(tmp_path / "rt"), web_port=8123,
+        rpc_users=(RpcUserConfig("admin", "pw", ("ALL",)),),
+    )
+    write_config(rt, str(tmp_path / "rt.toml"))
+    assert load_config(str(tmp_path / "rt.toml")).web_port == 8123
+
+    cfg = NodeConfig(
+        name="Web",
+        base_dir=str(tmp_path / "web"),
+        web_port=0,
+        rpc_users=(RpcUserConfig("admin", "pw", ("ALL",)),),
+        key_seed=77,
+    )
+    node = Node(cfg, batch_verifier=CpuBatchVerifier()).start()
+    try:
+        assert node.web is not None and node.web.port > 0
+        # the gateway polls RPC futures; the pump loop must be live
+        pump = threading.Thread(target=node.run, daemon=True)
+        pump.start()
+
+        def get(path):
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{node.web.port}{path}", timeout=30
+            ) as r:
+                return r.status, r.headers["Content-Type"], r.read()
+
+        status, ctype, body = get("/api/status")
+        assert status == 200
+        assert json.loads(body)["identity"] == "Web" or b"Web" in body
+
+        status, ctype, page = get("/web/explorer/")
+        assert status == 200 and ctype == "text/html"
+        assert b"ledger explorer" in page
+
+        status, _, body = get("/api/explorer/dashboard")
+        assert status == 200
+        dash = json.loads(body)
+        assert dash["me"] == "Web" and dash["transactions"] == 0
+    finally:
+        node.stop()
+    # the CLI signal path clears `running` BEFORE the finally-block
+    # stop(): teardown must still run (gateway socket released), and a
+    # second stop() stays a no-op
+    node.stop()
+    with pytest.raises(OSError):
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{node.web.port}/api/status", timeout=2
+        )
