@@ -28,6 +28,12 @@ def main(argv=None) -> int:
         "--print-port", action="store_true",
         help="print the bound p2p port on stdout after start (driver handshake)",
     )
+    parser.add_argument(
+        "--initial-registration", action="store_true",
+        help="register with the permissioning server named by "
+        "registration_server in the config, store certificates, and exit "
+        "(NodeStartup's --initial-registration)",
+    )
     args = parser.parse_args(argv)
 
     logging.basicConfig(
@@ -39,6 +45,34 @@ def main(argv=None) -> int:
     except (ConfigError, OSError) as e:
         print(f"bad config: {e}", file=sys.stderr)
         return 1
+
+    if args.initial_registration:
+        from .registration import (
+            CertificateRequestException,
+            HttpRegistrationService,
+            NetworkRegistrationHelper,
+        )
+
+        if not config.registration_server:
+            print(
+                "bad config: --initial-registration needs "
+                "registration_server", file=sys.stderr,
+            )
+            return 1
+        helper = NetworkRegistrationHelper(
+            config.base_dir, config.name,
+            HttpRegistrationService(config.registration_server),
+        )
+        try:
+            helper.build_keystore()
+        except CertificateRequestException as e:
+            print(str(e), file=sys.stderr)
+            print(
+                "Please make sure the details in the configuration file "
+                "are correct and try again.", file=sys.stderr,
+            )
+            return 1
+        return 0
 
     print(banner(config))
     node = Node(config).start()

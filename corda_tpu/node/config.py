@@ -93,6 +93,12 @@ class NodeConfig:
     # with the codec and @initiated_by responders (the reference's
     # CorDapp classpath scan, AbstractNode.kt:427)
     cordapps: tuple[str, ...] = ("corda_tpu.finance",)
+    # permissioning server URL for --initial-registration
+    # (NodeConfiguration certificateSigningService; registration.py)
+    registration_server: str = ""
+    # operator contact submitted with the signing request
+    # (NodeConfiguration.kt emailAddress)
+    email: str = ""
 
     def __post_init__(self):
         if not self.name:
@@ -219,6 +225,10 @@ def write_config(cfg: NodeConfig, path: str) -> None:
         emit("web_port", cfg.web_port)
     emit("cluster_name", cfg.cluster_name)
     emit("cluster_key_seed", cfg.cluster_key_seed)
+    if cfg.registration_server:
+        emit("registration_server", cfg.registration_server)
+    if cfg.email:
+        emit("email", cfg.email)
     if cfg.cluster_peers:
         peers = ", ".join(quote(p) for p in cfg.cluster_peers)
         lines.append(f"cluster_peers = [{peers}]")

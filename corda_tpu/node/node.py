@@ -322,6 +322,27 @@ class Node:
         return kp
 
     def _load_or_create_tls(self) -> TlsIdentity:
+        # registered material first: --initial-registration stored a
+        # doorman-certified TLS key+chain under certificates/tls.pem
+        # (registration.py NetworkRegistrationHelper); fall back to the
+        # dev-mode self-signed identity persisted in the node DB
+        import os
+
+        tls_pem = os.path.join(
+            self.config.base_dir, "certificates", "tls.pem"
+        )
+        if os.path.exists(tls_pem):
+            with open(tls_pem, "rb") as f:
+                blob = f.read()
+            # file layout: key PEM, then leaf cert, then the CA chain;
+            # the fabric serves (and peers pin) the leaf only
+            marker = b"-----BEGIN CERTIFICATE-----"
+            leaf_start = blob.index(marker)
+            leaf_end = blob.index(marker, leaf_start + 1) \
+                if blob.count(marker) > 1 else len(blob)
+            return TlsIdentity(
+                blob[leaf_start:leaf_end], blob[:leaf_start]
+            )
         store = PersistentKVStore(self.db, "node_tls")
         cert, key = store.get(b"cert"), store.get(b"key")
         if cert is not None and key is not None:

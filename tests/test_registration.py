@@ -1,0 +1,261 @@
+"""Initial node registration + permissioning server.
+
+Mirrors the reference's registration arc (NetworkRegistrationHelper.kt,
+HTTPNetworkRegistrationService.kt): CSR submission, poll-until-approved,
+keystore build, resume-after-crash, and rejection — over both the
+in-process binding and real HTTP.
+"""
+
+import threading
+import time
+
+import pytest
+
+from corda_tpu.node.registration import (
+    CertificateRequestException,
+    Doorman,
+    HttpRegistrationService,
+    InProcessRegistrationService,
+    NetworkRegistrationHelper,
+    PermissioningServer,
+)
+from corda_tpu.utils import x509 as xu
+
+
+def _helper(tmp_path, service, **kw):
+    kw.setdefault("poll_interval", 0.01)
+    kw.setdefault("max_polls", 500)
+    kw.setdefault("log", lambda *a: None)
+    return NetworkRegistrationHelper(
+        str(tmp_path / "node"), "Bank of TPU", service, **kw
+    )
+
+
+def test_auto_approve_builds_keystore(tmp_path):
+    dm = Doorman.create(auto_approve=True)
+    h = _helper(tmp_path, InProcessRegistrationService(dm))
+    assert h.build_keystore() is True
+
+    # node CA chain validates leaf-first down to the doorman's root
+    blob = h.node_ca_file.read_bytes()
+    certs = _certs(blob)
+    assert len(certs) == 3
+    assert xu.validate_chain(*certs)
+    root_pem = h.truststore_file.read_bytes()
+    assert xu.load_cert(root_pem).subject == dm.root.cert.subject
+
+    # TLS leaf chains through the node CA
+    tls_certs = _certs(h.tls_file.read_bytes())
+    assert xu.validate_chain(*tls_certs)
+    assert len(tls_certs) == 4
+
+    # in-flight files are cleaned up; rerun is a no-op
+    assert not (h.certs_dir / "certificate-request-id.txt").exists()
+    assert not (h.certs_dir / "selfsigned-key.pem").exists()
+    assert h.build_keystore() is False
+
+
+def _certs(blob: bytes):
+    marker = b"-----BEGIN CERTIFICATE-----"
+    out, idx = [], blob.find(marker)
+    while idx != -1:
+        nxt = blob.find(marker, idx + 1)
+        out.append(xu.load_cert(blob[idx:] if nxt == -1 else blob[idx:nxt]))
+        idx = nxt
+    return out
+
+
+def test_manual_approval_polls_until_approved(tmp_path):
+    dm = Doorman.create(auto_approve=False)
+    h = _helper(tmp_path, InProcessRegistrationService(dm))
+    result = {}
+
+    def run():
+        result["ok"] = h.build_keystore()
+
+    t = threading.Thread(target=run)
+    t.start()
+    deadline = time.monotonic() + 5
+    while not dm.pending() and time.monotonic() < deadline:
+        time.sleep(0.005)
+    [rid] = dm.pending()
+    dm.approve(rid)
+    t.join(timeout=5)
+    assert result.get("ok") is True
+    assert h.node_ca_file.exists()
+
+
+def test_rejection_raises_and_clears_request_id(tmp_path):
+    dm = Doorman.create(auto_approve=False)
+    h = _helper(tmp_path, InProcessRegistrationService(dm))
+    # pre-submit so the rejection is already recorded when we poll
+    key = xu.generate_tls_key()
+    h.certs_dir.mkdir(parents=True, exist_ok=True)
+    (h.certs_dir / "selfsigned-key.pem").write_bytes(xu.key_pem(key))
+    rid = dm.submit(xu.csr_pem(xu.create_csr("Bank of TPU", key)))
+    (h.certs_dir / "certificate-request-id.txt").write_text(rid)
+    dm.reject(rid, "name collision")
+    with pytest.raises(CertificateRequestException, match="name collision"):
+        h.build_keystore()
+    # the dead request id is dropped so a corrected retry starts fresh
+    assert not (h.certs_dir / "certificate-request-id.txt").exists()
+
+
+def test_resume_reuses_request_and_key(tmp_path):
+    """Crash between submit and approval: a new helper resumes the same
+    request id with the same key (submitOrResumeCertificateSigningRequest)."""
+    dm = Doorman.create(auto_approve=False)
+    svc = InProcessRegistrationService(dm)
+    h1 = _helper(tmp_path, svc, max_polls=1)
+    with pytest.raises(TimeoutError):
+        h1.build_keystore()          # "crash" while pending
+    [rid] = dm.pending()
+    key_before = (h1.certs_dir / "selfsigned-key.pem").read_bytes()
+
+    dm.approve(rid)
+    h2 = _helper(tmp_path, svc)
+    assert h2.build_keystore() is True
+    # same key the first attempt generated now sits under the node CA
+    leaf = _certs(h2.node_ca_file.read_bytes())[0]
+    from cryptography.hazmat.primitives.serialization import (
+        Encoding, PublicFormat,
+    )
+    spki = (Encoding.DER, PublicFormat.SubjectPublicKeyInfo)
+    assert leaf.public_key().public_bytes(*spki) == xu.load_key(
+        key_before
+    ).public_key().public_bytes(*spki)
+
+
+def test_same_csr_resubmission_is_idempotent():
+    dm = Doorman.create(auto_approve=False)
+    key = xu.generate_tls_key()
+    pem = xu.csr_pem(xu.create_csr("Acme", key))
+    assert dm.submit(pem) == dm.submit(pem)
+    assert len(dm.pending()) == 1
+
+
+def test_doorman_rejects_garbage_and_bad_signature():
+    dm = Doorman.create()
+    with pytest.raises(Exception):
+        dm.submit(b"not a csr")
+
+
+def test_legal_name_rules():
+    """LegalNameValidator.kt rule set."""
+    from corda_tpu.utils.legal_name import (
+        normalise_legal_name,
+        validate_legal_name,
+    )
+
+    assert normalise_legal_name("  Bank   of\tTPU ") == "Bank of TPU"
+    validate_legal_name("Bank of TPU")          # ok
+    for bad, why in [
+        ("Evil, Corp", "Character not allowed"),
+        ("Acme Node Ltd", "Word not allowed"),
+        ("acme corp", "capitalized"),
+        ("Банк", "Forbidden character"),
+        ("X", "at least two letters"),
+        (" Padded Name", "normalized"),
+        ("A" * 300, "longer"),
+    ]:
+        with pytest.raises(ValueError, match=why):
+            validate_legal_name(bad)
+
+
+def test_doorman_auto_rejects_bad_and_duplicate_names():
+    """permissioning.rst: rule-violating and already-taken legal names
+    are rejected by the server itself, even in auto-approve mode."""
+    dm = Doorman.create(auto_approve=True)
+
+    rid = dm.submit(xu.csr_pem(xu.create_csr("evil node corp", xu.generate_tls_key())))
+    with pytest.raises(CertificateRequestException, match="not allowed"):
+        dm.retrieve(rid)
+
+    a = dm.submit(xu.csr_pem(xu.create_csr("Unique Bank", xu.generate_tls_key())))
+    assert dm.retrieve(a) is not None
+    b = dm.submit(xu.csr_pem(xu.create_csr("Unique Bank", xu.generate_tls_key())))
+    with pytest.raises(CertificateRequestException, match="already in use"):
+        dm.retrieve(b)
+
+
+def test_http_roundtrip_and_admin_endpoints(tmp_path):
+    dm = Doorman.create(auto_approve=False)
+    server = PermissioningServer(dm).start()
+    try:
+        svc = HttpRegistrationService(server.url)
+        h = _helper(tmp_path, svc, max_polls=1)
+        with pytest.raises(TimeoutError):
+            h.build_keystore()       # pending over real HTTP (204 path)
+
+        import json
+        import urllib.request
+
+        with urllib.request.urlopen(f"{server.url}/admin/requests") as r:
+            [rid] = json.loads(r.read())
+        req = urllib.request.Request(
+            f"{server.url}/admin/approve/{rid}", data=b"", method="POST"
+        )
+        urllib.request.urlopen(req)
+
+        assert _helper(tmp_path, svc).build_keystore() is True
+        assert xu.validate_chain(*_certs(h.node_ca_file.read_bytes()))
+    finally:
+        server.stop()
+
+
+def test_http_rejection_maps_401(tmp_path):
+    dm = Doorman.create(auto_approve=False)
+    server = PermissioningServer(dm).start()
+    try:
+        svc = HttpRegistrationService(server.url)
+        key = xu.generate_tls_key()
+        rid = svc.submit_request(xu.csr_pem(xu.create_csr("Evil Corp", key)))
+        dm.reject(rid, "not welcome")
+        with pytest.raises(CertificateRequestException, match="not welcome"):
+            svc.retrieve_certificates(rid)
+    finally:
+        server.stop()
+
+
+def test_doorman_persistence_across_restart(tmp_path):
+    d = str(tmp_path / "dm")
+    dm1 = Doorman.create(auto_approve=False, data_dir=d)
+    key = xu.generate_tls_key()
+    rid = dm1.submit(xu.csr_pem(xu.create_csr("Persistent Bank", key)))
+    dm1.approve(rid)
+
+    dm2 = Doorman.create(auto_approve=False, data_dir=d)
+    chain = dm2.retrieve(rid)
+    assert chain is not None
+    certs = [xu.load_cert(p) for p in chain]
+    assert xu.validate_chain(*certs)
+    # the reloaded authority is the SAME authority
+    assert certs[-1].subject == dm1.root.cert.subject
+
+
+def test_node_boot_uses_registered_tls(tmp_path):
+    """After registration the fabric serves the doorman-certified TLS
+    leaf, not a generated self-signed one (node.py _load_or_create_tls)."""
+    from corda_tpu.node.config import NodeConfig
+    from corda_tpu.node.node import Node
+
+    dm = Doorman.create(auto_approve=True)
+    base = tmp_path / "node"
+    h = NetworkRegistrationHelper(
+        str(base), "RegBank", InProcessRegistrationService(dm),
+        poll_interval=0.01, max_polls=50, log=lambda *a: None,
+    )
+    assert h.build_keystore() is True
+
+    cfg = NodeConfig(
+        name="RegBank", base_dir=str(base), verifier_backend="cpu",
+        cordapps=(),
+    )
+    node = Node(cfg).start()
+    try:
+        tls_leaf = _certs(h.tls_file.read_bytes())[0]
+        served = xu.load_cert(node.tls.cert_pem)  # exactly one cert
+        assert served.subject == tls_leaf.subject
+        assert served.serial_number == tls_leaf.serial_number
+    finally:
+        node.stop()
