@@ -6,9 +6,15 @@ accept/reject vs the CPU reference semantics.
 
 Prints one JSON line per metric: {"metric", "value", "unit",
 "vs_baseline"}. The DEFAULT run (no BENCH_METRIC) measures the whole
-BASELINE.md table — mixed, merkle, notary — and prints the headline
-p256 line LAST, so a driver that parses the final line still records
-the headline while the full table lands in the same capture.
+BASELINE.md table — mixed, merkle, notary, plus a reduced-n kernel
+parity refresh — inside ONE wall-clock budget (BENCH_TIME_BUDGET
+seconds, default 900), trimming then skipping secondaries as the
+budget tightens, and ALWAYS prints the headline p256 line LAST, so a
+driver that parses the final line records the headline while the full
+table lands in the same capture. The p256 line carries `spread`
+(min/max over the timed reps) and `link_rtt_ms` (a tiny-transfer
+round-trip probe) so a sub-target reading on the remote-attached chip
+is attributable to link quality.
 
 BENCH_METRIC restricts to one measurement:
   p256            — the headline ECDSA-p256 batch
@@ -20,7 +26,9 @@ BENCH_METRIC restricts to one measurement:
                     Toeplitz matmul) vs VPU (shifted accumulate)
                     Montgomery-multiply formulations (experiment rig,
                     not part of the default table)
-  all  (default)  — everything, p256 last
+  parity          — reduced-n windowed+plain kernel parity refresh;
+                    rewrites KERNEL_PARITY.json (TPU backend only)
+  all  (default)  — everything, p256 last, under BENCH_TIME_BUDGET
 """
 
 import json
@@ -37,6 +45,22 @@ os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
 BASELINE = 50_000.0  # verifies/sec target per BASELINE.json
 
 
+def _timed_rates(run_once, batch: int, iters: int) -> list[float]:
+    """Per-iteration rates, one independent timing each."""
+    rates = []
+    for _ in range(max(iters, 1)):
+        t0 = time.perf_counter()
+        run_once()
+        rates.append(batch / (time.perf_counter() - t0))
+    return rates
+
+
+def _median(rates: list[float]) -> float:
+    """Lower median — ONE convention for every metric."""
+    ordered = sorted(rates)
+    return ordered[(len(ordered) - 1) // 2]
+
+
 def _median_rate(run_once, batch: int, iters: int) -> float:
     """batch/median(iteration wall): the remote-attached chip's link
     shows +/-35% run-to-run variance (BASELINE.md) — one congested
@@ -46,13 +70,26 @@ def _median_rate(run_once, batch: int, iters: int) -> float:
     verification metrics (spi, merkle); the notary metric deliberately
     pools time (a serving rate is sustained throughput) and the
     montmul A/B reports best-of-reps."""
+    return _median(_timed_rates(run_once, batch, iters))
+
+
+def _link_rtt_ms(probes: int = 5) -> float:
+    """Median round-trip of a tiny host->device->host transfer. The
+    remote-attached chip's link quality is the dominant variance source
+    (BASELINE.md measurement hygiene): recording the RTT alongside the
+    headline makes a sub-target reading attributable — a congested
+    link shows tens of ms here vs single-digit on a healthy one."""
+    import jax
+    import numpy as np
+
+    x = np.zeros(8, np.float32)
     times = []
-    for _ in range(max(iters, 1)):
+    for _ in range(max(probes, 1)):
         t0 = time.perf_counter()
-        run_once()
+        np.asarray(jax.device_put(x))
         times.append(time.perf_counter() - t0)
     times.sort()
-    return batch / times[len(times) // 2]
+    return round(times[len(times) // 2] * 1000.0, 2)
 
 
 
@@ -83,8 +120,13 @@ def _merkle_metric(batch: int, iters: int) -> dict:
         )
         for _ in range(8)
     ]
+    # fixture tiling, as in _requests: per-item signing dominates the
+    # fixture build and none of it is measured work — proof kernels and
+    # the SPI treat repeated rows identically to unique ones
+    tile = max(1, int(os.environ.get("BENCH_TILE", "8")))
+    unique = -(-batch // tile)
     items = []
-    for i in range(batch):
+    for i in range(unique):
         leaves = [SecureHash.sha256(rng.randbytes(64)) for _ in range(64)]
         included = [leaves[j] for j in sorted(rng.sample(range(64), 6))]
         pmt = PartialMerkleTree.build(leaves, included)
@@ -92,6 +134,7 @@ def _merkle_metric(batch: int, iters: int) -> dict:
         kp = keys[i % 8]
         sig = kp.private.sign(root.bytes_)
         items.append((pmt, root, included, kp.public, sig))
+    items = (items * tile)[:batch]
 
     chunk = min(int(os.environ.get("BENCH_CHUNK", "4096")), batch)
     verifier = TpuBatchVerifier(batch_sizes=(chunk,))
@@ -244,6 +287,7 @@ def _notary_metric(batch: int, iters: int) -> dict:
         "value": round(rate, 1),
         "unit": "notarisations/s",
         "vs_baseline": round(rate / BASELINE, 3),
+        "flush_depth": batch,   # actual queued depth this run measured
     }
 
 
@@ -325,6 +369,16 @@ def _requests(batch: int, metric: str):
     else:
         scheme_ids = (schemes.ECDSA_SECP256R1_SHA256,)
 
+    # fixture tiling: signing is pure-Python host math (~8 ms/sig), so
+    # a 32k fully-unique fixture costs minutes of child wall-clock —
+    # which is what timed the round-3 driver record out, and none of
+    # which is measured work. Build batch/BENCH_TILE unique rows and
+    # repeat the block: the SPI has no dedup/memo of any kind (every
+    # row packs, ships and verifies identically), so the measured rate
+    # is unchanged while the fixture builds 8x faster. BENCH_TILE=1
+    # restores a fully unique fixture.
+    tile = max(1, int(os.environ.get("BENCH_TILE", "8")))
+    unique = -(-batch // tile)   # ceil
     rng = random.Random(2026)
     keys = {
         sid: [
@@ -334,7 +388,7 @@ def _requests(batch: int, metric: str):
         for sid in scheme_ids
     }
     reqs = []
-    for i in range(batch):
+    for i in range(unique):
         sid = scheme_ids[i % len(scheme_ids)]
         kp = keys[sid][i % 8]
         msg = rng.randbytes(64)
@@ -342,7 +396,7 @@ def _requests(batch: int, metric: str):
         if i % 7 == 3:  # mix in rejects so accept/reject is exercised
             msg = msg + b"x"
         reqs.append(VerificationRequest(kp.public, sig, msg))
-    return reqs
+    return (reqs * tile)[:batch]
 
 
 def _spi_metric(metric: str, batch: int, iters: int) -> dict:
@@ -375,7 +429,11 @@ def _spi_metric(metric: str, batch: int, iters: int) -> dict:
     if [got[i] for i in spot] != cpu:   # must survive python -O
         raise SystemExit("TPU/CPU mismatch — bench aborted")
 
-    rate = _median_rate(lambda: verifier.verify_batch(reqs), batch, iters)
+    rtt = _link_rtt_ms()
+    rates = sorted(
+        _timed_rates(lambda: verifier.verify_batch(reqs), batch, iters)
+    )
+    rate = _median(rates)
     name = (
         "ecdsa_p256_verifies_per_sec_via_spi"
         if metric == "p256"
@@ -386,6 +444,42 @@ def _spi_metric(metric: str, batch: int, iters: int) -> dict:
         "value": round(rate, 1),
         "unit": "verifies/s",
         "vs_baseline": round(rate / BASELINE, 3),
+        # variance attribution (BASELINE.md measurement hygiene): the
+        # per-rep spread and the link round-trip measured just before
+        # the timed reps — a sub-target value with a fat RTT is a bad
+        # link, not a regression
+        "spread": {
+            "min": round(rates[0], 1),
+            "max": round(rates[-1], 1),
+            "reps": len(rates),
+        },
+        "link_rtt_ms": rtt,
+    }
+
+
+def _parity_metric(batch: int, iters: int) -> dict:
+    """Reduced-n refresh of the windowed+plain kernel-parity artifact
+    (VERDICT r3 #8): regenerates KERNEL_PARITY.json from the default
+    bench run so the evidence cannot rot. n is small (BENCH_PARITY_N,
+    default 256 adversarial vectors) — the full 2048-vector record
+    remains available via `tpu_selfcheck --full`."""
+    from corda_tpu.testing.tpu_selfcheck import run_full
+
+    n = int(os.environ.get("BENCH_PARITY_N", "256"))
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "KERNEL_PARITY.json")
+    # allow_cpu stays False: overwriting the committed artifact with an
+    # XLA-only (no-Pallas) record on a CPU box would downgrade the
+    # evidence — off-TPU this raises and the orchestrator reports it
+    rec = run_full(n=n, allow_cpu=False, out_path=out)
+    return {
+        "metric": "kernel_parity_bit_exact",
+        "value": 1.0,     # run_full raises on any device/CPU mismatch
+        "unit": "bool",
+        "vs_baseline": 1.0,
+        "n": rec["n"],
+        "backend": rec["backend"],
+        "runs": rec["runs"],
     }
 
 
@@ -398,13 +492,52 @@ def _run_metric(metric: str, batch: int, iters: int) -> dict:
         # with flush-time GC suspended the rate is FLAT beyond that
         # (post-fix sweep 2026-08-01: 4096=13.5k, 16384=21-22.6k band,
         # true-32768=21.0k), so the cap only bounds fixture build time
-        return _notary_metric(min(batch, 16384), iters)
+        out = _notary_metric(min(batch, 16384), iters)
+        out["flush_depth_cap"] = 16384   # explicit: a larger
+        # BENCH_BATCH still measures a 16384-deep flush (VERDICT r3
+        # Weak #3 — the cap must be visible in the record, not prose)
+        if batch > 16384:
+            out["batch_requested"] = batch
+        return out
     if metric == "montmul":
         return _montmul_metric(min(batch, 8192), iters)
+    if metric == "parity":
+        return _parity_metric(batch, iters)
     return _spi_metric(metric, batch, iters)
 
 
+def _run_child(m: str, env: dict, timeout: float) -> bool:
+    """One metric in its own interpreter; prints its metric line on
+    success. Returns False on any failure (reported to stderr)."""
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, capture_output=True, text=True, timeout=timeout,
+        )
+        # pass the child's diagnostics through (the profile lines
+        # docs/serving-notary.md documents arrive on stderr)
+        if out.stderr:
+            sys.stderr.write(out.stderr)
+        line = out.stdout.strip().splitlines()[-1] if out.stdout.strip() else ""
+        json.loads(line)          # a metric line, not stray output
+        print(line, flush=True)
+        return True
+    except Exception as e:   # noqa: BLE001 - keep the run alive
+        # a timed-out child still captured diagnostics worth keeping
+        child_err = getattr(e, "stderr", None)
+        if child_err:
+            sys.stderr.write(
+                child_err if isinstance(child_err, str)
+                else child_err.decode(errors="replace")
+            )
+        print(f"bench metric {m!r} failed: {e}", file=sys.stderr)
+        return False
+
+
 def main() -> None:
+    t_start = time.perf_counter()
     # On a remote-attached TPU the host<->device link latency (~50-100
     # ms/transfer) dominates small batches; 32k records (5 MB packed)
     # amortise it. Device compute is ~7M verifies/s — far from the
@@ -412,11 +545,11 @@ def main() -> None:
     batch = int(os.environ.get("BENCH_BATCH", "32768"))
     iters = int(os.environ.get("BENCH_ITERS", "3"))
     metric = os.environ.get("BENCH_METRIC", "all")
-    if metric not in ("all", "p256", "mixed", "merkle", "notary", "montmul"):
+    known = ("all", "p256", "mixed", "merkle", "notary", "montmul", "parity")
+    if metric not in known:
         # a typo must not record a p256-only rate under another name
         raise SystemExit(
-            "unknown BENCH_METRIC "
-            f"{metric!r}: all | p256 | mixed | merkle | notary | montmul"
+            f"unknown BENCH_METRIC {metric!r}: " + " | ".join(known)
         )
     if metric != "all":
         print(json.dumps(_run_metric(metric, batch, iters)))
@@ -427,40 +560,56 @@ def main() -> None:
     # interpreter (earlier metrics' live jit programs, device buffers
     # and heap survive into later ones) — and the persistent compile
     # cache keeps subprocesses warm, so isolation costs only startup.
-    # Secondary metrics first (a secondary failure must not cost the
-    # driver the headline — report it on stderr and move on), headline
-    # p256 LAST so tail-line parsers record it.
-    import subprocess
+    #
+    # The whole default run now lives under ONE wall-clock budget
+    # (BENCH_TIME_BUDGET seconds): round 3's record was lost to an
+    # unbounded four-child run timing out under the driver
+    # (BENCH_r03.json rc=124). Secondary metrics spend only what the
+    # budget allows — trimmed (fewer iters, smaller batch) when it is
+    # tight, skipped (reported on stderr) when it is tighter — and the
+    # headline p256 ALWAYS runs before the budget expires, LAST so
+    # tail-line parsers record it.
+    budget = float(os.environ.get("BENCH_TIME_BUDGET", "900"))
+    # wall-clock held back for the headline child: JAX startup + tiled
+    # fixture + warm-cache timing fit well under it, and the margin
+    # absorbs a cold kernel compile (minutes per scheme/shape)
+    reserve = float(os.environ.get("BENCH_HEADLINE_RESERVE", "420"))
 
-    for m in ("mixed", "merkle", "notary", "p256"):
-        env = dict(os.environ, BENCH_METRIC=m)
-        out = None
-        try:
-            out = subprocess.run(
-                [sys.executable, os.path.abspath(__file__)],
-                env=env, capture_output=True, text=True, timeout=1800,
+    def left() -> float:
+        return budget - (time.perf_counter() - t_start)
+
+    # parity runs LAST of the optional work (cheapest to drop), but
+    # before the headline so the headline stays the final stdout line
+    for m in ("mixed", "merkle", "notary", "parity"):
+        avail = left() - reserve
+        if avail < 60:
+            print(
+                f"bench: skipped {m} — {avail:.0f}s of secondary budget"
+                " left (BENCH_TIME_BUDGET)",
+                file=sys.stderr,
             )
-            # pass the child's diagnostics through (the profile lines
-            # docs/serving-notary.md documents arrive on stderr)
-            if out.stderr:
-                sys.stderr.write(out.stderr)
-            line = out.stdout.strip().splitlines()[-1] if out.stdout.strip() else ""
-            json.loads(line)          # a metric line, not stray output
-            print(line, flush=True)
-        except Exception as e:   # noqa: BLE001 - keep the headline alive
-            # a timed-out child still captured diagnostics worth keeping
-            child_err = getattr(e, "stderr", None)
-            if child_err:
-                sys.stderr.write(
-                    child_err if isinstance(child_err, str)
-                    else child_err.decode(errors="replace")
-                )
-            if m == "p256":
-                # the headline must come from THIS interpreter if the
-                # subprocess path is unavailable (e.g. sandboxed spawn)
-                print(json.dumps(_spi_metric("p256", batch, iters)))
-                return
-            print(f"bench metric {m!r} failed: {e}", file=sys.stderr)
+            continue
+        env = dict(os.environ, BENCH_METRIC=m)
+        if avail < 300 and m in ("mixed", "merkle", "notary"):
+            # trim before dropping: one timed rep at a shallower batch
+            # still yields a usable point for the table
+            env["BENCH_ITERS"] = "1"
+            env["BENCH_BATCH"] = str(min(batch, 8192))
+            print(
+                f"bench: trimmed {m} to iters=1 batch<=8192 "
+                f"({avail:.0f}s of secondary budget)",
+                file=sys.stderr,
+            )
+        _run_child(m, env, timeout=max(avail, 60))
+    # headline: subprocess when there is room for a clean retry margin,
+    # else straight to the in-process fallback — the p256 line must
+    # exist in every record this instrument produces
+    headline_env = dict(os.environ, BENCH_METRIC="p256")
+    if left() > 150 and _run_child(
+        "p256", headline_env, timeout=max(left() - 30, 120)
+    ):
+        return
+    print(json.dumps(_spi_metric("p256", batch, iters)), flush=True)
 
 
 if __name__ == "__main__":

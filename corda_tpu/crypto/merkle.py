@@ -91,7 +91,30 @@ def single_leaf_proofs(
     ONCE — O(n) hashing — then each leaf's proof is just its sibling
     path, O(log n) lookups with no further hashing. Calling
     PartialMerkleTree.build per leaf would rebuild the levels each
-    time, O(n^2) for a batch."""
+    time, O(n^2) for a batch. The native kernel does levels AND path
+    extraction in one C call (differential-tested in
+    tests/test_native.py); Python here is the fallback + reference."""
+    from ..native import get as _native
+
+    native = _native()
+    # getattr: a stale compiled extension from before this kernel was
+    # added must fall back, not AttributeError the signing hot path
+    if getattr(native, "merkle_paths", None) is not None and leaves:
+        root_b, paths = native.merkle_paths([h.bytes_ for h in leaves])
+        size = 1
+        while size < len(leaves):
+            size *= 2
+        proofs = [
+            PartialMerkleTree(
+                size,
+                (i0,),
+                tuple(
+                    SecureHash(p[j : j + 32]) for j in range(0, len(p), 32)
+                ),
+            )
+            for i0, p in enumerate(paths)
+        ]
+        return SecureHash(root_b), proofs
     levels = merkle_levels(leaves)
     size = len(levels[0])
     root = levels[-1][0]

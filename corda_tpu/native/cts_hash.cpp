@@ -300,6 +300,74 @@ PyObject* py_merkle_root(PyObject*, PyObject* arg) {
         reinterpret_cast<char*>(level.data()), 32);
 }
 
+// Batch-signing shape (tx_signature.sign_tx_ids): build every tree
+// level once, then emit each leaf's sibling path — (root, [path...])
+// where path i is the concatenation of the 32-byte siblings bottom-up.
+// One C call replaces 2N hashlib round trips plus N*log2(N) Python
+// level lookups on the notary's reply-signing hot path.
+PyObject* py_merkle_paths(PyObject*, PyObject* arg) {
+    PyObject* seq = PySequence_Fast(arg, "merkle_paths takes a sequence");
+    if (!seq) return nullptr;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    if (n == 0) {
+        Py_DECREF(seq);
+        PyErr_SetString(PyExc_ValueError,
+                        "cannot build a Merkle tree with no leaves");
+        return nullptr;
+    }
+    size_t size = 1;
+    while (size < size_t(n)) size *= 2;
+    // levels[0] = padded leaves ... levels[d] = [root]
+    std::vector<std::vector<uint8_t>> levels;
+    levels.emplace_back(size * 32, 0);
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject* item = PySequence_Fast_GET_ITEM(seq, i);
+        Py_buffer view;
+        if (PyObject_GetBuffer(item, &view, PyBUF_SIMPLE) < 0) {
+            Py_DECREF(seq); return nullptr;
+        }
+        if (view.len != 32) {
+            PyBuffer_Release(&view);
+            Py_DECREF(seq);
+            PyErr_SetString(PyExc_ValueError, "leaves must be 32 bytes");
+            return nullptr;
+        }
+        std::memcpy(&levels[0][i * 32], view.buf, 32);
+        PyBuffer_Release(&view);
+    }
+    Py_DECREF(seq);
+    for (size_t w = size; w > 1; w /= 2) {
+        const std::vector<uint8_t>& prev = levels.back();
+        std::vector<uint8_t> next((w / 2) * 32);
+        for (size_t i = 0; i < w; i += 2) {
+            sha256_once(&prev[i * 32], 64, &next[(i / 2) * 32]);
+        }
+        levels.push_back(std::move(next));
+    }
+    size_t depth = levels.size() - 1;   // path length per leaf
+    PyObject* paths = PyList_New(n);
+    if (!paths) return nullptr;
+    std::vector<uint8_t> path(depth * 32);
+    for (Py_ssize_t i0 = 0; i0 < n; i0++) {
+        size_t i = size_t(i0);
+        for (size_t d = 0; d < depth; d++) {
+            std::memcpy(&path[d * 32], &levels[d][(i ^ 1) * 32], 32);
+            i /= 2;
+        }
+        PyObject* b = PyBytes_FromStringAndSize(
+            reinterpret_cast<char*>(path.data()), depth * 32);
+        if (!b) { Py_DECREF(paths); return nullptr; }
+        PyList_SET_ITEM(paths, i0, b);
+    }
+    PyObject* root = PyBytes_FromStringAndSize(
+        reinterpret_cast<char*>(levels.back().data()), 32);
+    if (!root) { Py_DECREF(paths); return nullptr; }
+    PyObject* out = PyTuple_Pack(2, root, paths);
+    Py_DECREF(root);
+    Py_DECREF(paths);
+    return out;
+}
+
 // ---------------------------------------------------------------------------
 // Batched partial-Merkle-proof verification.
 //
@@ -486,6 +554,8 @@ PyMethodDef methods[] = {
      "SHA-256 digest of every item of a sequence of bytes-likes."},
     {"merkle_root", py_merkle_root, METH_O,
      "Root of the zero-padded pairwise-SHA-256 tree over 32-byte leaves."},
+    {"merkle_paths", py_merkle_paths, METH_O,
+     "(root, [sibling-path bytes per leaf]) for the zero-padded tree."},
     {nullptr, nullptr, 0, nullptr},
 };
 

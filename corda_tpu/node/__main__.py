@@ -59,9 +59,19 @@ def main(argv=None) -> int:
                 "registration_server", file=sys.stderr,
             )
             return 1
+        root_pem = None
+        if config.network_root_file:
+            try:
+                with open(config.network_root_file, "rb") as f:
+                    root_pem = f.read()
+            except OSError as e:
+                print(f"bad network_root_file: {e}", file=sys.stderr)
+                return 1
         helper = NetworkRegistrationHelper(
             config.base_dir, config.name,
             HttpRegistrationService(config.registration_server),
+            email=config.email,
+            network_root_pem=root_pem,
         )
         try:
             helper.build_keystore()

@@ -21,12 +21,30 @@ state digests make a checkpoint stable and garbage-collect protocol
 state below it), and catch-up state transfer (a lagging or restarted
 replica installs a checkpoint attested by f+1 peers and replays the
 agreed tail — the BFTSMaRt getSnapshot/installSnapshot surface,
-BFTSMaRt.kt:193,219). Per-message signatures inside view-change and
-checkpoint certificates are descoped to the fabric's authenticated
-channels (the fabric's signed handshake), as the reference descopes
-them to the library. Liveness needs n-f live replicas; safety holds
-with ≤f byzantine ones because every quorum is 2f+1 and replies only
-count with f+1 agreement.
+BFTSMaRt.kt:193,219).
+
+View-change votes are proof-carrying (PBFT's prepared certificates,
+played by BFT-SMaRt internally for the reference): each prepared entry
+in a ViewChange carries the 2f+1 distinct PREPARE attestations
+(including the view primary's — its prepare plays classic PBFT's
+signed pre-prepare) that made it prepared, and both the new primary
+and every validator discard entries whose certificate does not check
+out — so a single authenticated-but-lying replica cannot smuggle a
+never-prepared command into the new view, and two conflicting
+certificates for one (view, seq) are impossible by quorum
+intersection. Seqs no vote certifies are re-proposed as no-ops
+(PBFT's null requests) so in-sequence execution never stalls on a
+hole. With the notary's signature hooks installed
+(`sign_prepare_fn`/`verify_prepare_fn`, wired by `BFTNotaryService`),
+certificates are per-replica signatures over (view, seq, digest) and
+the guarantee is cryptographic: safety holds with ≤f byzantine
+replicas in ANY role (primary, view-change voter, or backup). Without
+the hooks (bare protocol tests), validation falls back to requiring
+every attestation in a certificate to match a PREPARE the validator
+itself received over the fabric's authenticated channels — same
+safety on a lossless fabric, with liveness deferred (not lost) when a
+validator missed the original PREPAREs. Liveness needs n-f live
+replicas; replies only count with f+1 agreement.
 """
 
 from __future__ import annotations
@@ -80,6 +98,10 @@ class BftPrepare:
     seq: int
     digest: bytes
     replica: str
+    # the replica's signature over (cluster, view, seq, digest) when
+    # the service installed sign_prepare_fn — collected into the
+    # prepared certificate that makes view-change votes proof-carrying
+    signature: Optional[Any] = None
 
 
 @dataclass(frozen=True)
@@ -103,7 +125,12 @@ class BftReply:
 class ViewChange:
     new_view: int
     replica: str
-    # prepared set: tuple of (seq, view, cmd_id, origin, command)
+    # prepared set: tuple of (seq, view, cmd_id, origin, command, ts,
+    # cert) where cert = ((replica, prepare_signature), ...) — the
+    # 2f+1 distinct PREPARE attestations that made the entry prepared.
+    # Entries without a checkable quorum certificate are discarded by
+    # every consumer (_merge_prepared), so a lying voter cannot inject
+    # a never-prepared command.
     prepared: tuple
 
 
@@ -194,6 +221,13 @@ def _canon(command: Any) -> Any:
     return list(command) if isinstance(command, tuple) else command
 
 
+# NEW-VIEW gap filler (PBFT's null request): a seq the old primary
+# assigned but that never certifiably prepared is re-proposed as a
+# no-op, so execution (strictly in-sequence) can advance past it
+# instead of stalling forever on a hole below next_seq.
+NOOP = "__bft_noop__"
+
+
 class BftReplica:
     """One PBFT replica + embedded client gateway.
 
@@ -233,9 +267,24 @@ class BftReplica:
         self.exec_seq = 1                 # next sequence to execute
         # seq -> (view, cmd_id, origin, command)
         self.accepted: dict[int, tuple] = {}
-        self.prepares: dict[tuple, set[str]] = {}     # (view,seq,digest)->replicas
+        # (view,seq,digest) -> {replica: prepare_signature}
+        self.prepares: dict[tuple, dict[str, Any]] = {}
         self.commits: dict[tuple, set[str]] = {}
         self.prepared: dict[int, tuple] = {}          # seq -> accepted entry
+        # seq -> (view, digest, ((replica, sig), ...)) — the PREPARE
+        # evidence snapshot taken when the entry became prepared;
+        # shipped inside ViewChange votes as the prepared certificate
+        self.prepared_cert: dict[int, tuple] = {}
+        # prepared-certificate hooks (installed by BFTNotaryService):
+        # sign_prepare_fn(view, seq, digest) -> signature for our own
+        # PREPAREs; verify_prepare_fn(replica, view, seq, digest, sig)
+        # -> bool gates both incoming PREPAREs and certificate entries.
+        # Without them, certificates are validated against the
+        # PREPAREs this replica itself received (fabric-auth fallback).
+        self.sign_prepare_fn: Optional[Callable[[int, int, bytes], Any]] = None
+        self.verify_prepare_fn: Optional[
+            Callable[[str, int, int, bytes, Any], bool]
+        ] = None
         self.committed: set[int] = set()
         self.executed: dict[int, Any] = {}            # seq -> outcome
         self.seen_requests: dict[tuple, int] = {}     # (origin, cmd_id) -> seq
@@ -351,18 +400,33 @@ class BftReplica:
         self._accept_preprepare(pp)
         self._broadcast(pp)
 
-    def _accept_preprepare(self, pp: PrePrepare) -> None:
+    def _accept_preprepare(
+        self, pp: PrePrepare, skew_exempt: bool = False
+    ) -> None:
         if pp.seq in self.accepted and self.accepted[pp.seq][0] >= pp.view:
             return   # first pre-prepare per (seq, view) wins; stale views drop
         skew = abs(pp.timestamp - self.clock.now_micros())
-        if skew > self.config.timestamp_skew_micros:
-            return   # primary's clock is lying/broken: refuse to prepare
+        if skew > self.config.timestamp_skew_micros and not skew_exempt:
+            # primary's clock is lying/broken: refuse to prepare.
+            # NEW-VIEW re-proposals are exempt: they replay the ORIGINAL
+            # ordering timestamp (execution must be deterministic across
+            # views), and a view change delayed past the skew bound —
+            # partition, long outage — must not leave a certified entry
+            # un-re-preparable forever, stalling in-sequence execution
+            # at its hole. The certificate already proves 2f+1 replicas
+            # accepted that timestamp when it was fresh.
+            return
         self.accepted[pp.seq] = (
             pp.view, pp.cmd_id, pp.origin, pp.command, pp.timestamp,
         )
         self.seen_requests[(pp.origin, pp.cmd_id)] = pp.seq
         d = _digest(_canon(pp.command))
-        prep = BftPrepare(pp.view, pp.seq, d, self.name)
+        sig = (
+            self.sign_prepare_fn(pp.view, pp.seq, d)
+            if self.sign_prepare_fn is not None
+            else None
+        )
+        prep = BftPrepare(pp.view, pp.seq, d, self.name, sig)
         self._record_prepare(prep)
         self._broadcast(prep)
 
@@ -372,23 +436,51 @@ class BftReplica:
         self._accept_preprepare(pp)
 
     def _record_prepare(self, p: BftPrepare) -> None:
+        if (
+            p.replica != self.name
+            and self.verify_prepare_fn is not None
+            and not self.verify_prepare_fn(
+                p.replica, p.view, p.seq, bytes(p.digest), p.signature
+            )
+        ):
+            return   # unsigned/mis-signed PREPARE: inadmissible evidence
         key = (p.view, p.seq, bytes(p.digest))
-        group = self.prepares.setdefault(key, set())
-        group.add(p.replica)
-        # prepared = pre-prepare accepted + 2f prepares (incl. our own).
+        group = self.prepares.setdefault(key, {})
+        group[p.replica] = p.signature
+        # prepared = pre-prepare accepted + 2f+1 distinct prepares
+        # INCLUDING the view primary's (every replica here broadcasts a
+        # PREPARE on accept, so the primary's prepare plays the role of
+        # classic PBFT's signed pre-prepare in the certificate). The
+        # 2f+1-at-transition invariant is what guarantees every
+        # prepared replica can immediately produce a certificate that
+        # passes _valid_prepared_entry — an entry that COMMITS anywhere
+        # therefore always survives a view change, because any 2f+1
+        # view-change vote quorum contains a replica holding its cert.
         # A seq prepared in an OLD view prepares again in the new one
         # (the NEW-VIEW re-proposal path): commit quorums are per-view,
         # so the view-0 prepared state must not gag the view-1 commit.
         if (
             p.seq in self.accepted
             and self.accepted[p.seq][0] == p.view
-            and len(group) >= quorum_2f1(self.n) - 1
+            and len(group) >= quorum_2f1(self.n)
+            and self.peers[p.view % self.n] in group
             and (
                 p.seq not in self.prepared
                 or self.prepared[p.seq][0] < p.view
             )
         ):
             self.prepared[p.seq] = self.accepted[p.seq]
+            # snapshot the evidence: this tuple is the prepared
+            # certificate a future view-change vote will carry — the
+            # transition condition just guaranteed it holds 2f+1
+            # distinct attesters, exactly what _valid_prepared_entry
+            # demands (a larger snapshot would only give fallback-mode
+            # validators more inbox entries to have to confirm)
+            self.prepared_cert[p.seq] = (
+                p.view,
+                bytes(p.digest),
+                tuple(sorted(group.items(), key=lambda kv: kv[0])),
+            )
             c = BftCommitMsg(p.view, p.seq, bytes(p.digest), self.name)
             self._record_commit(c)
             self._broadcast(c)
@@ -413,6 +505,11 @@ class BftReplica:
             seq = self.exec_seq
             self.exec_seq += 1
             _view, cmd_id, origin, command, timestamp = self.accepted[seq]
+            if _canon(command) == NOOP:
+                # gap filler: no state transition, nobody to reply to
+                self.executed[seq] = (cmd_id, origin, None, None)
+                self._maybe_checkpoint(seq)
+                continue
             outcome, signature = self.execute_fn(
                 _canon(command), timestamp,
             )
@@ -455,7 +552,9 @@ class BftReplica:
         bookkeeping below it can never be needed again."""
         self.stable_checkpoint = seq
         self.stable_state = state
-        for d in (self.accepted, self.prepared, self.executed):
+        for d in (
+            self.accepted, self.prepared, self.prepared_cert, self.executed,
+        ):
             for s in [s for s in d if s <= seq]:
                 del d[s]
         for d in (self.prepares, self.commits):
@@ -593,7 +692,10 @@ class BftReplica:
             if not agreed:
                 break
             _seq, cmd_id, origin, command, ts = agreed[0][0]
-            outcome, signature = self.execute_fn(_canon(command), ts)
+            if _canon(command) == NOOP:
+                outcome, signature = None, None
+            else:
+                outcome, signature = self.execute_fn(_canon(command), ts)
             self.exec_seq = seq + 1
             self.next_seq = max(self.next_seq, self.exec_seq)
             self.executed[seq] = (cmd_id, origin, outcome, signature)
@@ -644,12 +746,23 @@ class BftReplica:
         return sent
 
     def _vote_view_change(self, new_view: int) -> int:
+        # EVERY certified entry above the stable checkpoint rides in
+        # the vote — including executed ones. Excluding executed seqs
+        # would break the NEW-VIEW no-op filler's invariant ("no vote
+        # certifies it => it cannot have committed anywhere"): a seq
+        # executed at 2f+1-minus-one replicas but missing from the
+        # merge would be no-op-filled at a lagging new primary and
+        # diverge it from the executed majority. Checkpoint GC
+        # (_stabilise) bounds the vote size.
         prepared = tuple(
-            (seq, v, cmd_id, origin, _canon(cmd), ts)
+            (
+                seq, v, cmd_id, origin, _canon(cmd), ts,
+                self.prepared_cert[seq][2],
+            )
             for seq, (v, cmd_id, origin, cmd, ts) in sorted(
                 self.prepared.items()
             )
-            if seq not in self.executed
+            if seq in self.prepared_cert
         )
         vc = ViewChange(new_view, self.name, prepared)
         self._record_view_change(vc)
@@ -682,14 +795,80 @@ class BftReplica:
                 if pending is not None:
                     self._on_new_view(pending, pending.primary)
 
-    @staticmethod
-    def _merge_prepared(prepared_sets) -> dict[int, tuple]:
-        """Merge view-change prepared sets: highest view wins per seq.
-        Deterministic — replicas recompute it from the NEW-VIEW
-        certificate to validate the primary's re-proposals."""
+    def _valid_prepared_entry(self, entry, support=None) -> bool:
+        """Check one view-change prepared entry's certificate: 2f+1
+        DISTINCT peer attestations over (view, seq, digest(command)).
+        With verify_prepare_fn installed each attestation is a
+        signature check (fabric-independent); otherwise each must
+        match a PREPARE this replica itself received — a lying voter
+        can fabricate names but not the validator's own inbox.
+
+        2f+1 (not the local prepared predicate's 2f) is what makes two
+        conflicting certificates for one (view, seq) impossible: any
+        two 2f+1 attester sets intersect in >= f+1 replicas, so at
+        least one HONEST replica would have had to attest both digests
+        — and honest replicas send exactly one PREPARE per (view, seq)
+        (the Castro-Liskov prepared-uniqueness argument). At 2f, an
+        equivocating primary plus f double-signing accomplices could
+        certify a second digest behind a committed one and the
+        view-change merge would tie-break by arrival order."""
+        try:
+            seq, v, _cmd_id, _origin, command, _ts, cert = entry
+            names = [r for r, _sig in cert]
+        except (TypeError, ValueError):
+            return False   # malformed entry (old wire shape / garbage)
+        if len(set(names)) != len(names) or not set(names) <= set(self.peers):
+            return False
+        if len(names) < quorum_2f1(self.n):
+            return False
+        d = _digest(_canon(command))
+        if self.verify_prepare_fn is not None:
+            return all(
+                self.verify_prepare_fn(r, v, seq, d, sig)
+                for r, sig in cert
+            )
+        # Fallback (no signature hooks): an attestation checks out if
+        # we received that replica's PREPARE ourselves. A validator
+        # that was down/partitioned for the original traffic instead
+        # accepts an entry carried IDENTICALLY (same seq, view,
+        # digest) by f+1 distinct view-change votes: at most f voters
+        # are byzantine, so one honest voter — who only carries
+        # entries it genuinely prepared with a full certificate —
+        # backs it.
+        own = self.prepares.get((v, seq, d), {})
+        if all(r in own for r in names):
+            return True
+        return (
+            support is not None
+            and support.get((seq, v, d), 0) >= weak_quorum(self.n)
+        )
+
+    def _merge_prepared(self, prepared_sets) -> dict[int, tuple]:
+        """Merge view-change prepared sets: highest view wins per seq,
+        over certificate-backed entries ONLY. Deterministic — replicas
+        recompute it from the NEW-VIEW certificate to validate the
+        primary's re-proposals."""
+        sets = [list(p) for p in prepared_sets]
+        # per-entry vote support (distinct votes carrying the same
+        # (seq, view, digest)) for the fallback admission rule above
+        support: dict[tuple, int] = {}
+        for prepared in sets:
+            seen = set()
+            for entry in prepared:
+                try:
+                    seq, v, _c, _o, command, _t, _cert = entry
+                except (TypeError, ValueError):
+                    continue
+                k = (seq, v, _digest(_canon(command)))
+                if k not in seen:
+                    seen.add(k)
+                    support[k] = support.get(k, 0) + 1
         best: dict[int, tuple] = {}
-        for prepared in prepared_sets:
-            for seq, v, cmd_id, origin, command, ts in prepared:
+        for prepared in sets:
+            for entry in prepared:
+                if not self._valid_prepared_entry(entry, support):
+                    continue
+                seq, v, cmd_id, origin, command, ts, _cert = entry
                 if seq not in best or best[seq][0] < v:
                     best[seq] = (v, cmd_id, origin, command, ts)
         return best
@@ -700,10 +879,13 @@ class BftReplica:
         carrying certificate + re-proposals, apply locally, then order
         any broadcast-but-never-ordered requests."""
         best = self._merge_prepared(votes.values())
+        # re-propose EVERY certified entry, even ones this primary has
+        # executed: a validator that missed the original round receives
+        # the command in-band (re-commitment is a no-op at replicas
+        # already past it — execution is exec_seq-gated)
         pps = tuple(
             (seq, cmd_id, origin, _canon(command), ts)
             for seq, (_v, cmd_id, origin, command, ts) in sorted(best.items())
-            if seq not in self.executed
         )
         # fresh ordering must start ABOVE every seq this cluster has
         # ever used: our own executed/accepted history AND the
@@ -716,11 +898,24 @@ class BftReplica:
         if best:
             top = max(top, max(best))
         self.next_seq = max(self.next_seq, top + 1)
+        # fill the holes: a seq the dead primary assigned that no vote
+        # certifies (it cannot have committed anywhere — commit implies
+        # a 2f+1 certificate in every vote quorum) re-proposes as a
+        # no-op, or in-sequence execution would stall below it forever
+        covered = {pp[0] for pp in pps}
+        now = self.clock.now_micros()
+        noops = tuple(
+            (seq, -seq, self.name, NOOP, now)
+            for seq in range(self.exec_seq, top + 1)
+            if seq not in covered
+        )
+        pps = tuple(sorted(pps + noops))
         cert = tuple((r, p) for r, p in sorted(votes.items()))
         self._broadcast(NewView(view, self.name, cert, pps))
         for seq, cmd_id, origin, command, ts in pps:
             self._accept_preprepare(
-                PrePrepare(view, seq, cmd_id, origin, command, ts)
+                PrePrepare(view, seq, cmd_id, origin, command, ts),
+                skew_exempt=True,
             )
         for (origin, cmd_id), command in list(self.pending_requests.items()):
             if (origin, cmd_id) in self.seen_requests:
@@ -741,7 +936,15 @@ class BftReplica:
         until its own quorum arrives). A re-proposal a replica cannot
         back with its own votes is rejected — worst case the request
         re-times-out into the next view (liveness deferred), never an
-        unbacked command executing (safety kept)."""
+        unbacked command executing (safety kept). The same stance
+        covers vote-set skew around no-ops: if the primary's quorum
+        missed the one vote certifying an entry and no-op-filled its
+        seq, a validator holding that vote rejects the whole NEW-VIEW
+        rather than risk a possibly-committed entry — transient
+        liveness loss (the next timeout retries with more votes
+        circulated), and impossible for committed entries under an
+        honest primary (a committed entry's certificate is in EVERY
+        2f+1 vote quorum, so an honest primary never no-ops it)."""
         if sender != m.primary or m.primary not in self.peers:
             return
         if m.view < self.view:
@@ -762,6 +965,12 @@ class BftReplica:
         for seq, cmd_id, origin, command, ts in m.preprepares:
             ref = merged.get(seq)
             if ref is None:
+                if (
+                    _canon(command) == NOOP
+                    and origin == m.primary
+                    and cmd_id == -seq
+                ):
+                    continue   # gap filler over an uncertified hole
                 return   # re-proposal not backed by our evidence
             _v, r_cmd_id, r_origin, r_command, r_ts = ref
             if (r_cmd_id, r_origin, r_ts) != (cmd_id, origin, ts) or (
@@ -776,7 +985,8 @@ class BftReplica:
         for seq, cmd_id, origin, command, ts in m.preprepares:
             self._note_seq(seq, m.primary)
             self._accept_preprepare(
-                PrePrepare(m.view, seq, cmd_id, origin, command, ts)
+                PrePrepare(m.view, seq, cmd_id, origin, command, ts),
+                skew_exempt=True,
             )
 
     # -- dispatch ------------------------------------------------------------
@@ -889,6 +1099,58 @@ class BFTNotaryService:
         replica.validate_reply = self._validate_reply
         replica.snapshot_fn = self._snapshot
         replica.restore_fn = self._restore
+        # proof-carrying view changes: replicas sign their PREPAREs so
+        # prepared certificates verify independently of the fabric
+        replica.sign_prepare_fn = self._sign_prepare
+        replica.verify_prepare_fn = self._verify_prepare
+
+    # -- prepared-certificate signatures (PBFT view-change evidence) ---------
+
+    def _prepare_hash(self, view: int, seq: int, digest: bytes):
+        """Domain-separated signing payload for a PREPARE attestation:
+        bound to the cluster name and (view, seq, digest) so a
+        certificate entry cannot be replayed across clusters, views or
+        sequence slots."""
+        from ..crypto.hashes import SecureHash
+
+        return SecureHash.sha256(
+            b"bft-prepare\x00"
+            + self.replica.cluster.encode()
+            + b"\x00"
+            + view.to_bytes(8, "big")
+            + seq.to_bytes(8, "big")
+            + digest
+        )
+
+    def _sign_prepare(self, view: int, seq: int, digest: bytes):
+        return self.services.key_management.sign(
+            self._prepare_hash(view, seq, digest),
+            self._member_key
+            or self.services.my_info.legal_identity.owning_key,
+        )
+
+    def _verify_prepare(
+        self, replica_name: str, view: int, seq: int, digest: bytes, sig
+    ) -> bool:
+        from ..crypto.tx_signature import TransactionSignature
+
+        if not isinstance(sig, TransactionSignature):
+            return False
+        # fail CLOSED on an unknown replica name: verifying against the
+        # attestation's own embedded key would leave the claimed
+        # identity unbound — a byzantine replica could sign with its
+        # own key and label the entry with any honest peer's name,
+        # fabricating a 2f+1 certificate. (Reply validation tolerates a
+        # missing key because replies need f+1 AGREEING replicas;
+        # certificate entries are each load-bearing.)
+        expected = self._member_keys.get(replica_name)
+        if expected is None or sig.by != expected:
+            return False
+        try:
+            sig.verify(self._prepare_hash(view, seq, digest))
+        except Exception:
+            return False
+        return True
 
     # -- state transfer (BFTSMaRt.kt:219 getSnapshot/installSnapshot) --------
 

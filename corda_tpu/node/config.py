@@ -99,6 +99,9 @@ class NodeConfig:
     # operator contact submitted with the signing request
     # (NodeConfiguration.kt emailAddress)
     email: str = ""
+    # optional out-of-band pinned network-root certificate (PEM file):
+    # registration refuses a returned chain under any other root
+    network_root_file: str = ""
 
     def __post_init__(self):
         if not self.name:
@@ -229,6 +232,8 @@ def write_config(cfg: NodeConfig, path: str) -> None:
         emit("registration_server", cfg.registration_server)
     if cfg.email:
         emit("email", cfg.email)
+    if cfg.network_root_file:
+        emit("network_root_file", cfg.network_root_file)
     if cfg.cluster_peers:
         peers = ", ".join(quote(p) for p in cfg.cluster_peers)
         lines.append(f"cluster_peers = [{peers}]")

@@ -337,7 +337,13 @@ class Node:
             # file layout: key PEM, then leaf cert, then the CA chain;
             # the fabric serves (and peers pin) the leaf only
             marker = b"-----BEGIN CERTIFICATE-----"
-            leaf_start = blob.index(marker)
+            leaf_start = blob.find(marker)
+            if leaf_start == -1:
+                raise RuntimeError(
+                    f"{tls_pem} contains no CERTIFICATE block — the "
+                    "file is corrupt or truncated; restore it or "
+                    "delete it and re-run --initial-registration"
+                )
             leaf_end = blob.index(marker, leaf_start + 1) \
                 if blob.count(marker) > 1 else len(blob)
             return TlsIdentity(

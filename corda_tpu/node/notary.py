@@ -99,6 +99,13 @@ def restore_uniqueness_map(state) -> dict:
 class UniquenessProvider:
     """stateRef -> consuming-tx registry; the core consensus primitive."""
 
+    # True on providers whose commit completes inline on this host
+    # (in-memory, sqlite): the batching notary then drains a whole
+    # flush through ONE commit_many call instead of a future +
+    # callback per transaction. Distributed providers (Raft, BFT)
+    # stay False — their commits resolve on cluster consensus.
+    batch_synchronous = False
+
     def commit(
         self, states: list[StateRef], tx_id: SecureHash, requester: Party
     ) -> None:
@@ -120,12 +127,31 @@ class UniquenessProvider:
             fut.set_exception(e)
         return fut
 
+    def commit_many(self, entries) -> list:
+        """Batched commit: `entries` is [(states, tx_id, requester)];
+        returns one outcome per entry, in order — None on success or
+        the exception (UniquenessConflict etc.) that entry raised.
+        Semantics are EXACTLY sequential commit in list order: an
+        earlier entry's refs are committed before a later conflicting
+        entry is checked, so intra-batch double spends resolve
+        first-wins like they would one call at a time."""
+        out = []
+        for states, tx_id, requester in entries:
+            try:
+                self.commit(states, tx_id, requester)
+                out.append(None)
+            except Exception as e:   # noqa: BLE001 - per-entry outcome
+                out.append(e)
+        return out
+
 
 class InMemoryUniquenessProvider(UniquenessProvider):
     """Single-node map (reference: PersistentUniquenessProvider
     semantics, minus the JDBC persistence — see persistence.py for the
     sqlite-backed version). Commit is all-or-nothing: on any conflict
     nothing is recorded and the full conflict set is reported."""
+
+    batch_synchronous = True
 
     def __init__(self):
         self.committed: dict[StateRef, SecureHash] = {}
@@ -515,8 +541,8 @@ class BatchingNotaryService(NotaryService):
             return
         self.batches_dispatched += 1
         self.requests_batched += len(pending)
-        # phase 2 — per-tx validation + commit dispatch in arrival order
-        to_commit: list[tuple[_PendingNotarisation, Any]] = []
+        # phase 2 — per-tx validation in arrival order
+        eligible: list[_PendingNotarisation] = []
         for i, (p, (off, n), cerr) in enumerate(
             zip(pending, spans, contract_errs)
         ):
@@ -538,25 +564,23 @@ class BatchingNotaryService(NotaryService):
                         NotaryError("invalid-transaction", str(e))
                     )
                     continue
-            to_commit.append(
-                (
-                    p,
-                    self.uniqueness.commit_async(
-                        list(p.stx.wtx.inputs), p.stx.id, p.requester
-                    ),
-                )
-            )
-        t = self._mark("validate_commit", t)
-        if not to_commit:
+            eligible.append(p)
+        t = self._mark("validate", t)
+        if not eligible:
             return
-        # phase 3 — once every commit resolves, ONE Merkle-batch notary
-        # signature over all committed ids, scattered with per-tx
-        # inclusion proofs (host signing is ~70 µs/signature — per-tx
-        # signing alone would cap the serving rate near 14k tx/s)
-        committed: dict[int, _PendingNotarisation] = {}
-        remaining = [len(to_commit)]
 
-        def finalize() -> None:
+        def conflict_error(e: UniquenessConflict) -> NotaryError:
+            return NotaryError(
+                "conflict",
+                str(e),
+                conflict={str(r): h for r, h in e.conflict.items()},
+            )
+
+        def finalize(committed: dict[int, _PendingNotarisation]) -> None:
+            # ONE Merkle-batch notary signature over all committed ids,
+            # scattered with per-tx inclusion proofs (host signing is
+            # ~70 µs/signature — per-tx signing alone would cap the
+            # serving rate near 14k tx/s)
             if not committed:
                 return
             order = sorted(committed)
@@ -574,29 +598,64 @@ class BatchingNotaryService(NotaryService):
             for i, sig in zip(order, sigs):
                 committed[i].future.set_result(sig)
 
+        # phase 3 — uniqueness commit. A synchronous provider takes the
+        # WHOLE flush through one commit_many (one lock/DB transaction,
+        # no future+callback per tx); a distributed provider keeps the
+        # per-tx future path since each commit resolves on consensus.
+        if getattr(self.uniqueness, "batch_synchronous", False):
+            try:
+                outcomes = self.uniqueness.commit_many(
+                    [
+                        (list(p.stx.wtx.inputs), p.stx.id, p.requester)
+                        for p in eligible
+                    ]
+                )
+            except Exception as e:
+                # a failed batch write (db locked, disk error) must
+                # answer every waiting requester, not strand them and
+                # crash the pump tick — same contract as the phase-1
+                # dispatch failure path above
+                for p in eligible:
+                    p.future.set_result(
+                        NotaryError("commit-unavailable", str(e))
+                    )
+                return
+            committed: dict[int, _PendingNotarisation] = {}
+            for i, (p, err) in enumerate(zip(eligible, outcomes)):
+                if err is None:
+                    committed[i] = p
+                elif isinstance(err, UniquenessConflict):
+                    p.future.set_result(conflict_error(err))
+                else:
+                    p.future.set_result(
+                        NotaryError("commit-unavailable", str(err))
+                    )
+            t = self._mark("commit", t)
+            finalize(committed)
+            self._mark("sign_scatter", t)
+            return
+
+        committed_async: dict[int, _PendingNotarisation] = {}
+        remaining = [len(eligible)]
+
         def on_commit(f, i: int, p: _PendingNotarisation) -> None:
             try:
                 f.result()
             except UniquenessConflict as e:
-                p.future.set_result(
-                    NotaryError(
-                        "conflict",
-                        str(e),
-                        conflict={str(r): h for r, h in e.conflict.items()},
-                    )
-                )
+                p.future.set_result(conflict_error(e))
             except Exception as e:
                 p.future.set_result(NotaryError("commit-unavailable", str(e)))
             else:
-                committed[i] = p
+                committed_async[i] = p
             remaining[0] -= 1
             if remaining[0] == 0:
-                finalize()
+                finalize(committed_async)
 
-        for i, (p, fut) in enumerate(to_commit):
-            fut.add_done_callback(
-                lambda f, i=i, p=p: on_commit(f, i, p)
+        for i, p in enumerate(eligible):
+            fut = self.uniqueness.commit_async(
+                list(p.stx.wtx.inputs), p.stx.id, p.requester
             )
+            fut.add_done_callback(lambda f, i=i, p=p: on_commit(f, i, p))
         self._mark("sign_scatter", t)
 
     def _validate_one(

@@ -302,6 +302,8 @@ class PersistentUniquenessProvider(UniquenessProvider):
     PersistentUniquenessProvider.kt:20, commit at :63+). All-or-nothing:
     the conflict check and the inserts share one DB transaction."""
 
+    batch_synchronous = True
+
     def __init__(self, db: NodeDatabase):
         self._db = db
 
@@ -332,6 +334,57 @@ class PersistentUniquenessProvider(UniquenessProvider):
                         requester.name,
                     ),
                 )
+
+    def commit_many(self, entries) -> list:
+        """A whole notary flush in ONE DB transaction (the reference
+        batches JDBC work per CommitRequest the same way): sequential
+        first-wins semantics per entry, one executemany for all the
+        surviving inserts instead of a statement per StateRef."""
+        from .notary import UniquenessConflict
+
+        out = []
+        rows = []
+        with self._db.transaction() as conn:
+            # staged view: refs committed by EARLIER entries in this
+            # batch must conflict later ones exactly as sequential
+            # commits would
+            staged: dict = {}
+            for states, tx_id, requester in entries:
+                conflict = {}
+                for ref in states:
+                    prior = staged.get(ref)
+                    if prior is None:
+                        row = conn.execute(
+                            "SELECT consumer FROM notary_commits"
+                            " WHERE ref_tx=? AND ref_index=?",
+                            (ref.txhash.bytes_, ref.index),
+                        ).fetchone()
+                        if row is not None:
+                            prior = SecureHash(bytes(row[0]))
+                    if prior is not None and prior != tx_id:
+                        conflict[ref] = prior
+                if conflict:
+                    out.append(UniquenessConflict(conflict))
+                    continue
+                for ref in states:
+                    staged[ref] = tx_id
+                    rows.append(
+                        (
+                            ref.txhash.bytes_,
+                            ref.index,
+                            tx_id.bytes_,
+                            requester.name,
+                        )
+                    )
+                out.append(None)
+            if rows:
+                conn.executemany(
+                    "INSERT OR IGNORE INTO notary_commits"
+                    " (ref_tx, ref_index, consumer, requester)"
+                    " VALUES (?,?,?,?)",
+                    rows,
+                )
+        return out
 
     @property
     def committed_count(self) -> int:

@@ -132,8 +132,14 @@ class Doorman:
             + csr.public_key().public_bytes(_Enc.DER, _PubFmt.SubjectPublicKeyInfo)
         ).hexdigest()[:24]
         with self._lock:
-            if rid in self._requests:
+            prior = self._requests.get(rid)
+            if prior is not None and prior["status"] != "rejected":
                 return rid
+            # a resubmission of a previously-rejected request is
+            # re-evaluated fresh (round-3 advisor): the operator may
+            # have reversed a mistaken rejection or the conflicting
+            # name may have freed up — the deterministic request id
+            # must not wedge a subject+key on a stale rejection
             status = "approved" if self.auto_approve else "pending"
             reason = ""
             # the reference doorman auto-rejects rule-violating and
@@ -229,7 +235,7 @@ _PEM = _Enc.PEM
 class RegistrationService:
     """What the helper talks to: submit a CSR, poll for the chain."""
 
-    def submit_request(self, csr_pem: bytes) -> str:
+    def submit_request(self, csr_pem: bytes, email: str = "") -> str:
         raise NotImplementedError
 
     def retrieve_certificates(self, request_id: str) -> Optional[list[bytes]]:
@@ -242,8 +248,8 @@ class InProcessRegistrationService(RegistrationService):
     def __init__(self, doorman: Doorman):
         self.doorman = doorman
 
-    def submit_request(self, csr_pem: bytes) -> str:
-        return self.doorman.submit(csr_pem)
+    def submit_request(self, csr_pem: bytes, email: str = "") -> str:
+        return self.doorman.submit(csr_pem, email)
 
     def retrieve_certificates(self, request_id: str) -> Optional[list[bytes]]:
         return self.doorman.retrieve(request_id)
@@ -258,17 +264,22 @@ class HttpRegistrationService(RegistrationService):
     def __init__(self, server_url: str):
         self.server = server_url.rstrip("/")
 
-    def submit_request(self, csr_pem: bytes) -> str:
+    def submit_request(self, csr_pem: bytes, email: str = "") -> str:
         import urllib.request
 
+        headers = {
+            "Content-Type": "application/octet-stream",
+            "Client-Version": self.client_version,
+        }
+        if email:
+            # the reference submits emailAddress alongside the signing
+            # request (NetworkRegistrationHelper.kt:132)
+            headers["X-Email"] = email
         req = urllib.request.Request(
             f"{self.server}/api/certificate",
             data=csr_pem,
             method="POST",
-            headers={
-                "Content-Type": "application/octet-stream",
-                "Client-Version": self.client_version,
-            },
+            headers=headers,
         )
         with urllib.request.urlopen(req) as resp:
             return resp.read().decode()
@@ -365,7 +376,9 @@ class PermissioningServer:
                 body = self.rfile.read(length)
                 if self.path == "/api/certificate":
                     try:
-                        rid = outer.doorman.submit(body)
+                        rid = outer.doorman.submit(
+                            body, self.headers.get("X-Email", "")
+                        )
                     except ValueError as e:
                         self._send(400, str(e).encode())
                         return
@@ -434,13 +447,24 @@ class NetworkRegistrationHelper:
         poll_interval: float = 10.0,
         max_polls: Optional[int] = None,
         log=print,
+        email: str = "",
+        network_root_pem: Optional[bytes] = None,
     ):
+        """`email`: operator contact submitted with the CSR (the
+        reference's emailAddress, NetworkRegistrationHelper.kt:132).
+        `network_root_pem`: optional out-of-band pinned network root
+        certificate — when set, the returned chain's root must match
+        it byte-for-byte before anything is stored, closing the
+        registration-time MITM window the plain-http transport leaves
+        open (without it, trust-on-first-use like the reference)."""
         self.certs_dir = Path(base_dir) / "certificates"
         self.legal_name = legal_name
         self.service = service
         self.poll_interval = poll_interval
         self.max_polls = max_polls
         self.log = log
+        self.email = email
+        self.network_root_pem = network_root_pem
         self._request_id_file = self.certs_dir / "certificate-request-id.txt"
         self._temp_key_file = self.certs_dir / "selfsigned-key.pem"
         self.node_ca_file = self.certs_dir / "node-ca.pem"
@@ -468,8 +492,13 @@ class NetworkRegistrationHelper:
         try:
             chain_pems = self._poll(request_id)
         except CertificateRequestException:
-            # a rejected request must not wedge the node on a dead id
+            # a rejected request must not wedge the node: drop BOTH the
+            # dead id AND the in-flight key — the request id is a hash
+            # of subject+pubkey, so retrying over the same key would
+            # resolve to the same (rejected) request forever (round-3
+            # advisor)
             self._request_id_file.unlink(missing_ok=True)
+            self._temp_key_file.unlink(missing_ok=True)
             raise
 
         certs = [xu.load_cert(p) for p in chain_pems]
@@ -503,7 +532,7 @@ class NetworkRegistrationHelper:
             f"Submitting certificate signing request for "
             f"{self.legal_name!r} to the permissioning server."
         )
-        rid = self.service.submit_request(xu.csr_pem(csr))
+        rid = self.service.submit_request(xu.csr_pem(csr), self.email)
         self._request_id_file.write_text(rid)
         self.log(f"Successfully submitted request, request ID: {rid}.")
         return rid
@@ -533,6 +562,14 @@ class NetworkRegistrationHelper:
             raise CertificateRequestException(
                 "returned certificate chain does not validate"
             )
+        if self.network_root_pem is not None:
+            pinned = xu.load_cert(self.network_root_pem)
+            if certs[-1].public_bytes(_PEM) != pinned.public_bytes(_PEM):
+                raise CertificateRequestException(
+                    "returned chain's root does not match the pinned "
+                    "network root (network_root_file) — possible MITM "
+                    "on the registration transport"
+                )
 
 
 def main(argv=None) -> int:
@@ -554,12 +591,35 @@ def main(argv=None) -> int:
         "--manual", action="store_true",
         help="hold requests for operator approval via /admin endpoints",
     )
+    parser.add_argument(
+        "--admin-token", default=None,
+        help="bearer token required on /admin calls; auto-generated "
+        "(and printed) when --manual binds a non-loopback host",
+    )
     args = parser.parse_args(argv)
 
+    token = args.admin_token
+    if (
+        token is None
+        and args.manual
+        and args.host not in ("127.0.0.1", "localhost", "::1")
+    ):
+        # an unauthenticated /admin/approve on a reachable port would
+        # let anyone self-admit to the network (round-3 advisor)
+        import secrets
+
+        token = secrets.token_urlsafe(16)
+        print(
+            f"ADMIN_TOKEN={token}  (auto-generated: --manual on a "
+            "non-loopback host without --admin-token)",
+            flush=True,
+        )
     doorman = Doorman.create(
         auto_approve=not args.manual, data_dir=args.data_dir
     )
-    server = PermissioningServer(doorman, args.host, args.port).start()
+    server = PermissioningServer(
+        doorman, args.host, args.port, admin_token=token
+    ).start()
     print(f"DOORMAN_URL={server.url}", flush=True)
     try:
         while True:

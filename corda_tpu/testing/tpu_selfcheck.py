@@ -180,7 +180,10 @@ def main(argv=None) -> int:
     import json
 
     parser = argparse.ArgumentParser(prog="corda_tpu.testing.tpu_selfcheck")
-    parser.add_argument("--n", type=int, default=256)
+    parser.add_argument(
+        "--n", type=int, default=None,
+        help="vector count (default 256; 2048 with --full)",
+    )
     parser.add_argument("--batch-size", type=int, default=256)
     parser.add_argument("--allow-cpu", action="store_true")
     parser.add_argument(
@@ -189,13 +192,12 @@ def main(argv=None) -> int:
     )
     parser.add_argument("--out", default="KERNEL_PARITY.json")
     args = parser.parse_args(argv)
+    n = args.n if args.n is not None else (2048 if args.full else 256)
     try:
         if args.full:
-            print(json.dumps(
-                run_full(max(args.n, 2048), args.allow_cpu, args.out)
-            ))
+            print(json.dumps(run_full(n, args.allow_cpu, args.out)))
         else:
-            print(json.dumps(run(args.n, args.batch_size, args.allow_cpu)))
+            print(json.dumps(run(n, args.batch_size, args.allow_cpu)))
     except RuntimeError as e:
         raise SystemExit(str(e))
     return 0

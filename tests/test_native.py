@@ -78,6 +78,45 @@ def test_merkle_root_rejects_bad_input(native):
         native.merkle_root([b"short"])
 
 
+def test_merkle_paths_matches_python_single_leaf_proofs(native):
+    """The batch-signing kernel (round-4 notary hot path): native
+    (root, sibling paths) must equal the pure-Python level walk, and
+    every produced proof must verify against the root."""
+    rng = random.Random(9)
+    for n in (1, 2, 3, 5, 8, 17, 33, 64):
+        leaves = [
+            SecureHash.sha256(rng.getrandbits(64).to_bytes(8, "big"))
+            for _ in range(n)
+        ]
+        root_b, paths = native.merkle_paths([h.bytes_ for h in leaves])
+        # python reference: explicit level walk (bypass the native path)
+        levels = merkle.merkle_levels(leaves)
+        assert bytes(root_b) == levels[-1][0].bytes_
+        assert len(paths) == n
+        for i0, p in enumerate(paths):
+            want = []
+            i = i0
+            for level in levels[:-1]:
+                want.append(level[i ^ 1].bytes_)
+                i //= 2
+            assert bytes(p) == b"".join(want)
+        # the integrated path produces verifying proofs
+        root, proofs = merkle.single_leaf_proofs(leaves)
+        assert root == levels[-1][0]
+        assert all(
+            merkle.verify_proofs(
+                [(pmt, root, [leaves[i]]) for i, pmt in enumerate(proofs)]
+            )
+        )
+
+
+def test_merkle_paths_rejects_bad_input(native):
+    with pytest.raises(ValueError):
+        native.merkle_paths([])
+    with pytest.raises(ValueError):
+        native.merkle_paths([b"short"])
+
+
 def test_transaction_ids_stable_with_and_without_native(native):
     """A WireTransaction id must not depend on which implementation
     hashed it (consensus!)."""

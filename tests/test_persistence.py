@@ -94,6 +94,44 @@ def test_conflict_is_all_or_nothing(tmp_path):
     up.commit([fresh], SecureHash.sha256(b"third"), party)
 
 
+def test_commit_many_matches_sequential_semantics(tmp_path):
+    """The batched flush commit (one DB transaction, round-4 notary
+    hot path) must be observationally identical to sequential commits:
+    first-wins inside the batch, conflicts reported per entry,
+    idempotent re-commits accepted, persisted like any other commit."""
+    from corda_tpu.node.notary import InMemoryUniquenessProvider
+
+    path = str(tmp_path / "n.db")
+    db = NodeDatabase(path)
+    kp = schemes.generate_keypair(seed=7)
+    party = Party("N", kp.public)
+    r1 = StateRef(SecureHash.sha256(b"x"), 0)
+    r2 = StateRef(SecureHash.sha256(b"x"), 1)
+    tx_a, tx_b, tx_c = (
+        SecureHash.sha256(s) for s in (b"a", b"b", b"c")
+    )
+    entries = [
+        ([r1], tx_a, party),          # commits
+        ([r1, r2], tx_b, party),      # intra-batch conflict on r1
+        ([r2], tx_c, party),          # r2 NOT burned by the failure
+        ([r1], tx_a, party),          # idempotent re-commit
+    ]
+    for up in (PersistentUniquenessProvider(db), InMemoryUniquenessProvider()):
+        assert up.batch_synchronous
+        out = up.commit_many(entries)
+        assert out[0] is None and out[2] is None and out[3] is None
+        assert isinstance(out[1], UniquenessConflict)
+        assert out[1].conflict[r1] == tx_a
+    db.close()
+    # ...and the batch landed in the DB like sequential commits would
+    db2 = NodeDatabase(path)
+    up2 = PersistentUniquenessProvider(db2)
+    with pytest.raises(UniquenessConflict):
+        up2.commit([r2], tx_b, party)
+    assert up2.committed_count == 2
+    db2.close()
+
+
 def test_nested_transaction_failure_preserves_outer_writes(tmp_path):
     """A caught inner-transaction failure (savepoint rollback) must not
     roll back the outer transaction's earlier writes nor leak its later
