@@ -570,10 +570,12 @@ def main() -> None:
     # headline p256 ALWAYS runs before the budget expires, LAST so
     # tail-line parsers record it.
     budget = float(os.environ.get("BENCH_TIME_BUDGET", "900"))
-    # wall-clock held back for the headline child: JAX startup + tiled
-    # fixture + warm-cache timing fit well under it, and the margin
-    # absorbs a cold kernel compile (minutes per scheme/shape)
-    reserve = float(os.environ.get("BENCH_HEADLINE_RESERVE", "420"))
+    # wall-clock held back for the headline child. With a warm AOT
+    # store (crypto/aot_store) the p256 child runs in ~60-90 s; the
+    # reserve covers the fresh-container worst case where the child
+    # must trace+lower the ladder once (~430 s measured) and save the
+    # artifact for every later run.
+    reserve = float(os.environ.get("BENCH_HEADLINE_RESERVE", "480"))
 
     def left() -> float:
         return budget - (time.perf_counter() - t_start)

@@ -44,6 +44,62 @@ class VerificationRequest:
     message: bytes
 
 
+class _AotLadder:
+    """Lazy AOT wrapper around one jitted ladder program.
+
+    First call loads the program's export artifact (crypto/aot_store)
+    — skipping the minutes of tracing + lowering a fresh process
+    otherwise pays — or, when no artifact exists, exports through the
+    jit fn (the ONE trace it would have done anyway) and saves the
+    artifact for every later process. Any failure anywhere falls back
+    permanently to the plain jit path; CORDA_TPU_AOT=0 bypasses the
+    store entirely."""
+
+    def __init__(self, fn, scheme_id: int, batch: int):
+        self._fn = fn
+        self._scheme_id = scheme_id
+        self._batch = batch
+        self._callable = None
+
+    def _build(self, staged):
+        from . import aot_store
+
+        if not aot_store.enabled():
+            return self._fn
+        from jax import export as jexport
+
+        exp = aot_store.load(self._scheme_id, self._batch)
+        if exp is None:
+            try:
+                exp = jexport.export(self._fn)(**staged)
+                aot_store.save(exp, self._scheme_id, self._batch)
+            except Exception:
+                return self._fn
+        call = jax.jit(exp.call)
+
+        def run(**kw):
+            return call(**kw)
+
+        return run
+
+    def __call__(self, **staged):
+        if self._callable is None:
+            try:
+                self._callable = self._build(staged)
+            except Exception:
+                # "any failure anywhere falls back": _build itself may
+                # raise (no jax.export on this jax, store path errors)
+                self._callable = self._fn
+        try:
+            return self._callable(**staged)
+        except Exception:
+            if self._callable is self._fn:
+                raise
+            # poisoned/incompatible artifact path: pin the jit fallback
+            self._callable = self._fn
+            return self._fn(**staged)
+
+
 class BatchSignatureVerifier:
     """SPI: verify a batch of signature requests, preserving order."""
 
@@ -103,7 +159,14 @@ class TpuBatchVerifier(BatchSignatureVerifier):
                 }[scheme_id]
                 inner = partial(ecdsa_verify_packed, curve)
             if self.mesh is None:
-                fn = jax.jit(partial(inner, use_pallas=None))
+                # AOT wrapper: tracing + lowering the ladder costs
+                # minutes per (scheme, batch); the wrapper loads a
+                # serialized export when one exists (crypto/aot_store)
+                # and pays the one trace otherwise
+                fn = _AotLadder(
+                    jax.jit(partial(inner, use_pallas=None)),
+                    scheme_id, batch,
+                )
             else:
                 # GSPMD has no partitioning rule for Mosaic custom
                 # calls, but shard_map sidesteps GSPMD: the kernel runs

@@ -6,7 +6,11 @@ Mounted at /api/cash:
   GET  /api/cash/balances            {currency: total} of unconsumed cash
   POST /api/cash/issue               {"quantity", "currency", "recipient",
                                       "notary"} -> issue via CashIssueFlow
-Static demo page at /web/cash/index.html.
+  POST /api/cash/pay                 {"quantity", "currency", "recipient"}
+                                     -> spend via CashPaymentFlow
+Static demo page at /web/cash/index.html. Both writes start flows over
+the gateway's RPC login, so RPCUserService's StartFlow.<flow>
+permission check applies exactly as for any RPC client.
 """
 
 from __future__ import annotations
@@ -40,11 +44,9 @@ def _issue(ctx, query, body):
         notary = str(body["notary"])
     except (KeyError, TypeError, ValueError) as e:
         return 400, {"error": f"bad issue request: {e}"}
-    parties = {}
-    for info in ctx.wait(ctx.client.network_map_snapshot()):
-        parties[info.legal_identity.name] = info.legal_identity
-    for p in ctx.wait(ctx.client.notary_identities()):
-        parties.setdefault(p.name, p)
+    if quantity <= 0:
+        return 400, {"error": "quantity must be positive"}
+    parties = _parties(ctx)
     if recipient not in parties or notary not in parties:
         return 400, {"error": "unknown recipient or notary party"}
     handle = ctx.wait(
@@ -54,6 +56,44 @@ def _issue(ctx, query, body):
             currency=currency,
             recipient=parties[recipient],
             notary=parties[notary],
+        )
+    )
+    stx = ctx.wait(handle.result)
+    return 200, {"tx_id": stx.id.bytes_.hex()}
+
+
+def _parties(ctx) -> dict:
+    parties = {}
+    for info in ctx.wait(ctx.client.network_map_snapshot()):
+        parties[info.legal_identity.name] = info.legal_identity
+    for p in ctx.wait(ctx.client.notary_identities()):
+        parties.setdefault(p.name, p)
+    return parties
+
+
+def _pay(ctx, query, body):
+    if not isinstance(body, dict):
+        return 400, {"error": "JSON object body required"}
+    try:
+        quantity = int(body["quantity"])
+        currency = str(body["currency"])
+        recipient = str(body["recipient"])
+    except (KeyError, TypeError, ValueError) as e:
+        return 400, {"error": f"bad pay request: {e}"}
+    if quantity <= 0:
+        # a negative quantity would build a change output exceeding the
+        # inputs (an opaque contract-violation 500); zero, a pointless
+        # self-move — reject both at the edge
+        return 400, {"error": "quantity must be positive"}
+    parties = _parties(ctx)
+    if recipient not in parties:
+        return 400, {"error": "unknown recipient party"}
+    handle = ctx.wait(
+        ctx.client.start_flow(
+            "corda_tpu.finance.cash.CashPaymentFlow",
+            quantity=quantity,
+            currency=currency,
+            recipient=parties[recipient],
         )
     )
     stx = ctx.wait(handle.result)
@@ -72,6 +112,7 @@ CASH_WEB_API = WebApiPlugin(
     routes=(
         ("GET", "balances", _balances),
         ("POST", "issue", _issue),
+        ("POST", "pay", _pay),
     ),
     static=(("index.html", "text/html", _INDEX),),
 )

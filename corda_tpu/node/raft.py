@@ -82,15 +82,38 @@ class AppendReply:
 class InstallSnapshot:
     """Leader→lagging-follower state transfer (Raft §7): the follower's
     next entry was compacted away, so ship the state machine snapshot
-    instead of replaying from genesis. Copycat does the same for the
-    reference's RaftUniquenessProvider (RaftUniquenessProvider.kt:41
-    delegates storage/compaction to Copycat)."""
+    instead of replaying from genesis. Copycat streams snapshots the
+    same way for the reference's RaftUniquenessProvider
+    (RaftUniquenessProvider.kt:41 delegates storage/compaction to
+    Copycat).
+
+    Chunked per §7 (offset/done): `data` is a slice of the CTS-encoded
+    snapshot at `offset`; a real uniqueness map (millions of
+    StateRefs) encodes far past the fabric's frame limit, so one
+    message cannot carry it. The transfer is follower-paced: each
+    chunk is acked with a SnapshotAck naming the next offset wanted,
+    and the leader answers statelessly from its cached blob — a lost
+    chunk heals when the heartbeat re-sends chunk 0 and the follower
+    re-acks its true position."""
 
     term: int
     leader: str
     last_included_index: int
     last_included_term: int
-    state: Any              # snapshot_fn() output, ser-encodable
+    offset: int             # byte position of `data` in the blob
+    data: bytes             # one chunk of ser.encode(snapshot state)
+    done: bool              # True on the final chunk
+    total: int              # full blob size (progress/validation)
+
+
+@dataclass(frozen=True)
+class SnapshotAck:
+    """Follower→leader: got chunks up to `next_offset`; send more."""
+
+    term: int
+    follower: str
+    last_included_index: int
+    next_offset: int
 
 
 @dataclass(frozen=True)
@@ -111,7 +134,7 @@ class ClientResult:
 
 for _cls in (
     RequestVote, VoteReply, AppendEntries, AppendReply,
-    InstallSnapshot, ClientCommand, ClientResult,
+    InstallSnapshot, SnapshotAck, ClientCommand, ClientResult,
 ):
     ser.serializable(_cls)
 
@@ -128,6 +151,9 @@ class RaftConfig:
     # take a state-machine snapshot and truncate the log every N
     # applied entries (0 disables; requires snapshot_fn/restore_fn)
     snapshot_interval: int = 1024
+    # InstallSnapshot chunk size, bytes — comfortably under the
+    # fabric's 64 MiB frame limit with CTS overhead to spare
+    snapshot_chunk_bytes: int = 1 << 20
 
 
 _RAFT_SCHEMA = """
@@ -205,6 +231,16 @@ class RaftNode:
         self.snap_index = 0
         self.snap_term = 0
         self._snap_state: Any = None   # last snapshot payload (for IS)
+        # leader: cached ser.encode(_snap_state), keyed by snap_index,
+        # answering SnapshotAck chunk requests without re-encoding
+        self._snap_blob: Optional[bytes] = None
+        self._snap_blob_index = -1
+        # leader: peer -> (snap_index, last_chunk_sent_micros) — gates
+        # heartbeat re-initiation so one transfer runs per follower
+        self._snap_inflight: dict[str, tuple] = {}
+        # follower: in-progress chunked transfer —
+        # (leader, last_included_index, last_included_term, buffer)
+        self._snap_incoming: Optional[tuple] = None
         self.log: list[tuple[int, Any]] = []   # [(term, command)]
         self._load()
 
@@ -450,14 +486,24 @@ class RaftNode:
         prev = nxt - 1
         if prev < self.snap_index:
             # the follower needs entries the log no longer holds:
-            # transfer the snapshot instead (Raft §7)
-            self._send(
-                peer,
-                InstallSnapshot(
-                    self.term, self.name,
-                    self.snap_index, self.snap_term, self._snap_state,
-                ),
-            )
+            # transfer the snapshot instead (Raft §7). Initiate with
+            # chunk 0 and let the follower's SnapshotAcks pull the
+            # rest — but do NOT re-initiate on every heartbeat while
+            # the ack-driven chain is making progress: each redundant
+            # chunk 0 would spawn a parallel chunk/ack chain (the
+            # follower re-acks its true position on duplicates) and
+            # the transfer would amplify linearly with its own
+            # duration. Only a stalled transfer (no chunk sent for a
+            # few heartbeats — a lost chunk or ack) is re-kicked.
+            now = self.clock.now_micros()
+            st = self._snap_inflight.get(peer)
+            if (
+                st is not None
+                and st[0] == self.snap_index
+                and now - st[1] < 4 * self.config.heartbeat_micros
+            ):
+                return
+            self._send_snapshot_chunk(peer, 0)
             return
         off = prev - self.snap_index
         entries = tuple(
@@ -522,6 +568,9 @@ class RaftNode:
             self._on_append(m, msg.sender)
         elif isinstance(m, InstallSnapshot):
             self._on_install_snapshot(m, msg.sender)
+        elif isinstance(m, SnapshotAck):
+            if msg.sender == m.follower:
+                self._on_snapshot_ack(m)
         elif isinstance(m, AppendReply):
             self._on_append_reply(m)
         elif isinstance(m, ClientCommand):
@@ -645,6 +694,46 @@ class RaftNode:
                 return
             self._send_append(m.follower)
 
+    def _snapshot_blob(self) -> bytes:
+        if self._snap_blob_index != self.snap_index or self._snap_blob is None:
+            self._snap_blob = ser.encode(self._snap_state)
+            self._snap_blob_index = self.snap_index
+        return self._snap_blob
+
+    def _send_snapshot_chunk(self, peer: str, offset: int) -> None:
+        blob = self._snapshot_blob()
+        chunk = max(1, self.config.snapshot_chunk_bytes)
+        offset = min(max(offset, 0), len(blob))
+        data = blob[offset : offset + chunk]
+        self._snap_inflight[peer] = (
+            self.snap_index, self.clock.now_micros(),
+        )
+        self._send(
+            peer,
+            InstallSnapshot(
+                self.term, self.name, self.snap_index, self.snap_term,
+                offset, data, offset + len(data) >= len(blob), len(blob),
+            ),
+        )
+
+    def _on_snapshot_ack(self, m: SnapshotAck) -> None:
+        """Stateless chunk server: answer each ack with the chunk the
+        follower asks for next. An ack for a superseded snapshot (we
+        compacted again mid-transfer) restarts it at chunk 0 of the
+        current one."""
+        self._maybe_step_down(m.term)
+        if self.role != LEADER or m.term != self.term:
+            return
+        if m.follower not in self.peers:
+            return
+        if m.last_included_index != self.snap_index:
+            self._send_snapshot_chunk(m.follower, 0)
+            return
+        if m.next_offset < len(self._snapshot_blob()):
+            self._send_snapshot_chunk(m.follower, m.next_offset)
+        # else: the follower holds every byte and is installing; its
+        # final AppendReply advances next_index past the snapshot
+
     def _maybe_advance_commit(self) -> None:
         for idx in range(self.last_log_index, self.commit_index, -1):
             if self._term_at(idx) != self.term:
@@ -734,6 +823,55 @@ class RaftNode:
         self.votes = set()
         self._election_deadline = self._fresh_election_deadline()
         self._flush_parked()
+        # -- chunk assembly (Raft §7 offset/done) -------------------------
+        if not (m.done and m.offset == 0):
+            key = (m.leader, m.last_included_index, m.last_included_term)
+            buf = (
+                self._snap_incoming[3]
+                if self._snap_incoming is not None
+                and self._snap_incoming[:3] == key
+                else None
+            )
+            if m.offset == 0:
+                if buf and not m.done:
+                    # duplicate heartbeat-paced chunk 0 mid-transfer:
+                    # re-ack our true position instead of restarting,
+                    # which also heals a lost chunk/ack
+                    self._send(
+                        m.leader,
+                        SnapshotAck(
+                            self.term, self.name,
+                            m.last_included_index, len(buf),
+                        ),
+                    )
+                    return
+                buf = bytearray()
+                self._snap_incoming = (*key, buf)
+            elif buf is None or m.offset != len(buf):
+                # out-of-order / superseded chunk: report where we
+                # really are (0 if we hold nothing for this snapshot)
+                self._send(
+                    m.leader,
+                    SnapshotAck(
+                        self.term, self.name, m.last_included_index,
+                        len(buf) if buf is not None else 0,
+                    ),
+                )
+                return
+            buf += bytes(m.data)
+            if not m.done:
+                self._send(
+                    m.leader,
+                    SnapshotAck(
+                        self.term, self.name,
+                        m.last_included_index, len(buf),
+                    ),
+                )
+                return
+            self._snap_incoming = None
+            state = ser.decode(bytes(buf))
+        else:
+            state = ser.decode(bytes(m.data))
         if m.last_included_index > self.last_applied:
             if self.restore_fn is None:
                 # cannot install: answer failure rather than hang the
@@ -742,7 +880,7 @@ class RaftNode:
                     m.leader, AppendReply(self.term, self.name, False, 0)
                 )
                 return
-            self.restore_fn(m.state)
+            self.restore_fn(state)
             keep_suffix = (
                 m.last_included_index <= self.last_log_index
                 and self._term_at(m.last_included_index)
@@ -754,7 +892,7 @@ class RaftNode:
                 self.log = []
             self.snap_index = m.last_included_index
             self.snap_term = m.last_included_term
-            self._snap_state = m.state
+            self._snap_state = state
             self.last_applied = self.snap_index
             self.commit_index = max(self.commit_index, self.snap_index)
             if self._db is not None:

@@ -281,6 +281,13 @@ class CordaRPCOpsImpl:
         return self.services.validated_transactions.count()
 
     @rpc_method
+    def transaction_by_id(self, tx_id):
+        """One verified transaction (or None) without copying the
+        store — the explorer's detail view resolves a transaction and
+        its inputs' source transactions this way."""
+        return self.services.validated_transactions.get(tx_id)
+
+    @rpc_method
     def verified_transactions_feed(self) -> DataFeed:
         store = self.services.validated_transactions
         updates = Observable()

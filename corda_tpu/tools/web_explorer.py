@@ -16,7 +16,20 @@ Mounted at /api/explorer (JSON) and /web/explorer/ (the page):
   GET /api/explorer/states          unconsumed states with contract tag
   GET /api/explorer/transactions    verified transaction summaries
                                     (?limit=N, newest last)
+  GET /api/explorer/tx?id=<hex>     one transaction in full: resolved
+                                    inputs, outputs, commands+signers,
+                                    signatures, and the tear-off
+                                    structure (component groups with
+                                    the notary-revealed flags) — the
+                                    reference explorer's
+                                    TransactionViewer.kt detail pane
   GET /api/explorer/machines        in-flight flow state machines
+
+The page also carries the reference explorer's "new transaction"
+action (views/cordapps/cash NewTransaction.kt): cash issue and pay
+forms posting to the finance CorDapp's /api/cash routes. Writes ride
+the gateway's RPC login, so the node's RPCUserService permission check
+(StartFlow.<flow>) gates them exactly like any RPC client.
 
 Usage: import this module (registers the plugin) before starting the
 gateway — `corda_tpu.node` does it for every node with a webserver
@@ -70,6 +83,7 @@ def _dashboard(ctx, query, body):
             {
                 "name": info.legal_identity.name,
                 "services": list(info.advertised_services),
+                "address": getattr(info, "address", None),
             }
             for info in sorted(infos, key=lambda i: i.legal_identity.name)
         ],
@@ -109,6 +123,7 @@ def _transactions(ctx, query, body):
         "transactions": [
             {
                 "id": stx.id.prefix_chars(12),
+                "full_id": stx.id.bytes_.hex(),
                 "inputs": len(stx.wtx.inputs),
                 "outputs": len(stx.wtx.outputs),
                 "commands": [
@@ -118,6 +133,98 @@ def _transactions(ctx, query, body):
                 "signatures": len(stx.sigs),
             }
             for stx in (txs[-limit:] if limit else [])
+        ],
+    }
+
+
+def _tx_detail(ctx, query, body):
+    """One transaction in full — the reference explorer's
+    TransactionViewer detail pane (TransactionViewer.kt: inputs
+    resolved to their source outputs, outputs, commands with signers,
+    signatures) plus the Merkle tear-off structure: each component
+    group's size and whether a non-validating notary's tear-off
+    reveals it (FilteredTransaction; notary completeness checks in
+    node/notary.py)."""
+    from ..core.transactions import (
+        G_ATTACHMENTS, G_COMMANDS, G_INPUTS, G_NOTARY, G_OUTPUTS,
+        G_TIMEWINDOW,
+    )
+    from ..crypto.hashes import SecureHash
+
+    tx_hex = (query.get("id", [""])[0] or "").strip()
+    try:
+        tx_id = SecureHash(bytes.fromhex(tx_hex))
+    except (ValueError, TypeError):
+        return 400, {"error": "id must be the full 64-hex-char tx id"}
+    stx = ctx.wait(ctx.client.transaction_by_id(tx_id))
+    if stx is None:
+        return 404, {"error": f"no verified transaction {tx_hex}"}
+    wtx = stx.wtx
+    # one fetch per DISTINCT source tx (coin selection routinely spends
+    # several outputs of one issue/change tx; each RPC is a blocking
+    # round trip on a remote gateway)
+    sources = {
+        h: ctx.wait(ctx.client.transaction_by_id(h))
+        for h in {ref.txhash for ref in wtx.inputs}
+    }
+    inputs = []
+    for ref in wtx.inputs:
+        src = sources[ref.txhash]
+        state = None
+        if src is not None and ref.index < len(src.wtx.outputs):
+            ts = src.wtx.outputs[ref.index]
+            state = {
+                "contract": ts.contract,
+                "data": js.to_jsonable(ts.data),
+            }
+        inputs.append(
+            {
+                "ref": f"{ref.txhash.bytes_.hex()}:{ref.index}",
+                "state": state,   # None when the source tx is unknown
+            }
+        )
+    groups = (
+        (G_INPUTS, "inputs", len(wtx.inputs)),
+        (G_OUTPUTS, "outputs", len(wtx.outputs)),
+        (G_COMMANDS, "commands", len(wtx.commands)),
+        (G_ATTACHMENTS, "attachments", len(wtx.attachments)),
+        (G_NOTARY, "notary", 1 if wtx.notary else 0),
+        (G_TIMEWINDOW, "time_window", 1 if wtx.time_window else 0),
+    )
+    revealed = {G_INPUTS, G_NOTARY, G_TIMEWINDOW}
+    return 200, {
+        "id": stx.id.bytes_.hex(),
+        "notary": wtx.notary.name if wtx.notary else None,
+        "time_window": js.to_jsonable(wtx.time_window),
+        "inputs": inputs,
+        "outputs": [
+            {
+                "index": i,
+                "contract": ts.contract,
+                "notary": ts.notary.name if ts.notary else None,
+                "data": js.to_jsonable(ts.data),
+            }
+            for i, ts in enumerate(wtx.outputs)
+        ],
+        "commands": [
+            {
+                "command": type(c.value).__name__,
+                "value": js.to_jsonable(c.value),
+                "signers": [js.to_jsonable(k) for k in c.signers],
+            }
+            for c in wtx.commands
+        ],
+        "attachments": [a.bytes_.hex() for a in wtx.attachments],
+        "signatures": [js.to_jsonable(s) for s in stx.sigs],
+        # the Merkle tear-off shape: id = root over these groups; a
+        # non-validating notary sees only the `revealed` ones
+        "tear_off": [
+            {
+                "group": name,
+                "components": count,
+                "revealed_to_nonvalidating_notary": g in revealed,
+            }
+            for g, name, count in groups
         ],
     }
 
@@ -152,10 +259,24 @@ _PAGE = b"""<!doctype html>
 <table id="balances"></table>
 <h2>network</h2>
 <table id="network"></table>
+<h2>cash actions</h2>
+<p>
+  <label>quantity <input id="act-qty" size="8" value="100"></label>
+  <label>currency <input id="act-ccy" size="4" value="USD"></label>
+  <label>recipient <input id="act-to" size="12"></label>
+  <label>notary (issue) <input id="act-notary" size="12"></label>
+  <button onclick="cashAction('issue')">issue</button>
+  <button onclick="cashAction('pay')">pay</button>
+  <span id="act-out"></span>
+</p>
 <h2>unconsumed states</h2>
 <table id="states"></table>
-<h2>transactions (newest last)</h2>
+<h2>transactions (newest last; click an id for detail)</h2>
 <table id="txs"></table>
+<h2>transaction detail</h2>
+<p><input id="txid" size="66" placeholder="full 64-hex tx id">
+   <button onclick="showTx(q('txid').value)">show</button></p>
+<pre id="txdetail"></pre>
 <h2>flows in flight</h2>
 <table id="machines"></table>
 <script>
@@ -169,6 +290,31 @@ const row = cells => "<tr>" +
   cells.map(c => "<td>" + esc(c) + "</td>").join("") + "</tr>";
 const head = cells => "<tr>" +
   cells.map(c => "<th>" + esc(c) + "</th>").join("") + "</tr>";
+async function showTx(id) {
+  // hex-only id: a non-hex value is rejected server-side with a 400
+  const r = await fetch("/api/explorer/tx?id=" + encodeURIComponent(id));
+  // textContent, not innerHTML: detail JSON embeds ledger data
+  q("txdetail").textContent = JSON.stringify(await r.json(), null, 2);
+  q("txid").value = id;
+}
+async function cashAction(kind) {
+  const body = {
+    quantity: Number(q("act-qty").value),
+    currency: q("act-ccy").value,
+    recipient: q("act-to").value,
+  };
+  if (kind === "issue") body.notary = q("act-notary").value;
+  q("act-out").textContent = "...";
+  const r = await fetch("/api/cash/" + kind, {
+    method: "POST",
+    headers: {"Content-Type": "application/json"},
+    body: JSON.stringify(body),
+  });
+  const out = await r.json();
+  q("act-out").textContent =
+    r.ok ? "tx " + out.tx_id.slice(0, 12) : "failed: " + out.error;
+  refresh();
+}
 async function refresh() {
   try {
     const dash = await (await fetch("/api/explorer/dashboard")).json();
@@ -181,8 +327,9 @@ async function refresh() {
     q("balances").innerHTML = Object.keys(dash.balances).sort().map(
       p => row([p, dash.balances[p].toLocaleString()])).join("")
       || row(["(empty vault)", ""]);
-    q("network").innerHTML = head(["peer", "services"]) + dash.peers.map(
-      p => row([p.name, p.services.join(",")])).join("");
+    q("network").innerHTML = head(["peer", "address", "services"]) +
+      dash.peers.map(
+        p => row([p.name, p.address || "-", p.services.join(",")])).join("");
     const st = await (await fetch("/api/explorer/states")).json();
     q("states").innerHTML = head(["ref", "contract", "notary"]) +
       st.states.map(s => row([s.ref, s.contract, s.notary])).join("");
@@ -190,8 +337,11 @@ async function refresh() {
       "/api/explorer/transactions?limit=20")).json();
     q("txs").innerHTML = head(
       ["id", "in", "out", "commands", "notary", "sigs"]) +
-      tx.transactions.map(t => row([t.id, t.inputs, t.outputs,
-        t.commands.join(","), t.notary || "-", t.signatures])).join("");
+      tx.transactions.map(t => "<tr><td><a href=\\"#txid\\" onclick=\\"" +
+        "showTx('" + esc(t.full_id) + "')\\">" + esc(t.id) + "</a></td>" +
+        [t.inputs, t.outputs, t.commands.join(","), t.notary || "-",
+         t.signatures].map(c => "<td>" + esc(c) + "</td>").join("") +
+        "</tr>").join("");
     const sm = await (await fetch("/api/explorer/machines")).json();
     q("machines").innerHTML = sm.machines.map(
       m => row([m.flow_id.slice(0, 12), m.flow])).join("")
@@ -210,6 +360,7 @@ EXPLORER_WEB = WebApiPlugin(
         ("GET", "dashboard", _dashboard),
         ("GET", "states", _states),
         ("GET", "transactions", _transactions),
+        ("GET", "tx", _tx_detail),
         ("GET", "machines", _machines),
     ),
     # both spellings: /web/explorer/ and /web/explorer/index.html

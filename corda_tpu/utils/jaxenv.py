@@ -47,3 +47,21 @@ def enable_compile_cache() -> None:
         jax.config.update("jax_persistent_cache_enable_xla_caches", "all")
     except Exception:
         pass  # knob not present on older jax
+    # jax gates the persistent cache on a platform-name allowlist
+    # ("tpu"/"gpu"/"cpu"/"neuron") checked ONCE per process by
+    # whichever backend compiles first — the tunneled "axon" TPU
+    # plugin is not on it, so a process whose first compile lands on
+    # axon silently loses the cache and re-pays minutes of
+    # Mosaic/XLA compile per (scheme, shape). The plugin serializes
+    # executables fine (entries round-trip whenever a CPU compile
+    # happened to win that one-shot race), so flip the global check
+    # to "used". Private API, guarded: on a jax without these
+    # attributes this is a no-op and the allowlist behavior stands.
+    try:
+        from jax._src import compilation_cache as _cc
+
+        with _cc._cache_initialized_mutex:
+            _cc._cache_checked = True
+            _cc._cache_used = True
+    except Exception:
+        pass

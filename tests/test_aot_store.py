@@ -1,0 +1,101 @@
+"""AOT export artifacts for the ladder programs (crypto/aot_store).
+
+The store must (1) round-trip a program through serialize/deserialize
+with identical results, (2) never serve an artifact across a code or
+trace-knob change, (3) fall back to the plain jit path on any
+corruption, and (4) keep the verifier bit-exact against the CPU
+reference when artifacts ARE served. Runs on the conftest CPU mesh —
+the artifact machinery is backend-agnostic (the key embeds the
+platform)."""
+
+import os
+import random
+
+import pytest
+
+from corda_tpu.crypto import aot_store, schemes
+from corda_tpu.crypto.batch_verifier import (
+    CpuBatchVerifier,
+    TpuBatchVerifier,
+    VerificationRequest,
+)
+
+
+@pytest.fixture
+def store(tmp_path, monkeypatch):
+    monkeypatch.setenv("CORDA_TPU_AOT_DIR", str(tmp_path))
+    monkeypatch.delenv("CORDA_TPU_AOT", raising=False)
+    return tmp_path
+
+
+def _reqs(n=6, seed=3):
+    rng = random.Random(seed)
+    kp = schemes.generate_keypair(
+        schemes.ECDSA_SECP256R1_SHA256, seed=rng.getrandbits(64)
+    )
+    out = []
+    for i in range(n):
+        msg = rng.randbytes(40)
+        sig = kp.private.sign(msg)
+        if i % 3 == 2:
+            msg = msg + b"!"
+        out.append(VerificationRequest(kp.public, sig, msg))
+    return out
+
+
+def test_artifact_roundtrip_and_reuse(store):
+    reqs = _reqs()
+    want = CpuBatchVerifier().verify_batch(reqs)
+    got = TpuBatchVerifier(batch_sizes=(8,)).verify_batch(reqs)
+    assert got == want
+    arts = [f for f in os.listdir(store) if f.endswith(".jaxexport")]
+    assert len(arts) == 1   # the p256@8 program was exported
+    # a second verifier (fresh kernels dict) LOADS the artifact — and
+    # the results stay bit-exact vs the CPU reference
+    got2 = TpuBatchVerifier(batch_sizes=(8,)).verify_batch(reqs)
+    assert got2 == want
+    assert len(os.listdir(store)) == 1   # reused, not rebuilt
+
+
+def test_corrupt_artifact_falls_back_and_is_dropped(store):
+    reqs = _reqs()
+    want = CpuBatchVerifier().verify_batch(reqs)
+    assert TpuBatchVerifier(batch_sizes=(8,)).verify_batch(reqs) == want
+    [art] = [f for f in os.listdir(store) if f.endswith(".jaxexport")]
+    path = os.path.join(store, art)
+    with open(path, "wb") as f:
+        f.write(b"garbage, not a serialized export")
+    # corrupt artifact: dropped, jit path used, answers still right
+    assert TpuBatchVerifier(batch_sizes=(8,)).verify_batch(reqs) == want
+    assert not os.path.exists(path) or open(path, "rb").read() != (
+        b"garbage, not a serialized export"
+    )
+
+
+def test_key_tracks_code_and_knobs(store, monkeypatch):
+    p1 = aot_store._artifact_path(schemes.ECDSA_SECP256R1_SHA256, 8)
+    # trace-shaping knob changes the key (resolved, not raw env:
+    # forcing p256 windowed OFF differs from its windowed default)
+    monkeypatch.setenv("CORDA_TPU_WINDOWED", "0")
+    p2 = aot_store._artifact_path(schemes.ECDSA_SECP256R1_SHA256, 8)
+    assert p1 != p2
+    monkeypatch.delenv("CORDA_TPU_WINDOWED")
+    # ...and forcing it ON resolves to the same program as the default
+    monkeypatch.setenv("CORDA_TPU_WINDOWED", "1")
+    p3 = aot_store._artifact_path(schemes.ECDSA_SECP256R1_SHA256, 8)
+    assert p3 == p1
+    # code fingerprint shifts with source content
+    monkeypatch.setattr(aot_store, "_fingerprint", None)
+    monkeypatch.setattr(
+        aot_store, "_FINGERPRINT_SOURCES", ("ecdsa.py",)
+    )
+    p4 = aot_store._artifact_path(schemes.ECDSA_SECP256R1_SHA256, 8)
+    assert p4 != p1
+
+
+def test_kill_switch(store, monkeypatch):
+    monkeypatch.setenv("CORDA_TPU_AOT", "0")
+    reqs = _reqs()
+    want = CpuBatchVerifier().verify_batch(reqs)
+    assert TpuBatchVerifier(batch_sizes=(8,)).verify_batch(reqs) == want
+    assert not [f for f in os.listdir(store) if f.endswith(".jaxexport")]

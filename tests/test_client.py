@@ -162,6 +162,21 @@ def _post(server, path, body):
         return r.status, json.loads(r.read())
 
 
+def _get_any(server, path):
+    """Like _get but returns (status, body) for 4xx too."""
+    try:
+        return _get(server, path)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _post_any(server, path, body):
+    try:
+        return _post(server, path, body)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
 def test_webserver_get_endpoints(web):
     net, server, alice, bob = web
     status, body = _get(server, "/api/status")
@@ -445,3 +460,90 @@ def test_web_explorer(web):
             assert r.headers["Content-Type"] == "text/html"
             page = r.read()
         assert b"ledger explorer" in page and b"/api/explorer/dashboard" in page
+        # round-4 surfaces: tx detail pane + cash action forms
+        assert b"/api/explorer/tx" in page and b"cashAction" in page
+
+
+def test_web_explorer_tx_detail(web):
+    """The transaction detail endpoint (TransactionViewer.kt analogue):
+    a spend resolves its inputs to the issue's outputs, lists commands
+    with signers and signatures, and exposes the tear-off structure
+    with the notary-revealed flags."""
+    import corda_tpu.tools.web_explorer  # noqa: F401 - registers the routes
+
+    from corda_tpu.finance import CashIssueFlow, CashPaymentFlow
+
+    net, server, alice, bob = web
+    notary_party = next(n.party for n in net.nodes if n.party.name == "Notary")
+    fsm = alice.start_flow(
+        CashIssueFlow(1_000, "USD", alice.party, notary_party)
+    )
+    net.run()
+    fsm.result_or_throw()
+    fsm = alice.start_flow(CashPaymentFlow(400, "USD", bob.party))
+    net.run()
+    spend = fsm.result_or_throw()
+
+    status, det = _get(
+        server, f"/api/explorer/tx?id={spend.id.bytes_.hex()}"
+    )
+    assert status == 200
+    assert det["id"] == spend.id.bytes_.hex()
+    assert det["notary"] == "Notary"
+    # the input resolved to the issue's output state
+    assert len(det["inputs"]) == 1
+    assert det["inputs"][0]["state"]["contract"].endswith("Cash")
+    assert len(det["outputs"]) == 2          # payment + change
+    assert det["commands"] and det["commands"][0]["signers"]
+    assert det["signatures"]
+    tear = {g["group"]: g for g in det["tear_off"]}
+    assert tear["inputs"]["revealed_to_nonvalidating_notary"]
+    assert tear["notary"]["revealed_to_nonvalidating_notary"]
+    assert not tear["outputs"]["revealed_to_nonvalidating_notary"]
+    assert tear["outputs"]["components"] == 2
+
+    # bad ids: 400 for non-hex, 404 for unknown
+    status, body = _get_any(server, "/api/explorer/tx?id=nothex")
+    assert status == 400
+    status, body = _get_any(server, f"/api/explorer/tx?id={'0' * 64}")
+    assert status == 404
+
+
+def test_web_explorer_cash_actions(web):
+    """The explorer's write actions (NewTransaction.kt analogue) ride
+    the finance CorDapp's REST routes under the gateway's RPC user:
+    issue then pay from the browser surface, balances move."""
+    import corda_tpu.tools.web_explorer  # noqa: F401
+    import corda_tpu.finance.web  # noqa: F401 - registers /api/cash
+
+    net, server, alice, bob = web
+    status, body = _post(
+        server, "/api/cash/issue",
+        {"quantity": 900, "currency": "GBP", "recipient": "Alice",
+         "notary": "Notary"},
+    )
+    assert status == 200 and len(body["tx_id"]) == 64
+    status, body = _post(
+        server, "/api/cash/pay",
+        {"quantity": 350, "currency": "GBP", "recipient": "Bob"},
+    )
+    assert status == 200 and len(body["tx_id"]) == 64
+    status, dash = _get(server, "/api/explorer/dashboard")
+    assert dash["balances"]["GBP"] == 550
+    # the paid tx is fully inspectable through the detail endpoint
+    status, det = _get(server, f"/api/explorer/tx?id={body['tx_id']}")
+    assert status == 200 and len(det["outputs"]) == 2
+    # bad pay: unknown recipient is a clean 400, not a stuck flow
+    status, body = _post_any(
+        server, "/api/cash/pay",
+        {"quantity": 1, "currency": "GBP", "recipient": "Nobody"},
+    )
+    assert status == 400
+    # non-positive quantities are rejected at the edge — a negative
+    # would otherwise surface as an opaque contract-violation 500
+    for bad_q in (-5, 0):
+        for route in ("pay", "issue"):
+            body = {"quantity": bad_q, "currency": "GBP",
+                    "recipient": "Bob", "notary": "Notary"}
+            status, out = _post_any(server, f"/api/cash/{route}", body)
+            assert status == 400, (route, bad_q, out)
