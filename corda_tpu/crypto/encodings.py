@@ -273,6 +273,40 @@ def stage_ecdsa_packed(
         curve.gx.to_bytes(32, "big") + curve.gy.to_bytes(32, "big")
     )
     benign = b"\x00" * 32 + _ONE32 + _ONE32 + g_rec
+    # native fast path: sha256 + strict-DER + pack in one C sweep
+    # (differential-fuzzed against the loop below in
+    # tests/test_native.py — the DER rules are consensus-critical).
+    # Rows with COMPRESSED pubkeys come back for host decompression.
+    from ..native import get as _native
+
+    fast = getattr(_native(), "stage_ecdsa_many", None)
+    if fast is not None:
+        packed_b, valid_l, todo = fast(items, batch, g_rec)
+        valid = np.array(valid_l, dtype=bool)
+        if not todo:
+            packed = np.frombuffer(packed_b, dtype=np.uint8).reshape(
+                batch, ECDSA_RECORD_BYTES
+            )
+            return packed, valid
+        buf = bytearray(packed_b)
+        for i in todo:
+            pub, sig, msg = items[i]
+            z_b = hashlib.sha256(msg).digest()
+            rs_pair = parse_der_ecdsa(sig)
+            pt_b = _sec1_bytes(curve, pub)
+            if rs_pair is None or pt_b is None:
+                continue   # stays benign/invalid
+            r, s = rs_pair
+            if r >> 256 or s >> 256:
+                continue
+            buf[i * ECDSA_RECORD_BYTES : (i + 1) * ECDSA_RECORD_BYTES] = (
+                z_b + r.to_bytes(32, "big") + s.to_bytes(32, "big") + pt_b
+            )
+            valid[i] = True
+        packed = np.frombuffer(bytes(buf), dtype=np.uint8).reshape(
+            batch, ECDSA_RECORD_BYTES
+        )
+        return packed, valid
     records = []
     valid = np.zeros(batch, dtype=bool)
     for i, (pub, sig, msg) in enumerate(items):
@@ -337,6 +371,23 @@ def stage_ed25519_packed(
     n_items = len(items)
     assert n_items <= batch
     benign = b"\x00" * 64 + (1).to_bytes(32, "big") * 2
+    # native fast path: sha512 + mod-L + pack in one C sweep
+    # (differential-fuzzed against the loop below in
+    # tests/test_native.py — k = H(R||A||M) mod L is consensus-math)
+    from ..native import get as _native
+
+    fast = getattr(_native(), "stage_ed25519_many", None)
+    if fast is not None:
+        packed_b, a_l, r_l, v_l = fast(items, batch)
+        packed = np.frombuffer(packed_b, dtype=np.uint8).reshape(
+            batch, ED25519_RECORD_BYTES
+        )
+        return (
+            packed,
+            np.array(a_l, dtype=np.int32),
+            np.array(r_l, dtype=np.int32),
+            np.array(v_l, dtype=bool),
+        )
     records = []
     a_signs = np.zeros(batch, dtype=np.int32)
     r_signs = np.zeros(batch, dtype=np.int32)

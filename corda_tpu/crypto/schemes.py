@@ -101,7 +101,14 @@ class PublicKey:
         return h
 
     def fingerprint(self) -> bytes:
-        return hashlib.sha256(bytes([self.scheme_id]) + self.data).digest()
+        # memoised like __hash__: identity lookups fingerprint per
+        # call on the notary's resolve hot path (party_from_key once
+        # per command signer per transaction)
+        fp = self.__dict__.get("_fp")
+        if fp is None:
+            fp = hashlib.sha256(bytes([self.scheme_id]) + self.data).digest()
+            object.__setattr__(self, "_fp", fp)
+        return fp
 
     def __repr__(self) -> str:
         return f"PublicKey({SCHEMES[self.scheme_id].code_name}, {self.data.hex()[:16]}…)"

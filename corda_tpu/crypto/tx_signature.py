@@ -16,7 +16,9 @@ from .hashes import SecureHash
 from .schemes import PrivateKey, PublicKey
 
 if TYPE_CHECKING:   # pragma: no cover
-    from .merkle import PartialMerkleTree
+    from typing import Union
+
+    from .merkle import PartialMerkleTree, SingleLeafProof
 
 PLATFORM_VERSION = 1
 
@@ -89,7 +91,11 @@ class TransactionSignature:
     signature: bytes
     by: PublicKey
     metadata: SignatureMetadata
-    partial_merkle: Optional["PartialMerkleTree"] = None
+    # the compact SingleLeafProof is what the batched notary signing
+    # path emits; both forms expose _root_for and verify identically
+    partial_merkle: Optional[
+        "Union[PartialMerkleTree, SingleLeafProof]"
+    ] = None
 
     def signable_payload(self, tx_id: SecureHash) -> bytes:
         if self.partial_merkle is not None:

@@ -369,6 +369,458 @@ PyObject* py_merkle_paths(PyObject*, PyObject* arg) {
 }
 
 // ---------------------------------------------------------------------------
+// SHA-512 (FIPS 180-4) — one-shot, for the ed25519 staging sweep.
+
+static const uint64_t SHA512_K[80] = {
+    0x428a2f98d728ae22ULL, 0x7137449123ef65cdULL, 0xb5c0fbcfec4d3b2fULL,
+    0xe9b5dba58189dbbcULL, 0x3956c25bf348b538ULL, 0x59f111f1b605d019ULL,
+    0x923f82a4af194f9bULL, 0xab1c5ed5da6d8118ULL, 0xd807aa98a3030242ULL,
+    0x12835b0145706fbeULL, 0x243185be4ee4b28cULL, 0x550c7dc3d5ffb4e2ULL,
+    0x72be5d74f27b896fULL, 0x80deb1fe3b1696b1ULL, 0x9bdc06a725c71235ULL,
+    0xc19bf174cf692694ULL, 0xe49b69c19ef14ad2ULL, 0xefbe4786384f25e3ULL,
+    0x0fc19dc68b8cd5b5ULL, 0x240ca1cc77ac9c65ULL, 0x2de92c6f592b0275ULL,
+    0x4a7484aa6ea6e483ULL, 0x5cb0a9dcbd41fbd4ULL, 0x76f988da831153b5ULL,
+    0x983e5152ee66dfabULL, 0xa831c66d2db43210ULL, 0xb00327c898fb213fULL,
+    0xbf597fc7beef0ee4ULL, 0xc6e00bf33da88fc2ULL, 0xd5a79147930aa725ULL,
+    0x06ca6351e003826fULL, 0x142929670a0e6e70ULL, 0x27b70a8546d22ffcULL,
+    0x2e1b21385c26c926ULL, 0x4d2c6dfc5ac42aedULL, 0x53380d139d95b3dfULL,
+    0x650a73548baf63deULL, 0x766a0abb3c77b2a8ULL, 0x81c2c92e47edaee6ULL,
+    0x92722c851482353bULL, 0xa2bfe8a14cf10364ULL, 0xa81a664bbc423001ULL,
+    0xc24b8b70d0f89791ULL, 0xc76c51a30654be30ULL, 0xd192e819d6ef5218ULL,
+    0xd69906245565a910ULL, 0xf40e35855771202aULL, 0x106aa07032bbd1b8ULL,
+    0x19a4c116b8d2d0c8ULL, 0x1e376c085141ab53ULL, 0x2748774cdf8eeb99ULL,
+    0x34b0bcb5e19b48a8ULL, 0x391c0cb3c5c95a63ULL, 0x4ed8aa4ae3418acbULL,
+    0x5b9cca4f7763e373ULL, 0x682e6ff3d6b2b8a3ULL, 0x748f82ee5defb2fcULL,
+    0x78a5636f43172f60ULL, 0x84c87814a1f0ab72ULL, 0x8cc702081a6439ecULL,
+    0x90befffa23631e28ULL, 0xa4506cebde82bde9ULL, 0xbef9a3f7b2c67915ULL,
+    0xc67178f2e372532bULL, 0xca273eceea26619cULL, 0xd186b8c721c0c207ULL,
+    0xeada7dd6cde0eb1eULL, 0xf57d4f7fee6ed178ULL, 0x06f067aa72176fbaULL,
+    0x0a637dc5a2c898a6ULL, 0x113f9804bef90daeULL, 0x1b710b35131c471bULL,
+    0x28db77f523047d84ULL, 0x32caab7b40c72493ULL, 0x3c9ebe0a15c9bebcULL,
+    0x431d67c49c100d4cULL, 0x4cc5d4becb3e42b6ULL, 0x597f299cfc657e2aULL,
+    0x5fcb6fab3ad6faecULL, 0x6c44198c4a475817ULL,
+};
+
+static inline uint64_t rotr64(uint64_t x, int n) {
+    return (x >> n) | (x << (64 - n));
+}
+
+static void sha512_once(const uint8_t* data, size_t len, uint8_t out[64]) {
+    uint64_t st[8] = {
+        0x6a09e667f3bcc908ULL, 0xbb67ae8584caa73bULL,
+        0x3c6ef372fe94f82bULL, 0xa54ff53a5f1d36f1ULL,
+        0x510e527fade682d1ULL, 0x9b05688c2b3e6c1fULL,
+        0x1f83d9abfb41bd6bULL, 0x5be0cd19137e2179ULL,
+    };
+    // pad into a local message (staging rows are small: 96 + len)
+    size_t total = len + 1 + 16;
+    size_t blocks = (total + 127) / 128;
+    std::vector<uint8_t> m(blocks * 128, 0);
+    std::memcpy(m.data(), data, len);
+    m[len] = 0x80;
+    // 128-bit big-endian bit length (low 64 bits suffice here)
+    uint64_t bits = uint64_t(len) * 8;
+    for (int i = 0; i < 8; i++) {
+        m[m.size() - 1 - i] = uint8_t(bits >> (8 * i));
+    }
+    for (size_t b = 0; b < blocks; b++) {
+        const uint8_t* p = m.data() + b * 128;
+        uint64_t w[80];
+        for (int t = 0; t < 16; t++) {
+            w[t] = 0;
+            for (int k = 0; k < 8; k++) w[t] = (w[t] << 8) | p[t * 8 + k];
+        }
+        for (int t = 16; t < 80; t++) {
+            uint64_t s0 = rotr64(w[t - 15], 1) ^ rotr64(w[t - 15], 8)
+                          ^ (w[t - 15] >> 7);
+            uint64_t s1 = rotr64(w[t - 2], 19) ^ rotr64(w[t - 2], 61)
+                          ^ (w[t - 2] >> 6);
+            w[t] = w[t - 16] + s0 + w[t - 7] + s1;
+        }
+        uint64_t a = st[0], bb = st[1], c = st[2], d = st[3];
+        uint64_t e = st[4], f = st[5], g = st[6], h = st[7];
+        for (int t = 0; t < 80; t++) {
+            uint64_t S1 = rotr64(e, 14) ^ rotr64(e, 18) ^ rotr64(e, 41);
+            uint64_t ch = (e & f) ^ (~e & g);
+            uint64_t t1 = h + S1 + ch + SHA512_K[t] + w[t];
+            uint64_t S0 = rotr64(a, 28) ^ rotr64(a, 34) ^ rotr64(a, 39);
+            uint64_t maj = (a & bb) ^ (a & c) ^ (bb & c);
+            uint64_t t2 = S0 + maj;
+            h = g; g = f; f = e; e = d + t1;
+            d = c; c = bb; bb = a; a = t1 + t2;
+        }
+        st[0] += a; st[1] += bb; st[2] += c; st[3] += d;
+        st[4] += e; st[5] += f; st[6] += g; st[7] += h;
+    }
+    for (int i = 0; i < 8; i++) {
+        for (int k = 0; k < 8; k++) {
+            out[i * 8 + k] = uint8_t(st[i] >> (56 - 8 * k));
+        }
+    }
+}
+
+// ---------------------------------------------------------------------------
+// 512-bit (little-endian bytes) mod the ed25519 group order
+// L = 2^252 + c, c = 27742317777372353535851937790883648493.
+//
+// The fold uses 2^252 === -c (mod L): split x = hi*2^252 + lo and
+// replace with the SIGNED value lo - hi*c; |x| shrinks by ~2^127 per
+// fold, so three folds reduce any 512-bit input below 2^252 < L.
+// Magnitudes live in 17 little-endian 32-bit limbs.
+
+static const uint32_t ED_C_LIMBS[4] = {
+    0x5cf5d3edU, 0x5812631aU, 0xa2f79cd6U, 0x14def9deU,
+};
+static const uint32_t ED_L_LIMBS[8] = {
+    0x5cf5d3edU, 0x5812631aU, 0xa2f79cd6U, 0x14def9deU,
+    0x00000000U, 0x00000000U, 0x00000000U, 0x10000000U,
+};
+
+#define ED_NLIMB 17
+
+// a <=> b over ED_NLIMB limbs
+static int ed_cmp(const uint32_t* a, const uint32_t* b) {
+    for (int i = ED_NLIMB - 1; i >= 0; i--) {
+        if (a[i] != b[i]) return a[i] < b[i] ? -1 : 1;
+    }
+    return 0;
+}
+
+// out = a - b (requires a >= b)
+static void ed_sub(const uint32_t* a, const uint32_t* b, uint32_t* out) {
+    int64_t borrow = 0;
+    for (int i = 0; i < ED_NLIMB; i++) {
+        int64_t d = int64_t(a[i]) - b[i] - borrow;
+        borrow = d < 0;
+        if (d < 0) d += (int64_t(1) << 32);
+        out[i] = uint32_t(d);
+    }
+}
+
+static bool ed_is_zero_above(const uint32_t* a, int from) {
+    for (int i = from; i < ED_NLIMB; i++) {
+        if (a[i]) return false;
+    }
+    return true;
+}
+
+// digest64 (little-endian) mod L -> 32 bytes big-endian
+static void mod_L_be(const uint8_t digest[64], uint8_t out_be[32]) {
+    uint32_t x[ED_NLIMB] = {0};
+    for (int i = 0; i < 16; i++) {
+        x[i] = uint32_t(digest[i * 4]) | uint32_t(digest[i * 4 + 1]) << 8
+               | uint32_t(digest[i * 4 + 2]) << 16
+               | uint32_t(digest[i * 4 + 3]) << 24;
+    }
+    bool negative = false;
+    // fold while anything lives at or above bit 252
+    for (int rounds = 0; rounds < 8; rounds++) {
+        if ((x[7] >> 28) == 0 && ed_is_zero_above(x, 8)) break;
+        // hi = x >> 252 (shift = 7 limbs + 28 bits), lo = low 252 bits
+        uint32_t hi[ED_NLIMB] = {0};
+        for (int i = 0; i < ED_NLIMB - 7; i++) {
+            uint32_t lo_part = x[i + 7] >> 28;
+            uint32_t hi_part =
+                (i + 8 < ED_NLIMB) ? (x[i + 8] << 4) : 0;
+            hi[i] = lo_part | hi_part;
+        }
+        uint32_t lo[ED_NLIMB] = {0};
+        for (int i = 0; i < 7; i++) lo[i] = x[i];
+        lo[7] = x[7] & 0x0fffffffU;
+        // prod = hi * c  (hi <= 2^260, c < 2^125 -> prod < 2^385)
+        uint32_t prod[ED_NLIMB] = {0};
+        for (int i = 0; i < ED_NLIMB; i++) {
+            if (!hi[i]) continue;
+            uint64_t carry = 0;
+            for (int j = 0; j < 4 && i + j < ED_NLIMB; j++) {
+                unsigned __int128 t =
+                    (unsigned __int128)hi[i] * ED_C_LIMBS[j]
+                    + prod[i + j] + carry;
+                prod[i + j] = uint32_t(uint64_t(t) & 0xffffffffULL);
+                carry = uint64_t(t >> 32);
+            }
+            for (int j = i + 4; j < ED_NLIMB && carry; j++) {
+                uint64_t t = uint64_t(prod[j]) + carry;
+                prod[j] = uint32_t(t & 0xffffffffULL);
+                carry = t >> 32;
+            }
+        }
+        // x = |lo - prod|, sign flips when prod > lo
+        if (ed_cmp(lo, prod) >= 0) {
+            ed_sub(lo, prod, x);
+        } else {
+            ed_sub(prod, lo, x);
+            negative = !negative;
+        }
+    }
+    // magnitude now < 2^252 < L; a negative value is L - magnitude
+    if (negative && !ed_is_zero_above(x, 0)) {
+        uint32_t l[ED_NLIMB] = {0};
+        for (int i = 0; i < 8; i++) l[i] = ED_L_LIMBS[i];
+        uint32_t r[ED_NLIMB];
+        ed_sub(l, x, r);
+        std::memcpy(x, r, sizeof(r));
+    }
+    for (int i = 0; i < 8; i++) {
+        uint32_t limb = x[7 - i];
+        out_be[i * 4] = uint8_t(limb >> 24);
+        out_be[i * 4 + 1] = uint8_t(limb >> 16);
+        out_be[i * 4 + 2] = uint8_t(limb >> 8);
+        out_be[i * 4 + 3] = uint8_t(limb);
+    }
+}
+
+// ---------------------------------------------------------------------------
+// Batched ed25519 staging (encodings.stage_ed25519_packed semantics):
+// per row s|k|A.y|R.y as 32-byte big-endian, plus sign bits + valid.
+
+PyObject* py_stage_ed25519_many(PyObject*, PyObject* args) {
+    PyObject* seq_obj; Py_ssize_t batch;
+    if (!PyArg_ParseTuple(args, "On", &seq_obj, &batch)) return nullptr;
+    PyObject* seq = PySequence_Fast(seq_obj, "expected a sequence");
+    if (!seq) return nullptr;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    if (n > batch) {
+        Py_DECREF(seq);
+        PyErr_SetString(PyExc_ValueError, "more items than batch");
+        return nullptr;
+    }
+    uint8_t benign[128];
+    std::memset(benign, 0, 64);
+    std::memset(benign + 64, 0, 64);
+    benign[95] = 1;    // A.y = 1
+    benign[127] = 1;   // R.y = 1
+    PyObject* packed = PyBytes_FromStringAndSize(nullptr, batch * 128);
+    PyObject* a_signs = PyList_New(batch);
+    PyObject* r_signs = PyList_New(batch);
+    PyObject* valid = PyList_New(batch);
+    if (!packed || !a_signs || !r_signs || !valid) {
+        Py_XDECREF(packed); Py_XDECREF(a_signs); Py_XDECREF(r_signs);
+        Py_XDECREF(valid); Py_DECREF(seq);
+        return nullptr;
+    }
+    uint8_t* out = reinterpret_cast<uint8_t*>(PyBytes_AS_STRING(packed));
+    std::vector<uint8_t> msgbuf;
+    for (Py_ssize_t row = 0; row < batch; row++) {
+        uint8_t* rec = out + row * 128;
+        bool ok = false;
+        long a_sign = 0, r_sign = 0;
+        if (row < n) {
+            PyObject* item = PySequence_Fast_GET_ITEM(seq, row);
+            PyObject* pub_o = PySequence_GetItem(item, 0);
+            PyObject* sig_o = PySequence_GetItem(item, 1);
+            PyObject* msg_o = PySequence_GetItem(item, 2);
+            Py_buffer pub, sig, msg;
+            bool pv = pub_o && sig_o && msg_o
+                && PyObject_GetBuffer(pub_o, &pub, PyBUF_SIMPLE) == 0;
+            bool sv = pv && PyObject_GetBuffer(sig_o, &sig, PyBUF_SIMPLE) == 0;
+            bool mv = sv && PyObject_GetBuffer(msg_o, &msg, PyBUF_SIMPLE) == 0;
+            if (mv && sig.len == 64 && pub.len == 32) {
+                const uint8_t* sb = static_cast<const uint8_t*>(sig.buf);
+                const uint8_t* pb = static_cast<const uint8_t*>(pub.buf);
+                // k = sha512(R || A || M) mod L, big-endian out
+                msgbuf.resize(64 + size_t(msg.len));
+                std::memcpy(msgbuf.data(), sb, 32);
+                std::memcpy(msgbuf.data() + 32, pb, 32);
+                std::memcpy(msgbuf.data() + 64, msg.buf, msg.len);
+                uint8_t digest[64];
+                sha512_once(msgbuf.data(), msgbuf.size(), digest);
+                mod_L_be(digest, rec + 32);
+                // s: little-endian 32 -> big-endian
+                for (int i = 0; i < 32; i++) rec[i] = sb[63 - i];
+                // A.y / R.y: low 255 bits, little->big endian
+                for (int i = 0; i < 32; i++) rec[64 + i] = pb[31 - i];
+                rec[64] &= 0x7f;
+                for (int i = 0; i < 32; i++) rec[96 + i] = sb[31 - i];
+                rec[96] &= 0x7f;
+                a_sign = (pb[31] >> 7) & 1;
+                r_sign = (sb[31] >> 7) & 1;
+                ok = true;
+            }
+            if (mv) PyBuffer_Release(&msg);
+            if (sv) PyBuffer_Release(&sig);
+            if (pv) PyBuffer_Release(&pub);
+            Py_XDECREF(pub_o); Py_XDECREF(sig_o); Py_XDECREF(msg_o);
+            if (PyErr_Occurred()) {
+                Py_DECREF(packed); Py_DECREF(a_signs); Py_DECREF(r_signs);
+                Py_DECREF(valid); Py_DECREF(seq);
+                return nullptr;
+            }
+        }
+        if (!ok) std::memcpy(rec, benign, 128);
+        PyList_SET_ITEM(a_signs, row, PyLong_FromLong(a_sign));
+        PyList_SET_ITEM(r_signs, row, PyLong_FromLong(r_sign));
+        PyObject* flag = ok ? Py_True : Py_False;
+        Py_INCREF(flag);
+        PyList_SET_ITEM(valid, row, flag);
+    }
+    Py_DECREF(seq);
+    PyObject* result = PyTuple_Pack(4, packed, a_signs, r_signs, valid);
+    Py_DECREF(packed); Py_DECREF(a_signs); Py_DECREF(r_signs);
+    Py_DECREF(valid);
+    return result;
+}
+
+// ---------------------------------------------------------------------------
+// Batched ECDSA staging (encodings.stage_ecdsa_packed semantics).
+//
+// Per row: z = sha256(message); STRICT DER parse of the signature
+// (definite minimal lengths, minimal-magnitude non-negative integers,
+// no trailing bytes — byte-for-byte the rules of
+// encodings.parse_der_ecdsa, which is consensus-critical and
+// differential-fuzzed against this in tests/test_native.py); SEC1
+// uncompressed public key (0x04 || 64 bytes). Output record is
+// z|r|s|qx|qy as 32-byte big-endian each; malformed rows get the
+// benign record with valid=false. Rows whose pubkey is COMPRESSED
+// (0x02/0x03) need host field math to decompress — they are reported
+// back for the Python path to patch.
+
+// Strict DER length at b[i]; returns length or -1, advances *next.
+static long der_len(const uint8_t* b, Py_ssize_t blen, Py_ssize_t i,
+                    Py_ssize_t* next) {
+    if (i >= blen) return -1;
+    uint8_t first = b[i];
+    if (first < 0x80) { *next = i + 1; return first; }
+    int nlen = first & 0x7F;
+    if (nlen == 0 || nlen > 2 || i + 1 + nlen > blen) return -1;
+    long val = 0;
+    for (int k = 0; k < nlen; k++) val = (val << 8) | b[i + 1 + k];
+    if (val < 0x80 || (nlen == 2 && val < 0x100)) return -1;  // non-minimal
+    *next = i + 1 + nlen;
+    return val;
+}
+
+// Strict DER INTEGER at b[i] -> 32-byte BE into out (or fail).
+// Returns false on malformed OR magnitude >= 2^256 (staging treats
+// oversized r/s as invalid rows, same as the Python path's >>256).
+static bool der_int256(const uint8_t* b, Py_ssize_t blen, Py_ssize_t i,
+                       Py_ssize_t* next, uint8_t out[32]) {
+    if (i >= blen || b[i] != 0x02) return false;
+    Py_ssize_t j;
+    long n = der_len(b, blen, i + 1, &j);
+    if (n <= 0 || j + n > blen) return false;
+    const uint8_t* body = b + j;
+    if (body[0] & 0x80) return false;                        // negative
+    if (n > 1 && body[0] == 0 && !(body[1] & 0x80)) return false;  // non-minimal
+    // magnitude must fit 256 bits: <=32 bytes, or 33 with leading 0x00
+    const uint8_t* mag = body;
+    long mlen = n;
+    if (mlen == 33 && mag[0] == 0) { mag++; mlen--; }
+    if (mlen > 32) return false;
+    std::memset(out, 0, 32);
+    std::memcpy(out + (32 - mlen), mag, mlen);
+    *next = j + n;
+    return true;
+}
+
+PyObject* py_stage_ecdsa_many(PyObject*, PyObject* args) {
+    PyObject* seq_obj; Py_ssize_t batch; Py_buffer g_rec;
+    if (!PyArg_ParseTuple(args, "Ony*", &seq_obj, &batch, &g_rec))
+        return nullptr;
+    if (g_rec.len != 64) {
+        PyBuffer_Release(&g_rec);
+        PyErr_SetString(PyExc_ValueError, "g_rec must be 64 bytes");
+        return nullptr;
+    }
+    PyObject* seq = PySequence_Fast(seq_obj, "expected a sequence");
+    if (!seq) { PyBuffer_Release(&g_rec); return nullptr; }
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    if (n > batch) {
+        Py_DECREF(seq); PyBuffer_Release(&g_rec);
+        PyErr_SetString(PyExc_ValueError, "more items than batch");
+        return nullptr;
+    }
+    uint8_t benign[160];
+    std::memset(benign, 0, 64);
+    benign[63] = 1;                       // r = 1
+    std::memset(benign + 64, 0, 32);
+    benign[95] = 1;                       // s = 1
+    std::memcpy(benign + 96, g_rec.buf, 64);
+    PyObject* packed = PyBytes_FromStringAndSize(nullptr, batch * 160);
+    PyObject* valid = PyList_New(batch);
+    PyObject* fallback = PyList_New(0);
+    if (!packed || !valid || !fallback) {
+        Py_XDECREF(packed); Py_XDECREF(valid); Py_XDECREF(fallback);
+        Py_DECREF(seq); PyBuffer_Release(&g_rec);
+        return nullptr;
+    }
+    uint8_t* out = reinterpret_cast<uint8_t*>(PyBytes_AS_STRING(packed));
+    for (Py_ssize_t row = 0; row < batch; row++) {
+        uint8_t* rec = out + row * 160;
+        bool ok = false;
+        bool needs_python = false;
+        if (row < n) {
+            PyObject* item = PySequence_Fast_GET_ITEM(seq, row);
+            PyObject* pub_o = PySequence_GetItem(item, 0);
+            PyObject* sig_o = PySequence_GetItem(item, 1);
+            PyObject* msg_o = PySequence_GetItem(item, 2);
+            Py_buffer pub, sig, msg;
+            bool views = pub_o && sig_o && msg_o
+                && PyObject_GetBuffer(pub_o, &pub, PyBUF_SIMPLE) == 0;
+            bool sv = views && PyObject_GetBuffer(sig_o, &sig, PyBUF_SIMPLE) == 0;
+            bool mv = sv && PyObject_GetBuffer(msg_o, &msg, PyBUF_SIMPLE) == 0;
+            if (mv) {
+                const uint8_t* sb = static_cast<const uint8_t*>(sig.buf);
+                uint8_t r32[32], s32[32];
+                bool sig_ok = false;
+                if (sig.len >= 2 && sb[0] == 0x30) {
+                    Py_ssize_t i;
+                    long total = der_len(sb, sig.len, 1, &i);
+                    if (total >= 0 && i + total == sig.len) {
+                        Py_ssize_t j;
+                        if (der_int256(sb, sig.len, i, &j, r32)
+                            && der_int256(sb, sig.len, j, &j, s32)
+                            && j == sig.len) {
+                            sig_ok = true;
+                        }
+                    }
+                }
+                const uint8_t* pb = static_cast<const uint8_t*>(pub.buf);
+                if (sig_ok && pub.len == 65 && pb[0] == 0x04) {
+                    sha256_once(static_cast<const uint8_t*>(msg.buf),
+                                msg.len, rec);
+                    std::memcpy(rec + 32, r32, 32);
+                    std::memcpy(rec + 64, s32, 32);
+                    std::memcpy(rec + 96, pb + 1, 64);
+                    ok = true;
+                } else if (sig_ok && pub.len == 33
+                           && (pb[0] == 0x02 || pb[0] == 0x03)) {
+                    needs_python = true;   // compressed: host sqrt
+                }
+            }
+            if (mv) PyBuffer_Release(&msg);
+            if (sv) PyBuffer_Release(&sig);
+            if (views) PyBuffer_Release(&pub);
+            Py_XDECREF(pub_o); Py_XDECREF(sig_o); Py_XDECREF(msg_o);
+            if (PyErr_Occurred()) {
+                Py_DECREF(packed); Py_DECREF(valid); Py_DECREF(fallback);
+                Py_DECREF(seq); PyBuffer_Release(&g_rec);
+                return nullptr;
+            }
+        }
+        if (!ok) std::memcpy(rec, benign, 160);
+        if (needs_python) {
+            PyObject* idx = PyLong_FromSsize_t(row);
+            if (!idx || PyList_Append(fallback, idx) < 0) {
+                Py_XDECREF(idx);
+                Py_DECREF(packed); Py_DECREF(valid); Py_DECREF(fallback);
+                Py_DECREF(seq); PyBuffer_Release(&g_rec);
+                return nullptr;
+            }
+            Py_DECREF(idx);
+        }
+        PyObject* flag = ok ? Py_True : Py_False;
+        Py_INCREF(flag);
+        PyList_SET_ITEM(valid, row, flag);
+    }
+    Py_DECREF(seq);
+    PyBuffer_Release(&g_rec);
+    PyObject* result = PyTuple_Pack(3, packed, valid, fallback);
+    Py_DECREF(packed); Py_DECREF(valid); Py_DECREF(fallback);
+    return result;
+}
+
+// ---------------------------------------------------------------------------
 // Batched partial-Merkle-proof verification.
 //
 // Semantics locked to crypto/merkle.py PartialMerkleTree._root_for
@@ -556,6 +1008,12 @@ PyMethodDef methods[] = {
      "Root of the zero-padded pairwise-SHA-256 tree over 32-byte leaves."},
     {"merkle_paths", py_merkle_paths, METH_O,
      "(root, [sibling-path bytes per leaf]) for the zero-padded tree."},
+    {"stage_ecdsa_many", py_stage_ecdsa_many, METH_VARARGS,
+     "Stage [(pub, der_sig, msg)] into packed z|r|s|qx|qy records: "
+     "(packed_bytes, [valid], [rows needing python decompression])."},
+    {"stage_ed25519_many", py_stage_ed25519_many, METH_VARARGS,
+     "Stage [(pub32, sig64, msg)] into packed s|k|A.y|R.y records: "
+     "(packed_bytes, [a_sign], [r_sign], [valid])."},
     {nullptr, nullptr, 0, nullptr},
 };
 

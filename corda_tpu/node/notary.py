@@ -672,7 +672,11 @@ class BatchingNotaryService(NotaryService):
             # contract result, matching the reference's check order
             # (SignedTransaction.kt:143-149)
             stx.raise_on_invalid(sig_results)
-            stx.verify_required_signatures({self.identity.owning_key})
+            except_keys = self.__dict__.get("_except_keys")
+            if except_keys is None:
+                except_keys = frozenset((self.identity.owning_key,))
+                self._except_keys = except_keys
+            stx.verify_required_signatures(except_keys)
             if contract_err is not None:
                 raise contract_err
         except Exception as e:

@@ -912,24 +912,28 @@ class ServiceHub:
     def resolve_transaction(self, wtx: WireTransaction) -> LedgerTransaction:
         """WireTransaction -> LedgerTransaction: resolve input refs from
         storage, signers to parties, attachment ids to blobs
-        (WireTransaction.toLedgerTransaction, WireTransaction.kt:60)."""
+        (WireTransaction.toLedgerTransaction, WireTransaction.kt:60).
+        Hot path: the batching notary resolves every queued transaction
+        per flush, so the bound-method hoists below are deliberate."""
+        txs_get = self.validated_transactions.get
         inputs = []
         for ref in wtx.inputs:
-            stx = self.validated_transactions.get(ref.txhash)
+            stx = txs_get(ref.txhash)
             if stx is None:
                 raise TransactionResolutionError(ref.txhash)
-            if ref.index >= len(stx.wtx.outputs):
+            outs = stx.wtx.outputs
+            if ref.index >= len(outs):
                 raise TransactionResolutionError(ref.txhash)
-            inputs.append(StateAndRef(stx.wtx.outputs[ref.index], ref))
+            inputs.append(StateAndRef(outs[ref.index], ref))
+        party_from_key = self.identity.party_from_key
         commands = []
         for cmd in wtx.commands:
-            parties = []
-            for k in cmd.signers:
-                p = self.identity.party_from_key(k)
-                if p is not None:
-                    parties.append(p)
+            signers = cmd.signers
+            parties = [
+                p for p in map(party_from_key, signers) if p is not None
+            ]
             commands.append(
-                CommandWithParties(cmd.signers, tuple(parties), cmd.value)
+                CommandWithParties(signers, tuple(parties), cmd.value)
             )
         attachments = []
         for att_id in wtx.attachments:

@@ -117,6 +117,105 @@ def test_merkle_paths_rejects_bad_input(native):
         native.merkle_paths([b"short"])
 
 
+def test_stage_ecdsa_native_matches_python(native):
+    """The native ECDSA staging sweep (sha256 + strict DER + SEC1 pack,
+    round-4 notary hot path) must be byte-identical to the Python
+    reference on adversarial rows — the DER rules are
+    consensus-critical (a parser disagreement would let one node
+    accept a signature another rejects)."""
+    import corda_tpu.native as nat
+    from corda_tpu.crypto import encodings, schemes
+    from corda_tpu.crypto.curves import SECP256R1
+
+    rng = random.Random(77)
+    kp = schemes.generate_keypair(schemes.ECDSA_SECP256R1_SHA256, seed=9)
+    items = []
+    for i in range(300):
+        msg = rng.randbytes(rng.randrange(0, 80))
+        sig = kp.private.sign(msg)
+        kind = i % 12
+        if kind == 3:
+            sig = sig[: len(sig) // 2]             # truncated
+        elif kind == 4:
+            sig = sig + b"\x00"                     # trailing byte
+        elif kind == 5:
+            pos = rng.randrange(len(sig))           # bitflip
+            sig = sig[:pos] + bytes([sig[pos] ^ 0x41]) + sig[pos + 1:]
+        elif kind == 6:
+            sig = b""
+        elif kind == 7:
+            sig = bytes([0x30, 0x81, len(sig) - 2]) + sig[2:]  # non-minimal
+        elif kind == 11:
+            # integer with magnitude > 256 bits
+            big = (1 << 260) + 5
+            sig = encodings.encode_der_ecdsa(big, 7)
+        pub = kp.public.data
+        if kind == 8:
+            pub = pub[:33]                          # bad length
+        elif kind == 9:
+            pub = b"\x02" + pub[1:33]               # compressed: host path
+        elif kind == 10:
+            pub = b"\x05" + pub[1:]                 # bad SEC1 tag
+        items.append((pub, sig, msg))
+
+    native_mod = nat.get()
+    p_nat, v_nat = encodings.stage_ecdsa_packed(SECP256R1, items, 512)
+    nat._native, nat._tried = None, True            # force python path
+    try:
+        p_py, v_py = encodings.stage_ecdsa_packed(SECP256R1, items, 512)
+    finally:
+        nat._native = native_mod
+    assert (v_nat == v_py).all()
+    assert (p_nat == p_py).all()
+    assert v_nat.sum() > 0 and not v_nat.all()
+
+
+def test_stage_ed25519_native_matches_python(native):
+    """Native ed25519 staging (hand-rolled SHA-512 + 512-bit mod-L in
+    C) must be byte-identical to the Python reference's
+    `sha512(R||A||M) % L` — k is consensus math: a divergence would
+    make native and non-native nodes disagree on signature validity."""
+    import corda_tpu.native as nat
+    from corda_tpu.crypto import encodings, schemes
+
+    rng = random.Random(31)
+    kp = schemes.generate_keypair(schemes.EDDSA_ED25519_SHA512, seed=6)
+    items = []
+    for i in range(400):
+        msg = rng.randbytes(rng.randrange(0, 150))
+        sig = kp.private.sign(msg)
+        kind = i % 8
+        if kind == 3:
+            sig = sig[:40]                          # truncated
+        elif kind == 4:
+            sig = sig + b"\x00"                     # trailing byte
+        elif kind == 5:
+            pos = rng.randrange(64)                 # bitflip incl sign bits
+            sig = sig[:pos] + bytes([sig[pos] ^ 0x80]) + sig[pos + 1:]
+        elif kind == 6:
+            # s forced to huge values: exercises the mod-L fold on
+            # inputs far above L (k derives from sha512 — also varied
+            # by every msg permutation here)
+            sig = sig[:32] + b"\xff" * 32
+        pub = kp.public.data
+        if kind == 7:
+            pub = pub[:31]                          # bad length
+        items.append((pub, sig, msg))
+
+    native_mod = nat.get()
+    p_nat, a_nat, r_nat, v_nat = encodings.stage_ed25519_packed(items, 512)
+    nat._native, nat._tried = None, True            # force python path
+    try:
+        p_py, a_py, r_py, v_py = encodings.stage_ed25519_packed(items, 512)
+    finally:
+        nat._native = native_mod
+    assert (v_nat == v_py).all()
+    assert (a_nat == a_py).all()
+    assert (r_nat == r_py).all()
+    assert (p_nat == p_py).all()
+    assert v_nat.sum() > 0 and not v_nat.all()
+
+
 def test_transaction_ids_stable_with_and_without_native(native):
     """A WireTransaction id must not depend on which implementation
     hashed it (consensus!)."""
