@@ -164,6 +164,19 @@ class BftCheckpoint:
 
 
 @dataclass(frozen=True)
+class NewViewRequest:
+    """A replica stuck awaiting a NEW-VIEW (its vote quorum advanced
+    the view but the primary's one broadcast never arrived — dropped
+    over a reconnect, say) asks the primary to retransmit. Without
+    this the _awaiting_new_view gate would refuse ordinary
+    pre-prepares in that view forever, silently costing the cluster
+    one replica of fault margin."""
+
+    view: int
+    replica: str
+
+
+@dataclass(frozen=True)
 class CatchUpRequest:
     """A lagging/restarted replica asking peers for state transfer."""
 
@@ -183,7 +196,8 @@ class CatchUpReply:
 
 for _cls in (
     BftRequest, PrePrepare, BftPrepare, BftCommitMsg, BftReply,
-    ViewChange, NewView, BftCheckpoint, CatchUpRequest, CatchUpReply,
+    ViewChange, NewView, NewViewRequest, BftCheckpoint, CatchUpRequest,
+    CatchUpReply,
 ):
     ser.serializable(_cls)
 
@@ -305,6 +319,21 @@ class BftReplica:
         self._view_votes: dict[int, dict[str, tuple]] = {}
         # NEW-VIEW messages parked until our own vote quorum arrives
         self._pending_new_view: dict[int, NewView] = {}
+        # view-change gating (round-4 advisor, high): between our own
+        # vote quorum advancing the view and a VALIDATED NEW-VIEW for
+        # it, ordinary pre-prepares are refused outright — and after
+        # adoption they are refused at or below the NEW-VIEW's
+        # re-proposal top. Without this a byzantine new primary could
+        # OMIT a certified seq from its NEW-VIEW and then reorder that
+        # seq with a fresh pre-prepare carrying a different command
+        # (the coverage check in _on_new_view rejects the omission;
+        # this floor closes the reorder half of the same attack).
+        self._awaiting_new_view = False
+        self._awaiting_since = 0
+        self._new_view_floor = 0
+        # primary side: the NewView we broadcast per view, kept so a
+        # replica that missed the one broadcast can ask for a resend
+        self._sent_new_view: dict[int, NewView] = {}
         # state-transfer hooks (installed by the notary service):
         # snapshot_fn() -> canonical state, restore_fn(state, seq)
         self.snapshot_fn: Optional[Callable[[], Any]] = None
@@ -433,6 +462,14 @@ class BftReplica:
     def _on_preprepare(self, pp: PrePrepare, sender: str) -> None:
         if sender != self.primary or pp.view != self.view:
             return   # only the current primary may order
+        if self._awaiting_new_view:
+            return   # no ordinary ordering until the NEW-VIEW validates
+        if pp.seq < self._new_view_floor or pp.seq < self.exec_seq:
+            # at/below the adopted NEW-VIEW top or our own executed
+            # history: an honest primary never orders there (its
+            # next_seq starts above its top), so this is either a
+            # stale redelivery or a byzantine reorder attempt
+            return
         self._accept_preprepare(pp)
 
     def _record_prepare(self, p: BftPrepare) -> None:
@@ -742,6 +779,23 @@ class BftReplica:
                 fut.set_exception(
                     BftUnavailable("no f+1 agreement within deadline")
                 )
+        # stuck awaiting a NEW-VIEW (the one broadcast was lost, or we
+        # rejected it for vote-set skew): ask the primary to resend.
+        # Recovers the replica's participation; a primary that cannot
+        # produce an acceptable NEW-VIEW just leaves us re-asking until
+        # the next view change supersedes the wait.
+        if (
+            self._awaiting_new_view
+            and now - self._awaiting_since >= self.config.request_timeout_micros
+            and self.primary != self.name
+        ):
+            self._awaiting_since = now   # re-arm
+            self.messaging.send(
+                self.topic,
+                ser.encode(NewViewRequest(self.view, self.name)),
+                self.primary,
+            )
+            sent += 1
         sent += self._maybe_request_catchup(now)
         return sent
 
@@ -789,8 +843,13 @@ class BftReplica:
                 if v >= self.view
             }
             if self.is_primary:
+                # a stale wait from an earlier, never-completed view
+                # must not outlive our own primaryship
+                self._awaiting_new_view = False
                 self._send_new_view(new_view, votes)
             else:
+                self._awaiting_new_view = True
+                self._awaiting_since = self.clock.now_micros()
                 pending = self._pending_new_view.pop(new_view, None)
                 if pending is not None:
                     self._on_new_view(pending, pending.primary)
@@ -898,6 +957,7 @@ class BftReplica:
         if best:
             top = max(top, max(best))
         self.next_seq = max(self.next_seq, top + 1)
+        self._new_view_floor = max(self._new_view_floor, top + 1)
         # fill the holes: a seq the dead primary assigned that no vote
         # certifies (it cannot have committed anywhere — commit implies
         # a 2f+1 certificate in every vote quorum) re-proposes as a
@@ -911,7 +971,10 @@ class BftReplica:
         )
         pps = tuple(sorted(pps + noops))
         cert = tuple((r, p) for r, p in sorted(votes.items()))
-        self._broadcast(NewView(view, self.name, cert, pps))
+        nv = NewView(view, self.name, cert, pps)
+        # kept for retransmission (NewViewRequest); older views pruned
+        self._sent_new_view = {view: nv}
+        self._broadcast(nv)
         for seq, cmd_id, origin, command, ts in pps:
             self._accept_preprepare(
                 PrePrepare(view, seq, cmd_id, origin, command, ts),
@@ -962,6 +1025,17 @@ class BftReplica:
         # command under a prepared seq (that would overwrite an entry
         # another replica already executed)
         merged = self._merge_prepared(own_votes.values())
+        # COVERAGE (round-4 advisor, high): every seq OUR evidence
+        # certifies must be re-proposed. A byzantine primary that
+        # simply omits a certified (possibly committed) seq — rather
+        # than tampering with it — would otherwise slip past the
+        # per-entry checks below, free to reorder that seq later with
+        # a fresh pre-prepare for a conflicting command. Same stance
+        # as vote-set skew around no-ops: reject the whole NEW-VIEW,
+        # worst case liveness defers to the next view.
+        listed = {pp[0] for pp in m.preprepares}
+        if not set(merged) <= listed:
+            return   # certified seq omitted from the NEW-VIEW
         for seq, cmd_id, origin, command, ts in m.preprepares:
             ref = merged.get(seq)
             if ref is None:
@@ -982,6 +1056,11 @@ class BftReplica:
             self._view_votes = {
                 v: vm for v, vm in self._view_votes.items() if v >= self.view
             }
+        self._awaiting_new_view = False
+        if listed:
+            # ordinary ordering in this view must start above the
+            # adopted re-proposal top — see _on_preprepare
+            self._new_view_floor = max(self._new_view_floor, max(listed) + 1)
         for seq, cmd_id, origin, command, ts in m.preprepares:
             self._note_seq(seq, m.primary)
             self._accept_preprepare(
@@ -1021,6 +1100,13 @@ class BftReplica:
                 self._record_view_change(m)
         elif isinstance(m, NewView):
             self._on_new_view(m, sender)
+        elif isinstance(m, NewViewRequest):
+            if sender == m.replica and sender in self.peers:
+                nv = self._sent_new_view.get(m.view)
+                if nv is not None:
+                    self.messaging.send(
+                        self.topic, ser.encode(nv), m.replica
+                    )
         elif isinstance(m, BftCheckpoint):
             if sender == m.replica and sender in self.peers:
                 self._note_seq(m.seq, sender)
@@ -1100,7 +1186,11 @@ class BFTNotaryService:
         replica.snapshot_fn = self._snapshot
         replica.restore_fn = self._restore
         # proof-carrying view changes: replicas sign their PREPAREs so
-        # prepared certificates verify independently of the fabric
+        # prepared certificates verify independently of the fabric.
+        # The hook-less fallback in _valid_prepared_entry (inbox/f+1
+        # support) is a weaker, test-rig-only mode — every service
+        # construction (and therefore every node-config path) MUST
+        # leave the cluster in signed-certificate mode.
         replica.sign_prepare_fn = self._sign_prepare
         replica.verify_prepare_fn = self._verify_prepare
 

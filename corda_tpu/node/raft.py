@@ -869,9 +869,20 @@ class RaftNode:
                 )
                 return
             self._snap_incoming = None
-            state = ser.decode(bytes(buf))
+            try:
+                state = ser.decode(bytes(buf))
+            except ser.SerializationError:
+                # corrupt assembled blob: abandon the transfer WITHOUT
+                # acking — an ack(0) would restart the whole stream at
+                # network speed (an unthrottled loop when the failure
+                # is deterministic); silence lets the leader's stall
+                # re-kick retry at heartbeat pace instead
+                return
         else:
-            state = ser.decode(bytes(m.data))
+            try:
+                state = ser.decode(bytes(m.data))
+            except ser.SerializationError:
+                return   # malformed single-chunk snapshot: drop
         if m.last_included_index > self.last_applied:
             if self.restore_fn is None:
                 # cannot install: answer failure rather than hang the

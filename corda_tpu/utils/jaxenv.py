@@ -55,8 +55,17 @@ def enable_compile_cache() -> None:
     # Mosaic/XLA compile per (scheme, shape). The plugin serializes
     # executables fine (entries round-trip whenever a CPU compile
     # happened to win that one-shot race), so flip the global check
-    # to "used". Private API, guarded: on a jax without these
-    # attributes this is a no-op and the allowlist behavior stands.
+    # to "used". Private API, double-guarded (round-4 advisor): the
+    # poke only runs on jax versions where this internals layout was
+    # actually tested — a future jax that KEEPS the attribute names
+    # but shifts their semantics must fall back to the stock
+    # allowlist behavior, not silently misuse the cache.
+    ver = tuple(
+        int(p) for p in (jax.__version__.split(".") + ["0", "0"])[:2]
+        if p.isdigit()
+    )
+    if not ((0, 4) <= ver <= (0, 9)):
+        return
     try:
         from jax._src import compilation_cache as _cc
 
