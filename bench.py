@@ -43,6 +43,7 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_compile_cache")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
 
 BASELINE = 50_000.0  # verifies/sec target per BASELINE.json
+MERKLE_TARGET = 45_000.0  # FilteredTransaction metric's own target
 
 
 def _timed_rates(run_once, batch: int, iters: int) -> list[float]:
@@ -165,6 +166,11 @@ def _merkle_metric(batch: int, iters: int) -> dict:
         "value": round(rate, 1),
         "unit": "verifies/s",
         "vs_baseline": round(rate / BASELINE, 3),
+        # this metric's OWN target (BASELINE.md north-star table,
+        # round-5): the merkle+sig composite is not the raw-sig
+        # headline and is judged against its own line
+        "target": MERKLE_TARGET,
+        "vs_target": round(rate / MERKLE_TARGET, 3),
     }
 
 

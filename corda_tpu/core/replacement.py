@@ -156,6 +156,18 @@ def _verify_contract_upgrade(ltx, cmd) -> None:
 _REPLACEMENT_COMMANDS = (NotaryChangeCommand, ContractUpgradeCommand)
 
 
+def has_replacement_command(commands) -> bool:
+    """True when any command value is a replacement command. Works on
+    wire Commands and resolved CommandWithParties alike (both expose
+    .value) — the notary's object-less fast sweep uses this to route
+    replacement transactions to the full LedgerTransaction path
+    without resolving first."""
+    for c in commands:
+        if isinstance(c.value, _REPLACEMENT_COMMANDS):
+            return True
+    return False
+
+
 def replacement_verifier(ltx):
     """Dispatch hook (installed by core/__init__): a tx carrying exactly
     one replacement command is verified by the replacement rules;

@@ -286,12 +286,23 @@ class TpuBatchVerifier(BatchSignatureVerifier):
         pending = []
         for sid, (items, idxs) in buckets.items():
             pending.extend(self._dispatch(sid, items, idxs))
+        # queue device->host transfers NOW: each chunk's result pushes
+        # to the host as its compute completes, so a later per-chunk
+        # consumer (PendingVerification.chunks) never pays a separate
+        # link round trip per chunk — only wait-for-compute
+        streamed = True
+        for res, _, _ in pending:
+            try:
+                res.copy_to_host_async()
+            except Exception:   # noqa: BLE001 - optional acceleration
+                streamed = False
+                break
         if cpu_idx:
             # CPU fallbacks also overlap the in-flight device chunks
             cpu_res = self._cpu.verify_batch([requests[i] for i in cpu_idx])
             for i, ok in zip(cpu_idx, cpu_res):
                 out[i] = ok
-        return PendingVerification(out, pending)
+        return PendingVerification(out, pending, streamed)
 
     def verify_batch(self, requests: Sequence[VerificationRequest]) -> list[bool]:
         return self.verify_batch_async(requests).result()
@@ -300,15 +311,43 @@ class TpuBatchVerifier(BatchSignatureVerifier):
 class PendingVerification:
     """Handle for an in-flight TpuBatchVerifier dispatch."""
 
-    def __init__(self, out, pending):
+    def __init__(self, out, pending, streamed: bool = False):
         self._out = out
         self._pending = pending
         self._done = False
+        # True when every chunk's device->host transfer was queued at
+        # dispatch (copy_to_host_async): per-chunk consumption then
+        # costs wait-for-compute only, no per-chunk link round trip
+        self.streamed = streamed
+
+    def skeleton(self) -> list:
+        """A copy of the result rows known WITHOUT waiting on the
+        device: CPU-fallback rows filled, device rows None. Streaming
+        consumers seed from this and fill from chunks()."""
+        return list(self._out)
+
+    def chunks(self):
+        """Yield (request_indices, [bool]) per device chunk in dispatch
+        order, as each chunk's compute completes — the streaming form
+        of result() (notary flush: validate+commit chunk k's
+        transactions while the device still runs chunk k+1). CPU
+        fallback rows are already present in the `out` skeleton before
+        the first yield. Only sensible on a `streamed` handle; on a
+        non-streamed one each yield pays a link round trip."""
+        for res, chunk_idxs, n in self._pending or ():
+            arr = np.asarray(res)
+            yield chunk_idxs, [bool(v) for v in arr[:n].tolist()]
 
     def result(self) -> list[bool]:
         if not self._done:
             out, pending = self._out, self._pending
-            if pending:
+            if pending and self.streamed:
+                # transfers were queued at dispatch: per-chunk reads
+                # are free once compute finishes
+                for chunk_idxs, vals in self.chunks():
+                    for j, ok in zip(chunk_idxs, vals):
+                        out[j] = ok
+            elif pending:
                 # ONE device->host fetch for all chunks: on a
                 # remote-attached TPU each fetch pays ~50-100 ms of link
                 # latency, so per-chunk np.asarray calls would serialise

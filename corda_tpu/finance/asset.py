@@ -229,26 +229,38 @@ class OnLedgerAsset:
         return out
 
     def _verify_fast(self, ltx) -> None:
-        """Single-pass mirror of the clause tree. Check ORDER and
-        messages must stay aligned with the clause implementations
-        above — the first violation reported has to match."""
+        """Single-pass mirror of the clause tree over a resolved
+        LedgerTransaction."""
+        self.verify_fields(
+            ltx.commands,
+            [sar.state.data for sar in ltx.inputs],
+            [ts.data for ts in ltx.outputs],
+        )
+
+    def verify_fields(self, commands, input_datas, output_datas) -> None:
+        """The object-less entry point (core/batch_verify.py fused
+        notary path): verify straight from wire-level pieces — command
+        objects exposing .value/.signers (wire Command and resolved
+        CommandWithParties both do) and raw state-data lists — without
+        a LedgerTransaction ever existing. Check ORDER and messages
+        must stay aligned with the clause implementations above — the
+        first violation reported has to match; equivalence is
+        fuzz-checked in tests/test_batch_verify.py."""
         asset_types = (self.issue_cmd, self.move_cmd, self.exit_cmd)
-        cmds = [c for c in ltx.commands if type(c.value) in asset_types]
+        cmds = [c for c in commands if type(c.value) in asset_types]
         require_that("an asset command is present", len(cmds) >= 1)
         # group by issued token, inputs first then outputs — the
         # insertion order LedgerTransaction.group_states produces
         groups: dict = {}
         token_of = self.token_of
         state_class = self.state_class
-        for sar in ltx.inputs:
-            s = sar.state.data
+        for s in input_datas:
             if isinstance(s, state_class):
                 g = groups.get(k := token_of(s))
                 if g is None:
                     g = groups[k] = ([], [])
                 g[0].append(s)
-        for ts in ltx.outputs:
-            s = ts.data
+        for s in output_datas:
             if isinstance(s, state_class):
                 g = groups.get(k := token_of(s))
                 if g is None:

@@ -457,9 +457,25 @@ class BatchingNotaryService(NotaryService):
         try:
             collector: Optional[threading.Thread] = None
             box: dict = {}
+            handle = None
             if hasattr(verifier, "verify_batch_async"):
                 handle = verifier.verify_batch_async(reqs)
-
+            else:
+                results = verifier.verify_batch(reqs)
+            # STREAMING tail (round-5): when the handle's per-chunk
+            # transfers were queued at dispatch and the uniqueness
+            # provider commits synchronously, chunk k's transactions
+            # validate + commit while the device still runs chunk k+1 —
+            # the residual link_wait the join path pays disappears into
+            # downstream host work. Commit order stays exactly arrival
+            # order (the chunk consumer advances a monotonic pointer),
+            # so intra-batch first-wins semantics are unchanged.
+            stream_ok = (
+                handle is not None
+                and getattr(handle, "streamed", False)
+                and getattr(self.uniqueness, "batch_synchronous", False)
+            )
+            if handle is not None and not stream_ok:
                 # collect on a worker thread: on a remote-attached
                 # device the d2h result fetch is GIL-releasing link IO
                 # (~100 ms), which this overlaps with the contract loop
@@ -472,8 +488,6 @@ class BatchingNotaryService(NotaryService):
 
                 collector = threading.Thread(target=_collect, daemon=True)
                 collector.start()
-            else:
-                results = verifier.verify_batch(reqs)
             t = self._mark("dispatch", t)
             # overlap: contract execution (host Python) runs while the
             # device computes the signature batch and the collector
@@ -490,40 +504,27 @@ class BatchingNotaryService(NotaryService):
             # pool resolves its futures via the message pump this flush
             # is running ON, so blocking on it here would deadlock —
             # the batching notary then verifies in-process instead.
-            from ..core.batch_verify import (
-                uses_attachment_code,
-                verify_ledger_batch,
-            )
-
             tv = self.services.transaction_verifier
             tv_sync = getattr(tv, "synchronous", False)
-            contract_errs: list[Optional[Exception]] = []
-            deferred_ltx: dict[int, Any] = {}
-            ltxs: list = []
-            ltx_idx: list[int] = []
-            for i, p in enumerate(pending):
-                try:
-                    ltx = p.stx.to_ledger_transaction(self.services)
-                except Exception as e:
-                    contract_errs.append(e)
-                    continue
-                contract_errs.append(None)
-                if uses_attachment_code(ltx):
-                    deferred_ltx[i] = ltx
-                else:
-                    ltxs.append(ltx)
-                    ltx_idx.append(i)
-            t = self._mark("resolve", t)
-            if tv_sync:
-                for i, fut in zip(ltx_idx, tv.verify_many(ltxs)):
-                    try:
-                        fut.result()
-                    except Exception as e:
-                        contract_errs[i] = e
-            else:
-                for i, err in zip(ltx_idx, verify_ledger_batch(ltxs)):
-                    contract_errs[i] = err
-            t = self._mark("contract", t)
+            # ONE batched resolve+verify pass (services.py
+            # resolve_verify_batch): asset-shaped transactions take the
+            # object-less fast sweep, the rest build LedgerTransactions
+            # and honour the SPI seam / attachment-code deferral as
+            # before. Async (out-of-process) pools resolve their
+            # futures via the pump this flush runs ON, so the SPI is
+            # honoured only when synchronous — the in-process grouped
+            # sweep covers the rest.
+            contract_errs, deferred_ltx = self.services.resolve_verify_batch(
+                [p.stx for p in pending],
+                spi=tv if tv_sync else None,
+            )
+            t = self._mark("resolve_verify", t)
+            if stream_ok:
+                self._stream_tail(
+                    pending, spans, contract_errs, deferred_ltx,
+                    handle, tv, tv_sync, t,
+                )
+                return
             if collector is not None:
                 collector.join()
                 if "error" in box:
@@ -568,35 +569,8 @@ class BatchingNotaryService(NotaryService):
         t = self._mark("validate", t)
         if not eligible:
             return
-
-        def conflict_error(e: UniquenessConflict) -> NotaryError:
-            return NotaryError(
-                "conflict",
-                str(e),
-                conflict={str(r): h for r, h in e.conflict.items()},
-            )
-
-        def finalize(committed: dict[int, _PendingNotarisation]) -> None:
-            # ONE Merkle-batch notary signature over all committed ids,
-            # scattered with per-tx inclusion proofs (host signing is
-            # ~70 µs/signature — per-tx signing alone would cap the
-            # serving rate near 14k tx/s)
-            if not committed:
-                return
-            order = sorted(committed)
-            try:
-                sigs = self.services.key_management.sign_batch(
-                    [committed[i].stx.id for i in order],
-                    self.identity.owning_key,
-                )
-            except Exception as e:
-                for i in order:
-                    committed[i].future.set_result(
-                        NotaryError("commit-unavailable", str(e))
-                    )
-                return
-            for i, sig in zip(order, sigs):
-                committed[i].future.set_result(sig)
+        conflict_error = self._conflict_error
+        finalize = self._finalize_sign
 
         # phase 3 — uniqueness commit. A synchronous provider takes the
         # WHOLE flush through one commit_many (one lock/DB transaction,
@@ -656,6 +630,141 @@ class BatchingNotaryService(NotaryService):
                 list(p.stx.wtx.inputs), p.stx.id, p.requester
             )
             fut.add_done_callback(lambda f, i=i, p=p: on_commit(f, i, p))
+        self._mark("sign_scatter", t)
+
+    def _conflict_error(self, e: UniquenessConflict) -> NotaryError:
+        return NotaryError(
+            "conflict",
+            str(e),
+            conflict={str(r): h for r, h in e.conflict.items()},
+        )
+
+    def _finalize_sign(
+        self, committed: dict[int, _PendingNotarisation]
+    ) -> None:
+        # ONE Merkle-batch notary signature over all committed ids,
+        # scattered with per-tx inclusion proofs (host signing is
+        # ~70 µs/signature — per-tx signing alone would cap the
+        # serving rate near 14k tx/s)
+        if not committed:
+            return
+        order = sorted(committed)
+        try:
+            sigs = self.services.key_management.sign_batch(
+                [committed[i].stx.id for i in order],
+                self.identity.owning_key,
+            )
+        except Exception as e:
+            for i in order:
+                committed[i].future.set_result(
+                    NotaryError("commit-unavailable", str(e))
+                )
+            return
+        for i, sig in zip(order, sigs):
+            committed[i].future.set_result(sig)
+
+    def _stream_tail(
+        self, pending, spans, contract_errs, deferred_ltx,
+        handle, tv, tv_sync, t,
+    ) -> None:
+        """Streaming validate+commit (round-5): consume the SPI's
+        per-chunk results as each chunk's device compute completes,
+        validating and committing chunk k's transactions while the
+        device still runs chunk k+1. The pointer over `pending` is
+        monotonic and a transaction only passes it when EVERY one of
+        its signature rows is resolved, so validation and commit
+        happen in exact arrival order — intra-batch first-wins
+        double-spend semantics are identical to the join path's one
+        commit_many over the whole flush."""
+        results = handle.skeleton()
+        committed: dict[int, _PendingNotarisation] = {}
+        state = {"ptr": 0}
+        n_pend = len(pending)
+        # counted at dispatch like the join path (line above phase 2):
+        # a batch that later fails mid-stream was still dispatched
+        self.batches_dispatched += 1
+        self.requests_batched += n_pend
+
+        def drain() -> bool:
+            """Advance over fully-resolved transactions: validate,
+            then commit the ready group. False = batch write failed
+            (every requester answered)."""
+            ready: list[tuple[int, _PendingNotarisation]] = []
+            ptr = state["ptr"]
+            while ptr < n_pend:
+                off, n = spans[ptr]
+                row = results[off : off + n]
+                if any(r is None for r in row):
+                    break
+                i, p = ptr, pending[ptr]
+                ptr += 1
+                if not self._validate_one(p, row, contract_errs[i]):
+                    continue
+                dltx = deferred_ltx.get(i)
+                if dltx is not None:
+                    # signatures just validated: NOW peer-supplied
+                    # attachment code may run (sandboxed)
+                    try:
+                        if tv_sync:
+                            tv.verify(dltx).result()
+                        else:
+                            dltx.verify()
+                    except Exception as e:   # noqa: BLE001 - per tx
+                        p.future.set_result(
+                            NotaryError("invalid-transaction", str(e))
+                        )
+                        continue
+                ready.append((i, p))
+            state["ptr"] = ptr
+            if not ready:
+                return True
+            try:
+                outcomes = self.uniqueness.commit_many(
+                    [
+                        (list(p.stx.wtx.inputs), p.stx.id, p.requester)
+                        for _, p in ready
+                    ]
+                )
+            except Exception as e:   # noqa: BLE001 - answer all
+                # failed batch write: answer every unanswered
+                # requester (already-committed ones re-commit
+                # idempotently on client retry)
+                for p in pending:
+                    p.future.set_result(
+                        NotaryError("commit-unavailable", str(e))
+                    )
+                return False
+            for (i, p), err in zip(ready, outcomes):
+                if err is None:
+                    committed[i] = p
+                elif isinstance(err, UniquenessConflict):
+                    p.future.set_result(self._conflict_error(err))
+                else:
+                    p.future.set_result(
+                        NotaryError("commit-unavailable", str(err))
+                    )
+            return True
+
+        try:
+            for idxs, vals in handle.chunks():
+                for j, ok in zip(idxs, vals):
+                    results[j] = ok
+                if not drain():
+                    return
+            # all-CPU batches have no device chunks: drain once more
+            if state["ptr"] < n_pend and not drain():
+                return
+        except Exception as e:   # noqa: BLE001 - device/link failure
+            # a failed chunk fetch must answer every waiting requester,
+            # not strand them and crash the pump tick (set_result on an
+            # already-answered future is a no-op)
+            for p in pending:
+                p.future.set_result(
+                    NotaryError("verification-unavailable", str(e))
+                )
+            return
+        t = self._mark("stream_commit", t)
+        self._finalize_sign(committed)
         self._mark("sign_scatter", t)
 
     def _validate_one(

@@ -804,6 +804,10 @@ class InMemoryTransactionVerifierService(TransactionVerifierService):
     fabric pump provides concurrency)."""
 
     synchronous = True
+    # the notary's object-less fast sweep may bypass this service:
+    # verify_many below IS the same grouped contract sweep, so the
+    # decisions are identical and no custom SPI is being skipped
+    fast_sweep_ok = True
 
     def verify(self, ltx: LedgerTransaction) -> _Future:
         f = _Future()
@@ -912,11 +916,15 @@ class ServiceHub:
     def resolve_transaction(self, wtx: WireTransaction) -> LedgerTransaction:
         """WireTransaction -> LedgerTransaction: resolve input refs from
         storage, signers to parties, attachment ids to blobs
-        (WireTransaction.toLedgerTransaction, WireTransaction.kt:60).
-        Hot path: the batching notary resolves every queued transaction
-        per flush, so the bound-method hoists below are deliberate."""
+        (WireTransaction.toLedgerTransaction, WireTransaction.kt:60)."""
+        return self._ledger_tx_from_resolved(
+            wtx, self._resolve_input_states(wtx)
+        )
+
+    def _resolve_input_states(self, wtx: WireTransaction) -> list:
+        """Input StateRefs -> their TransactionStates, from storage."""
         txs_get = self.validated_transactions.get
-        inputs = []
+        resolved = []
         for ref in wtx.inputs:
             stx = txs_get(ref.txhash)
             if stx is None:
@@ -924,7 +932,16 @@ class ServiceHub:
             outs = stx.wtx.outputs
             if ref.index >= len(outs):
                 raise TransactionResolutionError(ref.txhash)
-            inputs.append(StateAndRef(outs[ref.index], ref))
+            resolved.append(outs[ref.index])
+        return resolved
+
+    def _ledger_tx_from_resolved(
+        self, wtx: WireTransaction, resolved_states: list
+    ) -> LedgerTransaction:
+        inputs = [
+            StateAndRef(ts, ref)
+            for ts, ref in zip(resolved_states, wtx.inputs)
+        ]
         party_from_key = self.identity.party_from_key
         commands = []
         for cmd in wtx.commands:
@@ -950,6 +967,110 @@ class ServiceHub:
             time_window=wtx.time_window,
             id=wtx.id,
         )
+
+    def resolve_verify_batch(self, stxs: list, spi=None) -> tuple:
+        """Batched resolution + contract verification — the notary
+        flush's host hot path (round-4 verdict #1). Returns
+        (errs, deferred): one entry per transaction — None on
+        acceptance or the exception the resolve-then-verify path would
+        raise — plus {index: LedgerTransaction} for transactions whose
+        (peer-supplied, sandboxed) attachment code must not run until
+        their signatures are known-good.
+
+        The OBJECT-LESS fast path: a transaction with no attachments,
+        no replacement command, and every touched contract registered
+        with a `verify_fields` hook is resolved and checked straight
+        from its wire pieces — no StateAndRef / CommandWithParties /
+        LedgerTransaction is ever built. That construction was ~11 of
+        the ~35 us/tx serving cost at depth 16384, for objects the
+        asset sweep immediately re-flattened into field lists.
+        Decision AND message identity with the LedgerTransaction path
+        is fuzz-checked in tests/test_batch_verify.py.
+
+        `spi`: a SYNCHRONOUS TransactionVerifierService to honour for
+        the non-fast transactions (the notary's SPI seam). The fast
+        path bypasses it only when the service opts in
+        (`fast_sweep_ok`, set by the in-memory service whose
+        verify_many is the same grouped sweep)."""
+        from ..core.batch_verify import (
+            uses_attachment_code,
+            verify_ledger_batch,
+        )
+        from ..core.contracts import ContractViolation, contract_by_name
+        from ..core.replacement import has_replacement_command
+
+        errs: list = [None] * len(stxs)
+        deferred: dict[int, LedgerTransaction] = {}
+        ltxs: list[LedgerTransaction] = []
+        ltx_idx: list[int] = []
+        allow_fast = spi is None or getattr(spi, "fast_sweep_ok", False)
+        handlers: dict[str, Any] = {}   # contract name -> hook | None
+        resolve_inputs = self._resolve_input_states
+        for i, stx in enumerate(stxs):
+            wtx = stx.wtx
+            try:
+                resolved = resolve_inputs(wtx)
+            except Exception as e:   # noqa: BLE001 - per-tx outcome
+                errs[i] = e
+                continue
+            outputs = wtx.outputs
+            commands = wtx.commands
+            names = None
+            fast = (
+                allow_fast
+                and not wtx.attachments
+                and not has_replacement_command(commands)
+            )
+            if fast:
+                nameset = {ts.contract for ts in outputs}
+                nameset.update(ts.contract for ts in resolved)
+                names = sorted(nameset)
+                for name in names:
+                    hook = handlers.get(name, False)
+                    if hook is False:
+                        try:
+                            hook = getattr(
+                                contract_by_name(name), "verify_fields",
+                                None,
+                            )
+                        except ContractViolation:
+                            hook = None   # attachment-carried contract
+                        handlers[name] = hook
+                    if hook is None:
+                        fast = False
+                        break
+            if fast:
+                in_datas = [ts.data for ts in resolved]
+                out_datas = [ts.data for ts in outputs]
+                try:
+                    # sorted-name order, first failure wins — exactly
+                    # LedgerTransaction.verify's contract order
+                    for name in names:
+                        handlers[name](commands, in_datas, out_datas)
+                except Exception as e:   # noqa: BLE001 - per-tx outcome
+                    errs[i] = e
+                continue
+            try:
+                ltx = self._ledger_tx_from_resolved(wtx, resolved)
+            except Exception as e:   # noqa: BLE001 - per-tx outcome
+                errs[i] = e
+                continue
+            if uses_attachment_code(ltx):
+                deferred[i] = ltx
+            else:
+                ltxs.append(ltx)
+                ltx_idx.append(i)
+        if ltxs:
+            if spi is not None:
+                for i, fut in zip(ltx_idx, spi.verify_many(ltxs)):
+                    try:
+                        fut.result()
+                    except Exception as e:   # noqa: BLE001 - per-tx
+                        errs[i] = e
+            else:
+                for i, e in zip(ltx_idx, verify_ledger_batch(ltxs)):
+                    errs[i] = e
+        return errs, deferred
 
     # -- signing ------------------------------------------------------------
 

@@ -263,6 +263,115 @@ def test_multi_contract_tx_error_order():
     assert "aaa contract always fails" in batch[1]
 
 
+def test_fuzz_resolve_verify_batch_equals_ltx_path():
+    """The notary's object-less fused path (services.py
+    resolve_verify_batch) must be decision- AND message-identical to
+    resolve-then-verify through LedgerTransaction — including
+    resolution failures, mixed non-fast contracts (slow-path routing)
+    and attachment/replacement deferral."""
+    from corda_tpu.core.batch_verify import uses_attachment_code
+    from corda_tpu.core.transactions import TransactionBuilder
+    from corda_tpu.testing.mock_network import MockNetwork
+
+    net = MockNetwork(seed=99)
+    notary = net.create_notary("N")
+    bank = net.create_node("Bank")
+    alice = net.create_node("Alice")
+    svc = alice.services
+
+    class _Plain:                      # no verify_fields: slow path
+        def verify(self, l) -> None:
+            if len(l.outputs) > 2:
+                raise ContractViolation("plain wants <= 2 outputs")
+
+    register_contract("test.fused.Plain", _Plain())
+
+    rng = random.Random(20260801)
+    token = Issued(PartyAndReference(bank.party, b"\x01"), "USD")
+    stxs = []
+    for i in range(160):
+        amt = rng.randint(1, 500)
+        ib = TransactionBuilder(notary.party)
+        ib.add_output_state(
+            CashState(Amount(amt, token), alice.party.owning_key),
+            CASH_CONTRACT,
+        )
+        ib.add_command(CashIssue(i), bank.party.owning_key)
+        issue_stx = bank.services.sign_initial_transaction(ib)
+        svc.record_transactions([issue_stx])
+        sb = TransactionBuilder(notary.party)
+        shape = rng.random()
+        if shape < 0.08:
+            # dangling input: resolution must fail identically
+            sb.add_input_state(
+                StateAndRef(
+                    TransactionState(
+                        CashState(Amount(amt, token),
+                                  alice.party.owning_key),
+                        CASH_CONTRACT, notary.party,
+                    ),
+                    StateRef(SecureHash.sha256(b"missing%d" % i), 0),
+                )
+            )
+        else:
+            sb.add_input_state(
+                StateAndRef(
+                    issue_stx.wtx.outputs[0], StateRef(issue_stx.id, 0)
+                )
+            )
+        out_amt = rng.choice((amt, amt, amt, amt + 1, max(amt - 1, 0)))
+        sb.add_output_state(
+            CashState(Amount(out_amt, token), bank.party.owning_key),
+            CASH_CONTRACT, notary.party,
+        )
+        if shape > 0.85:
+            # second, non-fast contract rides along: whole tx must
+            # route through the LedgerTransaction path
+            sb.add_output_state(
+                CashState(Amount(1, token), bank.party.owning_key),
+                "test.fused.Plain", notary.party,
+            )
+        if shape > 0.95:
+            # unregistered contract: attachment-code deferral
+            sb.add_output_state(
+                CashState(Amount(1, token), bank.party.owning_key),
+                "test.fused.NotInstalled", notary.party,
+            )
+        signer = (
+            alice.party.owning_key if rng.random() < 0.8
+            else bank.party.owning_key          # wrong mover signer
+        )
+        sb.add_command(CashMove(), signer)
+        stxs.append(alice.services.sign_initial_transaction(sb))
+
+    from corda_tpu.node.services import InMemoryTransactionVerifierService
+
+    # both notary configurations: bare (spi=None) and the production
+    # shape (synchronous in-memory SPI honoured for slow-path txs)
+    for spi in (None, InMemoryTransactionVerifierService()):
+        errs, deferred = svc.resolve_verify_batch(stxs, spi=spi)
+        accepts = rejects = deferrals = 0
+        for i, stx in enumerate(stxs):
+            try:
+                ltx = stx.to_ledger_transaction(svc)
+            except Exception as e:   # noqa: BLE001 - outcome compare
+                ref, ref_deferred = (type(e).__name__, str(e)), False
+            else:
+                ref_deferred = uses_attachment_code(ltx)
+                ref = None if ref_deferred else outcome(ltx.verify)
+            assert (i in deferred) == ref_deferred, f"tx {i} deferral"
+            got = norm(errs[i])
+            assert got == ref, f"tx {i}: {got} != {ref}"
+            if ref_deferred:
+                deferrals += 1
+            elif ref is None:
+                accepts += 1
+            else:
+                rejects += 1
+        # the fuzz must genuinely exercise every route
+        assert accepts > 30 and rejects > 30 and deferrals > 2
+
+
 def test_faulty_verify_batch_is_confined():
     """A broken verify_batch (wrong arity, or raising outright) falls
     back to per-tx verify for ITS transactions — it must not fail the

@@ -31,6 +31,113 @@ class SpyVerifier(CpuBatchVerifier):
         return super().verify_batch(requests)
 
 
+class StreamingStubVerifier(CpuBatchVerifier):
+    """CPU results delivered through a REAL streamed
+    PendingVerification in small chunks — CI coverage for the notary's
+    _stream_tail (CpuBatchVerifier alone has no verify_batch_async, so
+    the streaming consensus path would otherwise only execute on TPU
+    hardware)."""
+
+    def __init__(self, chunk: int = 2):
+        self.chunk = chunk
+        self.handles: list = []
+
+    def verify_batch_async(self, requests):
+        import numpy as np
+
+        from corda_tpu.crypto.batch_verifier import PendingVerification
+
+        res = super().verify_batch(requests)
+        pending = [
+            (
+                np.asarray(res[off : off + self.chunk], dtype=bool),
+                list(range(off, min(off + self.chunk, len(res)))),
+                min(self.chunk, len(res) - off),
+            )
+            for off in range(0, len(res), self.chunk)
+        ]
+        h = PendingVerification([None] * len(res), pending, streamed=True)
+        self.handles.append(h)
+        return h
+
+
+def test_streaming_tail_matches_join_path_outcomes():
+    """The round-5 streaming tail (per-chunk validate+commit while
+    later chunks 'compute') must decide identically to the join path:
+    the same mixed flush — valid spends, an intra-flush double spend,
+    a tampered signature — through both, with first-wins preserved."""
+    from corda_tpu.flows.api import FlowFuture
+    from corda_tpu.node.notary import _PendingNotarisation
+
+    outcomes = {}
+    for mode, verifier in (
+        ("stream", StreamingStubVerifier(chunk=2)),
+        ("join", CpuBatchVerifier()),
+    ):
+        net = MockNetwork(seed=44, batch_verifier=verifier)
+        notary = net.create_notary("Notary", batching=True)
+        bank = net.create_node("Bank")
+        alice = net.create_node("Alice")
+        for amt in (500, 300, 200):
+            bank.run_flow(CashIssueFlow(amt, "USD", alice.party, notary.party))
+        notary.services.record_transactions(
+            alice.services.validated_transactions.all()
+        )
+        coins = sorted(
+            alice.vault.unconsumed_states(CashState),
+            key=lambda s: s.state.data.amount.quantity,
+        )
+
+        def spend(coin, dest_key):
+            b = TransactionBuilder(notary.party)
+            b.add_input_state(coin)
+            b.add_output_state(
+                coin.state.data.with_owner(dest_key), CASH_CONTRACT,
+                notary.party,
+            )
+            b.add_command(CashMove(), alice.party.owning_key)
+            return alice.services.sign_initial_transaction(b)
+
+        stx_ok = spend(coins[0], bank.party.owning_key)
+        stx_first = spend(coins[1], bank.party.owning_key)
+        stx_second = spend(coins[1], notary.party.owning_key)  # double
+        stx_bad = spend(coins[2], bank.party.owning_key)
+        sig = stx_bad.sigs[0]
+        tampered = type(sig)(
+            by=sig.by,
+            signature=sig.signature[:-1] + bytes([sig.signature[-1] ^ 1]),
+            metadata=sig.metadata,
+        )
+        stx_bad = type(stx_bad)(stx_bad.wtx, (tampered,))
+
+        svc = notary.services.notary_service
+        futs = {}
+        for name, stx in (
+            ("ok", stx_ok), ("first", stx_first),
+            ("second", stx_second), ("bad", stx_bad),
+        ):
+            fut = FlowFuture()
+            svc._pending.append(
+                _PendingNotarisation(stx, alice.party, fut)
+            )
+            futs[name] = fut
+        svc.flush()
+        got = {}
+        for name, fut in futs.items():
+            v = fut.result()
+            got[name] = "signed" if hasattr(v, "by") else ("err", v.kind)
+        outcomes[mode] = got
+        if mode == "stream":
+            h = verifier.handles[-1]
+            # the streaming tail consumed chunks; result() never ran
+            assert not h._done, "join fallback ran instead of streaming"
+    assert outcomes["stream"] == outcomes["join"]
+    assert outcomes["join"]["ok"] == "signed"
+    assert outcomes["join"]["first"] == "signed"      # arrival order wins
+    assert outcomes["join"]["second"] == ("err", "conflict")
+    assert outcomes["join"]["bad"] == ("err", "invalid-transaction")
+
+
 def make_net(n_clients: int = 4):
     spy = SpyVerifier()
     net = MockNetwork(seed=33, batch_verifier=spy)

@@ -25,6 +25,13 @@ RUNNABLE = (
     "flow-cookbook.md",
     "notary-clusters.md",
     "verifier-pool.md",
+    # round-5 tranche: key-concepts + operator spine (VERDICT r4 #3)
+    "key-concepts-core-types.md",
+    "key-concepts-flows.md",
+    "key-concepts-notaries.md",
+    "wire-format.md",
+    "vault.md",
+    "node-administration.md",
 )
 
 
