@@ -58,11 +58,18 @@ def batch_spec_axes(mesh: Mesh):
     return names[0] if len(names) == 1 else names
 
 
+def batch_sharding(mesh: Mesh, ndim: int, batch_axis: int = -1) -> NamedSharding:
+    """The NamedSharding `shard_operand` places operands with — also
+    usable standalone to ask "how would this split?" (shard_shape)
+    without paying a device transfer."""
+    axis = batch_axis % ndim
+    b = batch_spec_axes(mesh)
+    spec = P(*[b if d == axis else None for d in range(ndim)])
+    return NamedSharding(mesh, spec)
+
+
 def shard_operand(mesh: Mesh, x, batch_axis: int = -1):
     """Place a host array on the mesh with its batch axis sharded over
     every mesh axis (last dim for [limbs, B] operands; axis 0 for
     [B, bytes] packed records)."""
-    axis = batch_axis % x.ndim
-    b = batch_spec_axes(mesh)
-    spec = P(*[b if d == axis else None for d in range(x.ndim)])
-    return jax.device_put(x, NamedSharding(mesh, spec))
+    return jax.device_put(x, batch_sharding(mesh, x.ndim, batch_axis))

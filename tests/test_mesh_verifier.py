@@ -118,3 +118,41 @@ def test_mesh_2d_dcn_ici_matches_cpu():
 def test_mesh_2d_wrong_device_count_raises():
     with pytest.raises(ValueError, match="needs 8 devices"):
         meshlib.make_mesh_2d(2, 4, jax.devices()[:4])
+
+
+def test_serving_shard_layout_pinned(mesh):
+    """The production serving configuration's shard layout (round-4
+    verdict #5): a serving-shaped chunk staged exactly as the SPI
+    stages it splits evenly over every mesh device — [B, width] packed
+    records shard on axis 0, [B] validity on its only axis, each
+    device holding B/8 contiguous rows. A layout regression (axis
+    swap, replication instead of sharding) fails here before it ever
+    reaches hardware."""
+    from corda_tpu.crypto import encodings
+    from corda_tpu.crypto.curves import SECP256R1
+
+    rng = random.Random(5)
+    n = 64   # serving SHAPE at test size; dryrun_multichip runs 4096
+    reqs = _requests(schemes.ECDSA_SECP256R1_SHA256, rng, 8)
+    items = ([(r.key.data, r.signature, r.message) for r in reqs] * 8)[:n]
+    packed, valid = encodings.stage_ecdsa_packed(SECP256R1, items, n)
+
+    sp = meshlib.shard_operand(mesh, packed, batch_axis=0)
+    shard_shapes = [s.data.shape for s in sp.addressable_shards]
+    assert len(shard_shapes) == 8
+    assert set(shard_shapes) == {(n // 8,) + tuple(packed.shape[1:])}
+    # contiguous row ranges, one per device, in MESH device order —
+    # make_mesh_2d's host-contiguous feeding depends on exactly this
+    order = {d: i for i, d in enumerate(mesh.devices.flat)}
+    starts = [None] * 8
+    for s in sp.addressable_shards:
+        starts[order[s.device]] = s.index[0].start or 0
+    assert starts == [i * (n // 8) for i in range(8)]
+
+    sv = meshlib.shard_operand(mesh, valid, batch_axis=-1)
+    assert {s.data.shape for s in sv.addressable_shards} == {(n // 8,)}
+    # the spec-level answer matches the placed layout (the dryrun's
+    # shard-shape print uses batch_sharding without a transfer)
+    assert meshlib.batch_sharding(mesh, packed.ndim, 0).shard_shape(
+        tuple(packed.shape)
+    ) == shard_shapes[0]
