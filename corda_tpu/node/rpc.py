@@ -242,6 +242,12 @@ class CordaRPCOpsImpl:
         return list(self.services.network_map_cache.all_nodes())
 
     @rpc_method
+    def network_map_last_seen(self) -> dict:
+        """name -> micros of each peer's last map sighting (the
+        explorer network view's liveness column)."""
+        return dict(self.services.network_map_cache.last_seen)
+
+    @rpc_method
     def network_map_feed(self) -> DataFeed:
         cache = self.services.network_map_cache
         updates = Observable()

@@ -356,9 +356,17 @@ class NetworkMapCache:
         self._cluster_parties: dict[str, Party] = {}
         self._rr: dict[str, int] = {}   # round-robin cursor per cluster
         self.observers: list[Callable[[MapChange], None]] = []
+        # liveness for the explorer's network view: name -> micros of
+        # the last map sighting (registration/push). Stamped only when
+        # a clock is wired (ServiceHub does) — the cache itself stays
+        # clock-free for bare test fills
+        self.last_seen: dict[str, int] = {}
+        self.clock_fn: Optional[Callable[[], int]] = None
 
     def add_node(self, info: NodeInfo) -> None:
         self._nodes[info.legal_identity.name] = info
+        if self.clock_fn is not None:
+            self.last_seen[info.legal_identity.name] = self.clock_fn()
         if info.cluster_identity is not None:
             cname = info.cluster_identity.name
             members = self._clusters.setdefault(cname, [])
@@ -373,6 +381,7 @@ class NetworkMapCache:
 
     def remove_node(self, info: NodeInfo) -> None:
         removed = self._nodes.pop(info.legal_identity.name, None)
+        self.last_seen.pop(info.legal_identity.name, None)
         if removed is not None:
             for cname, members in list(self._clusters.items()):
                 members[:] = [
@@ -860,6 +869,8 @@ class ServiceHub:
         self.identity = identity
         self.network_map_cache = network_map_cache or NetworkMapCache()
         self.clock = clock or Clock()
+        if self.network_map_cache.clock_fn is None:
+            self.network_map_cache.clock_fn = self.clock.now_micros
         self.db = db   # NodeDatabase for persistent hubs, else None
         self.validated_transactions = (
             validated_transactions or TransactionStorage()

@@ -23,6 +23,14 @@ Mounted at /api/explorer (JSON) and /web/explorer/ (the page):
                                     the notary-revealed flags) — the
                                     reference explorer's
                                     TransactionViewer.kt detail pane
+  GET /api/explorer/network         every mapped node: address,
+                                    services, notary role/cluster,
+                                    liveness from the map's last
+                                    sighting (Network.kt analogue)
+  GET /api/explorer/vault           fungible positions by
+                                    (product, issuer) + every state
+                                    with its full source tx id for
+                                    drill-in (CashViewer.kt analogue)
   GET /api/explorer/machines        in-flight flow state machines
 
 The page also carries the reference explorer's "new transaction"
@@ -229,6 +237,104 @@ def _tx_detail(ctx, query, body):
     }
 
 
+def _network(ctx, query, body):
+    """The network view (reference explorer's Network.kt map pane,
+    terminal-first): every node from the network-map feed with its
+    address, advertised services, notary role, cluster membership and
+    liveness (age since the map last saw it)."""
+    from ..node.services import SERVICE_NOTARY_VALIDATING
+
+    infos = ctx.wait(ctx.client.network_map_snapshot())
+    last_seen = ctx.wait(ctx.client.network_map_last_seen())
+    now = ctx.wait(ctx.client.current_node_time())
+    notary_names = {
+        p.name for p in ctx.wait(ctx.client.notary_identities())
+    }
+    nodes = []
+    for info in sorted(infos, key=lambda i: i.legal_identity.name):
+        name = info.legal_identity.name
+        services = list(info.advertised_services)
+        cluster = (
+            info.cluster_identity.name
+            if info.cluster_identity is not None
+            else None
+        )
+        seen = last_seen.get(name)
+        nodes.append(
+            {
+                "name": name,
+                "address": getattr(info, "address", None),
+                "services": services,
+                "notary": (
+                    name in notary_names
+                    or cluster in notary_names
+                    or any(s.startswith("corda.notary") for s in services)
+                ),
+                "validating_notary": (
+                    SERVICE_NOTARY_VALIDATING in services
+                ),
+                "cluster": cluster,
+                "last_seen_micros": seen,
+                "last_seen_age_s": (
+                    round((now - seen) / 1e6, 1) if seen is not None else None
+                ),
+            }
+        )
+    return 200, {"now_micros": now, "nodes": nodes}
+
+
+def _vault(ctx, query, body):
+    """The vault position view (reference explorer's CashViewer.kt):
+    fungible positions aggregated by (product, issuer) plus every
+    unconsumed state with its FULL source tx id, so the page can drill
+    straight into the transaction detail pane."""
+    states = _vault_states(ctx)
+    positions: dict[tuple[str, str], dict] = {}
+    rows = []
+    for sar in states:
+        data = sar.state.data
+        amount = getattr(data, "amount", None)
+        issuer = None
+        quantity = None
+        product = None
+        if amount is not None and hasattr(amount, "quantity"):
+            quantity = int(amount.quantity)
+            product = _amount_product(amount)
+            issuer_ref = getattr(amount.token, "issuer", None)
+            issuer = (
+                issuer_ref.party.name if issuer_ref is not None else None
+            )
+            key = (product, issuer or "-")
+            pos = positions.setdefault(
+                key,
+                {
+                    "product": product,
+                    "issuer": issuer or "-",
+                    "states": 0,
+                    "total": 0,
+                },
+            )
+            pos["states"] += 1
+            pos["total"] += quantity
+        rows.append(
+            {
+                "tx_id": sar.ref.txhash.bytes_.hex(),   # drill-in key
+                "index": sar.ref.index,
+                "contract": sar.state.contract,
+                "product": product,
+                "issuer": issuer,
+                "quantity": quantity,
+                "notary": sar.state.notary.name if sar.state.notary else None,
+            }
+        )
+    return 200, {
+        "positions": sorted(
+            positions.values(), key=lambda p: (p["product"], p["issuer"])
+        ),
+        "states": rows,
+    }
+
+
 def _machines(ctx, query, body):
     machines = ctx.wait(ctx.client.state_machines_snapshot())
     return 200, {
@@ -259,6 +365,8 @@ _PAGE = b"""<!doctype html>
 <table id="balances"></table>
 <h2>network</h2>
 <table id="network"></table>
+<h2>vault positions</h2>
+<table id="positions"></table>
 <h2>cash actions</h2>
 <p>
   <label>quantity <input id="act-qty" size="8" value="100"></label>
@@ -269,7 +377,7 @@ _PAGE = b"""<!doctype html>
   <button onclick="cashAction('pay')">pay</button>
   <span id="act-out"></span>
 </p>
-<h2>unconsumed states</h2>
+<h2>unconsumed states (click a ref for its source transaction)</h2>
 <table id="states"></table>
 <h2>transactions (newest last; click an id for detail)</h2>
 <table id="txs"></table>
@@ -327,12 +435,29 @@ async function refresh() {
     q("balances").innerHTML = Object.keys(dash.balances).sort().map(
       p => row([p, dash.balances[p].toLocaleString()])).join("")
       || row(["(empty vault)", ""]);
-    q("network").innerHTML = head(["peer", "address", "services"]) +
-      dash.peers.map(
-        p => row([p.name, p.address || "-", p.services.join(",")])).join("");
-    const st = await (await fetch("/api/explorer/states")).json();
-    q("states").innerHTML = head(["ref", "contract", "notary"]) +
-      st.states.map(s => row([s.ref, s.contract, s.notary])).join("");
+    const net = await (await fetch("/api/explorer/network")).json();
+    q("network").innerHTML = head(
+      ["peer", "address", "notary", "cluster", "services", "last seen"]) +
+      net.nodes.map(p => row([
+        p.name, p.address || "-",
+        p.notary ? (p.validating_notary ? "validating" : "yes") : "-",
+        p.cluster || "-", p.services.join(","),
+        p.last_seen_age_s == null ? "-" : p.last_seen_age_s + "s ago",
+      ])).join("");
+    const vault = await (await fetch("/api/explorer/vault")).json();
+    q("positions").innerHTML = head(
+      ["product", "issuer", "states", "total"]) +
+      (vault.positions.map(p => row(
+        [p.product, p.issuer, p.states, p.total.toLocaleString()]
+      )).join("") || row(["(no fungible positions)", "", "", ""]));
+    q("states").innerHTML = head(
+      ["ref", "contract", "product", "quantity", "notary"]) +
+      vault.states.map(s => "<tr><td><a href=\\"#txid\\" onclick=\\"" +
+        "showTx('" + esc(s.tx_id) + "')\\">" + esc(s.tx_id.slice(0, 12)) +
+        ":" + esc(s.index) + "</a></td>" +
+        [s.contract, s.product || "-", s.quantity == null ? "-" :
+         s.quantity.toLocaleString(), s.notary || "-"].map(
+          c => "<td>" + esc(c) + "</td>").join("") + "</tr>").join("");
     const tx = await (await fetch(
       "/api/explorer/transactions?limit=20")).json();
     q("txs").innerHTML = head(
@@ -361,6 +486,8 @@ EXPLORER_WEB = WebApiPlugin(
         ("GET", "states", _states),
         ("GET", "transactions", _transactions),
         ("GET", "tx", _tx_detail),
+        ("GET", "network", _network),
+        ("GET", "vault", _vault),
         ("GET", "machines", _machines),
     ),
     # both spellings: /web/explorer/ and /web/explorer/index.html
