@@ -13,6 +13,7 @@ same way)."""
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Callable, Optional
 
@@ -244,3 +245,119 @@ def _render(value) -> str:
         return js.dumps(value, indent=2)
     except ValueError:
         return repr(value)
+
+
+# -- remote login ------------------------------------------------------------
+
+
+def connect_remote(
+    host: str,
+    port: int,
+    node: str,
+    fingerprint: bytes,
+    user: str,
+    password: str,
+    console_name: Optional[str] = None,
+    db_path: Optional[str] = None,
+    timeout: float = 90.0,
+):
+    """Open a remote shell session against a live node — the
+    remote-login story (reference: the embedded CRaSH SSH shell,
+    node/.../shell/InteractiveShell.kt). Instead of running an SSH
+    server in the node, the operator connects over the node's OWN
+    authenticated transport: the TLS fabric with certificate pinning
+    (`fingerprint` is the node's TLS cert fingerprint, printed at boot
+    and held by the operator) plus the RPC user login — so the shell
+    has exactly an RPC client's power and the node grows no second
+    remote-access surface. See docs/node-administration.md for the
+    SSH-protocol descope rationale.
+
+    Returns (shell, close): a ready Shell and the cleanup callable.
+    """
+    import secrets
+    import shutil
+    import tempfile
+
+    from ..crypto import schemes
+    from ..node.fabric import FabricEndpoint, PeerAddress
+    from ..node.persistence import NodeDatabase
+
+    name = console_name or f"console-{secrets.token_hex(4)}"
+    tmp_dir = None
+    if db_path is None:
+        tmp_dir = tempfile.mkdtemp(prefix="corda_shell_")
+        db_path = os.path.join(tmp_dir, "console.db")
+    db = NodeDatabase(db_path)
+    ep = None
+
+    def close() -> None:
+        if ep is not None:
+            ep.stop()
+        db.close()
+        if tmp_dir is not None:   # only remove what THIS call created
+            shutil.rmtree(tmp_dir, ignore_errors=True)
+
+    try:
+        kp = schemes.generate_keypair(seed=secrets.randbits(128))
+        target = PeerAddress(host, port, bytes(fingerprint))
+        ep = FabricEndpoint(
+            name, kp, db, resolve=lambda peer: target if peer == node else None
+        )
+        ep.start()
+        client = rpclib.RPCClient(ep, node, user, password)
+    except Exception:
+        close()
+        raise
+    shell = Shell(client, pump=ep.pump, timeout=timeout)
+    return shell, close
+
+
+def main(argv=None) -> int:
+    import argparse
+    import getpass
+
+    parser = argparse.ArgumentParser(
+        prog="corda_tpu.client.shell",
+        description=(
+            "remote node shell: connects over the node's TLS fabric "
+            "(certificate-pinned) and authenticates as an RPC user"
+        ),
+    )
+    parser.add_argument("--host", required=True, help="node p2p host")
+    parser.add_argument(
+        "--port", type=int, required=True, help="node p2p port"
+    )
+    parser.add_argument(
+        "--node", required=True, help="the node's legal/peer name"
+    )
+    parser.add_argument(
+        "--fingerprint", required=True,
+        help="node TLS certificate fingerprint, hex (printed at boot)",
+    )
+    parser.add_argument("--user", required=True, help="RPC username")
+    parser.add_argument(
+        "--password", default=None,
+        help="RPC password (prompted when omitted)",
+    )
+    parser.add_argument(
+        "--timeout", type=float, default=90.0, help="per-command seconds"
+    )
+    args = parser.parse_args(argv)
+    try:
+        fingerprint = bytes.fromhex(args.fingerprint)
+    except ValueError:
+        parser.error("--fingerprint must be hex")
+    password = args.password or getpass.getpass(f"{args.user}@{args.node}: ")
+    shell, close = connect_remote(
+        args.host, args.port, args.node, fingerprint,
+        args.user, password, timeout=args.timeout,
+    )
+    try:
+        shell.repl(prompt=f"{args.user}@{args.node}> ")
+    finally:
+        close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -308,6 +308,11 @@ class NetworkMapClient:
     """
 
     DEFAULT_TTL_MICROS = 365 * 24 * 3600 * 1_000_000   # 1 year, like the ref
+    # periodic re-registration: the map's last-seen stamp is the
+    # explorer network view's liveness signal, and without renewal it
+    # would freeze at boot time (round-5 review). Re-ADDs are tiny
+    # signed deltas; subscribers re-stamp on the push.
+    RENEW_MICROS = 60 * 1_000_000
 
     def __init__(
         self,
@@ -330,6 +335,7 @@ class NetworkMapClient:
         self._known: set[str] = set()   # names this client learned from the map
         self.registered = False
         self.map_version: Optional[int] = None
+        self._last_renewal = 0
         messaging.add_handler(TOPIC_NM_REPLY, self._on_reply)
         messaging.add_handler(TOPIC_NM_PUSH, self._on_push)
 
@@ -352,6 +358,7 @@ class NetworkMapClient:
             op=op,
             expires_micros=self._services.clock.now_micros() + self.DEFAULT_TTL_MICROS,
         )
+        self._last_renewal = self._services.clock.now_micros()
         wire = sign_registration(reg, self._priv)
         req_id = self._fresh_req_id()
 
@@ -391,6 +398,17 @@ class NetworkMapClient:
 
     def deregister(self, on_done: Optional[Callable] = None) -> None:
         self.register(op=REMOVE, on_done=on_done)
+
+    def tick(self, now: Optional[int] = None) -> None:
+        """Heartbeat renewal (called from the node pump): re-register
+        every RENEW_MICROS so the map's last-seen stays a liveness
+        signal — a node that stops ticking ages visibly in every
+        peer's network view."""
+        if not self.registered:
+            return
+        now = now if now is not None else self._services.clock.now_micros()
+        if now - self._last_renewal >= self.RENEW_MICROS:
+            self.register()
 
     # -- inbound -------------------------------------------------------------
 

@@ -555,6 +555,10 @@ class Node:
             self.raft.tick()
         if self.bft is not None:
             self.bft.tick()
+        if self.network_map_client is not None:
+            # liveness heartbeat: periodic map re-registration keeps
+            # the explorer's last-seen column meaningful
+            self.network_map_client.tick()
 
     def run(self) -> None:
         """The pump loop — the single server thread (Node.kt:344)."""

@@ -352,3 +352,35 @@ def test_garbage_payloads_do_not_crash_service(net):
     client_a.register()
     fabric.run()
     assert service.registered_names() == ["Alice"]
+
+
+def test_renewal_heartbeat_restamps_last_seen(net):
+    """The explorer network view's liveness signal (round-5): the
+    client's tick() re-registers every RENEW_MICROS, subscribers
+    re-stamp last_seen on the push — so a live node's age stays small
+    while a stopped node's grows."""
+    fabric, clock, service = net
+    hub_a, client_a = make_client(fabric, clock, "Alice")
+    hub_w, client_w = make_client(fabric, clock, "Watcher")
+    client_a.register()
+    client_w.fetch(subscribe=True)
+    fabric.run()
+    cache = hub_w.network_map_cache
+    t0 = cache.last_seen["Alice"]
+
+    # within the renewal window: tick is a no-op (no message storm)
+    client_a.tick()
+    fabric.run()
+    assert cache.last_seen["Alice"] == t0
+
+    clock.advance(client_a.RENEW_MICROS + 1)
+    client_a.tick()
+    fabric.run()
+    t1 = cache.last_seen["Alice"]
+    assert t1 > t0            # the heartbeat restamped the watcher
+
+    # a node that STOPS ticking ages: another interval passes, only
+    # the watcher's clock moves
+    clock.advance(client_a.RENEW_MICROS + 1)
+    fabric.run()
+    assert cache.last_seen["Alice"] == t1

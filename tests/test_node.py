@@ -361,3 +361,51 @@ def test_web_gateway_from_config(tmp_path):
         urllib.request.urlopen(
             f"http://127.0.0.1:{node.web.port}/api/status", timeout=2
         )
+
+
+def test_remote_shell_login(trio):
+    """The remote-login story (round-4 verdict #7): an operator
+    holding only node credentials — address, TLS cert fingerprint,
+    RPC user — opens an interactive shell against a live node over
+    the certificate-pinned fabric (connect_remote, the
+    `python -m corda_tpu.client.shell` path). The SSH protocol itself
+    is a documented descope (docs/node-administration.md)."""
+    from corda_tpu.client.shell import connect_remote
+
+    hub, alice, bob = trio
+    shell, close = connect_remote(
+        "127.0.0.1",
+        alice.messaging.listen_port,
+        "Alice",
+        alice.tls.fingerprint,
+        "admin",
+        "pw",
+        timeout=30.0,
+    )
+    ep_pump = shell.pump
+    shell.pump = lambda: (ep_pump(), hub.pump(), alice.pump(), bob.pump())
+    try:
+        out = shell.run_command("peers")
+        assert "Alice" in out and "Bob" in out and "Hub" in out
+        assert "Hub" in shell.run_command("notaries")
+        assert shell.run_command("time").strip().isdigit()
+        # wrong login: the node's RPCUserService rejects, the shell
+        # surfaces the error instead of hanging
+        bad_shell, bad_close = connect_remote(
+            "127.0.0.1",
+            alice.messaging.listen_port,
+            "Alice",
+            alice.tls.fingerprint,
+            "admin",
+            "WRONG",
+            timeout=10.0,
+        )
+        bad_pump = bad_shell.pump
+        bad_shell.pump = lambda: (bad_pump(), alice.pump())
+        try:
+            out = bad_shell.run_command("peers")
+            assert "error" in out.lower() or "denied" in out.lower(), out
+        finally:
+            bad_close()
+    finally:
+        close()
