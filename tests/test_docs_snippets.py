@@ -32,6 +32,8 @@ RUNNABLE = (
     "wire-format.md",
     "vault.md",
     "node-administration.md",
+    "key-concepts-financial-model.md",
+    "building-transactions.md",
 )
 
 
