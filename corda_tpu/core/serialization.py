@@ -70,6 +70,7 @@ def serializable(cls=None, *, tag: Optional[str] = None):
             raise SerializationError(f"duplicate serialization tag {t!r}")
         _REGISTRY_BY_TAG[t] = c
         _REGISTRY_BY_TYPE[c] = t
+        _CLASS_ENC_CACHE.pop(c, None)
         return c
 
     return wrap(cls) if cls is not None else wrap
@@ -84,6 +85,7 @@ def register_custom(cls: type, tag: str, enc, dec) -> None:
     _REGISTRY_BY_TYPE[cls] = tag
     _CUSTOM_ENC[cls] = enc
     _CUSTOM_DEC[tag] = dec
+    _CLASS_ENC_CACHE.pop(cls, None)
 
 
 def _varint(n: int) -> bytes:
@@ -118,13 +120,90 @@ def _read_varint(buf: bytes, i: int) -> tuple[int, int]:
             raise SerializationError("varint too long")
 
 
+# -- native codec ------------------------------------------------------------
+# The C implementation of this exact format (native/cts_hash.cpp) —
+# semantics LOCKED to the pure-Python reference below and
+# differential-fuzzed in tests/test_native.py. encode/decode are the
+# id-preimage, wire, checkpoint and storage hot path (a cold
+# WireTransaction id walk was ~100 us/tx in Python); the C form cuts
+# it several-fold. CORDA_TPU_NATIVE=0 disables, and any import/probe
+# failure falls back to the reference implementation.
+
+_NATIVE_CODEC: Any = None
+_NATIVE_TRIED = False
+
+
+def _native_codec():
+    global _NATIVE_CODEC, _NATIVE_TRIED
+    if _NATIVE_TRIED:
+        return _NATIVE_CODEC
+    _NATIVE_TRIED = True
+    try:
+        from ..native import get as _get_native
+
+        mod = _get_native()
+        if mod is not None and hasattr(mod, "cts_encode"):
+            mod.cts_configure(
+                SerializationError,
+                _CLASS_ENC_CACHE,   # shared cache: .pop() invalidates
+                _class_enc_info,    # miss resolver (fills the cache)
+                _REGISTRY_BY_TAG,
+                _CUSTOM_DEC,
+                _decode_dataclass,
+                _unknown_tag_handler,
+                _varint_abs,
+            )
+            _NATIVE_CODEC = mod
+    except Exception:   # noqa: BLE001 - native is an optional accelerator
+        _NATIVE_CODEC = None
+    return _NATIVE_CODEC
+
+
+def _reset_native_codec() -> None:
+    """Re-probe after an in-process build (tests)."""
+    global _NATIVE_CODEC, _NATIVE_TRIED
+    _NATIVE_CODEC = None
+    _NATIVE_TRIED = False
+
+
+def _varint_abs(n: int) -> bytes:
+    """|n| as a varint — the native encoder's big-int fallback."""
+    return _varint(-n if n < 0 else n)
+
+
 def encode(obj: Any) -> bytes:
+    native = _native_codec()
+    if native is not None:
+        return native.cts_encode(obj)
     out = bytearray()
     _enc(obj, out)
     return bytes(out)
 
 
-def _enc(obj: Any, out: bytearray) -> None:
+def _encode_at(obj: Any, depth: int) -> bytes:
+    out = bytearray()
+    _enc(obj, out, depth)
+    return bytes(out)
+
+
+def encode_py(obj: Any) -> bytes:
+    """The pure-Python reference encoder (differential tests)."""
+    out = bytearray()
+    _enc(obj, out)
+    return bytes(out)
+
+
+# Explicit nesting bound, identical in the Python and C codecs: the
+# accept/reject decision on deep structures must be deterministic and
+# implementation-independent (interpreter recursion limits are
+# neither). No legitimate ledger structure is within two orders of
+# magnitude of this.
+MAX_DEPTH = 500
+
+
+def _enc(obj: Any, out: bytearray, depth: int = 0) -> None:
+    if depth > MAX_DEPTH:
+        raise SerializationError("nesting too deep")
     if obj is None:
         out.append(0x00)
     elif obj is True:
@@ -151,58 +230,103 @@ def _enc(obj: Any, out: bytearray) -> None:
         out.append(0x07)
         out += _varint(len(obj))
         for item in obj:
-            _enc(item, out)
+            _enc(item, out, depth + 1)
     elif isinstance(obj, (dict,)):
         out.append(0x08)
         out += _varint(len(obj))
-        entries = sorted((encode(k), encode(v)) for k, v in obj.items())
+        entries = sorted(
+            (_encode_at(k, depth + 1), _encode_at(v, depth + 1))
+            for k, v in obj.items()
+        )
         for ek, ev in entries:
             out += ek
             out += ev
     elif isinstance(obj, frozenset):
         # deterministic: encode as sorted list under a map-like rule
         out.append(0x07)
-        items = sorted(encode(i) for i in obj)
+        items = sorted(_encode_at(i, depth + 1) for i in obj)
         out += _varint(len(items))
         for e in items:
             out += e
     else:
         # registered object — or a carpenter-synthesized type, which
         # encodes under its original wire tag (__cts_tag__) so an
-        # unknown object round-trips bit-identically
-        tag = _REGISTRY_BY_TYPE.get(type(obj)) or getattr(
-            type(obj), "__cts_tag__", None
-        )
-        if tag is None:
+        # unknown object round-trips bit-identically. Per-class header
+        # and field-name encodings are constants — cached: the encode
+        # walk is the id-preimage/wire/checkpoint hot path, and
+        # dataclasses.fields() per instance was ~10% of it.
+        info = _class_enc_info(type(obj))
+        if info is None:
             raise SerializationError(
                 f"type {type(obj).__name__} is not canonically serializable"
             )
-        out.append(0x09)
-        tb = tag.encode("utf-8")
-        out += _varint(len(tb))
-        out += tb
-        if type(obj) in _CUSTOM_ENC:
-            _enc(_CUSTOM_ENC[type(obj)](obj), out)
+        header, custom, field_encs = info
+        out += header
+        if custom is not None:
+            _enc(custom(obj), out, depth + 1)
         else:
-            fields = [
-                (f.name, getattr(obj, f.name))
-                for f in dataclasses.fields(obj)
+            for name_bytes, name in field_encs:
+                out += name_bytes
+                _enc(getattr(obj, name), out, depth + 1)
+
+
+_CLASS_ENC_CACHE: dict[type, tuple] = {}
+
+
+def _class_enc_info(cls):
+    """(header_bytes, custom_enc_or_None, ((name_encoding, name), ...))
+    for a registered class — every byte here is per-class constant."""
+    info = _CLASS_ENC_CACHE.get(cls)
+    if info is None:
+        tag = _REGISTRY_BY_TYPE.get(cls) or getattr(cls, "__cts_tag__", None)
+        if tag is None:
+            return None   # not cached: the class may register later
+        tb = tag.encode("utf-8")
+        header = bytes([0x09]) + _varint(len(tb)) + tb
+        custom = _CUSTOM_ENC.get(cls)
+        if custom is not None:
+            info = (header, custom, ())
+        else:
+            names = [
+                f.name
+                for f in dataclasses.fields(cls)
                 if f.metadata.get("serialize", True)
             ]
-            out += _varint(len(fields))
-            for name, value in fields:
-                _enc(name, out)
-                _enc(value, out)
+            field_encs = tuple(
+                (
+                    bytes([0x06])
+                    + _varint(len(nb := name.encode("utf-8")))
+                    + nb,
+                    name,
+                )
+                for name in names
+            )
+            info = (header + _varint(len(names)), None, field_encs)
+        _CLASS_ENC_CACHE[cls] = info
+    return info
 
 
 def decode(buf: bytes) -> Any:
+    native = _native_codec()
+    if native is not None:
+        return native.cts_decode(bytes(buf))
     val, i = _dec(buf, 0)
     if i != len(buf):
         raise SerializationError("trailing bytes")
     return val
 
 
-def _dec(buf: bytes, i: int) -> tuple[Any, int]:
+def decode_py(buf: bytes) -> Any:
+    """The pure-Python reference decoder (differential tests)."""
+    val, i = _dec(buf, 0)
+    if i != len(buf):
+        raise SerializationError("trailing bytes")
+    return val
+
+
+def _dec(buf: bytes, i: int, depth: int = 0) -> tuple[Any, int]:
+    if depth > MAX_DEPTH:
+        raise SerializationError("nesting too deep")
     if i >= len(buf):
         raise SerializationError("truncated")
     tag = buf[i]
@@ -227,25 +351,35 @@ def _dec(buf: bytes, i: int) -> tuple[Any, int]:
         n, i = _read_varint(buf, i)
         if i + n > len(buf):
             raise SerializationError("truncated str")
-        return buf[i : i + n].decode("utf-8"), i + n
+        try:
+            return buf[i : i + n].decode("utf-8"), i + n
+        except UnicodeDecodeError:
+            # a malformed frame must be droppable by SerializationError
+            # handlers (the fabric's), not crash the pump
+            raise SerializationError("invalid utf-8 in str")
     if tag == 0x07:
         n, i = _read_varint(buf, i)
         out = []
         for _ in range(n):
-            v, i = _dec(buf, i)
+            v, i = _dec(buf, i, depth + 1)
             out.append(v)
         return out, i
     if tag == 0x08:
         n, i = _read_varint(buf, i)
         d = {}
         for _ in range(n):
-            k, i = _dec(buf, i)
-            v, i = _dec(buf, i)
+            k, i = _dec(buf, i, depth + 1)
+            v, i = _dec(buf, i, depth + 1)
             d[k] = v
         return d, i
     if tag == 0x09:
         n, i = _read_varint(buf, i)
-        tname = buf[i : i + n].decode("utf-8")
+        if i + n > len(buf):
+            raise SerializationError("truncated tag")
+        try:
+            tname = buf[i : i + n].decode("utf-8")
+        except UnicodeDecodeError:
+            raise SerializationError("invalid utf-8 in tag")
         i += n
         cls = _REGISTRY_BY_TAG.get(tname)
         if cls is None:
@@ -254,19 +388,19 @@ def _dec(buf: bytes, i: int) -> tuple[Any, int]:
                 nf, i = _read_varint(buf, i)
                 kwargs = {}
                 for _ in range(nf):
-                    name, i = _dec(buf, i)
-                    value, i = _dec(buf, i)
+                    name, i = _dec(buf, i, depth + 1)
+                    value, i = _dec(buf, i, depth + 1)
                     kwargs[name] = value
                 return handler(tname, kwargs), i
             raise SerializationError(f"unknown object tag {tname!r}")
         if tname in _CUSTOM_DEC:
-            payload, i = _dec(buf, i)
+            payload, i = _dec(buf, i, depth + 1)
             return _CUSTOM_DEC[tname](payload), i
         nf, i = _read_varint(buf, i)
         kwargs = {}
         for _ in range(nf):
-            name, i = _dec(buf, i)
-            value, i = _dec(buf, i)
+            name, i = _dec(buf, i, depth + 1)
+            value, i = _dec(buf, i, depth + 1)
             kwargs[name] = value
         return _decode_dataclass(cls, kwargs), i
     raise SerializationError(f"unknown tag byte {tag:#x}")

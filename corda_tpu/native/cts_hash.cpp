@@ -22,6 +22,8 @@
 #include <array>
 #include <cstdint>
 #include <cstring>
+#include <string>
+#include <utility>
 #include <vector>
 
 #if defined(__x86_64__)
@@ -997,7 +999,647 @@ PyObject* py_pmt_verify_many(PyObject*, PyObject* arg) {
     return result;
 }
 
+// ---------------------------------------------------------------------------
+// CTS codec — the native form of corda_tpu/core/serialization.py's
+// encode/decode. The byte format and every determinism rule (minimal
+// varints, map keys sorted by encoded bytes, whitelist-only object
+// decode) are LOCKED to the pure-Python reference; differential fuzz
+// in tests/test_native.py drives both over random object graphs and
+// mutated byte strings. Configured once per process via cts_configure
+// with the Python-side registry objects, so registration and cache
+// invalidation stay single-sourced in Python.
+
+struct CtsState {
+    PyObject* err = nullptr;           // SerializationError
+    PyObject* enc_cache = nullptr;     // dict type -> (header, custom, fields)
+    PyObject* enc_resolver = nullptr;  // callable type -> info|None
+    PyObject* registry_by_tag = nullptr;   // dict tag -> cls
+    PyObject* custom_dec = nullptr;        // dict tag -> callable
+    PyObject* construct = nullptr;     // _decode_dataclass(cls, kwargs)
+    PyObject* unknown_getter = nullptr;    // _unknown_tag_handler()
+    PyObject* varint_abs = nullptr;    // |int| -> varint bytes (big ints)
+};
+static CtsState g_cts;
+
+static int cts_err(const char* msg) {
+    PyErr_SetString(g_cts.err ? g_cts.err : PyExc_ValueError, msg);
+    return -1;
+}
+
+struct CtsBuf {
+    std::vector<uint8_t> v;
+    void push(uint8_t b) { v.push_back(b); }
+    void append(const void* p, size_t n) {
+        const uint8_t* q = static_cast<const uint8_t*>(p);
+        v.insert(v.end(), q, q + n);
+    }
+};
+
+static void cts_put_varint(CtsBuf& out, uint64_t n) {
+    while (true) {
+        uint8_t b = n & 0x7F;
+        n >>= 7;
+        if (n) {
+            out.push(b | 0x80);
+        } else {
+            out.push(b);
+            return;
+        }
+    }
+}
+
+// mirrors serialization.py MAX_DEPTH: the nesting accept/reject
+// decision must be implementation-independent
+static const int CTS_MAX_DEPTH = 500;
+
+static int cts_enc(PyObject* obj, CtsBuf& out, int depth);
+
+static int cts_enc_int(PyObject* obj, CtsBuf& out) {
+    int overflow = 0;
+    long long v = PyLong_AsLongLongAndOverflow(obj, &overflow);
+    if (!overflow) {
+        if (v == -1 && PyErr_Occurred()) return -1;
+        if (v >= 0) {
+            out.push(0x03);
+            cts_put_varint(out, static_cast<uint64_t>(v));
+        } else {
+            out.push(0x04);
+            // -v overflows at LLONG_MIN; -(v+1)+1 stays in range
+            cts_put_varint(out, static_cast<uint64_t>(-(v + 1)) + 1);
+        }
+        return 0;
+    }
+    // beyond 64 bits: sign from Python, payload via the helper
+    PyObject* zero = PyLong_FromLong(0);
+    if (zero == nullptr) return -1;
+    int neg = PyObject_RichCompareBool(obj, zero, Py_LT);
+    Py_DECREF(zero);
+    if (neg < 0) return -1;
+    out.push(neg ? 0x04 : 0x03);
+    PyObject* payload = PyObject_CallFunctionObjArgs(
+        g_cts.varint_abs, obj, nullptr);
+    if (payload == nullptr) return -1;
+    char* p;
+    Py_ssize_t n;
+    if (PyBytes_AsStringAndSize(payload, &p, &n) < 0) {
+        Py_DECREF(payload);
+        return -1;
+    }
+    out.append(p, static_cast<size_t>(n));
+    Py_DECREF(payload);
+    return 0;
+}
+
+static int cts_enc_object(PyObject* obj, CtsBuf& out, int depth) {
+    PyObject* tp = reinterpret_cast<PyObject*>(Py_TYPE(obj));
+    PyObject* info = PyDict_GetItemWithError(g_cts.enc_cache, tp);
+    if (info == nullptr) {
+        if (PyErr_Occurred()) return -1;
+        info = PyObject_CallFunctionObjArgs(g_cts.enc_resolver, tp, nullptr);
+        if (info == nullptr) return -1;
+        Py_DECREF(info);   // the resolver cached it (or returned None)
+        if (info == Py_None) {
+            PyErr_Format(
+                g_cts.err, "type %s is not canonically serializable",
+                Py_TYPE(obj)->tp_name);
+            return -1;
+        }
+        info = PyDict_GetItemWithError(g_cts.enc_cache, tp);
+        if (info == nullptr)
+            return cts_err("encoder cache desynchronised");
+    }
+    // info = (header_bytes, custom_or_None, ((name_bytes, name), ...)).
+    // STRONG ref for the duration: nested encoding runs arbitrary
+    // Python (custom encoders, property getters) that may invalidate
+    // the shared cache entry — a borrowed `info` would be freed under
+    // us (round-5 review: reproduced as an interpreter abort).
+    Py_INCREF(info);
+    PyObject* header = PyTuple_GET_ITEM(info, 0);
+    PyObject* custom = PyTuple_GET_ITEM(info, 1);
+    PyObject* fields = PyTuple_GET_ITEM(info, 2);
+    char* hp;
+    Py_ssize_t hn;
+    if (PyBytes_AsStringAndSize(header, &hp, &hn) < 0) {
+        Py_DECREF(info);
+        return -1;
+    }
+    out.append(hp, static_cast<size_t>(hn));
+    if (custom != Py_None) {
+        PyObject* payload =
+            PyObject_CallFunctionObjArgs(custom, obj, nullptr);
+        int rc = payload == nullptr ? -1 : cts_enc(payload, out, depth + 1);
+        Py_XDECREF(payload);
+        Py_DECREF(info);
+        return rc;
+    }
+    Py_ssize_t nf = PyTuple_GET_SIZE(fields);
+    for (Py_ssize_t i = 0; i < nf; i++) {
+        PyObject* pair = PyTuple_GET_ITEM(fields, i);
+        PyObject* name_bytes = PyTuple_GET_ITEM(pair, 0);
+        PyObject* name = PyTuple_GET_ITEM(pair, 1);
+        char* np;
+        Py_ssize_t nn;
+        if (PyBytes_AsStringAndSize(name_bytes, &np, &nn) < 0) {
+            Py_DECREF(info);
+            return -1;
+        }
+        out.append(np, static_cast<size_t>(nn));
+        PyObject* value = PyObject_GetAttr(obj, name);
+        if (value == nullptr) {
+            Py_DECREF(info);
+            return -1;
+        }
+        int rc = cts_enc(value, out, depth + 1);
+        Py_DECREF(value);
+        if (rc < 0) {
+            Py_DECREF(info);
+            return -1;
+        }
+    }
+    Py_DECREF(info);
+    return 0;
+}
+
+static int cts_enc(PyObject* obj, CtsBuf& out, int depth) {
+    if (depth > CTS_MAX_DEPTH) return cts_err("nesting too deep");
+    if (Py_EnterRecursiveCall(" in CTS encode")) return -1;
+    int rc = -1;
+    if (obj == Py_None) {
+        out.push(0x00);
+        rc = 0;
+    } else if (obj == Py_True) {
+        out.push(0x01);
+        rc = 0;
+    } else if (obj == Py_False) {
+        out.push(0x02);
+        rc = 0;
+    } else if (PyLong_Check(obj)) {
+        rc = cts_enc_int(obj, out);
+    } else if (PyBytes_Check(obj)) {
+        char* p;
+        Py_ssize_t n;
+        PyBytes_AsStringAndSize(obj, &p, &n);
+        out.push(0x05);
+        cts_put_varint(out, static_cast<uint64_t>(n));
+        out.append(p, static_cast<size_t>(n));
+        rc = 0;
+    } else if (PyByteArray_Check(obj)) {
+        out.push(0x05);
+        Py_ssize_t n = PyByteArray_GET_SIZE(obj);
+        cts_put_varint(out, static_cast<uint64_t>(n));
+        out.append(PyByteArray_AS_STRING(obj), static_cast<size_t>(n));
+        rc = 0;
+    } else if (PyUnicode_Check(obj)) {
+        Py_ssize_t n;
+        const char* p = PyUnicode_AsUTF8AndSize(obj, &n);
+        if (p != nullptr) {
+            out.push(0x06);
+            cts_put_varint(out, static_cast<uint64_t>(n));
+            out.append(p, static_cast<size_t>(n));
+            rc = 0;
+        }
+    } else if (PyList_Check(obj) || PyTuple_Check(obj)) {
+        // snapshot: nested encoding runs arbitrary Python that could
+        // mutate a list mid-walk (tuples return themselves, no copy)
+        PyObject* snap = PySequence_Tuple(obj);
+        if (snap != nullptr) {
+            Py_ssize_t n = PyTuple_GET_SIZE(snap);
+            out.push(0x07);
+            cts_put_varint(out, static_cast<uint64_t>(n));
+            rc = 0;
+            for (Py_ssize_t i = 0; i < n; i++) {
+                if (cts_enc(PyTuple_GET_ITEM(snap, i), out,
+                            depth + 1) < 0) {
+                    rc = -1;
+                    break;
+                }
+            }
+            Py_DECREF(snap);
+        }
+    } else if (PyDict_Check(obj)) {
+        out.push(0x08);
+        cts_put_varint(out, static_cast<uint64_t>(PyDict_Size(obj)));
+        std::vector<std::pair<std::string, std::string>> entries;
+        entries.reserve(static_cast<size_t>(PyDict_Size(obj)));
+        // snapshot for the same reason: PyDict_Next during reentrant
+        // mutation is undefined behaviour
+        PyObject* items = PyDict_Items(obj);
+        rc = items == nullptr ? -1 : 0;
+        Py_ssize_t n_items =
+            items == nullptr ? 0 : PyList_GET_SIZE(items);
+        for (Py_ssize_t j = 0; rc == 0 && j < n_items; j++) {
+            PyObject* pair = PyList_GET_ITEM(items, j);
+            CtsBuf kb, vb;
+            if (cts_enc(PyTuple_GET_ITEM(pair, 0), kb, depth + 1) < 0 ||
+                cts_enc(PyTuple_GET_ITEM(pair, 1), vb, depth + 1) < 0) {
+                rc = -1;
+                break;
+            }
+            entries.emplace_back(
+                std::string(kb.v.begin(), kb.v.end()),
+                std::string(vb.v.begin(), vb.v.end()));
+        }
+        Py_XDECREF(items);
+        if (rc == 0) {
+            // pair<string,string> sorts key-bytes-then-value-bytes —
+            // exactly the reference's sorted((encode(k), encode(v)))
+            std::sort(entries.begin(), entries.end());
+            for (auto& e : entries) {
+                out.append(e.first.data(), e.first.size());
+                out.append(e.second.data(), e.second.size());
+            }
+        }
+    } else if (PyFrozenSet_Check(obj)) {
+        out.push(0x07);
+        std::vector<std::string> items;
+        PyObject* it = PyObject_GetIter(obj);
+        rc = it == nullptr ? -1 : 0;
+        if (it != nullptr) {
+            PyObject* elem;
+            while ((elem = PyIter_Next(it)) != nullptr) {
+                CtsBuf eb;
+                int erc = cts_enc(elem, eb, depth + 1);
+                Py_DECREF(elem);
+                if (erc < 0) {
+                    rc = -1;
+                    break;
+                }
+                items.emplace_back(eb.v.begin(), eb.v.end());
+            }
+            Py_DECREF(it);
+            if (PyErr_Occurred()) rc = -1;
+        }
+        if (rc == 0) {
+            std::sort(items.begin(), items.end());
+            cts_put_varint(out, static_cast<uint64_t>(items.size()));
+            for (auto& e : items) out.append(e.data(), e.size());
+        }
+    } else {
+        rc = cts_enc_object(obj, out, depth);
+    }
+    Py_LeaveRecursiveCall();
+    return rc;
+}
+
+// -- decoder ---------------------------------------------------------------
+
+struct CtsRd {
+    const uint8_t* p;
+    Py_ssize_t n;
+    Py_ssize_t i;
+};
+
+// Reads one varint; values that fit uint64 return via `out`. A wider
+// value (the reference allows up to 640 bits) returns a Python int via
+// `big` instead — callers using the value as a LENGTH treat that as
+// out-of-bounds.
+static int cts_rd_varint(CtsRd& r, uint64_t& out, PyObject** big) {
+    int shift = 0;
+    uint64_t val = 0;
+    Py_ssize_t start = r.i;
+    if (big != nullptr) *big = nullptr;
+    while (true) {
+        if (r.i >= r.n) return cts_err("truncated varint");
+        uint8_t b = r.p[r.i++];
+        if (shift < 64) val |= static_cast<uint64_t>(b & 0x7F) << shift;
+        if (!(b & 0x80)) {
+            if (b == 0 && shift) return cts_err("non-minimal varint");
+            if (shift >= 64 || (shift > 56 && (b >> (64 - shift)) != 0)) {
+                // overflows uint64: rebuild exactly like the reference
+                PyObject* acc = PyLong_FromLong(0);
+                int s2 = 0;
+                for (Py_ssize_t j = start; j < r.i && acc != nullptr; j++) {
+                    PyObject* part =
+                        PyLong_FromUnsignedLongLong(r.p[j] & 0x7F);
+                    PyObject* shamt =
+                        part == nullptr ? nullptr : PyLong_FromLong(s2);
+                    PyObject* sh = shamt == nullptr
+                        ? nullptr
+                        : PyNumber_Lshift(part, shamt);
+                    Py_XDECREF(part);
+                    Py_XDECREF(shamt);
+                    PyObject* merged = sh == nullptr
+                        ? nullptr
+                        : PyNumber_Or(acc, sh);
+                    Py_XDECREF(sh);
+                    Py_DECREF(acc);
+                    acc = merged;
+                    s2 += 7;
+                }
+                if (acc == nullptr) return -1;
+                if (big == nullptr) {
+                    Py_DECREF(acc);
+                    return cts_err("length varint out of range");
+                }
+                *big = acc;
+                return 0;
+            }
+            out = val;
+            return 0;
+        }
+        shift += 7;
+        if (shift > 640) return cts_err("varint too long");
+    }
+}
+
+static PyObject* cts_dec(CtsRd& r, int depth);
+
+static PyObject* cts_dec_str(CtsRd& r, const char* truncated_msg) {
+    uint64_t n;
+    if (cts_rd_varint(r, n, nullptr) < 0) return nullptr;
+    if (n > static_cast<uint64_t>(r.n - r.i)) {
+        cts_err(truncated_msg);
+        return nullptr;
+    }
+    PyObject* s = PyUnicode_DecodeUTF8(
+        reinterpret_cast<const char*>(r.p + r.i),
+        static_cast<Py_ssize_t>(n), nullptr);
+    if (s == nullptr) {
+        PyErr_Clear();
+        cts_err("invalid utf-8 in str");
+        return nullptr;
+    }
+    r.i += static_cast<Py_ssize_t>(n);
+    return s;
+}
+
+static PyObject* cts_dec_object(CtsRd& r, int depth) {
+    PyObject* tname = cts_dec_str(r, "truncated tag");
+    if (tname == nullptr) return nullptr;
+    PyObject* cls = PyDict_GetItemWithError(g_cts.registry_by_tag, tname);
+    if (cls == nullptr && PyErr_Occurred()) {
+        Py_DECREF(tname);
+        return nullptr;
+    }
+    int has_custom = PyDict_Contains(g_cts.custom_dec, tname);
+    if (has_custom < 0) {
+        Py_DECREF(tname);
+        return nullptr;
+    }
+    if (cls == nullptr) {
+        PyObject* handler =
+            PyObject_CallFunctionObjArgs(g_cts.unknown_getter, nullptr);
+        if (handler == nullptr) {
+            Py_DECREF(tname);
+            return nullptr;
+        }
+        if (handler == Py_None || has_custom) {
+            Py_DECREF(handler);
+            PyErr_Format(g_cts.err, "unknown object tag '%U'", tname);
+            Py_DECREF(tname);
+            return nullptr;
+        }
+        // field map -> handler(tname, kwargs)
+        uint64_t nf;
+        if (cts_rd_varint(r, nf, nullptr) < 0) {
+            Py_DECREF(handler);
+            Py_DECREF(tname);
+            return nullptr;
+        }
+        PyObject* kwargs = PyDict_New();
+        for (uint64_t k = 0; kwargs != nullptr && k < nf; k++) {
+            PyObject* name = cts_dec(r, depth + 1);
+            PyObject* value = name == nullptr ? nullptr : cts_dec(r, depth + 1);
+            if (value == nullptr ||
+                PyDict_SetItem(kwargs, name, value) < 0) {
+                Py_XDECREF(name);
+                Py_XDECREF(value);
+                Py_CLEAR(kwargs);
+                break;
+            }
+            Py_DECREF(name);
+            Py_DECREF(value);
+        }
+        PyObject* obj = kwargs == nullptr
+            ? nullptr
+            : PyObject_CallFunctionObjArgs(handler, tname, kwargs, nullptr);
+        Py_XDECREF(kwargs);
+        Py_DECREF(handler);
+        Py_DECREF(tname);
+        return obj;
+    }
+    if (has_custom) {
+        PyObject* dec = PyDict_GetItemWithError(g_cts.custom_dec, tname);
+        Py_DECREF(tname);
+        if (dec == nullptr) return nullptr;
+        // strong ref: the payload decode below runs arbitrary Python
+        // that could replace this registry entry (round-5 review)
+        Py_INCREF(dec);
+        PyObject* payload = cts_dec(r, depth + 1);
+        PyObject* obj = payload == nullptr
+            ? nullptr
+            : PyObject_CallFunctionObjArgs(dec, payload, nullptr);
+        Py_XDECREF(payload);
+        Py_DECREF(dec);
+        return obj;
+    }
+    Py_DECREF(tname);
+    Py_INCREF(cls);   // same hazard: field decoding may re-register
+    uint64_t nf;
+    if (cts_rd_varint(r, nf, nullptr) < 0) {
+        Py_DECREF(cls);
+        return nullptr;
+    }
+    PyObject* kwargs = PyDict_New();
+    for (uint64_t k = 0; kwargs != nullptr && k < nf; k++) {
+        PyObject* name = cts_dec(r, depth + 1);
+        PyObject* value = name == nullptr ? nullptr : cts_dec(r, depth + 1);
+        if (value == nullptr || PyDict_SetItem(kwargs, name, value) < 0) {
+            Py_XDECREF(name);
+            Py_XDECREF(value);
+            Py_CLEAR(kwargs);
+            break;
+        }
+        Py_DECREF(name);
+        Py_DECREF(value);
+    }
+    PyObject* obj = kwargs == nullptr
+        ? nullptr
+        : PyObject_CallFunctionObjArgs(g_cts.construct, cls, kwargs, nullptr);
+    Py_XDECREF(kwargs);
+    Py_DECREF(cls);
+    return obj;
+}
+
+static PyObject* cts_dec(CtsRd& r, int depth) {
+    if (depth > CTS_MAX_DEPTH) {
+        cts_err("nesting too deep");
+        return nullptr;
+    }
+    if (Py_EnterRecursiveCall(" in CTS decode")) return nullptr;
+    PyObject* result = nullptr;
+    if (r.i >= r.n) {
+        cts_err("truncated");
+    } else {
+        uint8_t tag = r.p[r.i++];
+        switch (tag) {
+            case 0x00:
+                result = Py_NewRef(Py_None);
+                break;
+            case 0x01:
+                result = Py_NewRef(Py_True);
+                break;
+            case 0x02:
+                result = Py_NewRef(Py_False);
+                break;
+            case 0x03:
+            case 0x04: {
+                uint64_t v;
+                PyObject* big = nullptr;
+                if (cts_rd_varint(r, v, &big) == 0) {
+                    if (big != nullptr) {
+                        result = tag == 0x04
+                            ? PyNumber_Negative(big)
+                            : Py_NewRef(big);
+                        Py_DECREF(big);
+                    } else if (tag == 0x03) {
+                        result = PyLong_FromUnsignedLongLong(v);
+                    } else {
+                        PyObject* pos = PyLong_FromUnsignedLongLong(v);
+                        result =
+                            pos == nullptr ? nullptr : PyNumber_Negative(pos);
+                        Py_XDECREF(pos);
+                    }
+                }
+                break;
+            }
+            case 0x05: {
+                uint64_t n;
+                if (cts_rd_varint(r, n, nullptr) == 0) {
+                    if (n > static_cast<uint64_t>(r.n - r.i)) {
+                        cts_err("truncated bytes");
+                    } else {
+                        result = PyBytes_FromStringAndSize(
+                            reinterpret_cast<const char*>(r.p + r.i),
+                            static_cast<Py_ssize_t>(n));
+                        r.i += static_cast<Py_ssize_t>(n);
+                    }
+                }
+                break;
+            }
+            case 0x06:
+                result = cts_dec_str(r, "truncated str");
+                break;
+            case 0x07: {
+                uint64_t n;
+                if (cts_rd_varint(r, n, nullptr) == 0) {
+                    result = PyList_New(0);
+                    for (uint64_t k = 0; result != nullptr && k < n; k++) {
+                        PyObject* item = cts_dec(r, depth + 1);
+                        if (item == nullptr ||
+                            PyList_Append(result, item) < 0) {
+                            Py_XDECREF(item);
+                            Py_CLEAR(result);
+                            break;
+                        }
+                        Py_DECREF(item);
+                    }
+                }
+                break;
+            }
+            case 0x08: {
+                uint64_t n;
+                if (cts_rd_varint(r, n, nullptr) == 0) {
+                    result = PyDict_New();
+                    for (uint64_t k = 0; result != nullptr && k < n; k++) {
+                        PyObject* key = cts_dec(r, depth + 1);
+                        PyObject* value =
+                            key == nullptr ? nullptr : cts_dec(r, depth + 1);
+                        if (value == nullptr ||
+                            PyDict_SetItem(result, key, value) < 0) {
+                            Py_XDECREF(key);
+                            Py_XDECREF(value);
+                            Py_CLEAR(result);
+                            break;
+                        }
+                        Py_DECREF(key);
+                        Py_DECREF(value);
+                    }
+                }
+                break;
+            }
+            case 0x09:
+                result = cts_dec_object(r, depth);
+                break;
+            default:
+                PyErr_Format(g_cts.err, "unknown tag byte 0x%x", tag);
+        }
+    }
+    Py_LeaveRecursiveCall();
+    return result;
+}
+
+PyObject* py_cts_configure(PyObject*, PyObject* args) {
+    PyObject *err, *cache, *resolver, *by_tag, *custom_dec, *construct,
+        *unknown_getter, *varint_abs;
+    if (!PyArg_ParseTuple(
+            args, "OOOOOOOO", &err, &cache, &resolver, &by_tag,
+            &custom_dec, &construct, &unknown_getter, &varint_abs))
+        return nullptr;
+    // hold them forever (module lifetime); re-configure swaps cleanly
+    Py_INCREF(err);
+    Py_INCREF(cache);
+    Py_INCREF(resolver);
+    Py_INCREF(by_tag);
+    Py_INCREF(custom_dec);
+    Py_INCREF(construct);
+    Py_INCREF(unknown_getter);
+    Py_INCREF(varint_abs);
+    Py_XDECREF(g_cts.err);
+    Py_XDECREF(g_cts.enc_cache);
+    Py_XDECREF(g_cts.enc_resolver);
+    Py_XDECREF(g_cts.registry_by_tag);
+    Py_XDECREF(g_cts.custom_dec);
+    Py_XDECREF(g_cts.construct);
+    Py_XDECREF(g_cts.unknown_getter);
+    Py_XDECREF(g_cts.varint_abs);
+    g_cts.err = err;
+    g_cts.enc_cache = cache;
+    g_cts.enc_resolver = resolver;
+    g_cts.registry_by_tag = by_tag;
+    g_cts.custom_dec = custom_dec;
+    g_cts.construct = construct;
+    g_cts.unknown_getter = unknown_getter;
+    g_cts.varint_abs = varint_abs;
+    Py_RETURN_NONE;
+}
+
+PyObject* py_cts_encode(PyObject*, PyObject* obj) {
+    if (g_cts.err == nullptr) {
+        PyErr_SetString(PyExc_RuntimeError, "cts_configure not called");
+        return nullptr;
+    }
+    CtsBuf out;
+    if (cts_enc(obj, out, 0) < 0) return nullptr;
+    return PyBytes_FromStringAndSize(
+        reinterpret_cast<const char*>(out.v.data()),
+        static_cast<Py_ssize_t>(out.v.size()));
+}
+
+PyObject* py_cts_decode(PyObject*, PyObject* arg) {
+    if (g_cts.err == nullptr) {
+        PyErr_SetString(PyExc_RuntimeError, "cts_configure not called");
+        return nullptr;
+    }
+    Py_buffer view;
+    if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) < 0) return nullptr;
+    CtsRd r{static_cast<const uint8_t*>(view.buf), view.len, 0};
+    PyObject* result = cts_dec(r, 0);
+    if (result != nullptr && r.i != r.n) {
+        Py_CLEAR(result);
+        cts_err("trailing bytes");
+    }
+    PyBuffer_Release(&view);
+    return result;
+}
+
 PyMethodDef methods[] = {
+    {"cts_configure", py_cts_configure, METH_VARARGS,
+     "Wire the CTS codec to the Python-side registry objects."},
+    {"cts_encode", py_cts_encode, METH_O,
+     "Canonical CTS encoding of a value (serialization.py semantics)."},
+    {"cts_decode", py_cts_decode, METH_O,
+     "Decode a CTS blob (whitelist-only; serialization.py semantics)."},
     {"pmt_verify_many", py_pmt_verify_many, METH_O,
      "Verify many partial-Merkle proofs: "
      "[(tree_size, indices, proof, leaves, root)] -> [bool]."},
