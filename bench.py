@@ -6,8 +6,8 @@ accept/reject vs the CPU reference semantics.
 
 Prints one JSON line per metric: {"metric", "value", "unit",
 "vs_baseline"}. The DEFAULT run (no BENCH_METRIC) measures the whole
-BASELINE.md table — mixed, merkle, notary, plus a reduced-n kernel
-parity refresh — inside ONE wall-clock budget (BENCH_TIME_BUDGET
+BASELINE.md table — mixed, merkle, notary, ingest, plus a reduced-n
+kernel parity refresh — inside ONE wall-clock budget (BENCH_TIME_BUDGET
 seconds, default 900), trimming then skipping secondaries as the
 budget tightens, and ALWAYS prints the headline p256 line LAST, so a
 driver that parses the final line records the headline while the full
@@ -22,6 +22,9 @@ BENCH_METRIC restricts to one measurement:
   merkle          — FilteredTransaction shape: partial Merkle proof
                     (native host SHA-256) + p256 signature per item
   notary          — BatchingNotaryService serving rate
+  ingest          — wire-ingest rate: CTS decode + cold Merkle id +
+                    signature staging per received transaction (host
+                    only; the flush metrics never see this cost)
   montmul         — device-resident A/B of the MXU (batched int8
                     Toeplitz matmul) vs VPU (shifted accumulate)
                     Montgomery-multiply formulations (experiment rig,
@@ -297,6 +300,68 @@ def _notary_metric(batch: int, iters: int) -> dict:
     }
 
 
+def _ingest_metric(batch: int, iters: int) -> dict:
+    """Wire-ingest rate (round-5): decode a canonical signed cash
+    spend's CTS bytes, compute its Merkle id COLD, and stage its
+    signature requests — the per-transaction host cost a notary pays
+    on arrival, BEFORE any flush (the flush metrics' fixtures carry
+    warm objects and never see it). Pure host work, no device; the
+    native CTS codec is what lifted this from ~2.5k/s
+    (BASELINE.md round-5 second pass)."""
+    from corda_tpu.core import serialization as ser
+    from corda_tpu.core.contracts import Amount, Issued, StateRef
+    from corda_tpu.core.identity import PartyAndReference
+    from corda_tpu.core.transactions import TransactionBuilder
+    from corda_tpu.finance.cash import (
+        CASH_CONTRACT,
+        CashIssue,
+        CashMove,
+        CashState,
+    )
+    from corda_tpu.testing.mock_network import MockNetwork
+
+    net = MockNetwork(seed=9)
+    notary = net.create_notary("Notary")
+    bank = net.create_node("Bank")
+    alice = net.create_node("Alice")
+    token = Issued(PartyAndReference(bank.party, b"\x01"), "USD")
+    ib = TransactionBuilder(notary.party)
+    ib.add_output_state(
+        CashState(Amount(100, token), alice.party.owning_key), CASH_CONTRACT
+    )
+    ib.add_command(CashIssue(1), bank.party.owning_key)
+    issue = bank.services.sign_initial_transaction(ib)
+    alice.services.record_transactions([issue])
+    sb = TransactionBuilder(notary.party)
+    sb.add_input_state(alice.vault.state_and_ref(StateRef(issue.id, 0)))
+    sb.add_output_state(
+        CashState(Amount(100, token), bank.party.owning_key),
+        CASH_CONTRACT, notary.party,
+    )
+    sb.add_command(CashMove(), alice.party.owning_key)
+    blob = ser.encode(alice.services.sign_initial_transaction(sb))
+
+    def run_once() -> None:
+        for _ in range(batch):
+            stx = ser.decode(blob)
+            stx.wtx.id                  # cold Merkle id, every time
+            if not stx.signature_requests():
+                raise SystemExit("ingest staging produced nothing")
+
+    run_once()                          # warm-up
+    rate = _median_rate(run_once, batch, iters)
+    from corda_tpu.native import get as _native
+
+    return {
+        "metric": "wire_ingest_decode_id_stage_per_sec",
+        "value": round(rate, 1),
+        "unit": "tx/s",
+        "vs_baseline": round(rate / BASELINE, 3),
+        "wire_bytes": len(blob),
+        "native_codec": _native() is not None,
+    }
+
+
 def _montmul_metric(batch: int, iters: int) -> dict:
     """Interleaved device-resident A/B of the two variable x variable
     Montgomery-multiply formulations (round-3 MXU experiment, VERDICT
@@ -536,6 +601,12 @@ def _run_metric(metric: str, batch: int, iters: int) -> dict:
         return out
     if metric == "montmul":
         return _montmul_metric(min(batch, 8192), iters)
+    if metric == "ingest":
+        out = _ingest_metric(min(batch, 16384), iters)
+        out["batch"] = min(batch, 16384)   # cap visible in the record
+        if batch > 16384:
+            out["batch_requested"] = batch
+        return out
     if metric == "parity":
         return _parity_metric(batch, iters)
     return _spi_metric(metric, batch, iters)
@@ -580,7 +651,10 @@ def main() -> None:
     batch = int(os.environ.get("BENCH_BATCH", "32768"))
     iters = int(os.environ.get("BENCH_ITERS", "3"))
     metric = os.environ.get("BENCH_METRIC", "all")
-    known = ("all", "p256", "mixed", "merkle", "notary", "montmul", "parity")
+    known = (
+        "all", "p256", "mixed", "merkle", "notary", "ingest", "montmul",
+        "parity",
+    )
     if metric not in known:
         # a typo must not record a p256-only rate under another name
         raise SystemExit(
@@ -617,7 +691,7 @@ def main() -> None:
 
     # parity runs LAST of the optional work (cheapest to drop), but
     # before the headline so the headline stays the final stdout line
-    for m in ("mixed", "merkle", "notary", "parity"):
+    for m in ("mixed", "merkle", "notary", "ingest", "parity"):
         avail = left() - reserve
         if avail < 60:
             print(
@@ -627,7 +701,7 @@ def main() -> None:
             )
             continue
         env = dict(os.environ, BENCH_METRIC=m)
-        if avail < 300 and m in ("mixed", "merkle", "notary"):
+        if avail < 300 and m in ("mixed", "merkle", "notary", "ingest"):
             # trim before dropping: one timed rep at a shallower batch
             # still yields a usable point for the table
             env["BENCH_ITERS"] = "1"
