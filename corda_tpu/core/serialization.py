@@ -142,14 +142,18 @@ def _native_codec():
         from ..native import get as _get_native
 
         mod = _get_native()
-        if mod is not None and hasattr(mod, "cts_encode"):
+        # the ABI gate refuses a STALE extension build: cts_abi 2 =
+        # the construct callable receives pre-tuplified kwargs. An
+        # older .so would silently hand dataclasses list fields where
+        # tuples are expected — fall back to pure Python instead.
+        if mod is not None and getattr(mod, "cts_abi", 0) == 2:
             mod.cts_configure(
                 SerializationError,
                 _CLASS_ENC_CACHE,   # shared cache: .pop() invalidates
                 _class_enc_info,    # miss resolver (fills the cache)
                 _REGISTRY_BY_TAG,
                 _CUSTOM_DEC,
-                _decode_dataclass,
+                _construct_pretuplified,
                 _unknown_tag_handler,
                 _varint_abs,
             )
@@ -414,17 +418,28 @@ def _tuplify(v):
 
 
 def _decode_dataclass(cls, kwargs):
+    # ONE reconstruction implementation: the pure-Python path tuplifies
+    # here, the native decoder tuplified in C — identical from
+    # _construct_pretuplified onward, so the evolution rules cannot
+    # skew between the two codecs
+    return _construct_pretuplified(
+        cls, {k: _tuplify(v) for k, v in kwargs.items()}
+    )
+
+
+def _construct_pretuplified(cls, kwargs):
+    """Reconstruct a registered dataclass from ALREADY-tuplified field
+    values (the native decoder's C-side list->tuple walk; the Python
+    reference tuplifies before delegating here)."""
     try:
-        return cls(**{k: _tuplify(v) for k, v in kwargs.items()})
+        return cls(**kwargs)
     except TypeError as e:
         if _unknown_tag_handler() is not None and dataclasses.is_dataclass(cls):
             # evolution tolerance (carpenter contexts only): drop fields
             # this version doesn't know; removed-then-defaulted fields
             # fill from dataclass defaults
             known = {f.name for f in dataclasses.fields(cls)}
-            trimmed = {
-                k: _tuplify(v) for k, v in kwargs.items() if k in known
-            }
+            trimmed = {k: v for k, v in kwargs.items() if k in known}
             try:
                 return cls(**trimmed)
             except TypeError:

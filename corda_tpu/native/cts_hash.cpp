@@ -1344,6 +1344,25 @@ static int cts_rd_varint(CtsRd& r, uint64_t& out, PyObject** big) {
 
 static PyObject* cts_dec(CtsRd& r, int depth);
 
+// serialization.py _tuplify: lists (recursively) become tuples at
+// dataclass-construction boundaries; everything else passes through.
+// Pure C, GIL held, no callbacks — nothing can mutate mid-walk.
+static PyObject* cts_tuplify(PyObject* v) {
+    if (!PyList_Check(v)) return Py_NewRef(v);
+    Py_ssize_t n = PyList_GET_SIZE(v);
+    PyObject* t = PyTuple_New(n);
+    if (t == nullptr) return nullptr;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject* item = cts_tuplify(PyList_GET_ITEM(v, i));
+        if (item == nullptr) {
+            Py_DECREF(t);
+            return nullptr;
+        }
+        PyTuple_SET_ITEM(t, i, item);
+    }
+    return t;
+}
+
 static PyObject* cts_dec_str(CtsRd& r, const char* truncated_msg) {
     uint64_t n;
     if (cts_rd_varint(r, n, nullptr) < 0) return nullptr;
@@ -1444,14 +1463,18 @@ static PyObject* cts_dec_object(CtsRd& r, int depth) {
     for (uint64_t k = 0; kwargs != nullptr && k < nf; k++) {
         PyObject* name = cts_dec(r, depth + 1);
         PyObject* value = name == nullptr ? nullptr : cts_dec(r, depth + 1);
-        if (value == nullptr || PyDict_SetItem(kwargs, name, value) < 0) {
+        // tuplify HERE (construct is _construct_pretuplified): saves
+        // the Python-side tuplify recursion and second kwargs dict
+        PyObject* tupled = value == nullptr ? nullptr : cts_tuplify(value);
+        Py_XDECREF(value);
+        if (tupled == nullptr || PyDict_SetItem(kwargs, name, tupled) < 0) {
             Py_XDECREF(name);
-            Py_XDECREF(value);
+            Py_XDECREF(tupled);
             Py_CLEAR(kwargs);
             break;
         }
         Py_DECREF(name);
-        Py_DECREF(value);
+        Py_DECREF(tupled);
     }
     PyObject* obj = kwargs == nullptr
         ? nullptr
@@ -1667,4 +1690,14 @@ PyModuleDef module = {
 
 }  // namespace
 
-PyMODINIT_FUNC PyInit__cts_hash(void) { return PyModule_Create(&module); }
+PyMODINIT_FUNC PyInit__cts_hash(void) {
+    PyObject* m = PyModule_Create(&module);
+    if (m != nullptr) {
+        // codec ABI generation: serialization.py refuses to wire a
+        // stale .so whose contract differs (2 = construct callable
+        // receives PRE-TUPLIFIED kwargs). Bump on any change to the
+        // cts_* calling conventions.
+        PyModule_AddIntConstant(m, "cts_abi", 2);
+    }
+    return m;
+}
