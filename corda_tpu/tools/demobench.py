@@ -21,6 +21,7 @@ import os
 import selectors
 import subprocess
 import sys
+import threading
 import time
 from typing import Optional
 
@@ -60,6 +61,27 @@ class BenchNode:
         self.process = process
         self.port = port
         self.log_path = log_path
+        # keep draining stdout into the pane AFTER boot: the port
+        # handshake reader stops at P2P_PORT=, but later announcements
+        # (WEB_PORT=, runtime prints) must reach the pane — and an
+        # undrained pipe would eventually block a chatty node.
+        # (explorer/graphs wrap already-running processes in a
+        # stand-in with no stdout: nothing to drain there)
+        if getattr(process, "stdout", None) is not None:
+            threading.Thread(target=self._drain, daemon=True).start()
+
+    def _drain(self) -> None:
+        stdout = self.process.stdout
+        try:
+            os.set_blocking(stdout.fileno(), True)
+            # read1, not read: read(n) on a buffered pipe blocks until
+            # n bytes accumulate — a short announcement line would sit
+            # invisible until the next flush filled the buffer
+            for chunk in iter(lambda: stdout.read1(4096), b""):
+                with open(self.log_path, "ab") as pane:
+                    pane.write(chunk)
+        except (OSError, ValueError):
+            pass   # process gone / fd closed: pane is complete
 
     @property
     def alive(self) -> bool:
@@ -177,6 +199,11 @@ class DemoBench:
                         pane.write((line + "\n").encode())
         finally:
             sel.close()
+            if buf:
+                # anything read past the handshake line belongs to the
+                # pane (e.g. a WEB_PORT= announcement sharing the chunk)
+                with open(log_path, "ab") as pane:
+                    pane.write(buf.encode())
         if port is None:
             proc.kill()
             raise RuntimeError(

@@ -1,0 +1,134 @@
+"""Web DemoBench: the browser node launcher (tools/web_demobench.py).
+
+Reference behaviour under test: tools/demobench/ — spawn local node
+processes (first node hosts the network map), show their panes, open
+an explorer against any of them — driven here through the launcher's
+JSON API over a real HTTP server, with real node subprocesses.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=30
+    ) as r:
+        return r.status, json.loads(r.read())
+
+
+def _post(port, path, body):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _wait_state(port, name, want, timeout=120.0):
+    deadline = time.monotonic() + timeout
+    state = None
+    while time.monotonic() < deadline:
+        _, st = _get(port, "/api/bench/status")
+        state = next(
+            (n for n in st["nodes"] if n["name"] == name), {}
+        ).get("state")
+        if state == want:
+            return st
+        if state and state.startswith("failed"):
+            raise AssertionError(f"{name} failed to start: {state}")
+        time.sleep(0.3)
+    raise AssertionError(f"{name} never reached {want!r} (last: {state})")
+
+
+def test_web_demobench_launches_and_drives_nodes(tmp_path):
+    from corda_tpu.tools.web_demobench import serve
+
+    server, launcher = serve(str(tmp_path / "bench"), port=0)
+    port = server.server_port
+    try:
+        # the page itself serves
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/", timeout=30
+        ) as r:
+            page = r.read()
+        assert b"demobench" in page and b"/api/bench/add" in page
+
+        # validation before any process spawns
+        status, body = _post(port, "/api/bench/add", {"name": "bad name!"})
+        assert status == 400
+        status, body = _post(
+            port, "/api/bench/add", {"name": "X", "p2p_port": 1}
+        )
+        assert status == 400 and "unknown config keys" in body["error"]
+
+        # launch the map host (validating notary) WITH a web gateway,
+        # then a plain client node — exactly the reference demobench arc
+        status, body = _post(
+            port,
+            "/api/bench/add",
+            {"name": "Hub", "notary": "validating", "web": True,
+             "verifier_backend": "cpu"},
+        )
+        assert status == 202 and body["status"] == "starting"
+        # double-launch is refused while starting or after up
+        status, body = _post(
+            port, "/api/bench/add",
+            {"name": "Hub", "verifier_backend": "cpu"},
+        )
+        assert status == 409
+        st = _wait_state(port, "Hub", "up")
+        hub = next(n for n in st["nodes"] if n["name"] == "Hub")
+        assert hub["map_host"] is True and hub["notary"] == "validating"
+        assert hub["port"] > 0
+
+        status, _ = _post(
+            port, "/api/bench/add",
+            {"name": "Alice", "verifier_backend": "cpu"},
+        )
+        assert status == 202
+        st = _wait_state(port, "Alice", "up")
+        alice = next(n for n in st["nodes"] if n["name"] == "Alice")
+        assert alice["map_host"] is False
+
+        # the pane shows the node's log
+        status, body = _get(port, "/api/bench/pane?name=Alice&tail=50")
+        assert status == 200 and isinstance(body["lines"], list)
+
+        # the web-enabled node announced its explorer gateway; the
+        # launcher surfaces the port and the explorer actually serves
+        deadline = time.monotonic() + 30
+        web_port = None
+        while time.monotonic() < deadline and not web_port:
+            _, st = _get(port, "/api/bench/status")
+            web_port = next(
+                n for n in st["nodes"] if n["name"] == "Hub"
+            ).get("web_port")
+            time.sleep(0.3)
+        assert web_port, "Hub's web gateway port never surfaced"
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{web_port}/web/explorer/", timeout=30
+        ) as r:
+            assert r.status == 200 and b"ledger explorer" in r.read()
+
+        # stop one node; the other stays up
+        status, _ = _post(port, "/api/bench/stop", {"name": "Alice"})
+        assert status == 200
+        _, st = _get(port, "/api/bench/status")
+        states = {n["name"]: n["state"] for n in st["nodes"]}
+        assert states["Alice"] == "stopped" and states["Hub"] == "up"
+        status, _ = _post(port, "/api/bench/stop", {"name": "Nobody"})
+        assert status == 404
+    finally:
+        server.shutdown()
+        launcher.shutdown()
