@@ -27,6 +27,26 @@ from ..core.clauses import Clause, GroupClauseVerifier, mark, verify_clauses
 from ..core.contracts import Amount, ContractViolation, require_that
 from ..crypto.composite import is_fulfilled_by, leaves_of
 
+_SWEEP_PROBED = False
+_SWEEP_MOD = None
+
+
+def _native_sweep():
+    """The native asset sweep, or None (cached probe; CORDA_TPU_NATIVE=0
+    and missing-extension builds fall back to the Python reference)."""
+    global _SWEEP_PROBED, _SWEEP_MOD
+    if not _SWEEP_PROBED:
+        _SWEEP_PROBED = True
+        try:
+            from ..native import get as _get_native
+
+            mod = _get_native()
+            if mod is not None and hasattr(mod, "asset_verify_fields"):
+                _SWEEP_MOD = mod
+        except Exception:   # noqa: BLE001 - optional accelerator
+            _SWEEP_MOD = None
+    return _SWEEP_MOD
+
 
 def signed_by(key, signers) -> bool:
     """Composite-aware signer check: `key` is satisfied when it (or,
@@ -171,6 +191,13 @@ class AssetGroupClause(Clause):
         return self.move.verify(ltx, inputs, outputs, commands, group_key)
 
 
+def _default_token_of(s):
+    """The standard fungible token key. NAMED (not a lambda default)
+    so the native sweep can recognise it and read .amount.token
+    directly instead of calling back into Python per state."""
+    return s.amount.token
+
+
 class OnLedgerAsset:
     """Generic fungible-asset contract. Concrete assets instantiate it
     with their state class + command types and register the instance
@@ -182,7 +209,7 @@ class OnLedgerAsset:
         issue_cmd: type,
         move_cmd: type,
         exit_cmd: type,
-        token_of: Callable[[Any], Any] = lambda s: s.amount.token,
+        token_of: Callable[[Any], Any] = _default_token_of,
     ):
         self.state_class = state_class
         self.issue_cmd = issue_cmd
@@ -245,7 +272,32 @@ class OnLedgerAsset:
         a LedgerTransaction ever existing. Check ORDER and messages
         must stay aligned with the clause implementations above — the
         first violation reported has to match; equivalence is
-        fuzz-checked in tests/test_batch_verify.py."""
+        fuzz-checked in tests/test_batch_verify.py.
+
+        Runs in C when the native extension is loaded
+        (native/cts_hash.cpp asset_verify_fields — this loop is the
+        notary flush's largest host slice); the Python body below is
+        the locked reference the fuzzes compare the clause stack
+        against, and the fallback (CORDA_TPU_NATIVE=0)."""
+        native = _native_sweep()
+        if native is not None:
+            native.asset_verify_fields(
+                commands, input_datas, output_datas,
+                self.state_class, self.issue_cmd, self.move_cmd,
+                self.exit_cmd,
+                # None = "the default token key": C reads .amount.token
+                # itself instead of a Python call per state
+                None if self.token_of is _default_token_of
+                else self.token_of,
+                signed_by,
+                ContractViolation,
+            )
+            return
+        self.verify_fields_py(commands, input_datas, output_datas)
+
+    def verify_fields_py(self, commands, input_datas, output_datas) -> None:
+        """The pure-Python reference implementation (differential
+        tests; exact clause-stack semantics)."""
         asset_types = (self.issue_cmd, self.move_cmd, self.exit_cmd)
         cmds = [c for c in commands if type(c.value) in asset_types]
         require_that("an asset command is present", len(cmds) >= 1)

@@ -1656,7 +1656,487 @@ PyObject* py_cts_decode(PyObject*, PyObject* arg) {
     return result;
 }
 
+// ---------------------------------------------------------------------------
+// Fused asset contract sweep — the native form of
+// finance/asset.py OnLedgerAsset.verify_fields (itself the single-pass
+// mirror of the clause tree). Semantics are LOCKED to the Python
+// implementation: check ORDER and "Failed requirement: ..." messages
+// must match the clause stack exactly; the 2000-case corrupted-tx
+// fuzzes in tests/test_batch_verify.py drive this path against the
+// clause stack whenever the extension is loaded. Composite-aware
+// signer checks call back into Python (signed_by), everything else —
+// command triage, token grouping, conservation sums, set building —
+// runs in C: this loop is the notary flush's largest host slice.
+
+struct AssetCtx {
+    PyObject* cv;          // ContractViolation
+    PyObject* signed_by;   // finance.asset.signed_by
+    PyObject* token_of;    // callable state -> token
+    PyObject* state_class;
+    PyTypeObject* issue_t;
+    PyTypeObject* move_t;
+    PyTypeObject* exit_t;
+};
+
+static int asset_require(const AssetCtx& ctx, const char* msg, int cond) {
+    if (cond > 0) return 0;
+    if (cond == 0)
+        PyErr_Format(ctx.cv, "Failed requirement: %s", msg);
+    return -1;   // cond < 0: an error is already set
+}
+
+static int asset_signed_by(const AssetCtx& ctx, PyObject* key, PyObject* signers) {
+    // fast path: the Python form's leaf pool always CONTAINS the
+    // signers themselves (leaf_pool.add(s)), so direct membership is
+    // a sound early accept; only misses (composite keys, leaf
+    // fulfilment) pay the full Python check
+    int direct = PySet_Contains(signers, key);
+    if (direct != 0) return direct;   // 1 = signed, -1 = error
+    PyObject* r =
+        PyObject_CallFunctionObjArgs(ctx.signed_by, key, signers, nullptr);
+    if (r == nullptr) return -1;
+    int ok = PyObject_IsTrue(r);
+    Py_DECREF(r);
+    return ok;
+}
+
+// sum(s.amount.quantity for s in states); new ref or nullptr
+static PyObject* asset_sum_quantities(const std::vector<PyObject*>& states) {
+    PyObject* total = PyLong_FromLong(0);
+    for (PyObject* s : states) {
+        if (total == nullptr) return nullptr;
+        PyObject* amount = PyObject_GetAttrString(s, "amount");
+        PyObject* q =
+            amount ? PyObject_GetAttrString(amount, "quantity") : nullptr;
+        Py_XDECREF(amount);
+        PyObject* next = q ? PyNumber_Add(total, q) : nullptr;
+        Py_XDECREF(q);
+        Py_DECREF(total);
+        total = next;
+    }
+    return total;
+}
+
+// all(s.amount.quantity > 0 for s in states); 1/0/-1
+static int asset_all_positive(const std::vector<PyObject*>& states) {
+    for (PyObject* s : states) {
+        PyObject* amount = PyObject_GetAttrString(s, "amount");
+        PyObject* q =
+            amount ? PyObject_GetAttrString(amount, "quantity") : nullptr;
+        Py_XDECREF(amount);
+        if (q == nullptr) return -1;
+        PyObject* zero = PyLong_FromLong(0);
+        int gt = zero ? PyObject_RichCompareBool(q, zero, Py_GT) : -1;
+        Py_XDECREF(zero);
+        Py_DECREF(q);
+        if (gt <= 0) return gt;
+    }
+    return 1;
+}
+
+// {s.owner for s in inputs}: every owner signed (composite-aware)
+static int asset_owners_signed(
+    const AssetCtx& ctx, const std::vector<PyObject*>& inputs,
+    PyObject* signers, const char* msg) {
+    PyObject* owners = PySet_New(nullptr);
+    if (owners == nullptr) return -1;
+    for (PyObject* s : inputs) {
+        PyObject* owner = PyObject_GetAttrString(s, "owner");
+        if (owner == nullptr || PySet_Add(owners, owner) < 0) {
+            Py_XDECREF(owner);
+            Py_DECREF(owners);
+            return -1;
+        }
+        Py_DECREF(owner);
+    }
+    int rc = 0;
+    PyObject* it = PyObject_GetIter(owners);
+    PyObject* owner;
+    while (rc == 0 && it != nullptr &&
+           (owner = PyIter_Next(it)) != nullptr) {
+        rc = asset_require(ctx, msg, asset_signed_by(ctx, owner, signers));
+        Py_DECREF(owner);
+    }
+    Py_XDECREF(it);
+    Py_DECREF(owners);
+    if (PyErr_Occurred()) rc = -1;
+    return rc;
+}
+
+struct AssetCmd {
+    PyObject* cmd;     // borrowed from the commands sequence
+    PyObject* value;   // strong
+    int kind;          // 0 issue, 1 move, 2 exit
+};
+
+static int asset_set_update(PyObject* set, PyObject* iterable) {
+    PyObject* it = PyObject_GetIter(iterable);
+    if (it == nullptr) return -1;
+    PyObject* item;
+    int rc = 0;
+    while (rc == 0 && (item = PyIter_Next(it)) != nullptr) {
+        rc = PySet_Add(set, item);
+        Py_DECREF(item);
+    }
+    Py_DECREF(it);
+    return PyErr_Occurred() ? -1 : rc;
+}
+
+// signers of a subset of commands as a fresh set
+static PyObject* asset_signer_set(
+    const std::vector<AssetCmd>& cmds, int kind /* -1 = all */) {
+    PyObject* out = PySet_New(nullptr);
+    for (const AssetCmd& c : cmds) {
+        if (out == nullptr) break;
+        if (kind >= 0 && c.kind != kind) continue;
+        PyObject* signers = PyObject_GetAttrString(c.cmd, "signers");
+        if (signers == nullptr || asset_set_update(out, signers) < 0) {
+            Py_XDECREF(signers);
+            Py_CLEAR(out);
+            break;
+        }
+        Py_DECREF(signers);
+    }
+    return out;
+}
+
+// one group (AssetGroupClause dispatch); fills `processed`; 0/-1
+static int asset_verify_group(
+    const AssetCtx& ctx, PyObject* token,
+    const std::vector<PyObject*>& inputs,
+    const std::vector<PyObject*>& outputs,
+    std::vector<AssetCmd>& cmds, PyObject* all_signers,
+    std::vector<char>& processed) {
+    bool any_issue = false;
+    for (const AssetCmd& c : cmds) any_issue |= (c.kind == 0);
+    if (any_issue && inputs.empty()) {               // IssueClause
+        PyObject* out_sum = asset_sum_quantities(outputs);
+        PyObject* zero = PyLong_FromLong(0);
+        int pos = (out_sum && zero)
+            ? PyObject_RichCompareBool(out_sum, zero, Py_GT) : -1;
+        Py_XDECREF(out_sum);
+        Py_XDECREF(zero);
+        if (asset_require(ctx, "issued amount is positive", pos) < 0)
+            return -1;
+        if (asset_require(ctx, "output amounts are positive",
+                          asset_all_positive(outputs)) < 0)
+            return -1;
+        PyObject* issuer = PyObject_GetAttrString(token, "issuer");
+        PyObject* party =
+            issuer ? PyObject_GetAttrString(issuer, "party") : nullptr;
+        PyObject* ikey =
+            party ? PyObject_GetAttrString(party, "owning_key") : nullptr;
+        Py_XDECREF(issuer);
+        Py_XDECREF(party);
+        PyObject* issue_signers =
+            ikey ? asset_signer_set(cmds, 0) : nullptr;
+        int ok = issue_signers
+            ? asset_signed_by(ctx, ikey, issue_signers) : -1;
+        Py_XDECREF(ikey);
+        Py_XDECREF(issue_signers);
+        if (asset_require(ctx, "issue is signed by the issuer", ok) < 0)
+            return -1;
+        for (size_t i = 0; i < cmds.size(); i++)
+            if (cmds[i].kind == 0) processed[i] = 1;
+        return 0;
+    }
+    // group exits: exit commands whose amount.token == this token
+    std::vector<size_t> group_exits;
+    for (size_t i = 0; i < cmds.size(); i++) {
+        if (cmds[i].kind != 2) continue;
+        PyObject* amount = PyObject_GetAttrString(cmds[i].value, "amount");
+        PyObject* tok =
+            amount ? PyObject_GetAttrString(amount, "token") : nullptr;
+        Py_XDECREF(amount);
+        if (tok == nullptr) return -1;
+        int eq = PyObject_RichCompareBool(tok, token, Py_EQ);
+        Py_DECREF(tok);
+        if (eq < 0) return -1;
+        if (eq) group_exits.push_back(i);
+    }
+    if (!group_exits.empty()) {                      // ExitClause
+        if (asset_require(ctx, "output amounts are positive",
+                          asset_all_positive(outputs)) < 0)
+            return -1;
+        PyObject* in_sum = asset_sum_quantities(inputs);
+        PyObject* out_sum =
+            in_sum ? asset_sum_quantities(outputs) : nullptr;
+        if (out_sum == nullptr) {   // sum error pending: stop here
+            Py_XDECREF(in_sum);
+            return -1;
+        }
+        PyObject* exited = PyLong_FromLong(0);
+        for (size_t i : group_exits) {
+            if (exited == nullptr) break;
+            PyObject* amount =
+                PyObject_GetAttrString(cmds[i].value, "amount");
+            PyObject* q =
+                amount ? PyObject_GetAttrString(amount, "quantity")
+                       : nullptr;
+            Py_XDECREF(amount);
+            PyObject* next = q ? PyNumber_Add(exited, q) : nullptr;
+            Py_XDECREF(q);
+            Py_DECREF(exited);
+            exited = next;
+        }
+        PyObject* diff = (in_sum && out_sum)
+            ? PyNumber_Subtract(in_sum, out_sum) : nullptr;
+        int eq = (diff && exited)
+            ? PyObject_RichCompareBool(diff, exited, Py_EQ) : -1;
+        Py_XDECREF(in_sum);
+        Py_XDECREF(out_sum);
+        Py_XDECREF(diff);
+        Py_XDECREF(exited);
+        if (asset_require(ctx, "exit conserves value", eq) < 0) return -1;
+        // signers of THIS GROUP's exits only (the Python form's
+        // {k for _, c in group_exits for k in c.signers})
+        PyObject* exit_signers = PySet_New(nullptr);
+        for (size_t i : group_exits) {
+            if (exit_signers == nullptr) break;
+            PyObject* signers =
+                PyObject_GetAttrString(cmds[i].cmd, "signers");
+            if (signers == nullptr ||
+                asset_set_update(exit_signers, signers) < 0) {
+                Py_XDECREF(signers);
+                Py_CLEAR(exit_signers);
+                break;
+            }
+            Py_DECREF(signers);
+        }
+        if (exit_signers == nullptr) return -1;   // error pending
+        PyObject* issuer = PyObject_GetAttrString(token, "issuer");
+        PyObject* party =
+            issuer ? PyObject_GetAttrString(issuer, "party") : nullptr;
+        PyObject* ikey =
+            party ? PyObject_GetAttrString(party, "owning_key") : nullptr;
+        Py_XDECREF(issuer);
+        Py_XDECREF(party);
+        int ok = ikey ? asset_signed_by(ctx, ikey, exit_signers) : -1;
+        Py_XDECREF(ikey);
+        Py_DECREF(exit_signers);
+        if (asset_require(ctx, "exit is signed by the issuer", ok) < 0)
+            return -1;
+        if (asset_owners_signed(ctx, inputs, all_signers,
+                                "exit is signed by every input owner") < 0)
+            return -1;
+        for (size_t i : group_exits) processed[i] = 1;
+        return 0;
+    }
+    // MoveClause (unconditional fallthrough, as in the group clause)
+    PyObject* in_sum = asset_sum_quantities(inputs);
+    PyObject* out_sum = in_sum ? asset_sum_quantities(outputs) : nullptr;
+    if (out_sum == nullptr) {   // sum errors surface first, like Python
+        Py_XDECREF(in_sum);
+        return -1;
+    }
+    if (asset_require(ctx, "output amounts are positive",
+                      asset_all_positive(outputs)) < 0) {
+        Py_DECREF(in_sum);
+        Py_DECREF(out_sum);
+        return -1;
+    }
+    int conserved = -1;
+    if (in_sum && out_sum) {
+        conserved = PyObject_RichCompareBool(in_sum, out_sum, Py_EQ);
+        if (conserved > 0) {
+            PyObject* zero = PyLong_FromLong(0);
+            conserved = zero
+                ? PyObject_RichCompareBool(in_sum, zero, Py_GT) : -1;
+            Py_XDECREF(zero);
+        }
+    }
+    Py_XDECREF(in_sum);
+    Py_XDECREF(out_sum);
+    if (asset_require(ctx, "value is conserved (inputs == outputs)",
+                      conserved) < 0)
+        return -1;
+    if (asset_owners_signed(ctx, inputs, all_signers,
+                            "move is signed by every input owner") < 0)
+        return -1;
+    for (size_t i = 0; i < cmds.size(); i++)
+        if (cmds[i].kind == 1) processed[i] = 1;
+    return 0;
+}
+
+PyObject* py_asset_verify_fields(PyObject*, PyObject* args) {
+    PyObject *commands, *input_datas, *output_datas;
+    AssetCtx ctx;
+    PyObject *state_class, *issue_t, *move_t, *exit_t;
+    if (!PyArg_ParseTuple(
+            args, "OOOOOOOOOO", &commands, &input_datas, &output_datas,
+            &state_class, &issue_t, &move_t, &exit_t, &ctx.token_of,
+            &ctx.signed_by, &ctx.cv))
+        return nullptr;
+    ctx.state_class = state_class;
+    ctx.issue_t = reinterpret_cast<PyTypeObject*>(issue_t);
+    ctx.move_t = reinterpret_cast<PyTypeObject*>(move_t);
+    ctx.exit_t = reinterpret_cast<PyTypeObject*>(exit_t);
+
+    // 1. triage asset commands (exact-type match, like `type(v) in`)
+    std::vector<AssetCmd> cmds;
+    PyObject* cseq = PySequence_Fast(commands, "commands");
+    if (cseq == nullptr) return nullptr;
+    bool failed = false;
+    for (Py_ssize_t i = 0;
+         !failed && i < PySequence_Fast_GET_SIZE(cseq); i++) {
+        PyObject* c = PySequence_Fast_GET_ITEM(cseq, i);
+        PyObject* v = PyObject_GetAttrString(c, "value");
+        if (v == nullptr) {
+            failed = true;
+            break;
+        }
+        PyTypeObject* t = Py_TYPE(v);
+        int kind = t == ctx.issue_t ? 0
+            : t == ctx.move_t ? 1
+            : t == ctx.exit_t ? 2 : -1;
+        if (kind < 0) {
+            Py_DECREF(v);
+            continue;
+        }
+        cmds.push_back({c, v, kind});   // v stays strong
+    }
+    auto cleanup = [&]() {
+        for (AssetCmd& c : cmds) Py_DECREF(c.value);
+        Py_DECREF(cseq);
+    };
+    if (failed) {
+        cleanup();
+        return nullptr;
+    }
+    if (cmds.empty()) {
+        PyErr_Format(ctx.cv,
+                     "Failed requirement: an asset command is present");
+        cleanup();
+        return nullptr;
+    }
+    // 2. group states by token, inputs first then outputs (insertion
+    // order == the order LedgerTransaction.group_states produces)
+    PyObject* groups = PyDict_New();   // token -> (in_list, out_list)
+    for (int which = 0; groups != nullptr && which < 2 && !failed;
+         which++) {
+        PyObject* seq = PySequence_Fast(
+            which == 0 ? input_datas : output_datas, "state datas");
+        if (seq == nullptr) {
+            failed = true;
+            break;
+        }
+        for (Py_ssize_t i = 0;
+             !failed && i < PySequence_Fast_GET_SIZE(seq); i++) {
+            PyObject* s = PySequence_Fast_GET_ITEM(seq, i);
+            int isinst = PyObject_IsInstance(s, state_class);
+            if (isinst < 0) {
+                failed = true;
+                break;
+            }
+            if (!isinst) continue;
+            PyObject* tok;
+            if (ctx.token_of == Py_None) {   // the default token key
+                PyObject* amount = PyObject_GetAttrString(s, "amount");
+                tok = amount
+                    ? PyObject_GetAttrString(amount, "token") : nullptr;
+                Py_XDECREF(amount);
+            } else {
+                tok = PyObject_CallFunctionObjArgs(
+                    ctx.token_of, s, nullptr);
+            }
+            if (tok == nullptr) {
+                failed = true;
+                break;
+            }
+            PyObject* entry = PyDict_GetItemWithError(groups, tok);
+            if (entry == nullptr) {
+                if (PyErr_Occurred()) {
+                    Py_DECREF(tok);
+                    failed = true;
+                    break;
+                }
+                entry = PyTuple_New(2);
+                if (entry != nullptr) {
+                    PyObject* a = PyList_New(0);
+                    PyObject* b = PyList_New(0);
+                    if (a == nullptr || b == nullptr) {
+                        Py_XDECREF(a);
+                        Py_XDECREF(b);
+                        Py_CLEAR(entry);
+                    } else {
+                        PyTuple_SET_ITEM(entry, 0, a);
+                        PyTuple_SET_ITEM(entry, 1, b);
+                    }
+                }
+                if (entry == nullptr ||
+                    PyDict_SetItem(groups, tok, entry) < 0) {
+                    Py_XDECREF(entry);
+                    Py_DECREF(tok);
+                    failed = true;
+                    break;
+                }
+                // the dict now holds a reference; our (about to be
+                // dropped) pointer stays valid for this iteration —
+                // no re-lookup, which could fail and return NULL
+                Py_DECREF(entry);
+            }
+            Py_DECREF(tok);
+            if (PyList_Append(PyTuple_GET_ITEM(entry, which), s) < 0) {
+                failed = true;
+                break;
+            }
+        }
+        Py_DECREF(seq);
+    }
+    if (failed || groups == nullptr) {
+        Py_XDECREF(groups);
+        cleanup();
+        return nullptr;
+    }
+    // 3. all command signers
+    PyObject* all_signers = asset_signer_set(cmds, -1);
+    if (all_signers == nullptr) {
+        Py_DECREF(groups);
+        cleanup();
+        return nullptr;
+    }
+    // 4. per-group clause dispatch, insertion order
+    std::vector<char> processed(cmds.size(), 0);
+    PyObject *token, *entry;
+    Py_ssize_t pos = 0;
+    int rc = 0;
+    while (rc == 0 && PyDict_Next(groups, &pos, &token, &entry)) {
+        std::vector<PyObject*> ins, outs;
+        PyObject* in_list = PyTuple_GET_ITEM(entry, 0);
+        PyObject* out_list = PyTuple_GET_ITEM(entry, 1);
+        for (Py_ssize_t i = 0; i < PyList_GET_SIZE(in_list); i++)
+            ins.push_back(PyList_GET_ITEM(in_list, i));
+        for (Py_ssize_t i = 0; i < PyList_GET_SIZE(out_list); i++)
+            outs.push_back(PyList_GET_ITEM(out_list, i));
+        rc = asset_verify_group(
+            ctx, token, ins, outs, cmds, all_signers, processed);
+    }
+    Py_DECREF(all_signers);
+    Py_DECREF(groups);
+    if (rc < 0) {
+        cleanup();
+        return nullptr;
+    }
+    // 5. every asset command consumed by some clause
+    std::string leftover;
+    for (size_t i = 0; i < cmds.size(); i++) {
+        if (processed[i]) continue;
+        if (!leftover.empty()) leftover += ", ";
+        leftover += Py_TYPE(cmds[i].value)->tp_name;
+    }
+    cleanup();
+    if (!leftover.empty()) {
+        PyErr_Format(ctx.cv, "commands not processed by any clause: %s",
+                     leftover.c_str());
+        return nullptr;
+    }
+    Py_RETURN_NONE;
+}
+
 PyMethodDef methods[] = {
+    {"asset_verify_fields", py_asset_verify_fields, METH_VARARGS,
+     "Fused OnLedgerAsset field verification "
+     "(finance/asset.py verify_fields semantics)."},
     {"cts_configure", py_cts_configure, METH_VARARGS,
      "Wire the CTS codec to the Python-side registry objects."},
     {"cts_encode", py_cts_encode, METH_O,

@@ -63,6 +63,7 @@ class WebDemoBench:
         self._spawn_lock = threading.Lock()
         self._starting: dict[str, Optional[str]] = {}  # name -> error|None
         self._web_ports: dict[str, int] = {}   # announced ports, cached
+        self._closed = False
 
     # -- operations ----------------------------------------------------------
 
@@ -77,6 +78,8 @@ class WebDemoBench:
         if body.get("web"):
             kw["web_port"] = 0              # ephemeral gateway + explorer
         with self._lock:
+            if self._closed:
+                return 409, {"error": "launcher is shutting down"}
             node = self.bench.nodes.get(name)
             in_flight = (
                 name in self._starting and self._starting[name] is None
@@ -121,14 +124,19 @@ class WebDemoBench:
         with self._lock:
             map_host = self.bench._map_host()
             nodes = []
+            seen = set()
             for name in self.bench._order:
+                seen.add(name)
                 node = self.bench.nodes.get(name)
                 if node is None:
-                    err = self._starting.get(name)
-                    nodes.append(
-                        {"name": name,
-                         "state": f"failed: {err}" if err else "stopped"}
-                    )
+                    # a re-added name stays in _order: an in-flight or
+                    # failed spawn outranks the stale "stopped" row
+                    if name in self._starting:
+                        err = self._starting[name]
+                        state = f"failed: {err}" if err else "starting"
+                    else:
+                        state = "stopped"
+                    nodes.append({"name": name, "state": state})
                     continue
                 nodes.append(
                     {
@@ -142,7 +150,7 @@ class WebDemoBench:
                     }
                 )
             for name, err in self._starting.items():
-                if name not in self.bench.nodes:
+                if name not in seen and name not in self.bench.nodes:
                     nodes.append(
                         {"name": name,
                          "state": f"failed: {err}" if err else "starting"}
@@ -182,7 +190,13 @@ class WebDemoBench:
 
     def shutdown(self) -> None:
         with self._lock:
-            self.bench.shutdown()
+            self._closed = True   # add() refuses from here on
+        # wait out any in-flight boot (it holds _spawn_lock), so a
+        # node finishing its handshake mid-shutdown is IN the bench
+        # and gets stopped — never orphaned past the launcher
+        with self._spawn_lock:
+            with self._lock:
+                self.bench.shutdown()
 
 
 _PAGE = b"""<!doctype html>

@@ -85,10 +85,23 @@ def norm(err):
 
 
 def assert_equivalent(l):
-    """Clause stack vs specialized batch sweep: identical outcome."""
+    """Clause stack vs specialized batch sweep vs the pure-Python
+    field-level reference: identical outcomes. When the native
+    extension is loaded, verify_batch routes through the C sweep
+    (native/cts_hash.cpp asset_verify_fields), so this fuzz pins
+    C == Python reference == clause stack in one pass."""
     expected = outcome(lambda: CASH.verify(l))
     got = norm(CASH.verify_batch([l])[0])
     assert got == expected, f"batch diverged: {got} != {expected}"
+    fields = (
+        l.commands,
+        [sar.state.data for sar in l.inputs],
+        [ts.data for ts in l.outputs],
+    )
+    got_py = outcome(lambda: CASH.verify_fields_py(*fields))
+    assert got_py == expected, f"py reference diverged: {got_py}"
+    got_native = outcome(lambda: CASH.verify_fields(*fields))
+    assert got_native == expected, f"active sweep diverged: {got_native}"
     return expected
 
 
