@@ -27,25 +27,18 @@ from ..core.clauses import Clause, GroupClauseVerifier, mark, verify_clauses
 from ..core.contracts import Amount, ContractViolation, require_that
 from ..crypto.composite import is_fulfilled_by, leaves_of
 
-_SWEEP_PROBED = False
-_SWEEP_MOD = None
-
-
 def _native_sweep():
-    """The native asset sweep, or None (cached probe; CORDA_TPU_NATIVE=0
-    and missing-extension builds fall back to the Python reference)."""
-    global _SWEEP_PROBED, _SWEEP_MOD
-    if not _SWEEP_PROBED:
-        _SWEEP_PROBED = True
-        try:
-            from ..native import get as _get_native
+    """The native asset sweep, or None (CORDA_TPU_NATIVE=0 and
+    missing-extension builds fall back to the Python reference). No
+    second-level cache on purpose: native.get() already caches, and
+    its reset_cache() (in-process builds, tests) must take effect
+    here too."""
+    from ..native import get as _get_native
 
-            mod = _get_native()
-            if mod is not None and hasattr(mod, "asset_verify_fields"):
-                _SWEEP_MOD = mod
-        except Exception:   # noqa: BLE001 - optional accelerator
-            _SWEEP_MOD = None
-    return _SWEEP_MOD
+    mod = _get_native()
+    if mod is not None and hasattr(mod, "asset_verify_fields"):
+        return mod
+    return None
 
 
 def signed_by(key, signers) -> bool:

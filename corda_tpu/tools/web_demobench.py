@@ -92,6 +92,12 @@ class WebDemoBench:
         def spawn() -> None:
             try:
                 with self._spawn_lock:
+                    with self._lock:
+                        if self._closed:
+                            # shutdown won the race: booting now would
+                            # orphan a node past the launcher
+                            self._starting.pop(name, None)
+                            return
                     self.bench.add_node(name, **kw)
                 with self._lock:
                     del self._starting[name]

@@ -34,6 +34,8 @@ RUNNABLE = (
     "node-administration.md",
     "key-concepts-financial-model.md",
     "building-transactions.md",
+    "schemas.md",
+    "key-concepts-identity.md",
 )
 
 

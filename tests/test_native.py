@@ -578,3 +578,34 @@ def test_cts_codec_float_rejected(codec):
             enc(1.5)
         with pytest.raises(ser.SerializationError):
             enc({"a": [1, 2.5]})
+
+
+def test_cts_codec_cross_process_hash_seed_determinism(codec):
+    """Consensus-critical: encodings must be byte-identical across
+    interpreters regardless of PYTHONHASHSEED (map keys sort by
+    encoded bytes, never by hash order) — for BOTH codecs."""
+    import subprocess
+    import sys
+
+    prog = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "from corda_tpu.core import serialization as ser\n"
+        "v = {'b': 1, 'a': [2, {'z': b'\\x01', 'y': None}],\n"
+        "     b'k': frozenset({3, 1, 2})}\n"
+        "print(ser.encode(v).hex(), ser.encode_py(v).hex())\n"
+    ) % str(__import__("pathlib").Path(__file__).resolve().parent.parent)
+    import os
+
+    outs = set()
+    for seed in ("0", "1", "31337"):
+        r = subprocess.run(
+            [sys.executable, "-c", prog],
+            env={**os.environ, "PYTHONHASHSEED": seed,
+                 "JAX_PLATFORMS": "cpu"},
+            capture_output=True, text=True, timeout=120,
+        )
+        assert r.returncode == 0, r.stderr[-500:]
+        c_hex, py_hex = r.stdout.strip().splitlines()[-1].split()
+        assert c_hex == py_hex, f"seed {seed}: C != python reference"
+        outs.add(c_hex)
+    assert len(outs) == 1, "encoding depends on the hash seed"
