@@ -36,6 +36,8 @@ RUNNABLE = (
     "building-transactions.md",
     "schemas.md",
     "key-concepts-identity.md",
+    "event-scheduling.md",
+    "contract-upgrades.md",
 )
 
 
