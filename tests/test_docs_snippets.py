@@ -38,6 +38,7 @@ RUNNABLE = (
     "key-concepts-identity.md",
     "event-scheduling.md",
     "contract-upgrades.md",
+    "writing-a-cordapp.md",
 )
 
 
