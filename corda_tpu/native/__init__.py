@@ -16,13 +16,19 @@ _native = None
 _tried = False
 
 
+def disabled() -> bool:
+    """True when the kill switch turns the native path off — ONE
+    source of truth for the CORDA_TPU_NATIVE gate (tests skip on it)."""
+    return os.environ.get("CORDA_TPU_NATIVE", "1") == "0"
+
+
 def get():
     """The native module, or None (cached)."""
     global _native, _tried
     if _tried:
         return _native
     _tried = True
-    if os.environ.get("CORDA_TPU_NATIVE", "1") == "0":
+    if disabled():
         return None
     try:
         from . import _cts_hash   # type: ignore
