@@ -39,6 +39,7 @@ RUNNABLE = (
     "event-scheduling.md",
     "contract-upgrades.md",
     "writing-a-cordapp.md",
+    "message-fabric.md",
 )
 
 
