@@ -40,6 +40,7 @@ RUNNABLE = (
     "contract-upgrades.md",
     "writing-a-cordapp.md",
     "message-fabric.md",
+    "versioning.md",
 )
 
 
