@@ -25,6 +25,13 @@ BENCH_METRIC restricts to one measurement:
   ingest          — wire-ingest rate: CTS decode + cold Merkle id +
                     signature staging per received transaction (host
                     only; the flush metrics never see this cost)
+  ingest_pipelined — the same work through node/ingest.py (sharded
+                    decode pool, batched Merkle-id pass, content-keyed
+                    digest + hot-frame caches); records vs_serial
+                    measured on the same fixture in the same process
+
+`python bench.py --quick ingest` runs tiny serial + pipelined ingest
+records in one CPU-safe process (tier-1 smoke of the perf plumbing).
   montmul         — device-resident A/B of the MXU (batched int8
                     Toeplitz matmul) vs VPU (shifted accumulate)
                     Montgomery-multiply formulations (experiment rig,
@@ -353,14 +360,10 @@ def _notary_metric(batch: int, iters: int) -> dict:
     }
 
 
-def _ingest_metric(batch: int, iters: int) -> dict:
-    """Wire-ingest rate (round-5): decode a canonical signed cash
-    spend's CTS bytes, compute its Merkle id COLD, and stage its
-    signature requests — the per-transaction host cost a notary pays
-    on arrival, BEFORE any flush (the flush metrics' fixtures carry
-    warm objects and never see it). Pure host work, no device; the
-    native CTS codec is what lifted this from ~2.5k/s
-    (BASELINE.md round-5 second pass)."""
+def _ingest_fixture(unique: int = 1) -> list:
+    """`unique` distinct canonical signed cash spends' CTS bytes — the
+    wire frames a notary ingests. One fixture builder for the serial
+    and pipelined ingest metrics so they measure identical work."""
     from corda_tpu.core import serialization as ser
     from corda_tpu.core.contracts import Amount, Issued, StateRef
     from corda_tpu.core.identity import PartyAndReference
@@ -378,21 +381,38 @@ def _ingest_metric(batch: int, iters: int) -> dict:
     bank = net.create_node("Bank")
     alice = net.create_node("Alice")
     token = Issued(PartyAndReference(bank.party, b"\x01"), "USD")
-    ib = TransactionBuilder(notary.party)
-    ib.add_output_state(
-        CashState(Amount(100, token), alice.party.owning_key), CASH_CONTRACT
-    )
-    ib.add_command(CashIssue(1), bank.party.owning_key)
-    issue = bank.services.sign_initial_transaction(ib)
-    alice.services.record_transactions([issue])
-    sb = TransactionBuilder(notary.party)
-    sb.add_input_state(alice.vault.state_and_ref(StateRef(issue.id, 0)))
-    sb.add_output_state(
-        CashState(Amount(100, token), bank.party.owning_key),
-        CASH_CONTRACT, notary.party,
-    )
-    sb.add_command(CashMove(), alice.party.owning_key)
-    blob = ser.encode(alice.services.sign_initial_transaction(sb))
+    blobs = []
+    for i in range(max(unique, 1)):
+        ib = TransactionBuilder(notary.party)
+        ib.add_output_state(
+            CashState(Amount(100 + i, token), alice.party.owning_key),
+            CASH_CONTRACT,
+        )
+        ib.add_command(CashIssue(i + 1), bank.party.owning_key)
+        issue = bank.services.sign_initial_transaction(ib)
+        alice.services.record_transactions([issue])
+        sb = TransactionBuilder(notary.party)
+        sb.add_input_state(alice.vault.state_and_ref(StateRef(issue.id, 0)))
+        sb.add_output_state(
+            CashState(Amount(100 + i, token), bank.party.owning_key),
+            CASH_CONTRACT, notary.party,
+        )
+        sb.add_command(CashMove(), alice.party.owning_key)
+        blobs.append(ser.encode(alice.services.sign_initial_transaction(sb)))
+    return blobs
+
+
+def _ingest_metric(batch: int, iters: int) -> dict:
+    """Wire-ingest rate (round-5): decode a canonical signed cash
+    spend's CTS bytes, compute its Merkle id COLD, and stage its
+    signature requests — the per-transaction host cost a notary pays
+    on arrival, BEFORE any flush (the flush metrics' fixtures carry
+    warm objects and never see it). Pure host work, no device; the
+    native CTS codec is what lifted this from ~2.5k/s
+    (BASELINE.md round-5 second pass)."""
+    from corda_tpu.core import serialization as ser
+
+    blob = _ingest_fixture(1)[0]
 
     def run_once() -> None:
         for _ in range(batch):
@@ -411,6 +431,78 @@ def _ingest_metric(batch: int, iters: int) -> dict:
         "unit": "tx/s",
         "vs_baseline": round(rate / BASELINE, 3),
         "wire_bytes": len(blob),
+        "native_codec": _native() is not None,
+    }
+
+
+def _ingest_pipelined_metric(batch: int, iters: int) -> dict:
+    """Pipelined wire-ingest rate: the SAME decode + Merkle-id +
+    signature-staging work as the serial metric, through the
+    node/ingest.py pipeline — sharded decode pool double-buffered so
+    decode of batch N+1 overlaps consumption of batch N, ONE batched
+    SHA-256 pass per chunk for every component leaf, content-keyed
+    leaf/subtree digest caches, and the hot-frame cache in front of
+    decode. The fixture tiles BENCH_TILE unique frames across the
+    batch (the SPI fixture-tiling convention), so the record shows the
+    re-seen-frame serving shape a loaded notary actually ingests;
+    `frame_cache_hits` makes the cache's share attributable, and
+    `serial_per_sec` is the serial path measured on the SAME fixture
+    in the SAME process, so the win is a ratio inside one record, not
+    an inference across runs. Bit-identity of ids and staged requests
+    vs the serial path is gated here and fuzzed in
+    tests/test_ingest.py."""
+    from corda_tpu.core import serialization as ser
+    from corda_tpu.node.ingest import IngestPipeline
+
+    tile = max(1, int(os.environ.get("BENCH_TILE", "8")))
+    uniq = _ingest_fixture(min(tile, batch))
+    blobs = (uniq * (batch // len(uniq) + 1))[:batch]
+    chunk = min(512, batch)
+    pipe = IngestPipeline()
+
+    def run_once() -> None:
+        n = 0
+        for entries in pipe.pipeline_blobs(blobs, chunk=chunk):
+            for e in entries:
+                if e.error is not None or not e.requests:
+                    raise SystemExit(f"pipelined ingest failed: {e.error}")
+            n += len(entries)
+        if n != batch:
+            raise SystemExit("pipelined ingest lost transactions")
+
+    run_once()                          # warm-up + correctness
+    # parity gate (explicit raise, survives python -O): pipelined ids
+    # and staged-request counts must match a cold serial decode
+    for b in uniq:
+        cold = ser.decode(b)
+        ent = pipe.ingest([b])[0]
+        if ent.tx_id != cold.wtx.id or len(ent.requests) != len(
+            cold.signature_requests()
+        ):
+            raise SystemExit("pipelined/serial ingest parity failure")
+    rate = _median_rate(run_once, batch, iters)
+
+    def serial_once() -> None:
+        for b in blobs:
+            stx = ser.decode(b)
+            stx.wtx.id                  # cold Merkle id, every time
+            if not stx.signature_requests():
+                raise SystemExit("ingest staging produced nothing")
+
+    serial_once()                       # warm-up
+    serial_rate = _median_rate(serial_once, batch, iters)
+    from corda_tpu.native import get as _native
+
+    return {
+        "metric": "wire_ingest_pipelined_per_sec",
+        "value": round(rate, 1),
+        "unit": "tx/s",
+        "vs_baseline": round(rate / BASELINE, 3),
+        "serial_per_sec": round(serial_rate, 1),
+        "vs_serial": round(rate / serial_rate, 3),
+        "unique_frames": len(uniq),
+        "frame_cache_hits": pipe.frame_hits,
+        "wire_bytes": len(uniq[0]),
         "native_codec": _native() is not None,
     }
 
@@ -652,6 +744,12 @@ def _run_metric(metric: str, batch: int, iters: int) -> dict:
         if batch > 16384:
             out["batch_requested"] = batch
         return out
+    if metric == "ingest_pipelined":
+        out = _ingest_pipelined_metric(min(batch, 16384), iters)
+        out["batch"] = min(batch, 16384)   # cap visible in the record
+        if batch > 16384:
+            out["batch_requested"] = batch
+        return out
     if metric == "parity":
         return _parity_metric(batch, iters)
     return _spi_metric(metric, batch, iters)
@@ -690,7 +788,32 @@ def _run_child(m: str, env: dict, timeout: float) -> bool:
         return False
 
 
+def _quick(metric: str) -> None:
+    """`python bench.py --quick ingest`: a tiny, CPU-safe smoke run of
+    the ingest metrics — both the serial and pipelined lines, one
+    process, shallow batch — so tier-1 (JAX_PLATFORMS=cpu, no device)
+    can assert the perf plumbing emits well-formed records without
+    paying a real measurement. Values from this mode are NOT
+    comparable to the default run's."""
+    if metric != "ingest":
+        raise SystemExit(f"--quick supports 'ingest', not {metric!r}")
+    batch = int(os.environ.get("BENCH_BATCH", "256"))
+    iters = int(os.environ.get("BENCH_ITERS", "1"))
+    out = _ingest_metric(batch, iters)
+    out["quick"] = True
+    print(json.dumps(out), flush=True)
+    out = _ingest_pipelined_metric(batch, iters)
+    out["quick"] = True
+    print(json.dumps(out), flush=True)
+
+
 def main() -> None:
+    argv = sys.argv[1:]
+    if argv[:1] == ["--quick"]:
+        _quick(argv[1] if len(argv) > 1 else "ingest")
+        return
+    if argv:
+        raise SystemExit(f"unknown arguments {argv!r} (try --quick ingest)")
     t_start = time.perf_counter()
     # On a remote-attached TPU the host<->device link latency (~50-100
     # ms/transfer) dominates small batches; 32k records (5 MB packed)
@@ -700,8 +823,8 @@ def main() -> None:
     iters = int(os.environ.get("BENCH_ITERS", "3"))
     metric = os.environ.get("BENCH_METRIC", "all")
     known = (
-        "all", "p256", "mixed", "merkle", "notary", "ingest", "montmul",
-        "parity",
+        "all", "p256", "mixed", "merkle", "notary", "ingest",
+        "ingest_pipelined", "montmul", "parity",
     )
     if metric not in known:
         # a typo must not record a p256-only rate under another name
@@ -739,7 +862,8 @@ def main() -> None:
 
     # parity runs LAST of the optional work (cheapest to drop), but
     # before the headline so the headline stays the final stdout line
-    for m in ("mixed", "merkle", "notary", "ingest", "parity"):
+    for m in ("mixed", "merkle", "notary", "ingest", "ingest_pipelined",
+              "parity"):
         avail = left() - reserve
         if avail < 60:
             print(
@@ -749,7 +873,9 @@ def main() -> None:
             )
             continue
         env = dict(os.environ, BENCH_METRIC=m)
-        if avail < 300 and m in ("mixed", "merkle", "notary", "ingest"):
+        if avail < 300 and m in (
+            "mixed", "merkle", "notary", "ingest", "ingest_pipelined"
+        ):
             # trim before dropping: one timed rep at a shallower batch
             # still yields a usable point for the table
             env["BENCH_ITERS"] = "1"

@@ -121,6 +121,17 @@ class WireTransaction:
     def leaf_hashes(self) -> list[SecureHash]:
         return [component_hash(g, i, c) for g, i, c in self.component_leaves()]
 
+    def leaf_preimages(self) -> list[bytes]:
+        """Every component leaf's id-preimage (the canonical encoding
+        `component_hash` digests), in leaf order. The pipelined ingest
+        path (node/ingest.py) collects these across a whole decode
+        batch and hashes them in ONE batched SHA-256 pass — and uses
+        the bytes as the key of its leaf-digest cache, so re-seen
+        component structures skip hashing entirely."""
+        return [
+            component_preimage(g, i, c) for g, i, c in self.component_leaves()
+        ]
+
     @property
     def id(self) -> SecureHash:
         """Merkle root over component hashes — THE transaction identity.
@@ -182,8 +193,15 @@ class WireTransaction:
         )
 
 
+def component_preimage(group: int, index: int, component: Any) -> bytes:
+    """The id-preimage bytes of one component leaf — ONE encoding
+    shared by component_hash and the batched ingest id stage, so the
+    two can never drift."""
+    return ser.encode([group, index, component])
+
+
 def component_hash(group: int, index: int, component: Any) -> SecureHash:
-    return SecureHash.sha256(ser.encode([group, index, component]))
+    return SecureHash.sha256(component_preimage(group, index, component))
 
 
 @ser.serializable
@@ -274,13 +292,22 @@ class SignedTransaction:
         return SignedTransaction(self.wtx, self.sigs + tuple(sigs))
 
     def signature_requests(self) -> list[VerificationRequest]:
-        """Stage every attached signature for batch verification."""
-        return [
-            VerificationRequest(
-                s.by, s.signature, s.signable_payload(self.id)
-            )
-            for s in self.sigs
-        ]
+        """Stage every attached signature for batch verification.
+
+        Memoised like `wtx.id` (the instance is frozen): the ingest
+        pipeline stages at decode time, and downstream drains — the
+        notary flush, the verifier worker — then reuse the staged list
+        instead of re-staging per consumer."""
+        cached = self.__dict__.get("_sigreq_cache")
+        if cached is None:
+            cached = [
+                VerificationRequest(
+                    s.by, s.signature, s.signable_payload(self.id)
+                )
+                for s in self.sigs
+            ]
+            object.__setattr__(self, "_sigreq_cache", cached)
+        return cached
 
     def check_signatures_are_valid(
         self, verifier: Optional[BatchSignatureVerifier] = None

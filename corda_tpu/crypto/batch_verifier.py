@@ -44,6 +44,25 @@ class VerificationRequest:
     message: bytes
 
 
+def _shard_map(fn, *, mesh, in_specs, out_specs, check_vma):
+    """jax.shard_map across the supported jax range: 0.4.x ships it as
+    jax.experimental.shard_map with the replication check named
+    check_rep instead of check_vma; newer jax promotes it to the top
+    level with the new kwarg. Same program either way."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as esm
+
+    return esm(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma,
+    )
+
+
 class _AotLadder:
     """Lazy AOT wrapper around one jitted ladder program.
 
@@ -200,7 +219,7 @@ class TpuBatchVerifier(BatchSignatureVerifier):
                 # replicated constants and become shard-varying, which
                 # the VMA checker rejects; the program is collective-
                 # free so the check buys nothing here
-                smapped = jax.shard_map(
+                smapped = _shard_map(
                     partial(inner, use_pallas=mesh_use_pallas),
                     mesh=self.mesh,
                     in_specs=in_specs,

@@ -67,6 +67,21 @@ ser.register_custom(
 )
 
 
+def sha256_many(payloads: list) -> list:
+    """Batched SHA-256: `[bytes] -> [32-byte digest]` in ONE native
+    call when the extension is built (the ingest pipeline's Merkle-id
+    stage hashes every component leaf of a decode batch in a single
+    pass — node/ingest.py), hashlib loop otherwise. Differentially
+    tested against hashlib in tests/test_native.py."""
+    from ..native import get as _native
+
+    native = _native()
+    if native is not None:
+        return list(native.sha256_many(payloads))
+    _h = hashlib.sha256
+    return [_h(p).digest() for p in payloads]
+
+
 def secure_hash_of(obj) -> SecureHash:
     """SHA-256 of the canonical encoding of any serializable value."""
     return SecureHash.sha256(ser.encode(obj))

@@ -48,6 +48,34 @@ def merkle_root(leaves: list[SecureHash]) -> SecureHash:
     return level[0]
 
 
+def merkle_roots_from_digests(leaf_lists: list) -> list:
+    """Many tree roots from RAW 32-byte digests: `[[bytes]] -> [bytes]`.
+
+    The batched Merkle-id stage (node/ingest.py) already holds every
+    transaction's leaf digests as plain bytes — one native call
+    computes the whole batch's roots with no SecureHash object churn.
+    The getattr probe tolerates a stale pre-merkle_root_many .so; the
+    Python fallback mirrors merkle_root exactly."""
+    from ..native import get as _native
+
+    native = _native()
+    if native is not None:
+        many = getattr(native, "merkle_root_many", None)
+        if many is not None:
+            return list(many(leaf_lists))
+        return [native.merkle_root(leaves) for leaves in leaf_lists]
+    out = []
+    for leaves in leaf_lists:
+        level = _pad_leaves([SecureHash(b) for b in leaves])
+        while len(level) > 1:
+            level = [
+                level[i].hash_concat(level[i + 1])
+                for i in range(0, len(level), 2)
+            ]
+        out.append(level[0].bytes_)
+    return out
+
+
 def merkle_levels(leaves: list[SecureHash]) -> list[list[SecureHash]]:
     """All levels bottom-up (levels[0] = padded leaves, levels[-1] = [root])."""
     level = _pad_leaves(leaves)

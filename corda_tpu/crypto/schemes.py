@@ -15,24 +15,44 @@ Mirrors the reference's scheme table (core/.../crypto/Crypto.kt:78-184):
 
 Signing happens on the host (nodes sign one transaction at a time — it
 is verification that fans out to batches). The `cryptography` (OpenSSL)
-library backs RSA/ECDSA/Ed25519 signing and keygen; deterministic
-from-seed key derivation is provided for tests, mirroring the
-reference's entropyToKeyPair (test-utils/.../TestConstants.kt).
+library backs RSA/ECDSA/Ed25519 signing and keygen when present;
+deterministic from-seed key derivation is provided for tests, mirroring
+the reference's entropyToKeyPair (test-utils/.../TestConstants.kt).
+
+The OpenSSL dependency is GATED: jax-only containers (the TPU bench
+image) ship without `cryptography`, and verification never needed it —
+refmath is the bit-exactness anchor for every EC scheme. Without the
+package, EC keygen uses `secrets`, ECDSA signs with an RFC6979-style
+deterministic nonce over refmath, and Ed25519 signs per RFC8032 over
+refmath (byte-identical to the OpenSSL signature — Ed25519 signing is
+deterministic). Only RSA genuinely requires OpenSSL and raises
+UnsupportedScheme when it is absent.
 """
 
 from __future__ import annotations
 
 import functools
 import hashlib
+import hmac as _hmac
+import secrets as _secrets
 from dataclasses import dataclass
 from typing import Optional
 
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric import ec as cec
-from cryptography.hazmat.primitives.asymmetric import ed25519 as ced
-from cryptography.hazmat.primitives.asymmetric import padding as cpad
-from cryptography.hazmat.primitives.asymmetric import rsa as crsa
-from cryptography.hazmat.primitives.asymmetric.utils import decode_dss_signature
+try:
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec as cec
+    from cryptography.hazmat.primitives.asymmetric import ed25519 as ced
+    from cryptography.hazmat.primitives.asymmetric import padding as cpad
+    from cryptography.hazmat.primitives.asymmetric import rsa as crsa
+    from cryptography.hazmat.primitives.asymmetric.utils import (
+        decode_dss_signature,
+    )
+
+    _HAVE_OPENSSL = True
+except ImportError:   # gated dep: pure-python fallbacks below
+    hashes = serialization = cec = ced = cpad = crsa = None
+    decode_dss_signature = None
+    _HAVE_OPENSSL = False
 
 from . import encodings, refmath
 from .curves import ED25519, SECP256K1, SECP256R1
@@ -72,11 +92,87 @@ SCHEMES: dict[int, SignatureScheme] = {
 }
 
 _WCURVE = {ECDSA_SECP256K1_SHA256: SECP256K1, ECDSA_SECP256R1_SHA256: SECP256R1}
-_CCURVE = {ECDSA_SECP256K1_SHA256: cec.SECP256K1(), ECDSA_SECP256R1_SHA256: cec.SECP256R1()}
+_CCURVE = (
+    {
+        ECDSA_SECP256K1_SHA256: cec.SECP256K1(),
+        ECDSA_SECP256R1_SHA256: cec.SECP256R1(),
+    }
+    if _HAVE_OPENSSL
+    else {}
+)
 
 
 class UnsupportedScheme(Exception):
     pass
+
+
+# -- pure-python signing fallbacks (OpenSSL-less containers) -----------------
+# Verification NEVER needed OpenSSL (refmath is the anchor); these make
+# signing work too, so the full fixture/test/bench surface runs in the
+# jax-only image. Ed25519 output is byte-identical to OpenSSL's
+# (RFC 8032 signing is deterministic); ECDSA uses an RFC 6979
+# deterministic nonce — OpenSSL's own ECDSA nonce is random, so no
+# byte-compatibility exists to preserve there, only validity.
+
+
+def _ed25519_expand(sk: bytes) -> tuple[int, bytes]:
+    h = hashlib.sha512(sk).digest()
+    a = bytearray(h[:32])
+    a[0] &= 248
+    a[31] &= 127
+    a[31] |= 64
+    return int.from_bytes(bytes(a), "little"), h[32:]
+
+
+def _ed25519_public_raw(sk: bytes) -> bytes:
+    a, _ = _ed25519_expand(sk)
+    c = ED25519
+    return refmath.ed_compress(c, refmath.ed_mul(c, a, (c.gx, c.gy)))
+
+
+def _ed25519_sign_py(sk: bytes, pub: bytes, msg: bytes) -> bytes:
+    c = ED25519
+    a, prefix = _ed25519_expand(sk)
+    r = int.from_bytes(hashlib.sha512(prefix + msg).digest(), "little") % c.L
+    big_r = refmath.ed_compress(c, refmath.ed_mul(c, r, (c.gx, c.gy)))
+    k = int.from_bytes(
+        hashlib.sha512(big_r + pub + msg).digest(), "little"
+    ) % c.L
+    s = (r + k * a) % c.L
+    return big_r + s.to_bytes(32, "little")
+
+
+def _rfc6979_nonce(curve, d: int, z: int) -> int:
+    """Deterministic ECDSA nonce per RFC 6979 §3.2 (SHA-256, qlen=256)."""
+    n = curve.n
+    mac = lambda key, data: _hmac.new(key, data, hashlib.sha256).digest()  # noqa: E731
+    x = d.to_bytes(32, "big")
+    m = (z % n).to_bytes(32, "big")
+    v = b"\x01" * 32
+    key = b"\x00" * 32
+    key = mac(key, v + b"\x00" + x + m)
+    v = mac(key, v)
+    key = mac(key, v + b"\x01" + x + m)
+    v = mac(key, v)
+    while True:
+        v = mac(key, v)
+        k = int.from_bytes(v, "big")
+        if 1 <= k < n:
+            return k
+        key = mac(key, v + b"\x00")
+        v = mac(key, v)
+
+
+def _ecdsa_sign_py(curve, d: int, message: bytes) -> bytes:
+    z = int.from_bytes(hashlib.sha256(message).digest(), "big")
+    k = _rfc6979_nonce(curve, d, z)
+    while True:
+        pt = refmath.wei_mul(curve, k, (curve.gx, curve.gy))
+        r = pt[0] % curve.n
+        s = (pow(k, -1, curve.n) * (z + r * d)) % curve.n
+        if r and s:   # zero r/s is cryptographically unreachable
+            return encodings.encode_der_ecdsa(r, s)
+        k = (k % (curve.n - 1)) + 1   # pragma: no cover - defensive
 
 
 @dataclass(frozen=True)
@@ -136,8 +232,10 @@ def generate_keypair(scheme_id: int = DEFAULT_SCHEME, seed: Optional[int] = None
         curve = _WCURVE[scheme_id]
         if seed is not None:
             d = (seed % (curve.n - 1)) + 1
-        else:
+        elif _HAVE_OPENSSL:
             d = cec.generate_private_key(_CCURVE[scheme_id]).private_numbers().private_value
+        else:
+            d = _secrets.randbelow(curve.n - 1) + 1
         pt = refmath.wei_mul(curve, d, (curve.gx, curve.gy))
         pub = PublicKey(scheme_id, encodings.encode_sec1_point(*pt))
         priv = PrivateKey(scheme_id, d.to_bytes(32, "big"), pub)
@@ -145,15 +243,25 @@ def generate_keypair(scheme_id: int = DEFAULT_SCHEME, seed: Optional[int] = None
     if scheme_id == EDDSA_ED25519_SHA512:
         if seed is not None:
             sk_bytes = hashlib.sha256(b"ed25519-seed" + seed.to_bytes(32, "big")).digest()
-        else:
+        elif _HAVE_OPENSSL:
             sk_bytes = ced.Ed25519PrivateKey.generate().private_bytes_raw()
-        sk = ced.Ed25519PrivateKey.from_private_bytes(sk_bytes)
-        pub = PublicKey(scheme_id, sk.public_key().public_bytes_raw())
+        else:
+            sk_bytes = _secrets.token_bytes(32)
+        if _HAVE_OPENSSL:
+            sk = ced.Ed25519PrivateKey.from_private_bytes(sk_bytes)
+            pub_raw = sk.public_key().public_bytes_raw()
+        else:
+            pub_raw = _ed25519_public_raw(sk_bytes)
+        pub = PublicKey(scheme_id, pub_raw)
         priv = PrivateKey(scheme_id, sk_bytes, pub)
         return KeyPair(priv, pub)
     if scheme_id == RSA_SHA256:
         if seed is not None:
             raise UnsupportedScheme("deterministic RSA keygen not supported")
+        if not _HAVE_OPENSSL:
+            raise UnsupportedScheme(
+                "RSA_SHA256 requires the 'cryptography' package"
+            )
         sk = crsa.generate_private_key(public_exponent=65537, key_size=2048)
         pub_der = sk.public_key().public_bytes(
             serialization.Encoding.DER, serialization.PublicFormat.SubjectPublicKeyInfo
@@ -191,10 +299,18 @@ def keypair_from_private(scheme_id: int, data: bytes) -> KeyPair:
         pub = PublicKey(scheme_id, encodings.encode_sec1_point(*pt))
         return KeyPair(PrivateKey(scheme_id, data, pub), pub)
     if scheme_id == EDDSA_ED25519_SHA512:
-        sk = ced.Ed25519PrivateKey.from_private_bytes(data)
-        pub = PublicKey(scheme_id, sk.public_key().public_bytes_raw())
+        if _HAVE_OPENSSL:
+            sk = ced.Ed25519PrivateKey.from_private_bytes(data)
+            pub_raw = sk.public_key().public_bytes_raw()
+        else:
+            pub_raw = _ed25519_public_raw(data)
+        pub = PublicKey(scheme_id, pub_raw)
         return KeyPair(PrivateKey(scheme_id, data, pub), pub)
     if scheme_id == RSA_SHA256:
+        if not _HAVE_OPENSSL:
+            raise UnsupportedScheme(
+                "RSA_SHA256 requires the 'cryptography' package"
+            )
         sk = serialization.load_der_private_key(data, password=None)
         pub_der = sk.public_key().public_bytes(
             serialization.Encoding.DER,
@@ -216,6 +332,10 @@ def keypair_from_private(scheme_id: int, data: bytes) -> KeyPair:
 # every transaction: memoise them, bounded for long-lived processes
 @functools.lru_cache(maxsize=256)
 def _backend_sk_cached(scheme_id: int, data: bytes):
+    if not _HAVE_OPENSSL:   # callers route to the pure paths first
+        raise UnsupportedScheme(
+            "OpenSSL-backed signing requires the 'cryptography' package"
+        )
     if scheme_id in _WCURVE:
         return cec.derive_private_key(
             int.from_bytes(data, "big"), _CCURVE[scheme_id]
@@ -235,10 +355,16 @@ def sign(priv: PrivateKey, message: bytes) -> bytes:
     """Host-side signing; signature formats match the verify kernels."""
     sid = priv.scheme_id
     if sid in _WCURVE:
+        if not _HAVE_OPENSSL:
+            return _ecdsa_sign_py(
+                _WCURVE[sid], int.from_bytes(priv.data, "big"), message
+            )
         der = _backend_sk(priv).sign(message, cec.ECDSA(hashes.SHA256()))
         r, s = decode_dss_signature(der)
         return encodings.encode_der_ecdsa(r, s)
     if sid == EDDSA_ED25519_SHA512:
+        if not _HAVE_OPENSSL:
+            return _ed25519_sign_py(priv.data, priv.public.data, message)
         return _backend_sk(priv).sign(message)
     if sid == RSA_SHA256:
         return _backend_sk(priv).sign(
@@ -270,6 +396,10 @@ def verify_one(pub: PublicKey, signature: bytes, message: bytes) -> bool:
     if sid == EDDSA_ED25519_SHA512:
         return refmath.ed25519_verify(pub.data, message, signature)
     if sid == RSA_SHA256:
+        if not _HAVE_OPENSSL:
+            raise UnsupportedScheme(
+                "RSA_SHA256 requires the 'cryptography' package"
+            )
         try:
             pk = serialization.load_der_public_key(pub.data)
             pk.verify(signature, message, cpad.PKCS1v15(), hashes.SHA256())

@@ -27,6 +27,7 @@
 #include <vector>
 
 #if defined(__x86_64__)
+#include <cpuid.h>
 #include <immintrin.h>
 #endif
 
@@ -110,9 +111,19 @@ void sha256_blocks_shani(uint32_t state[8], const uint8_t* data,
 }
 
 bool has_shani() {
-    static const bool v = __builtin_cpu_supports("sha") &&
-                          __builtin_cpu_supports("sse4.1") &&
-                          __builtin_cpu_supports("ssse3");
+    // raw cpuid, not __builtin_cpu_supports("sha"): older g++ (the
+    // image ships 10.x) rejects "sha" as a feature name at compile
+    // time, which used to fail the whole extension build — and a
+    // failed build silently costs the native codec, not just SHA-NI.
+    static const bool v = [] {
+        unsigned a, b, c, d;
+        if (!__get_cpuid_count(7, 0, &a, &b, &c, &d)) return false;
+        const bool sha = (b >> 29) & 1;        // leaf 7 EBX bit 29
+        if (!__get_cpuid(1, &a, &b, &c, &d)) return false;
+        const bool sse41 = (c >> 19) & 1;      // leaf 1 ECX bit 19
+        const bool ssse3 = (c >> 9) & 1;       // leaf 1 ECX bit 9
+        return sha && sse41 && ssse3;
+    }();
     return v;
 }
 #else
@@ -196,15 +207,21 @@ struct Sha256 {
     }
 
     void finish(uint8_t out[32]) {
+        // pad in place with memset, not byte-at-a-time update() calls:
+        // ~55 un-inlined 1-byte updates per digest cost more than the
+        // SHA-NI compression itself on the 64-byte messages the Merkle
+        // interior is made of (bitlen is already final, so the buffer
+        // writes below must bypass update's recounting)
         uint64_t bits = bitlen;
-        uint8_t pad = 0x80;
-        update(&pad, 1);
-        uint8_t zero = 0;
-        while (buflen != 56) update(&zero, 1);
-        uint8_t lenb[8];
-        for (int i = 0; i < 8; i++) lenb[i] = uint8_t(bits >> (56 - 8 * i));
-        // write length directly (update would re-count the bits)
-        std::memcpy(buffer + 56, lenb, 8);
+        buffer[buflen++] = 0x80;
+        if (buflen > 56) {
+            std::memset(buffer + buflen, 0, 64 - buflen);
+            transform(buffer);
+            buflen = 0;
+        }
+        std::memset(buffer + buflen, 0, 56 - buflen);
+        for (int i = 0; i < 8; i++)
+            buffer[56 + i] = uint8_t(bits >> (56 - 8 * i));
         transform(buffer);
         buflen = 0;
         for (int i = 0; i < 8; i++) {
@@ -258,18 +275,16 @@ PyObject* py_sha256_many(PyObject*, PyObject* arg) {
     return result;
 }
 
-// merkle_root(leaves: sequence of 32-byte hashes) -> 32 bytes
-// MerkleTree.kt semantics: zero-pad to the next power of two, pairwise
-// sha256(left || right) up to the root.
-PyObject* py_merkle_root(PyObject*, PyObject* arg) {
-    PyObject* seq = PySequence_Fast(arg, "merkle_root takes a sequence");
-    if (!seq) return nullptr;
+// MerkleTree.kt semantics shared by merkle_root / merkle_root_many:
+// zero-pad to the next power of two, pairwise sha256(left || right) up
+// to the root. `seq` is a PySequence_Fast of 32-byte leaves; 0 on
+// success with the root in `out`, -1 with a Python error set.
+static int merkle_root_of(PyObject* seq, uint8_t out[32]) {
     Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
     if (n == 0) {
-        Py_DECREF(seq);
         PyErr_SetString(PyExc_ValueError,
                         "cannot build a Merkle tree with no leaves");
-        return nullptr;
+        return -1;
     }
     size_t size = 1;
     while (size < size_t(n)) size *= 2;
@@ -277,29 +292,65 @@ PyObject* py_merkle_root(PyObject*, PyObject* arg) {
     for (Py_ssize_t i = 0; i < n; i++) {
         PyObject* item = PySequence_Fast_GET_ITEM(seq, i);
         Py_buffer view;
-        if (PyObject_GetBuffer(item, &view, PyBUF_SIMPLE) < 0) {
-            Py_DECREF(seq); return nullptr;
-        }
+        if (PyObject_GetBuffer(item, &view, PyBUF_SIMPLE) < 0) return -1;
         if (view.len != 32) {
             PyBuffer_Release(&view);
-            Py_DECREF(seq);
             PyErr_SetString(PyExc_ValueError, "leaves must be 32 bytes");
-            return nullptr;
+            return -1;
         }
         std::memcpy(&level[i * 32], view.buf, 32);
         PyBuffer_Release(&view);
     }
-    Py_DECREF(seq);
     while (size > 1) {
         for (size_t i = 0; i < size; i += 2) {
-            uint8_t out[32];
-            sha256_once(&level[i * 32], 64, out);
-            std::memcpy(&level[(i / 2) * 32], out, 32);
+            uint8_t h[32];
+            sha256_once(&level[i * 32], 64, h);
+            std::memcpy(&level[(i / 2) * 32], h, 32);
         }
         size /= 2;
     }
-    return PyBytes_FromStringAndSize(
-        reinterpret_cast<char*>(level.data()), 32);
+    std::memcpy(out, level.data(), 32);
+    return 0;
+}
+
+// merkle_root(leaves: sequence of 32-byte hashes) -> 32 bytes
+PyObject* py_merkle_root(PyObject*, PyObject* arg) {
+    PyObject* seq = PySequence_Fast(arg, "merkle_root takes a sequence");
+    if (!seq) return nullptr;
+    uint8_t out[32];
+    int rc = merkle_root_of(seq, out);
+    Py_DECREF(seq);
+    if (rc < 0) return nullptr;
+    return PyBytes_FromStringAndSize(reinterpret_cast<char*>(out), 32);
+}
+
+// merkle_root_many(leaf_lists: sequence of sequences of 32-byte
+// hashes) -> [32 bytes, ...]. One C call computes every transaction
+// id of an ingest batch (node/ingest.py batched Merkle-id stage)
+// instead of a Python-level loop of per-tx calls.
+PyObject* py_merkle_root_many(PyObject*, PyObject* arg) {
+    PyObject* outer = PySequence_Fast(
+        arg, "merkle_root_many takes a sequence of leaf sequences");
+    if (!outer) return nullptr;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(outer);
+    PyObject* result = PyList_New(n);
+    if (!result) { Py_DECREF(outer); return nullptr; }
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject* seq = PySequence_Fast(
+            PySequence_Fast_GET_ITEM(outer, i),
+            "merkle_root_many items must be sequences");
+        if (!seq) { Py_DECREF(result); Py_DECREF(outer); return nullptr; }
+        uint8_t out[32];
+        int rc = merkle_root_of(seq, out);
+        Py_DECREF(seq);
+        if (rc < 0) { Py_DECREF(result); Py_DECREF(outer); return nullptr; }
+        PyObject* b = PyBytes_FromStringAndSize(
+            reinterpret_cast<char*>(out), 32);
+        if (!b) { Py_DECREF(result); Py_DECREF(outer); return nullptr; }
+        PyList_SET_ITEM(result, i, b);
+    }
+    Py_DECREF(outer);
+    return result;
 }
 
 // Batch-signing shape (tx_signature.sign_tx_ids): build every tree
@@ -1092,28 +1143,31 @@ static int cts_enc_int(PyObject* obj, CtsBuf& out) {
 
 static int cts_enc_object(PyObject* obj, CtsBuf& out, int depth) {
     PyObject* tp = reinterpret_cast<PyObject*>(Py_TYPE(obj));
-    PyObject* info = PyDict_GetItemWithError(g_cts.enc_cache, tp);
-    if (info == nullptr) {
-        if (PyErr_Occurred()) return -1;
-        info = PyObject_CallFunctionObjArgs(g_cts.enc_resolver, tp, nullptr);
-        if (info == nullptr) return -1;
-        Py_DECREF(info);   // the resolver cached it (or returned None)
-        if (info == Py_None) {
-            PyErr_Format(
-                g_cts.err, "type %s is not canonically serializable",
-                Py_TYPE(obj)->tp_name);
-            return -1;
-        }
-        info = PyDict_GetItemWithError(g_cts.enc_cache, tp);
-        if (info == nullptr)
-            return cts_err("encoder cache desynchronised");
-    }
     // info = (header_bytes, custom_or_None, ((name_bytes, name), ...)).
     // STRONG ref for the duration: nested encoding runs arbitrary
     // Python (custom encoders, property getters) that may invalidate
     // the shared cache entry — a borrowed `info` would be freed under
     // us (round-5 review: reproduced as an interpreter abort).
-    Py_INCREF(info);
+    PyObject* info = PyDict_GetItemWithError(g_cts.enc_cache, tp);
+    if (info != nullptr) {
+        Py_INCREF(info);   // borrowed from the cache -> strong
+    } else {
+        if (PyErr_Occurred()) return -1;
+        // cache miss: KEEP the resolver call's strong reference (and
+        // check Py_None while still holding it) — the previous
+        // decref-then-refetch relied on the resolver having stored the
+        // tuple in the cache, a latent use-after-free if it ever
+        // returned an uncached tuple (round-5 advisor).
+        info = PyObject_CallFunctionObjArgs(g_cts.enc_resolver, tp, nullptr);
+        if (info == nullptr) return -1;
+        if (info == Py_None) {
+            Py_DECREF(info);
+            PyErr_Format(
+                g_cts.err, "type %s is not canonically serializable",
+                Py_TYPE(obj)->tp_name);
+            return -1;
+        }
+    }
     PyObject* header = PyTuple_GET_ITEM(info, 0);
     PyObject* custom = PyTuple_GET_ITEM(info, 1);
     PyObject* fields = PyTuple_GET_ITEM(info, 2);
@@ -2151,6 +2205,8 @@ PyMethodDef methods[] = {
      "SHA-256 digest of every item of a sequence of bytes-likes."},
     {"merkle_root", py_merkle_root, METH_O,
      "Root of the zero-padded pairwise-SHA-256 tree over 32-byte leaves."},
+    {"merkle_root_many", py_merkle_root_many, METH_O,
+     "Roots of many trees in one call: [leaf lists] -> [32-byte roots]."},
     {"merkle_paths", py_merkle_paths, METH_O,
      "(root, [sibling-path bytes per leaf]) for the zero-padded tree."},
     {"stage_ecdsa_many", py_stage_ecdsa_many, METH_VARARGS,

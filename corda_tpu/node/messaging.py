@@ -56,6 +56,17 @@ class MessagingService:
     def add_handler(self, topic: str, handler: Handler) -> None:
         raise NotImplementedError
 
+    def add_ring(self, topic: str, ring) -> None:
+        """OPTIONAL bulk-ingest seam (node/ingest.py): deliver `topic`
+        messages into a bounded ring (`ring.offer(msg) -> bool`)
+        instead of per-message handler dispatch, so a consumer can
+        decode whole delivery rounds through the sharded ingest
+        pipeline. A full ring parks the frame for redelivery
+        (`retry_parked`) — backpressure without blocking the pump.
+        Fabrics that don't implement it raise, and callers fall back
+        to the per-message handler path."""
+        raise NotImplementedError(f"{type(self).__name__} has no ring seam")
+
     @property
     def my_address(self) -> str:
         raise NotImplementedError
@@ -129,6 +140,7 @@ class InMemoryMessaging(MessagingService):
         self._network = network
         self._name = name
         self._handlers: dict[str, list[Handler]] = {}
+        self._rings: dict[str, object] = {}   # topic -> ingest ring
         self._next_id = 0
         self._seen: set[tuple[str, int]] = set()
         self._undelivered: deque[Message] = deque()
@@ -167,10 +179,51 @@ class InMemoryMessaging(MessagingService):
         if handler in handlers:
             handlers.remove(handler)
 
+    def add_ring(self, topic: str, ring) -> None:
+        """Route `topic` into a bounded ingest ring (wire-ingest fast
+        path — see MessagingService.add_ring). Messages already parked
+        for the topic flow into the ring immediately."""
+        self._rings[topic] = ring
+        self.retry_parked(topic)
+
+    def retry_parked(self, topic: str) -> int:
+        """Re-offer frames parked while the topic's ring was full
+        (the consumer calls this after draining). Returns how many
+        moved into the ring."""
+        ring = self._rings.get(topic)
+        if ring is None:
+            return 0
+        moved = 0
+        parked = [m for m in self._undelivered if m.topic == topic]
+        for m in parked:
+            key = (m.sender, m.unique_id)
+            if key in self._seen:
+                # an at-least-once redelivery of this frame already
+                # reached the ring while this copy sat parked — drop
+                # the duplicate, exactly-once holds on the ring path
+                # just like the handler path
+                self._undelivered.remove(m)
+                continue
+            if not ring.offer(m):
+                break   # still full: keep FIFO order, stop early
+            self._undelivered.remove(m)
+            self._seen.add(key)
+            moved += 1
+        return moved
+
     def _deliver(self, msg: Message) -> None:
         key = (msg.sender, msg.unique_id)
         if key in self._seen:
             return  # at-least-once upstream, exactly-once to handlers
+        ring = self._rings.get(msg.topic)
+        if ring is not None:
+            # ring seam: enqueue the raw frame for the bulk decoder; a
+            # full ring parks it (backpressure) for retry_parked
+            if ring.offer(msg):
+                self._seen.add(key)
+            else:
+                self._undelivered.append(msg)
+            return
         handlers = self._handlers.get(msg.topic)
         if not handlers:
             self._undelivered.append(msg)

@@ -350,6 +350,7 @@ class BatchingNotaryService(NotaryService):
         self.max_batch = max_batch
         self.max_wait_micros = max_wait_micros
         self._pending: list[_PendingNotarisation] = []
+        self._ingest_ring = None   # attach_ingest: pre-decoded arrivals
         self._oldest_arrival: Optional[int] = None
         # metrics: dispatches vs requests shows the batching ratio
         self.batches_dispatched = 0
@@ -377,12 +378,32 @@ class BatchingNotaryService(NotaryService):
         result = yield from wait_future(fut)
         return result
 
+    def attach_ingest(self, ring) -> None:
+        """Wire the pipelined wire-ingest seam (node/ingest.py): the
+        ring carries batches of _PendingNotarisation whose stx was
+        decoded, Merkle-id'd and signature-staged by the ingest
+        pipeline — the flush drains them directly, and its stage phase
+        reuses the memoised staging instead of re-staging. The ring is
+        BOUNDED: when this notary falls behind, the producer's `put`
+        blocks, which is the backpressure that keeps the decode pool
+        from running unboundedly ahead of the TPU dispatch."""
+        self._ingest_ring = ring
+
+    def _drain_ingest(self) -> None:
+        ring = self._ingest_ring
+        if ring is not None:
+            for batch in ring.drain():
+                self._pending.extend(batch)
+            if self._pending and self._oldest_arrival is None:
+                self._oldest_arrival = self.services.clock.now_micros()
+
     def tick(self) -> int:
         """Pump hook (MockNetwork `node.ticks` / Node._tick_services):
         flush whatever accumulated during the last delivery round —
         unless a batching deadline is set and neither it nor max_batch
         has been reached yet. Returns requests answered (0 = held or
         quiescent)."""
+        self._drain_ingest()
         n = len(self._pending)
         if not n:
             return 0
@@ -414,6 +435,7 @@ class BatchingNotaryService(NotaryService):
         # sweeps were 68% of the serving wall (BASELINE.md round-3
         # profile). Suspend automatic GC for the bounded flush body;
         # collection resumes (and catches up) between pump ticks.
+        self._drain_ingest()   # pre-ingested arrivals join this flush
         gc_was_enabled = gc.isenabled()
         if gc_was_enabled:
             gc.disable()

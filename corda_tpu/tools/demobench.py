@@ -118,8 +118,14 @@ class DemoBench:
         name: str,
         notary: str = "",
         timeout: float = 120.0,
+        register_lock=None,
         **config_kw,
     ) -> BenchNode:
+        """`register_lock`: held around the COMPLETION mutation (nodes
+        dict, _order, client invalidation) so a launcher whose readers
+        take the same lock (web_demobench status/pane) can never
+        observe a half-registered node. The slow boot itself runs
+        outside it."""
         if name in self.nodes and self.nodes[name].alive:
             raise ValueError(f"node {name!r} already running")
         # monotonic allocation: a stop/re-add cycle must never hand a
@@ -160,13 +166,16 @@ class DemoBench:
         )
         bound = self._await_port(proc, log_path, name, timeout)
         node = BenchNode(name, cfg, proc, bound, log_path)
-        self.nodes[name] = node
-        if name not in self._order:
-            self._order.append(name)
-        self._clients = {
-            k: v for k, v in self._clients.items()
-            if k.split(":", 1)[0] != name
-        }
+        import contextlib
+
+        with register_lock or contextlib.nullcontext():
+            self.nodes[name] = node
+            if name not in self._order:
+                self._order.append(name)
+            self._clients = {
+                k: v for k, v in self._clients.items()
+                if k.split(":", 1)[0] != name
+            }
         return node
 
     @staticmethod

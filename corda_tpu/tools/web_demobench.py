@@ -98,7 +98,13 @@ class WebDemoBench:
                             # orphan a node past the launcher
                             self._starting.pop(name, None)
                             return
-                    self.bench.add_node(name, **kw)
+                    # register_lock=self._lock: the bench-mutation
+                    # portion of add_node's completion happens under
+                    # the SAME lock status()/pane() read with, so a
+                    # poll can never observe a half-registered node
+                    # (round-5 advisor — GIL atomicity is not a
+                    # consistency contract)
+                    self.bench.add_node(name, register_lock=self._lock, **kw)
                 with self._lock:
                     del self._starting[name]
             except Exception as e:   # noqa: BLE001 - surfaced via status

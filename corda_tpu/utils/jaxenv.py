@@ -60,10 +60,16 @@ def enable_compile_cache() -> None:
     # actually tested — a future jax that KEEPS the attribute names
     # but shifts their semantics must fall back to the stock
     # allowlist behavior, not silently misuse the cache.
-    ver = tuple(
-        int(p) for p in (jax.__version__.split(".") + ["0", "0"])[:2]
-        if p.isdigit()
-    )
+    # regex, not a split-and-filter: a dev/rc version string like
+    # '0.5.0.dev20260101' must parse as (0, 5) — the old comprehension
+    # dropped non-digit parts and could yield a SHORT tuple (e.g.
+    # (0,)) that still passed the range check, defeating the
+    # tested-layout guard this gate promises (round-5 advisor). No
+    # match at all = unknown layout = skip the poke.
+    m = re.match(r"(\d+)\.(\d+)", jax.__version__)
+    if m is None:
+        return
+    ver = (int(m.group(1)), int(m.group(2)))
     if not ((0, 4) <= ver <= (0, 9)):
         return
     try:

@@ -41,6 +41,8 @@ RUNNABLE = (
     "writing-a-cordapp.md",
     "message-fabric.md",
     "versioning.md",
+    # PR 1: pipelined wire-ingest + notary retry-after-partial-commit
+    "serving-notary.md",
 )
 
 
