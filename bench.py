@@ -30,8 +30,17 @@ BENCH_METRIC restricts to one measurement:
                     digest + hot-frame caches); records vs_serial
                     measured on the same fixture in the same process
 
+  trace           — stage-attributed hot path: wire frames through
+                    IngestPipeline + a BatchingNotaryService flush with
+                    tracing on, recording the decode / merkle / stage /
+                    dispatch / kernel / commit seconds breakdown plus
+                    the measured tracing overhead vs an untraced run on
+                    the same fixture
+
 `python bench.py --quick ingest` runs tiny serial + pipelined ingest
-records in one CPU-safe process (tier-1 smoke of the perf plumbing).
+records in one CPU-safe process (tier-1 smoke of the perf plumbing);
+`--quick trace` smokes the traced hot path, asserting the stage
+breakdown sums to ~the batch wall and tracing overhead stays under 5%.
   montmul         — device-resident A/B of the MXU (batched int8
                     Toeplitz matmul) vs VPU (shifted accumulate)
                     Montgomery-multiply formulations (experiment rig,
@@ -507,6 +516,186 @@ def _ingest_pipelined_metric(batch: int, iters: int) -> dict:
     }
 
 
+# bench-stage names <- span names (utils/tracing.py): the BENCH
+# breakdown speaks decode/merkle/stage/dispatch/kernel/commit so the
+# perf trajectory pins a regression to a stage without knowing the
+# span vocabulary; "kernel" is the device wait (link_wait) — zero on
+# CPU-synchronous verifiers, whose compute lands inside "dispatch"
+_TRACE_STAGE_MAP = {
+    "ingest.decode": "decode",
+    "ingest.merkle_id": "merkle",
+    "ingest.stage": "stage",
+    "notary.stage": "stage",
+    "notary.dispatch": "dispatch",
+    "notary.resolve_verify": "dispatch",
+    "notary.link_wait": "kernel",
+    "notary.validate": "commit",
+    "notary.commit": "commit",
+    "notary.stream_commit": "commit",
+    "notary.sign_scatter": "commit",
+}
+
+
+def _trace_fixture(unique: int, batch: int, cpu: bool):
+    """(notary service, requester party, wire blobs): `unique` distinct
+    signed cash spends tiled to `batch`, their issue backchain recorded
+    at the notary — the full-path fixture the stage-breakdown metric
+    drives from wire bytes to uniqueness commit."""
+    from corda_tpu.core import serialization as ser
+    from corda_tpu.core.contracts import Amount, Issued, StateRef
+    from corda_tpu.core.identity import PartyAndReference
+    from corda_tpu.core.transactions import TransactionBuilder
+    from corda_tpu.crypto.batch_verifier import (
+        CpuBatchVerifier,
+        TpuBatchVerifier,
+    )
+    from corda_tpu.finance.cash import (
+        CASH_CONTRACT,
+        CashIssue,
+        CashMove,
+        CashState,
+    )
+    from corda_tpu.testing.mock_network import MockNetwork
+
+    if cpu:
+        verifier = CpuBatchVerifier()
+    else:
+        chunk = min(int(os.environ.get("BENCH_CHUNK", "4096")), batch)
+        verifier = TpuBatchVerifier(batch_sizes=(chunk,))
+    net = MockNetwork(seed=13, batch_verifier=verifier)
+    notary = net.create_notary("Notary", batching=True)
+    bank = net.create_node("Bank")
+    alice = net.create_node("Alice")
+    token = Issued(PartyAndReference(bank.party, b"\x01"), "USD")
+    blobs = []
+    for i in range(max(unique, 1)):
+        ib = TransactionBuilder(notary.party)
+        ib.add_output_state(
+            CashState(Amount(100 + i, token), alice.party.owning_key),
+            CASH_CONTRACT,
+        )
+        ib.add_command(CashIssue(i + 1), bank.party.owning_key)
+        issue = bank.services.sign_initial_transaction(ib)
+        notary.services.record_transactions([issue])
+        alice.services.record_transactions([issue])
+        sb = TransactionBuilder(notary.party)
+        sb.add_input_state(alice.vault.state_and_ref(StateRef(issue.id, 0)))
+        sb.add_output_state(
+            CashState(Amount(100 + i, token), bank.party.owning_key),
+            CASH_CONTRACT, notary.party,
+        )
+        sb.add_command(CashMove(), alice.party.owning_key)
+        blobs.append(ser.encode(alice.services.sign_initial_transaction(sb)))
+    blobs = (blobs * (batch // len(blobs) + 1))[:batch]
+    return notary.services.notary_service, alice.party, blobs
+
+
+def _trace_metric(batch: int, iters: int, cpu: bool = False) -> dict:
+    """Stage-attributed hot path (the tracing tentpole's bench leg):
+    drive `batch` wire frames through IngestPipeline -> one
+    BatchingNotaryService flush, alternating UNTRACED / TRACED reps,
+    and fold the tracer's per-stage summary into the record as the
+    decode / merkle / stage / dispatch / kernel / commit seconds
+    breakdown. `value` is the coverage fraction — how much of the
+    traced wall the stages attribute; `tracing_overhead` is
+    min(traced)/min(untraced)-1 on the SAME fixture in the SAME
+    process, so the cost of always-on tracing stays a measured ratio
+    inside one record."""
+    from corda_tpu.flows.api import FlowFuture
+    from corda_tpu.node.ingest import IngestPipeline
+    from corda_tpu.node.notary import (
+        InMemoryUniquenessProvider,
+        _PendingNotarisation,
+    )
+    from corda_tpu.utils import tracing
+
+    cpu = cpu or os.environ.get("BENCH_TRACE_CPU", "") not in ("", "0")
+    tile = max(1, int(os.environ.get("BENCH_TILE", "8")))
+    svc, requester, blobs = _trace_fixture(min(tile, batch), batch, cpu)
+    reps = max(2, iters)
+
+    def run_once(tracer) -> float:
+        # fresh uniqueness per pass (conflict-free re-notarise) and a
+        # fresh pipeline with the frame cache OFF so every rep decodes
+        # the same work — the traced/untraced ratio is then tracing,
+        # not cache luck
+        svc.uniqueness = InMemoryUniquenessProvider()
+        pipe = IngestPipeline(tracer=tracer, frame_cache_size=0)
+        futs = []
+        t0 = time.perf_counter()
+        entries = pipe.ingest(blobs, end_spans=False)
+        for e in entries:
+            if e.error is not None:
+                raise SystemExit(f"trace metric ingest failed: {e.error}")
+            fut = FlowFuture()
+            futs.append(fut)
+            svc._pending.append(
+                _PendingNotarisation(e.stx, requester, fut, span=e.span)
+            )
+        svc.flush()
+        wall = time.perf_counter() - t0
+        pipe.close()
+        for fut in futs:
+            sig = fut.result()
+            if not hasattr(sig, "by"):
+                raise SystemExit(f"trace metric notarisation failed: {sig}")
+        return wall
+
+    import gc
+
+    off = tracing.Tracer(enabled=False)
+    on = tracing.Tracer(
+        enabled=True,
+        recorder=tracing.FlightRecorder(
+            keep_recent=batch * reps, keep_slowest=16
+        ),
+    )
+    # warm-up BOTH modes (compile + correctness + first-run bytecode on
+    # the span paths), then drop the warm-up traces so the stage
+    # summary covers timed reps only
+    run_once(off)
+    run_once(on)
+    on.recorder.clear()
+    walls_off, walls_on = [], []
+    for _ in range(reps):               # interleaved A/B: drift cancels
+        gc.collect()                    # equalise collector debt per rep
+        walls_off.append(run_once(off))
+        gc.collect()
+        walls_on.append(run_once(on))
+    # min-of-reps on both sides: timing noise is one-sided positive, so
+    # the minima are the comparable "clean lap" walls
+    overhead = min(walls_on) / min(walls_off) - 1.0
+
+    # per-flush stage seconds: each stage interval is SHARED across the
+    # batch (one decode pass, one dispatch), so the per-frame mean IS
+    # the per-flush interval, averaged over the traced reps
+    summary = on.stage_summary()
+    stages = {
+        k: 0.0 for k in
+        ("decode", "merkle", "stage", "dispatch", "kernel", "commit")
+    }
+    for span_name, row in summary.items():
+        bucket = _TRACE_STAGE_MAP.get(span_name)
+        if bucket is not None:
+            stages[bucket] += row["mean_s"]
+    attributed = sum(stages.values())
+    wall = _median(walls_on)
+    coverage = attributed / wall if wall > 0 else 0.0
+    return {
+        "metric": "hot_path_stage_breakdown",
+        "value": round(coverage, 3),
+        "unit": "fraction of traced wall attributed to stages",
+        "vs_baseline": round(coverage, 3),
+        "stages_seconds": {k: round(v, 6) for k, v in stages.items()},
+        "wall_seconds": round(wall, 6),
+        "untraced_wall_seconds": round(_median(walls_off), 6),
+        "tracing_overhead": round(overhead, 4),
+        "batch": batch,
+        "reps": reps,
+        "verifier": "cpu" if cpu else "tpu",
+    }
+
+
 def _montmul_metric(batch: int, iters: int) -> dict:
     """Interleaved device-resident A/B of the two variable x variable
     Montgomery-multiply formulations (round-3 MXU experiment, VERDICT
@@ -750,6 +939,11 @@ def _run_metric(metric: str, batch: int, iters: int) -> dict:
         if batch > 16384:
             out["batch_requested"] = batch
         return out
+    if metric == "trace":
+        out = _trace_metric(min(batch, 4096), iters)
+        if batch > 4096:
+            out["batch_requested"] = batch   # cap visible in the record
+        return out
     if metric == "parity":
         return _parity_metric(batch, iters)
     return _spi_metric(metric, batch, iters)
@@ -789,14 +983,44 @@ def _run_child(m: str, env: dict, timeout: float) -> bool:
 
 
 def _quick(metric: str) -> None:
-    """`python bench.py --quick ingest`: a tiny, CPU-safe smoke run of
-    the ingest metrics — both the serial and pipelined lines, one
-    process, shallow batch — so tier-1 (JAX_PLATFORMS=cpu, no device)
-    can assert the perf plumbing emits well-formed records without
-    paying a real measurement. Values from this mode are NOT
-    comparable to the default run's."""
+    """`python bench.py --quick ingest|trace`: tiny, CPU-safe smoke
+    runs so tier-1 (JAX_PLATFORMS=cpu, no device) can assert the perf
+    plumbing emits well-formed records without paying a real
+    measurement. Values from this mode are NOT comparable to the
+    default run's.
+
+      ingest — serial + pipelined ingest metric lines (PR 1).
+      trace  — the full hot path with tracing ON: asserts the stage
+               breakdown sums to ~the traced wall and that tracing
+               overhead stays under BENCH_TRACE_OVERHEAD_MAX (default
+               5%) vs the untraced run on the same fixture.
+    """
+    if metric == "trace":
+        batch = int(os.environ.get("BENCH_BATCH", "192"))
+        reps = int(os.environ.get("BENCH_TRACE_REPS", "3"))
+        out = _trace_metric(batch, reps, cpu=True)
+        out["quick"] = True
+        print(json.dumps(out), flush=True)
+        coverage = out["value"]
+        if not 0.6 <= coverage <= 1.4:
+            raise SystemExit(
+                f"stage breakdown covers {coverage:.2f} of the traced "
+                "wall — expected ~1.0 (stages must sum to ~batch wall "
+                "time)"
+            )
+        max_overhead = float(
+            os.environ.get("BENCH_TRACE_OVERHEAD_MAX", "0.05")
+        )
+        if out["tracing_overhead"] > max_overhead:
+            raise SystemExit(
+                f"tracing overhead {out['tracing_overhead']:.3f} exceeds "
+                f"{max_overhead:.0%} vs the untraced run"
+            )
+        return
     if metric != "ingest":
-        raise SystemExit(f"--quick supports 'ingest', not {metric!r}")
+        raise SystemExit(
+            f"--quick supports 'ingest' or 'trace', not {metric!r}"
+        )
     batch = int(os.environ.get("BENCH_BATCH", "256"))
     iters = int(os.environ.get("BENCH_ITERS", "1"))
     out = _ingest_metric(batch, iters)
@@ -824,7 +1048,7 @@ def main() -> None:
     metric = os.environ.get("BENCH_METRIC", "all")
     known = (
         "all", "p256", "mixed", "merkle", "notary", "ingest",
-        "ingest_pipelined", "montmul", "parity",
+        "ingest_pipelined", "trace", "montmul", "parity",
     )
     if metric not in known:
         # a typo must not record a p256-only rate under another name
@@ -863,7 +1087,7 @@ def main() -> None:
     # parity runs LAST of the optional work (cheapest to drop), but
     # before the headline so the headline stays the final stdout line
     for m in ("mixed", "merkle", "notary", "ingest", "ingest_pipelined",
-              "parity"):
+              "trace", "parity"):
         avail = left() - reserve
         if avail < 60:
             print(
@@ -874,7 +1098,8 @@ def main() -> None:
             continue
         env = dict(os.environ, BENCH_METRIC=m)
         if avail < 300 and m in (
-            "mixed", "merkle", "notary", "ingest", "ingest_pipelined"
+            "mixed", "merkle", "notary", "ingest", "ingest_pipelined",
+            "trace",
         ):
             # trim before dropping: one timed rep at a shallower batch
             # still yields a usable point for the table

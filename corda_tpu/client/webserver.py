@@ -103,14 +103,21 @@ class NodeWebServer:
         port: int = 0,
         rpc_timeout: float = 90.0,
         metrics=None,
+        tracer=None,
     ):
         """`metrics`: an optional MetricRegistry served at GET /metrics
         in prometheus exposition format (the reference exports
-        dropwizard metrics over JMX/Jolokia HTTP, Node.kt:306-308)."""
+        dropwizard metrics over JMX/Jolokia HTTP, Node.kt:306-308).
+
+        `tracer`: an optional utils.tracing.Tracer whose flight
+        recorder is served at GET /traces — chrome://tracing-loadable
+        trace-event JSON (object form) with a per-stage latency
+        summary under `stageSummary`."""
         self.client = client
         self.pump = pump
         self.rpc_timeout = rpc_timeout
         self.metrics = metrics
+        self.tracer = tracer
         self._lock = threading.Lock()   # one RPC conversation at a time
         gateway = self
 
@@ -165,6 +172,34 @@ class NodeWebServer:
                 status = 200
             req.send_response(status)
             req.send_header("Content-Type", ctype)
+            req.send_header("Content-Length", str(len(payload)))
+            req.end_headers()
+            req.wfile.write(payload)
+            return
+        if method == "GET" and urlparse(req.path).path == "/traces":
+            # hot-path traces: the flight recorder's retained traces
+            # (N slowest + N most recent) as chrome://tracing-loadable
+            # JSON plus the per-stage latency summary — /metrics tells
+            # you THAT serving slowed, this tells you WHICH stage
+            try:
+                if self.tracer is not None:
+                    # serialize INSIDE the guard: a non-JSON span
+                    # attribute must yield the 500, not a half-written
+                    # response (span attributes are caller-typed Any)
+                    payload = json.dumps(self.tracer.export()).encode()
+                    status = 200
+                else:
+                    payload = json.dumps(
+                        {"error": "tracing not wired on this gateway"}
+                    ).encode()
+                    status = 404
+            except Exception as e:   # noqa: BLE001 - defensive render
+                payload = json.dumps(
+                    {"error": f"trace export failed: {e}"}
+                ).encode()
+                status = 500
+            req.send_response(status)
+            req.send_header("Content-Type", "application/json")
             req.send_header("Content-Length", str(len(payload)))
             req.end_headers()
             req.wfile.write(payload)

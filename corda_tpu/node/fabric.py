@@ -234,12 +234,21 @@ class FabricEndpoint(MessagingService):
         payload: bytes,
         target: str,
         unique_id: Optional[int] = None,
+        trace: Optional[tuple] = None,
     ) -> None:
         """Durably journal, then wake the peer's bridge. uid None mints
         an id from a persistent monotonic counter — NEVER reused, even
         after rows ack away, because the receiver's dedupe key
         (sender, uid) lives forever: a recycled uid would be silently
-        swallowed as a duplicate."""
+        swallowed as a duplicate.
+
+        `trace` (tracing header) is accepted for interface parity but
+        NOT journaled: the durable frame format carries consensus
+        payload only, and a redelivered frame after a crash could not
+        honour a stale trace anyway — across this fabric a trace starts
+        fresh at the receiving frame (best-effort propagation, see
+        MessagingService.send)."""
+        del trace
         with self._db.transaction():
             if unique_id is None:
                 unique_id = self._next_uid()

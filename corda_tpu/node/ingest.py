@@ -55,6 +55,7 @@ tests/test_ingest.py.
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
@@ -64,6 +65,7 @@ from ..core import serialization as ser
 from ..core.transactions import SignedTransaction
 from ..crypto.hashes import SecureHash, sha256_many
 from ..crypto.merkle import merkle_roots_from_digests
+from ..utils import tracing
 
 
 @dataclass
@@ -84,6 +86,12 @@ class IngestedTx:
     #                            envelope, e.g. TxVerificationRequest)
     error: Optional[Exception] = None
     requests: list = field(default_factory=list)
+    # tracing (utils/tracing.py): the frame's LIVE root span, opened at
+    # ingest (continuing the wire frame's propagated context when the
+    # fabric carried one). Downstream consumers — the notary flush —
+    # attach their stage spans under it and END it when the frame's
+    # future resolves. None whenever tracing is off.
+    span: Any = None
 
     @property
     def tx_id(self) -> Optional[SecureHash]:
@@ -250,6 +258,10 @@ class IngestRing:
         self._dq: deque = deque()
         self._cond = threading.Condition()
         self._closed = False
+        # lifetime high-water mark: how close the consumer ever let the
+        # ring get to its bound — a depth gauge samples, this remembers
+        # (messaging.register_ring_gauges exports both)
+        self.high_water = 0
 
     def put(self, batch, timeout: Optional[float] = None) -> bool:
         """Block until there is room (backpressure); False on timeout
@@ -262,6 +274,8 @@ class IngestRing:
             if self._closed:
                 return False
             self._dq.append(batch)
+            if len(self._dq) > self.high_water:
+                self.high_water = len(self._dq)
             self._cond.notify_all()
             return True
 
@@ -273,6 +287,8 @@ class IngestRing:
             if self._closed or len(self._dq) >= self.depth:
                 return False
             self._dq.append(batch)
+            if len(self._dq) > self.high_water:
+                self.high_water = len(self._dq)
             self._cond.notify_all()
             return True
 
@@ -326,6 +342,7 @@ class IngestPipeline:
         root_cache_size: int = 16384,
         frame_cache_size: int = 8192,
         stage: bool = True,
+        tracer=None,
     ):
         self.pool = DecodePool(shards, decode)
         self.ring = IngestRing(ring_depth)
@@ -340,18 +357,39 @@ class IngestPipeline:
         self.frame_hits = 0          # observability (bench records this)
         self._extract = extract or (lambda obj: obj)
         self._stage = stage
+        # explicit tracer, or the process default resolved per batch
+        # (None here so a later set_tracer()/env enable is honoured)
+        self.tracer = tracer
+
+    def _tracer(self):
+        return self.tracer if self.tracer is not None else tracing.get_tracer()
 
     # -- one batch ---------------------------------------------------------
 
-    def ingest(self, blobs: list) -> list[IngestedTx]:
+    def ingest(
+        self,
+        blobs: list,
+        trace_parents: Optional[list] = None,
+        end_spans: bool = True,
+    ) -> list[IngestedTx]:
         """Decode + id + stage one batch synchronously (the pipelined
         form below overlaps; this is the building block and the test
-        surface)."""
-        return self._finish(self._start(blobs))
+        surface).
 
-    def _start(self, blobs: list):
+        Tracing: with the tracer enabled, every entry gets a root span
+        (continuing `trace_parents[i]` — the wire frame's propagated
+        header — when given) plus decode / merkle_id / stage child
+        spans carrying the batch-stage boundaries. `end_spans=False`
+        leaves the root OPEN and hands ownership downstream: the notary
+        flush attaches its phase spans under it and ends it when the
+        frame's future resolves — one connected trace per
+        notarisation."""
+        return self._finish(self._start(blobs, trace_parents), end_spans)
+
+    def _start(self, blobs: list, trace_parents: Optional[list] = None):
         """Probe the frame cache, then kick the MISSES off on the
         decode pool. Returns the in-flight handle _finish consumes."""
+        t0 = time.perf_counter()
         cache = self.frame_cache
         hits: dict[int, tuple] = {}
         if cache is None:
@@ -367,10 +405,10 @@ class IngestPipeline:
                     hits[i] = cached
             self.frame_hits += len(hits)
         handle = self.pool.decode_async(misses) if misses else None
-        return blobs, hits, miss_idx, handle
+        return blobs, hits, miss_idx, handle, trace_parents, t0
 
-    def _finish(self, started) -> list[IngestedTx]:
-        blobs, hits, miss_idx, handle = started
+    def _finish(self, started, end_spans: bool = True) -> list[IngestedTx]:
+        blobs, hits, miss_idx, handle, parents, t0 = started
         entries: list[Optional[IngestedTx]] = [None] * len(blobs)
         for i, (stx, obj, requests) in hits.items():
             entries[i] = IngestedTx(
@@ -378,36 +416,40 @@ class IngestPipeline:
             )
         stxs: list[SignedTransaction] = []
         fresh: list[IngestedTx] = []
-        if handle is not None:
-            for i, obj in zip(miss_idx, handle.result()):
-                blob = blobs[i]
-                if isinstance(obj, Exception):
-                    entries[i] = IngestedTx(blob, error=obj)
-                    continue
-                try:
-                    stx = self._extract(obj)
-                    # None is a VALID extract result (a verifier-request
-                    # envelope with no stx: contract-only work) — the
-                    # entry passes through with nothing to id/stage.
-                    # Anything else non-stx is a malformed frame.
-                    if stx is not None and not isinstance(
-                        stx, SignedTransaction
-                    ):
-                        raise ser.SerializationError(
-                            f"ingest expected a SignedTransaction, got "
-                            f"{type(stx).__name__}"
-                        )
-                except Exception as e:  # noqa: BLE001 - per-blob isolation
-                    entries[i] = IngestedTx(blob, obj=obj, error=e)
-                    continue
-                e = IngestedTx(blob, stx=stx, obj=obj)
-                entries[i] = e
-                if stx is not None:
-                    stxs.append(stx)
-                fresh.append(e)
+        results = handle.result() if handle is not None else []
+        tracer = self._tracer()
+        tracing_on = tracer.enabled
+        t_decode = time.perf_counter() if tracing_on else 0.0
+        for i, obj in zip(miss_idx, results):
+            blob = blobs[i]
+            if isinstance(obj, Exception):
+                entries[i] = IngestedTx(blob, error=obj)
+                continue
+            try:
+                stx = self._extract(obj)
+                # None is a VALID extract result (a verifier-request
+                # envelope with no stx: contract-only work) — the
+                # entry passes through with nothing to id/stage.
+                # Anything else non-stx is a malformed frame.
+                if stx is not None and not isinstance(
+                    stx, SignedTransaction
+                ):
+                    raise ser.SerializationError(
+                        f"ingest expected a SignedTransaction, got "
+                        f"{type(stx).__name__}"
+                    )
+            except Exception as e:  # noqa: BLE001 - per-blob isolation
+                entries[i] = IngestedTx(blob, obj=obj, error=e)
+                continue
+            e = IngestedTx(blob, stx=stx, obj=obj)
+            entries[i] = e
+            if stx is not None:
+                stxs.append(stx)
+            fresh.append(e)
         install_tx_ids(
             [s.wtx for s in stxs], self.leaf_cache, self.root_cache
         )
+        t_id = time.perf_counter() if tracing_on else 0.0
         cache = self.frame_cache
         for e in fresh:
             if self._stage and e.stx is not None:
@@ -416,7 +458,51 @@ class IngestPipeline:
                 e.requests = e.stx.signature_requests()
             if cache is not None:
                 cache.put(e.blob, (e.stx, e.obj, e.requests))
+        if tracing_on:
+            self._emit_spans(
+                tracer, entries, hits, parents,
+                t0, t_decode, t_id, time.perf_counter(), end_spans,
+            )
         return entries
+
+    def _emit_spans(
+        self, tracer, entries, hits, parents,
+        t0, t_decode, t_id, t_stage, end_spans,
+    ) -> None:
+        """Per-frame trace assembly for one batch: a root span per
+        entry (joining the frame's propagated context when the fabric
+        carried one) with decode / merkle_id / stage children stamped
+        with the BATCH stage boundaries — the stages run batched, so
+        the interval is shared and the batch size is an attribute."""
+        n = len(entries)
+        for i, e in enumerate(entries):
+            parent = None
+            if parents is not None and i < len(parents):
+                parent = parents[i]
+            root = tracer.start_trace("notarise.frame", parent=parent)
+            root.start = t0
+            root.set_attribute("wire_bytes", len(e.blob))
+            if e.tx_id is not None:
+                root.set_attribute("tx_id", str(e.tx_id))
+            if i in hits:
+                root.set_attribute("frame_cache_hit", True)
+            else:
+                tracer.span_at(
+                    "ingest.decode", root, t0, t_decode, batch=n
+                )
+                if e.error is None:
+                    tracer.span_at(
+                        "ingest.merkle_id", root, t_decode, t_id, batch=n
+                    )
+                    tracer.span_at(
+                        "ingest.stage", root, t_id, t_stage, batch=n
+                    )
+            e.span = root
+            if e.error is not None:
+                root.set_attribute("error", repr(e.error))
+                root.end(t_stage)   # nothing downstream will own it
+            elif end_spans:
+                root.end(t_stage)
 
     # -- double-buffered stream --------------------------------------------
 

@@ -36,6 +36,12 @@ class Message:
     payload: bytes          # canonical-serialized body
     sender: str             # peer name of origin
     unique_id: int          # per-sender unique id (dedupe key)
+    # OPTIONAL tracing header (utils/tracing.py): the sender's
+    # (trace_id, span_id) SpanContext pair, so a receiver's spans join
+    # the SAME trace — one connected tree per notarisation across the
+    # fabric hop. None (the default) everywhere tracing is off; the
+    # field is observability metadata, never consensus input.
+    trace: Optional[tuple] = None
 
 
 Handler = Callable[[Message], None]
@@ -50,13 +56,17 @@ class MessagingService:
         payload: bytes,
         target: str,
         unique_id: Optional[int] = None,
+        trace: Optional[tuple] = None,
     ) -> None:
+        """`trace`: optional tracing SpanContext header (see
+        Message.trace); fabrics that cannot carry it drop it — trace
+        propagation is best-effort, delivery semantics are not."""
         raise NotImplementedError
 
     def add_handler(self, topic: str, handler: Handler) -> None:
         raise NotImplementedError
 
-    def add_ring(self, topic: str, ring) -> None:
+    def add_ring(self, topic: str, ring, metrics=None) -> None:
         """OPTIONAL bulk-ingest seam (node/ingest.py): deliver `topic`
         messages into a bounded ring (`ring.offer(msg) -> bool`)
         instead of per-message handler dispatch, so a consumer can
@@ -64,12 +74,29 @@ class MessagingService:
         pipeline. A full ring parks the frame for redelivery
         (`retry_parked`) — backpressure without blocking the pump.
         Fabrics that don't implement it raise, and callers fall back
-        to the per-message handler path."""
+        to the per-message handler path.
+
+        `metrics`: an optional MetricRegistry; implementations register
+        ring-depth / high-water / parked-frame gauges for the topic so
+        the backpressure is visible on /metrics BEFORE it stalls the
+        pump (see register_ring_gauges)."""
         raise NotImplementedError(f"{type(self).__name__} has no ring seam")
 
     @property
     def my_address(self) -> str:
         raise NotImplementedError
+
+
+def register_ring_gauges(metrics, topic: str, ring, parked_count=None) -> None:
+    """Gauges over one topic's ingest ring: current depth, lifetime
+    high-water mark, and (when the fabric exposes a counter) frames
+    parked waiting for retry_parked. ONE naming scheme for every
+    fabric, so dashboards don't fork per transport."""
+    base = f"Ingest.{topic}.Ring"
+    metrics.gauge(base + "Depth", lambda: len(ring))
+    metrics.gauge(base + "HighWater", lambda: ring.high_water)
+    if parked_count is not None:
+        metrics.gauge(f"Ingest.{topic}.Parked", parked_count)
 
 
 class InMemoryMessagingNetwork:
@@ -156,6 +183,7 @@ class InMemoryMessaging(MessagingService):
         payload: bytes,
         target: str,
         unique_id: Optional[int] = None,
+        trace: Optional[tuple] = None,
     ) -> None:
         """Explicit unique_id lets flows use deterministic ids so that
         replayed sends after checkpoint restore dedupe at the receiver
@@ -164,7 +192,7 @@ class InMemoryMessaging(MessagingService):
         if unique_id is None:
             unique_id = self._next_id
             self._next_id += 1
-        msg = Message(topic, payload, self._name, unique_id)
+        msg = Message(topic, payload, self._name, unique_id, trace)
         self._network._enqueue(msg, target)
 
     def add_handler(self, topic: str, handler: Handler) -> None:
@@ -179,12 +207,25 @@ class InMemoryMessaging(MessagingService):
         if handler in handlers:
             handlers.remove(handler)
 
-    def add_ring(self, topic: str, ring) -> None:
+    def add_ring(self, topic: str, ring, metrics=None) -> None:
         """Route `topic` into a bounded ingest ring (wire-ingest fast
         path — see MessagingService.add_ring). Messages already parked
-        for the topic flow into the ring immediately."""
+        for the topic flow into the ring immediately. With a
+        MetricRegistry, the ring's depth/high-water and this endpoint's
+        parked-frame count become gauges — PR 1's backpressure made
+        visible before it stalls the pump."""
         self._rings[topic] = ring
+        if metrics is not None:
+            register_ring_gauges(
+                metrics, topic, ring,
+                parked_count=lambda t=topic: self.parked_count(t),
+            )
         self.retry_parked(topic)
+
+    def parked_count(self, topic: str) -> int:
+        """Frames parked for `topic` because its ring was full (they
+        re-enter via retry_parked)."""
+        return sum(1 for m in self._undelivered if m.topic == topic)
 
     def retry_parked(self, topic: str) -> int:
         """Re-offer frames parked while the topic's ring was full

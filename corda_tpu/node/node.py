@@ -215,6 +215,19 @@ class Node:
                 self.keypair.private,
             )
 
+        # -- metrics + tracing (MonitoringService's MetricRegistry;
+        # serve with node.webserver() -> GET /metrics in prometheus
+        # format, the JMX/Jolokia role of Node.kt:306-308, and the
+        # hot-path flight recorder at GET /traces). Created BEFORE the
+        # notary so its batching counters/phase timers land on this
+        # node's scrape surface. The tracer is the process default:
+        # disabled unless CORDA_TPU_TRACE=1 (utils/tracing.py).
+        from ..utils import tracing
+        from ..utils.metrics import MetricRegistry
+
+        self.metrics = MetricRegistry()
+        self.tracer = tracing.get_tracer()
+
         # -- flows, notary, scheduler ----------------------------------
         # @corda_service instances from the imported cordapps, before
         # any flow can run (installCordaServices, AbstractNode.kt:226)
@@ -227,13 +240,6 @@ class Node:
         )
         self._install_notary()
         self.scheduler = NodeSchedulerService(self.services, self.smm.start_flow)
-
-        # -- metrics (MonitoringService's MetricRegistry; serve it with
-        # node.webserver() -> GET /metrics in prometheus format, the
-        # JMX/Jolokia role of Node.kt:306-308)
-        from ..utils.metrics import MetricRegistry
-
-        self.metrics = MetricRegistry()
 
         # -- verifier offload ------------------------------------------
         self.verifier_service = None
@@ -408,6 +414,7 @@ class Node:
                     self.services,
                     uniqueness,
                     max_wait_micros=self.config.notary_batch_wait_micros,
+                    metrics=self.metrics,
                 )
                 return
             cls = {
@@ -638,6 +645,7 @@ class Node:
             pump=lambda: None,
             port=port,
             metrics=self.metrics,
+            tracer=self.tracer,
         ).start()
 
 

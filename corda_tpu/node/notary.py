@@ -37,6 +37,8 @@ from ..core.transactions import (
 )
 from ..crypto.hashes import SecureHash
 from ..crypto.tx_signature import TransactionSignature
+from ..utils import tracing
+from ..utils.metrics import MetricRegistry
 from .services import ServiceHub
 
 # -- errors (wire-serializable: sent back to the requesting flow) ------------
@@ -303,6 +305,11 @@ class _PendingNotarisation:
     stx: SignedTransaction
     requester: Party
     future: Any   # FlowFuture resolved with TransactionSignature | NotaryError
+    # tracing: the frame's live root span (utils/tracing.py), opened at
+    # wire-frame ingest. The flush attributes its phase intervals to it
+    # and ENDS it when this request is answered. None when tracing is
+    # off — the disabled path costs one falsy check per request.
+    span: Any = None
 
 
 class BatchingNotaryService(NotaryService):
@@ -336,6 +343,7 @@ class BatchingNotaryService(NotaryService):
         service_identity: Optional[Party] = None,
         max_batch: int = 512,
         max_wait_micros: int = 0,
+        metrics: Optional[MetricRegistry] = None,
     ):
         """`max_wait_micros` is the batching DEADLINE (SURVEY §7 hard
         part 4 — latency vs throughput): 0 (default) flushes every pump
@@ -343,7 +351,12 @@ class BatchingNotaryService(NotaryService):
         has waited that long (or `max_batch` fills), so a lightly
         loaded notary still forms deep batches — throughput rides the
         flush depth (BASELINE.md round-3 sweep), at a bounded latency
-        cost the operator chooses."""
+        cost the operator chooses.
+
+        `metrics`: the node's MetricRegistry — pass it and the batching
+        counters, ratio gauge, flush-phase timers and ingest-ring
+        gauges all land on the node's /metrics surface; None keeps a
+        private registry (embedded/test rigs)."""
         super().__init__(
             services, uniqueness, tolerance_micros, service_identity
         )
@@ -352,14 +365,53 @@ class BatchingNotaryService(NotaryService):
         self._pending: list[_PendingNotarisation] = []
         self._ingest_ring = None   # attach_ingest: pre-decoded arrivals
         self._oldest_arrival: Optional[int] = None
-        # metrics: dispatches vs requests shows the batching ratio
-        self.batches_dispatched = 0
-        self.requests_batched = 0
-        # CORDA_TPU_NOTARY_PROFILE=1: accumulate per-phase wall seconds
-        # across flushes (BASELINE.md serving-profile methodology)
-        self.phase_seconds: Optional[dict] = (
+        # registry-backed metrics (scrapeable at /metrics, unlike the
+        # bare ints they replace): dispatches vs requests IS the
+        # batching ratio, exported as its own gauge
+        self.metrics = metrics if metrics is not None else MetricRegistry()
+        self._batches_counter = self.metrics.counter(
+            "Notary.BatchesDispatched"
+        )
+        self._requests_counter = self.metrics.counter(
+            "Notary.RequestsBatched"
+        )
+        self.metrics.gauge(
+            "Notary.BatchingRatio",
+            lambda: (
+                self._requests_counter.count / self._batches_counter.count
+                if self._batches_counter.count
+                else 0.0
+            ),
+        )
+        # per-phase flush timers: always on (a handful of updates per
+        # FLUSH, not per tx), so /metrics carries the stage breakdown
+        # continuously — the registry-backed replacement for the old
+        # env-gated phase_seconds dict
+        self._phase_timers: dict[str, Any] = {}
+        # CORDA_TPU_NOTARY_PROFILE=1: additionally accumulate per-phase
+        # wall seconds across flushes into a plain dict (BASELINE.md
+        # serving-profile methodology; bench.py prints it). The
+        # phase_seconds property is the back-compat view.
+        self._phase_profile: Optional[dict] = (
             {} if os.environ.get("CORDA_TPU_NOTARY_PROFILE") else None
         )
+
+    # -- back-compat views over the registry-backed metrics ----------------
+
+    @property
+    def batches_dispatched(self) -> int:
+        return self._batches_counter.count
+
+    @property
+    def requests_batched(self) -> int:
+        return self._requests_counter.count
+
+    @property
+    def phase_seconds(self) -> Optional[dict]:
+        """The CORDA_TPU_NOTARY_PROFILE accumulation dict (None when
+        profiling is off) — the live object, so callers may clear() it
+        between warm-up and timed reps as before."""
+        return self._phase_profile
 
     def process(self, stx: SignedTransaction, requester: Party):
         from ..flows.api import FlowFuture, wait_future
@@ -372,7 +424,16 @@ class BatchingNotaryService(NotaryService):
         fut = FlowFuture()
         if not self._pending:
             self._oldest_arrival = self.services.clock.now_micros()
-        self._pending.append(_PendingNotarisation(stx, requester, fut))
+        # flow-driven requests trace too: a root span per notarisation
+        # (the wire-ingest path arrives with its span already attached
+        # via attach_ingest; this is the fabric-less service entry)
+        tracer = tracing.get_tracer()
+        span = None
+        if tracer.enabled:
+            span = tracer.start_trace(
+                "notarise.request", tx_id=str(stx.id), requester=requester.name
+            )
+        self._pending.append(_PendingNotarisation(stx, requester, fut, span=span))
         if len(self._pending) >= self.max_batch:
             self.flush()
         result = yield from wait_future(fut)
@@ -388,6 +449,12 @@ class BatchingNotaryService(NotaryService):
         blocks, which is the backpressure that keeps the decode pool
         from running unboundedly ahead of the TPU dispatch."""
         self._ingest_ring = ring
+        # backpressure visibility: depth + high-water gauges on this
+        # notary's registry, so the ring filling up shows on /metrics
+        # BEFORE it stalls the producer
+        from .messaging import register_ring_gauges
+
+        register_ring_gauges(self.metrics, "notary", ring)
 
     def _drain_ingest(self) -> None:
         ring = self._ingest_ring
@@ -417,14 +484,28 @@ class BatchingNotaryService(NotaryService):
         self.flush()
         return n
 
-    def _mark(self, phase: str, t_prev: float) -> float:
-        """Profile hook: charge now - t_prev to `phase` when profiling
-        is on; always returns now so call sites stay one-liners."""
+    def _mark(
+        self, phase: str, t_prev: float, marks: Optional[list] = None
+    ) -> float:
+        """Phase boundary: charge now - t_prev to `phase` on the
+        registry timer (always), the profile dict (when
+        CORDA_TPU_NOTARY_PROFILE is set), and `marks` (the per-flush
+        interval list trace-span emission consumes). Always returns
+        now so call sites stay one-liners."""
         now = time.perf_counter()
-        if self.phase_seconds is not None:
-            self.phase_seconds[phase] = (
-                self.phase_seconds.get(phase, 0.0) + (now - t_prev)
+        dt = now - t_prev
+        timer = self._phase_timers.get(phase)
+        if timer is None:
+            timer = self._phase_timers[phase] = self.metrics.timer(
+                "Notary.FlushPhase." + phase
             )
+        timer.update(dt)
+        if self._phase_profile is not None:
+            self._phase_profile[phase] = (
+                self._phase_profile.get(phase, 0.0) + dt
+            )
+        if marks is not None:
+            marks.append((phase, t_prev, now))
         return now
 
     def flush(self) -> None:
@@ -450,6 +531,50 @@ class BatchingNotaryService(NotaryService):
         self._oldest_arrival = None
         if not pending:
             return
+        # `marks` collects this flush's phase intervals; the finally
+        # attributes them to every member frame's trace and ENDS the
+        # per-frame root spans — on every exit path (normal, streamed,
+        # dispatch failure), so upstream traces always complete
+        marks: list[tuple[str, float, float]] = []
+        try:
+            self._flush_body(pending, marks)
+        finally:
+            self._emit_flush_trace(pending, marks)
+
+    def _emit_flush_trace(self, pending, marks) -> None:
+        """Per-frame trace assembly: the flush phases ran batched, so
+        each interval is shared across the batch and stamped into every
+        traced member's tree (batch size as an attribute). Spans are
+        emitted on the tracer that OWNS the frame's root span, so mixed
+        tracer setups still assemble whole traces."""
+        n = len(pending)
+        for p in pending:
+            span = p.span
+            if not span or span.ended:
+                # an already-ended root means ITS owner closed the
+                # trace at ingest (pipeline feed path): attaching phase
+                # spans now would re-open the assembled trace as orphan
+                # fragments — the flush only annotates roots it OWNS
+                continue
+            tracer = getattr(span, "_tracer", None)
+            if tracer is not None:
+                for phase, t0, t1 in marks:
+                    tracer.span_at("notary." + phase, span, t0, t1, batch=n)
+            # the root ends when the request is ANSWERED: on the
+            # synchronous paths every future resolved inside the flush
+            # body, but a distributed provider's commit_async resolves
+            # on cluster consensus AFTER this finally — deferring the
+            # end there keeps the consensus-commit latency inside the
+            # trace (the slow-commit regression the recorder hunts)
+            fut = p.future
+            if getattr(fut, "done", True) or not hasattr(
+                fut, "add_done_callback"
+            ):
+                span.end()
+            else:
+                fut.add_done_callback(lambda f, s=span: s.end())
+
+    def _flush_body(self, pending, marks) -> None:
         t = time.perf_counter()
         # phase 1 — ONE SPI dispatch across all pending transactions.
         # Staging is per-tx-protected: one malformed transaction (bad
@@ -474,16 +599,20 @@ class BatchingNotaryService(NotaryService):
         pending = live
         if not pending:
             return
-        t = self._mark("stage", t)
+        t = self._mark("stage", t, marks)
         verifier = self.services.batch_verifier
         try:
             collector: Optional[threading.Thread] = None
             box: dict = {}
             handle = None
-            if hasattr(verifier, "verify_batch_async"):
-                handle = verifier.verify_batch_async(reqs)
-            else:
-                results = verifier.verify_batch(reqs)
+            # TraceAnnotation (when jax provides it): the dispatch span
+            # becomes a named region in an XLA profiler capture, so
+            # host-side traces line up with the device timeline
+            with tracing.annotate("corda_tpu.notary.batch_verify_dispatch"):
+                if hasattr(verifier, "verify_batch_async"):
+                    handle = verifier.verify_batch_async(reqs)
+                else:
+                    results = verifier.verify_batch(reqs)
             # STREAMING tail (round-5): when the handle's per-chunk
             # transfers were queued at dispatch and the uniqueness
             # provider commits synchronously, chunk k's transactions
@@ -510,7 +639,7 @@ class BatchingNotaryService(NotaryService):
 
                 collector = threading.Thread(target=_collect, daemon=True)
                 collector.start()
-            t = self._mark("dispatch", t)
+            t = self._mark("dispatch", t, marks)
             # overlap: contract execution (host Python) runs while the
             # device computes the signature batch and the collector
             # thread drains the result transfer. Contracts run through
@@ -540,11 +669,11 @@ class BatchingNotaryService(NotaryService):
                 [p.stx for p in pending],
                 spi=tv if tv_sync else None,
             )
-            t = self._mark("resolve_verify", t)
+            t = self._mark("resolve_verify", t, marks)
             if stream_ok:
                 self._stream_tail(
                     pending, spans, contract_errs, deferred_ltx,
-                    handle, tv, tv_sync, t,
+                    handle, tv, tv_sync, t, marks,
                 )
                 return
             if collector is not None:
@@ -552,7 +681,7 @@ class BatchingNotaryService(NotaryService):
                 if "error" in box:
                     raise box["error"]
                 results = box["results"]
-            t = self._mark("link_wait", t)
+            t = self._mark("link_wait", t, marks)
         except Exception as e:
             # a failed dispatch (unsupported scheme in the batch, device
             # unavailable) must answer every waiting requester, not
@@ -562,8 +691,8 @@ class BatchingNotaryService(NotaryService):
                     NotaryError("verification-unavailable", str(e))
                 )
             return
-        self.batches_dispatched += 1
-        self.requests_batched += len(pending)
+        self._batches_counter.inc()
+        self._requests_counter.inc(len(pending))
         # phase 2 — per-tx validation in arrival order
         eligible: list[_PendingNotarisation] = []
         for i, (p, (off, n), cerr) in enumerate(
@@ -588,7 +717,7 @@ class BatchingNotaryService(NotaryService):
                     )
                     continue
             eligible.append(p)
-        t = self._mark("validate", t)
+        t = self._mark("validate", t, marks)
         if not eligible:
             return
         conflict_error = self._conflict_error
@@ -626,9 +755,9 @@ class BatchingNotaryService(NotaryService):
                     p.future.set_result(
                         NotaryError("commit-unavailable", str(err))
                     )
-            t = self._mark("commit", t)
+            t = self._mark("commit", t, marks)
             finalize(committed)
-            self._mark("sign_scatter", t)
+            self._mark("sign_scatter", t, marks)
             return
 
         committed_async: dict[int, _PendingNotarisation] = {}
@@ -652,7 +781,7 @@ class BatchingNotaryService(NotaryService):
                 list(p.stx.wtx.inputs), p.stx.id, p.requester
             )
             fut.add_done_callback(lambda f, i=i, p=p: on_commit(f, i, p))
-        self._mark("sign_scatter", t)
+        self._mark("sign_scatter", t, marks)
 
     def _conflict_error(self, e: UniquenessConflict) -> NotaryError:
         return NotaryError(
@@ -687,7 +816,7 @@ class BatchingNotaryService(NotaryService):
 
     def _stream_tail(
         self, pending, spans, contract_errs, deferred_ltx,
-        handle, tv, tv_sync, t,
+        handle, tv, tv_sync, t, marks=None,
     ) -> None:
         """Streaming validate+commit (round-5): consume the SPI's
         per-chunk results as each chunk's device compute completes,
@@ -704,8 +833,8 @@ class BatchingNotaryService(NotaryService):
         n_pend = len(pending)
         # counted at dispatch like the join path (line above phase 2):
         # a batch that later fails mid-stream was still dispatched
-        self.batches_dispatched += 1
-        self.requests_batched += n_pend
+        self._batches_counter.inc()
+        self._requests_counter.inc(n_pend)
 
         def drain() -> bool:
             """Advance over fully-resolved transactions: validate,
@@ -785,9 +914,9 @@ class BatchingNotaryService(NotaryService):
                     NotaryError("verification-unavailable", str(e))
                 )
             return
-        t = self._mark("stream_commit", t)
+        t = self._mark("stream_commit", t, marks)
         self._finalize_sign(committed)
-        self._mark("sign_scatter", t)
+        self._mark("sign_scatter", t, marks)
 
     def _validate_one(
         self,

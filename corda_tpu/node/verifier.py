@@ -292,7 +292,10 @@ class VerifierWorker:
         self._verifier = batch_verifier or default_verifier()
         self._batch_window = batch_window
         self._queue: list[TxVerificationRequest] = []
-        self._raw: list[bytes] = []
+        # handler-fed frames awaiting the ingest pipeline, as
+        # (payload, trace header) so propagated trace contexts survive
+        # into the pipeline's per-frame spans
+        self._raw: list[tuple[bytes, Optional[tuple]]] = []
         self.metrics = metrics or MetricRegistry()
         self._verified = self.metrics.meter("Verifier.Verified")
         self._failed = self.metrics.meter("Verifier.Failed")
@@ -304,7 +307,11 @@ class VerifierWorker:
 
             try:
                 ring = IngestRing(depth=ingest_window)
-                messaging.add_ring(msglib.TOPIC_VERIFIER_REQ, ring)
+                # metrics: ring depth / high-water / parked gauges on
+                # this worker's registry (messaging.register_ring_gauges)
+                messaging.add_ring(
+                    msglib.TOPIC_VERIFIER_REQ, ring, metrics=self.metrics
+                )
                 self._ring = ring
             except NotImplementedError:
                 # fabric has no ring seam: the handler path below still
@@ -322,7 +329,7 @@ class VerifierWorker:
 
     def _on_request(self, msg: msglib.Message) -> None:
         if self._ingest is not None:
-            self._raw.append(msg.payload)
+            self._raw.append((msg.payload, msg.trace))
             if len(self._raw) > self._batch_window:
                 self.drain()
             return
@@ -333,21 +340,28 @@ class VerifierWorker:
     def _pull_ingested(self) -> None:
         """Move every waiting frame through the ingest pipeline into
         the request queue: ring frames first (fabric fast path), then
-        handler-fed raw payloads."""
+        handler-fed raw payloads. Each frame's propagated trace header
+        (Message.trace) rides into the pipeline so the worker's ingest
+        spans join the sender's trace."""
         payloads: list[bytes] = []
+        traces: list = []
         if self._ring is not None:
-            payloads.extend(m.payload for m in self._ring.drain())
+            for m in self._ring.drain():
+                payloads.append(m.payload)
+                traces.append(m.trace)
             # frames parked while the ring was full re-enter it for the
             # next drain — the backpressure release valve
             retry = getattr(self._messaging, "retry_parked", None)
             if retry is not None:
                 retry(msglib.TOPIC_VERIFIER_REQ)
         if self._raw:
-            payloads.extend(self._raw)
+            for payload, trace in self._raw:
+                payloads.append(payload)
+                traces.append(trace)
             self._raw = []
         if not payloads:
             return
-        for e in self._ingest.ingest(payloads):
+        for e in self._ingest.ingest(payloads, trace_parents=traces):
             if e.error is not None:
                 self._failed.mark()   # malformed frame: its slot only
                 continue

@@ -126,6 +126,14 @@ class Histogram:
         return self._sum / self._count if self._count else 0.0
 
     @property
+    def sum(self) -> float:
+        """The true running sum — what the Prometheus `_sum` series
+        exports. Reconstructing it as mean * count round-trips through
+        a float division and drifts under load (mean is _sum/_count, so
+        mean * count != _sum once the division is inexact)."""
+        return self._sum
+
+    @property
     def min(self) -> float:
         return self._min if self._count else 0.0
 
@@ -247,7 +255,7 @@ def _histo_lines(p: str, h: Histogram) -> list[str]:
         f'{p}{{quantile="0.5"}} {h.quantile(0.5):.9f}',
         f'{p}{{quantile="0.95"}} {h.quantile(0.95):.9f}',
         f'{p}{{quantile="0.99"}} {h.quantile(0.99):.9f}',
-        f"{p}_sum {h.mean * h.count:.9f}",
+        f"{p}_sum {h.sum:.9f}",
         f"{p}_count {h.count}",
     ]
 

@@ -1,0 +1,527 @@
+"""End-to-end hot-path tracing: spans, flight recorder, Chrome export.
+
+The north-star rate (BASELINE.md: >=50k ECDSA-p256 verifies/sec through
+one chip) is only defendable if a regression can be *attributed*: the
+serving path crosses the fabric, the ingest pipeline, the batching
+notary and the TPU SPI, and a 20% loss anywhere in that chain looks
+identical from the outside. Hardware-verifier work (the FPGA ECDSA
+engine of arXiv:2112.02229, SZKP arXiv:2408.05890) keeps finding the
+same thing: the accelerator is rarely the bottleneck — the host
+staging/dispatch stages are. This module makes those stages visible on
+EVERY batch, not just in one-off profile runs:
+
+  Tracer / Span   — trace_id/span_id/parent links, monotonic
+                    timestamps, attributes + events. A span is cheap
+                    (one object, two perf_counter reads); a DISABLED
+                    tracer returns one shared no-op singleton so the
+                    hot path pays a single attribute check.
+  FlightRecorder  — bounded retention of completed traces: the N most
+                    RECENT (what just happened) and the N SLOWEST (what
+                    an operator is hunting). Churn evicts from the
+                    recent ring only; a slow trace survives until a
+                    slower one displaces it.
+  Chrome export   — `chrome_trace(traces)` renders trace-event JSON
+                    loadable by chrome://tracing / Perfetto; the node
+                    webserver serves it at GET /traces next to
+                    /metrics.
+  annotate(name)  — `jax.profiler.TraceAnnotation` when jax provides
+                    it (so host spans line up with XLA device traces in
+                    a profiler capture), a null context otherwise.
+
+Propagation: `Span.context` is a (trace_id, span_id) pair that rides
+as an optional message header across the MessagingService fabric
+(messaging.Message.trace) and as `trace_parents` through the ingest
+pipeline — `start_trace(name, parent=ctx)` on the receiving side
+continues the SAME trace, so one notarisation is one connected tree
+from wire-frame arrival to uniqueness commit.
+
+Enable process-wide with CORDA_TPU_TRACE=1 (the default tracer is
+disabled otherwise), or construct/set an explicit `Tracer`.
+"""
+
+from __future__ import annotations
+
+import heapq
+import os
+import random
+import threading
+import time
+from typing import Any, Iterable, Optional
+
+
+class SpanContext(tuple):
+    """(trace_id, span_id) — the wire-propagatable identity of a span.
+
+    A plain tuple subclass: it serializes anywhere a 2-tuple does (the
+    fabric's optional message header is exactly this pair), and
+    `from_header` accepts whatever a codec round-trip produced."""
+
+    __slots__ = ()
+
+    def __new__(cls, trace_id: int, span_id: int):
+        return super().__new__(cls, (int(trace_id), int(span_id)))
+
+    @property
+    def trace_id(self) -> int:
+        return self[0]
+
+    @property
+    def span_id(self) -> int:
+        return self[1]
+
+    @classmethod
+    def from_header(cls, header) -> Optional["SpanContext"]:
+        """None-tolerant decode of a propagated header (a 2-sequence of
+        ints, a SpanContext, or None/malformed -> None)."""
+        if header is None:
+            return None
+        try:
+            trace_id, span_id = header
+            return cls(int(trace_id), int(span_id))
+        except Exception:
+            return None
+
+
+class _NoopSpan:
+    """The disabled-tracer span: every operation is a no-op, `bool()`
+    is False so call sites can gate work with `if span:`. ONE shared
+    instance — a disabled run allocates nothing per frame."""
+
+    __slots__ = ()
+
+    def set_attribute(self, key: str, value: Any) -> None:
+        pass
+
+    def add_event(self, name: str, **attributes) -> None:
+        pass
+
+    def end(self, end_time: Optional[float] = None) -> None:
+        pass
+
+    @property
+    def context(self) -> Optional[SpanContext]:
+        return None
+
+    @property
+    def ended(self) -> bool:
+        return True
+
+    def __bool__(self) -> bool:
+        return False
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+class Span:
+    """One timed operation in a trace. Monotonic timestamps
+    (time.perf_counter), attributes (set any time before export),
+    events (point-in-time marks inside the span)."""
+
+    __slots__ = (
+        "_tracer", "name", "trace_id", "span_id", "parent_id",
+        "start", "end_time", "attributes", "events",
+    )
+
+    def __init__(
+        self,
+        tracer: "Tracer",
+        name: str,
+        trace_id: int,
+        span_id: int,
+        parent_id: Optional[int],
+        start: float,
+        attributes: Optional[dict] = None,
+    ):
+        self._tracer = tracer
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.start = start
+        self.end_time: Optional[float] = None
+        self.attributes = attributes or {}
+        self.events: list[tuple[float, str, dict]] = []
+
+    def set_attribute(self, key: str, value: Any) -> None:
+        self.attributes[key] = value
+
+    def add_event(self, name: str, **attributes) -> None:
+        self.events.append((time.perf_counter(), name, attributes))
+
+    def end(self, end_time: Optional[float] = None) -> None:
+        """Idempotent: the first end wins (error paths may race the
+        normal completion path to it)."""
+        if self.end_time is not None:
+            return
+        self.end_time = end_time if end_time is not None else time.perf_counter()
+        self._tracer._complete(self)
+
+    @property
+    def ended(self) -> bool:
+        return self.end_time is not None
+
+    @property
+    def duration_s(self) -> float:
+        if self.end_time is None:
+            return 0.0
+        return self.end_time - self.start
+
+    @property
+    def context(self) -> SpanContext:
+        return SpanContext(self.trace_id, self.span_id)
+
+    def __bool__(self) -> bool:
+        return True
+
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.end()
+        return False
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return (
+            f"Span({self.name!r}, trace={self.trace_id:#x}, "
+            f"span={self.span_id}, dur={self.duration_s * 1e6:.1f}us)"
+        )
+
+
+class Trace:
+    """A completed trace: every span this tracer opened for one
+    trace_id, in start order. `duration_s` is the ROOT span's wall
+    (the first span opened locally — frame arrival to final answer),
+    which is what the flight recorder ranks slowness by."""
+
+    __slots__ = ("trace_id", "spans")
+
+    def __init__(self, trace_id: int, spans: list[Span]):
+        self.trace_id = trace_id
+        self.spans = sorted(spans, key=lambda s: s.start)
+
+    @property
+    def root(self) -> Span:
+        return self.spans[0]
+
+    @property
+    def name(self) -> str:
+        return self.root.name
+
+    @property
+    def duration_s(self) -> float:
+        return self.root.duration_s
+
+    def __len__(self) -> int:
+        return len(self.spans)
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return (
+            f"Trace({self.name!r}, {len(self.spans)} spans, "
+            f"{self.duration_s * 1e3:.2f}ms)"
+        )
+
+
+class FlightRecorder:
+    """Bounded retention of completed traces: `keep_recent` most recent
+    plus `keep_slowest` slowest. The slow set is a min-heap keyed on
+    duration, so under churn a slow outlier survives until a SLOWER one
+    displaces it — the post-hoc 'what was that 300ms spike' question
+    the recent ring alone cannot answer."""
+
+    def __init__(self, keep_recent: int = 64, keep_slowest: int = 16):
+        self.keep_recent = max(1, keep_recent)
+        self.keep_slowest = max(1, keep_slowest)
+        self._lock = threading.Lock()
+        self._recent: list[Trace] = []
+        self._slow: list[tuple[float, int, Trace]] = []   # min-heap
+        self._seq = 0
+        self.recorded = 0   # lifetime total, for the /traces summary
+
+    def record(self, trace: Trace) -> None:
+        with self._lock:
+            self.recorded += 1
+            self._seq += 1
+            self._recent.append(trace)
+            if len(self._recent) > self.keep_recent:
+                del self._recent[0]
+            entry = (trace.duration_s, self._seq, trace)
+            if len(self._slow) < self.keep_slowest:
+                heapq.heappush(self._slow, entry)
+            elif entry[0] > self._slow[0][0]:
+                heapq.heapreplace(self._slow, entry)
+
+    def recent(self) -> list[Trace]:
+        with self._lock:
+            return list(self._recent)
+
+    def slowest(self) -> list[Trace]:
+        """Slowest-first."""
+        with self._lock:
+            return [t for _, _, t in sorted(self._slow, reverse=True)]
+
+    def traces(self) -> list[Trace]:
+        """Union of the slow and recent sets, deduplicated, slowest
+        set first — what GET /traces exports."""
+        seen: set[int] = set()
+        out: list[Trace] = []
+        for t in self.slowest() + self.recent():
+            if id(t) not in seen:
+                seen.add(id(t))
+                out.append(t)
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._recent.clear()
+            self._slow.clear()
+            self.recorded = 0
+
+
+class Tracer:
+    """Span factory + per-trace assembly.
+
+    A trace completes (and reaches the flight recorder) when every span
+    this tracer opened for its trace_id has ended — completion is
+    ref-counted, so out-of-order ends (a batch phase span finishing
+    after the per-frame root) assemble correctly. `max_open_traces`
+    bounds the in-flight table against spans that are never ended
+    (oldest trace dropped, not leaked)."""
+
+    def __init__(
+        self,
+        enabled: bool = True,
+        recorder: Optional[FlightRecorder] = None,
+        max_open_traces: int = 4096,
+    ):
+        self.enabled = enabled
+        self.recorder = recorder if recorder is not None else FlightRecorder()
+        self._lock = threading.Lock()
+        # trace ids are salted per-tracer so two processes' traces can
+        # merge into one recorder/export without colliding; span ids
+        # only need uniqueness within the tracer
+        self._trace_salt = random.getrandbits(32) << 20
+        self._next_trace = 0
+        self._next_span = 0
+        self._open: dict[int, list] = {}   # trace_id -> [spans, n_open]
+        self._max_open = max(16, max_open_traces)
+
+    # -- span factories -----------------------------------------------------
+
+    def start_trace(self, name: str, parent=None, **attributes):
+        """Root (or hop-continuation) span. `parent` is a propagated
+        SpanContext / (trace_id, span_id) header from an upstream hop:
+        given one, the new span JOINS that trace instead of starting a
+        fresh id — span parenting survives the fabric hop."""
+        if not self.enabled:
+            return NOOP_SPAN
+        ctx = SpanContext.from_header(parent) if parent is not None else None
+        if ctx is not None:
+            trace_id, parent_id = ctx.trace_id, ctx.span_id
+        else:
+            with self._lock:
+                self._next_trace += 1
+                trace_id = self._trace_salt + self._next_trace
+            parent_id = None
+        return self._open_span(name, trace_id, parent_id, attributes)
+
+    def start_span(self, name: str, parent, **attributes):
+        """Child span under a live Span or a SpanContext. A None/noop
+        parent yields the noop span — callers thread `entry.span`
+        through unconditionally and only real traces pay."""
+        if not self.enabled:
+            return NOOP_SPAN
+        ctx = parent.context if isinstance(parent, (Span, _NoopSpan)) \
+            else SpanContext.from_header(parent)
+        if ctx is None:
+            return NOOP_SPAN
+        return self._open_span(name, ctx.trace_id, ctx.span_id, attributes)
+
+    def span_at(self, name: str, parent, start: float, end: float,
+                **attributes):
+        """A pre-timed, immediately-completed child span: batch stages
+        (one decode pass over 512 frames) measure ONE interval and
+        attribute it to every member frame's trace without holding 512
+        live spans open."""
+        span = self.start_span(name, parent, **attributes)
+        if span:
+            span.start = start
+            span.end(end)
+        return span
+
+    # -- assembly -----------------------------------------------------------
+
+    def _open_span(self, name, trace_id, parent_id, attributes) -> Span:
+        with self._lock:
+            self._next_span += 1
+            span = Span(
+                self, name, trace_id, self._next_span, parent_id,
+                time.perf_counter(), dict(attributes) if attributes else None,
+            )
+            state = self._open.get(trace_id)
+            if state is None:
+                if len(self._open) >= self._max_open:
+                    # drop the oldest in-flight trace, not the new one:
+                    # an abandoned span must not wedge the table
+                    self._open.pop(next(iter(self._open)))
+                state = self._open[trace_id] = [[], 0]
+            state[0].append(span)
+            state[1] += 1
+        return span
+
+    def _complete(self, span: Span) -> None:
+        done: Optional[Trace] = None
+        with self._lock:
+            state = self._open.get(span.trace_id)
+            if state is None:
+                return   # trace was evicted from the open table
+            state[1] -= 1
+            if state[1] <= 0:
+                del self._open[span.trace_id]
+                done = Trace(span.trace_id, state[0])
+        if done is not None and self.recorder is not None:
+            self.recorder.record(done)
+
+    # -- export -------------------------------------------------------------
+
+    def export(self) -> dict:
+        """The GET /traces payload: chrome://tracing-loadable (object
+        form with `traceEvents`) plus the per-stage latency summary."""
+        traces = self.recorder.traces() if self.recorder else []
+        out = chrome_trace(traces)
+        out["stageSummary"] = stage_summary(traces)
+        out["tracesRecorded"] = self.recorder.recorded if self.recorder else 0
+        out["tracesRetained"] = len(traces)
+        out["enabled"] = self.enabled
+        return out
+
+    def stage_summary(self) -> dict:
+        traces = self.recorder.traces() if self.recorder else []
+        return stage_summary(traces)
+
+
+def chrome_trace(traces: Iterable[Trace]) -> dict:
+    """Chrome trace-event JSON (object form): one 'X' (complete) event
+    per span, ts/dur in microseconds, one tid per trace so each
+    notarisation renders as its own row; events become 'i' instants.
+    Extra top-level keys are permitted by the format and carry the
+    summary the webserver adds."""
+    events: list[dict] = []
+    for tid, trace in enumerate(traces, start=1):
+        for s in trace.spans:
+            if not s.ended:
+                continue
+            args = dict(s.attributes)
+            args["trace_id"] = f"{s.trace_id:#x}"
+            args["span_id"] = s.span_id
+            if s.parent_id is not None:
+                args["parent_span_id"] = s.parent_id
+            events.append({
+                "name": s.name,
+                "cat": "corda_tpu",
+                "ph": "X",
+                "ts": round(s.start * 1e6, 3),
+                "dur": round((s.end_time - s.start) * 1e6, 3),
+                "pid": 1,
+                "tid": tid,
+                "args": args,
+            })
+            for t, name, attrs in s.events:
+                events.append({
+                    "name": name,
+                    "cat": "corda_tpu",
+                    "ph": "i",
+                    "s": "t",
+                    "ts": round(t * 1e6, 3),
+                    "pid": 1,
+                    "tid": tid,
+                    "args": dict(attrs),
+                })
+    return {"displayTimeUnit": "ms", "traceEvents": events}
+
+
+def stage_summary(traces: Iterable[Trace]) -> dict:
+    """Per-span-name latency aggregate over `traces`: count / total /
+    mean / max seconds. The reading guide lives in
+    docs/serving-notary.md; bench.py folds this into the BENCH record
+    so the perf trajectory pins regressions to a stage."""
+    agg: dict[str, dict] = {}
+    for trace in traces:
+        for s in trace.spans:
+            if not s.ended:
+                continue
+            row = agg.get(s.name)
+            if row is None:
+                row = agg[s.name] = {
+                    "count": 0, "total_s": 0.0, "max_s": 0.0,
+                }
+            d = s.duration_s
+            row["count"] += 1
+            row["total_s"] += d
+            if d > row["max_s"]:
+                row["max_s"] = d
+    for row in agg.values():
+        row["total_s"] = round(row["total_s"], 9)
+        row["max_s"] = round(row["max_s"], 9)
+        row["mean_s"] = round(row["total_s"] / row["count"], 9)
+    return agg
+
+
+# -- XLA profiler alignment ---------------------------------------------------
+
+_annotation_cls: Any = None
+
+
+def annotate(name: str):
+    """`jax.profiler.TraceAnnotation(name)` when available — a span
+    wrapped in this shows up as a named region in an XLA profiler
+    capture, lining host spans up with device timelines — else a null
+    context. The import resolves once and never at module import (this
+    module must stay loadable without jax)."""
+    global _annotation_cls
+    if _annotation_cls is None:
+        try:
+            from jax.profiler import TraceAnnotation
+
+            _annotation_cls = TraceAnnotation
+        except Exception:   # jax absent or too old: permanent null
+            _annotation_cls = False
+    if _annotation_cls:
+        return _annotation_cls(name)
+    import contextlib
+
+    return contextlib.nullcontext()
+
+
+# -- process default ----------------------------------------------------------
+
+_default_tracer: Optional[Tracer] = None
+_default_lock = threading.Lock()
+
+
+def get_tracer() -> Tracer:
+    """The process-wide tracer. Disabled unless CORDA_TPU_TRACE is set
+    to a non-empty, non-'0' value at first use (or a later set_tracer
+    installs an enabled one) — the disabled path costs one attribute
+    check per instrumented seam."""
+    global _default_tracer
+    if _default_tracer is None:
+        with _default_lock:
+            if _default_tracer is None:
+                _default_tracer = Tracer(
+                    enabled=os.environ.get("CORDA_TPU_TRACE", "")
+                    not in ("", "0")
+                )
+    return _default_tracer
+
+
+def set_tracer(tracer: Optional[Tracer]) -> None:
+    global _default_tracer
+    with _default_lock:
+        _default_tracer = tracer

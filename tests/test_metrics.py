@@ -39,6 +39,33 @@ def test_histogram_quantiles():
     assert h.mean == pytest.approx(49.5)
 
 
+def test_histogram_exports_true_running_sum():
+    """The Prometheus `_sum` series must be the histogram's running
+    `_sum`, not `mean * count` — the float division round-trip drifts
+    under load (e.g. three updates of 1/3: mean*3 != the true sum)."""
+    reg = MetricRegistry()
+    h = reg.histogram("drift")
+    true_sum = 0.0
+    for _ in range(3):
+        h.update(1.0 / 3.0)
+        true_sum += 1.0 / 3.0
+    assert h.sum == true_sum
+    # the reconstruction the old code used is NOT the running sum here
+    # (if float rounding happens to agree, the exported line must still
+    # come from h.sum — assert the rendered text matches it exactly)
+    assert f"drift_sum {h.sum:.9f}" in reg.to_prometheus()
+    # and over many irrational-ish updates the running sum stays exact
+    # while mean*count drifts
+    h2 = reg.histogram("drift2")
+    total = 0.0
+    for i in range(1, 1001):
+        v = 1.0 / i
+        h2.update(v)
+        total += v
+    assert h2.sum == total
+    assert f"drift2_sum {total:.9f}" in reg.to_prometheus()
+
+
 def test_same_name_same_instance_and_type_conflicts():
     reg = MetricRegistry()
     assert reg.counter("x") is reg.counter("x")
