@@ -36,6 +36,10 @@ BENCH_METRIC restricts to one measurement:
                     dispatch / kernel / commit seconds breakdown plus
                     the measured tracing overhead vs an untraced run on
                     the same fixture
+  qos             — overload serving through the QoS plane
+                    (node/qos.py): goodput and admitted p99 at 2x the
+                    measured no-overload capacity, adaptive controller
+                    on vs off, shed fraction — CPU fixture, real time
 
 `python bench.py --quick ingest` runs tiny serial + pipelined ingest
 records in one CPU-safe process (tier-1 smoke of the perf plumbing);
@@ -696,6 +700,146 @@ def _trace_metric(batch: int, iters: int, cpu: bool = False) -> dict:
     }
 
 
+def _qos_metric(batch: int, iters: int) -> dict:
+    """QoS overload serving (the admission-control tentpole's bench
+    leg): drive ~2x the measured no-overload capacity of a CPU-fixture
+    batching notary, controller ON (node/qos.py NotaryQos — deadline
+    shedding + adaptive batching against a p99 target) vs OFF (the
+    plain unbounded flush), and record goodput, admitted p99, and the
+    shed fraction. `value` is goodput under overload as a fraction of
+    the no-overload capacity — the acceptance line is >= 0.9 (overload
+    must cost latency-budget sheds, not throughput). The OFF pass shows
+    WHY the controller exists: same goodput, but p99 grows with the
+    unbounded backlog instead of holding the target."""
+    import time as _time
+
+    from corda_tpu.flows.api import FlowFuture
+    from corda_tpu.node import qos as qoslib
+    from corda_tpu.node.notary import (
+        InMemoryUniquenessProvider,
+        _PendingNotarisation,
+    )
+    from corda_tpu.node.services import Clock
+
+    rounds = max(4, iters * 2)
+    base = max(8, min(batch, 128))         # no-overload flush depth
+    svc, requester, blobs = _trace_fixture(
+        rounds * 2 * base + base, rounds * 2 * base + base, cpu=True
+    )
+    from corda_tpu.core import serialization as ser
+
+    spends = [ser.decode(b) for b in blobs]
+    # real wall-clock throughout: flush depth COSTS latency here (the
+    # CPU verifier does real per-signature work), which is the trade
+    # the adaptive controller manages
+    clock = Clock()
+    svc.services.clock = clock
+    svc.time_window_checker.clock = clock
+
+    def submit(stx, deadline, log):
+        fut = FlowFuture()
+        arrival = clock.now_micros()
+        fut.add_done_callback(
+            lambda f: log.append(
+                (arrival, clock.now_micros(), deadline, f.result())
+            )
+        )
+        svc._pending.append(
+            _PendingNotarisation(
+                stx, requester, fut,
+                deadline=deadline, arrival_micros=arrival,
+            )
+        )
+
+    # -- no-overload capacity: one warmed flush of `base` ------------------
+    def timed_flush(n_spends, offset=0):
+        svc.uniqueness = InMemoryUniquenessProvider()
+        log: list = []
+        for stx in spends[offset : offset + n_spends]:
+            submit(stx, None, log)
+        t0 = _time.perf_counter()
+        svc.flush()
+        return _time.perf_counter() - t0, log
+
+    svc.qos = None
+    timed_flush(base)                       # warm-up (bytecode, caches)
+    flush_wall, _ = timed_flush(base)
+    capacity_per_sec = base / flush_wall
+    target_micros = int(2 * flush_wall * 1e6)
+
+    def overload_run(qos) -> dict:
+        """`rounds` rounds of 2x per-flush offered load; answered-
+        request latencies tracked in real micros."""
+        svc.qos = qos
+        svc.uniqueness = InMemoryUniquenessProvider()
+        # a capped ON run can leave requeued backlog behind its drain
+        # ticks; drop it so the OFF pass measures ONLY its own offered
+        # load (apples-to-apples A/B)
+        svc._pending = []
+        svc._oldest_arrival = None
+        log: list = []
+        it = iter(spends[base:])
+        t0 = _time.perf_counter()
+        for _ in range(rounds):
+            now = clock.now_micros()
+            for _ in range(2 * base):
+                submit(next(it), now + target_micros, log)
+            svc.tick()
+        for _ in range(4):                  # drain: serve or expire
+            svc.tick()
+        wall = _time.perf_counter() - t0
+        signed = [r for r in log if hasattr(r[3], "by")]
+        sheds = [
+            r for r in log
+            if getattr(r[3], "kind", None) == qoslib.SHED_KIND
+        ]
+        # steady-state p99: the controller needs a few flushes to find
+        # the depth the target affords, so rank over the last half
+        tail = sorted(
+            done - arr for arr, done, _, out in signed[len(signed) // 2 :]
+        )
+        p99 = tail[min(len(tail) - 1, int(0.99 * len(tail)))] if tail else 0
+        return {
+            "goodput_per_sec": round(len(signed) / wall, 1),
+            "p99_ms": round(p99 / 1e3, 3),
+            "shed_fraction": round(len(sheds) / max(1, len(log)), 3),
+            "answered": len(log),
+        }
+
+    # max_batch == the no-overload depth: per-flush capacity is the
+    # measured base, so 2x offered load genuinely backlogs and the
+    # deadline/shed machinery engages (an unbounded flush would just
+    # absorb the whole round and nothing would ever queue)
+    qos = qoslib.NotaryQos(
+        qoslib.QosPolicy(
+            target_p99_micros=target_micros,
+            min_batch=max(8, base // 2), max_batch=base,
+            max_wait_micros=0,
+        ),
+        clock=clock,
+    )
+    on = overload_run(qos)
+    off = overload_run(None)
+    svc.qos = None
+    goodput_ratio = on["goodput_per_sec"] / capacity_per_sec
+    return {
+        "metric": "qos_overload_serving",
+        "value": round(goodput_ratio, 3),
+        "unit": "goodput fraction of no-overload capacity at 2x load",
+        "vs_baseline": round(goodput_ratio, 3),
+        "capacity_per_sec": round(capacity_per_sec, 1),
+        "target_p99_ms": round(target_micros / 1e3, 3),
+        "controller_on": on,
+        "controller_off": off,
+        "controller_state": qos.controller.snapshot(),
+        "shed_counters": {
+            k: v for k, v in qos.snapshot()["shed"].items()
+        },
+        "rounds": rounds,
+        "offered_per_round": 2 * base,
+    }
+
+
 def _montmul_metric(batch: int, iters: int) -> dict:
     """Interleaved device-resident A/B of the two variable x variable
     Montgomery-multiply formulations (round-3 MXU experiment, VERDICT
@@ -944,6 +1088,11 @@ def _run_metric(metric: str, batch: int, iters: int) -> dict:
         if batch > 4096:
             out["batch_requested"] = batch   # cap visible in the record
         return out
+    if metric == "qos":
+        out = _qos_metric(min(batch, 256), iters)
+        if batch > 256:
+            out["batch_requested"] = batch   # cap visible in the record
+        return out
     if metric == "parity":
         return _parity_metric(batch, iters)
     return _spi_metric(metric, batch, iters)
@@ -983,7 +1132,7 @@ def _run_child(m: str, env: dict, timeout: float) -> bool:
 
 
 def _quick(metric: str) -> None:
-    """`python bench.py --quick ingest|trace`: tiny, CPU-safe smoke
+    """`python bench.py --quick ingest|trace|qos`: tiny, CPU-safe smoke
     runs so tier-1 (JAX_PLATFORMS=cpu, no device) can assert the perf
     plumbing emits well-formed records without paying a real
     measurement. Values from this mode are NOT comparable to the
@@ -994,7 +1143,33 @@ def _quick(metric: str) -> None:
                breakdown sums to ~the traced wall and that tracing
                overhead stays under BENCH_TRACE_OVERHEAD_MAX (default
                5%) vs the untraced run on the same fixture.
+      qos    — the QoS overload record at 2x offered load, controller
+               on vs off: asserts the plane engaged (sheds happened
+               and were counted) and goodput held a healthy fraction
+               of the no-overload capacity.
     """
+    if metric == "qos":
+        batch = int(os.environ.get("BENCH_BATCH", "24"))
+        out = _qos_metric(batch, int(os.environ.get("BENCH_ITERS", "2")))
+        out["quick"] = True
+        print(json.dumps(out), flush=True)
+        if out["controller_on"]["shed_fraction"] <= 0:
+            raise SystemExit(
+                "2x offered load shed nothing — the QoS plane is not "
+                "engaging (deadline shedding broken?)"
+            )
+        if not out["shed_counters"]:
+            raise SystemExit("sheds happened but Qos.Shed.* stayed empty")
+        # generous CI floor — the deterministic acceptance gate is
+        # tests/test_qos.py's simulated-time soak; this smokes the
+        # real-time plumbing end to end on a possibly noisy box
+        if out["value"] < 0.5:
+            raise SystemExit(
+                f"goodput under overload fell to {out['value']:.2f} of "
+                "the no-overload capacity (expected ~1.0; >=0.9 is the "
+                "acceptance line on a quiet machine)"
+            )
+        return
     if metric == "trace":
         batch = int(os.environ.get("BENCH_BATCH", "192"))
         reps = int(os.environ.get("BENCH_TRACE_REPS", "3"))
@@ -1019,7 +1194,7 @@ def _quick(metric: str) -> None:
         return
     if metric != "ingest":
         raise SystemExit(
-            f"--quick supports 'ingest' or 'trace', not {metric!r}"
+            f"--quick supports 'ingest', 'trace' or 'qos', not {metric!r}"
         )
     batch = int(os.environ.get("BENCH_BATCH", "256"))
     iters = int(os.environ.get("BENCH_ITERS", "1"))
@@ -1037,7 +1212,9 @@ def main() -> None:
         _quick(argv[1] if len(argv) > 1 else "ingest")
         return
     if argv:
-        raise SystemExit(f"unknown arguments {argv!r} (try --quick ingest)")
+        raise SystemExit(
+            f"unknown arguments {argv!r} (try --quick ingest|trace|qos)"
+        )
     t_start = time.perf_counter()
     # On a remote-attached TPU the host<->device link latency (~50-100
     # ms/transfer) dominates small batches; 32k records (5 MB packed)
@@ -1048,7 +1225,7 @@ def main() -> None:
     metric = os.environ.get("BENCH_METRIC", "all")
     known = (
         "all", "p256", "mixed", "merkle", "notary", "ingest",
-        "ingest_pipelined", "trace", "montmul", "parity",
+        "ingest_pipelined", "trace", "qos", "montmul", "parity",
     )
     if metric not in known:
         # a typo must not record a p256-only rate under another name
@@ -1087,7 +1264,7 @@ def main() -> None:
     # parity runs LAST of the optional work (cheapest to drop), but
     # before the headline so the headline stays the final stdout line
     for m in ("mixed", "merkle", "notary", "ingest", "ingest_pipelined",
-              "trace", "parity"):
+              "trace", "qos", "parity"):
         avail = left() - reserve
         if avail < 60:
             print(
@@ -1099,7 +1276,7 @@ def main() -> None:
         env = dict(os.environ, BENCH_METRIC=m)
         if avail < 300 and m in (
             "mixed", "merkle", "notary", "ingest", "ingest_pipelined",
-            "trace",
+            "trace", "qos",
         ):
             # trim before dropping: one timed rep at a shallower batch
             # still yields a usable point for the table

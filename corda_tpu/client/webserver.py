@@ -104,6 +104,7 @@ class NodeWebServer:
         rpc_timeout: float = 90.0,
         metrics=None,
         tracer=None,
+        qos=None,
     ):
         """`metrics`: an optional MetricRegistry served at GET /metrics
         in prometheus exposition format (the reference exports
@@ -112,12 +113,19 @@ class NodeWebServer:
         `tracer`: an optional utils.tracing.Tracer whose flight
         recorder is served at GET /traces — chrome://tracing-loadable
         trace-event JSON (object form) with a per-stage latency
-        summary under `stageSummary`."""
+        summary under `stageSummary`.
+
+        `qos`: an optional node/qos.NotaryQos whose live control-plane
+        state (adaptive-controller knobs + admitted p99, brownout
+        level, Qos.Shed.* counts, lane depths, admission gate) is
+        served as JSON at GET /qos — the operator's overload view next
+        to /metrics and /traces."""
         self.client = client
         self.pump = pump
         self.rpc_timeout = rpc_timeout
         self.metrics = metrics
         self.tracer = tracer
+        self.qos = qos
         self._lock = threading.Lock()   # one RPC conversation at a time
         gateway = self
 
@@ -196,6 +204,32 @@ class NodeWebServer:
             except Exception as e:   # noqa: BLE001 - defensive render
                 payload = json.dumps(
                     {"error": f"trace export failed: {e}"}
+                ).encode()
+                status = 500
+            req.send_response(status)
+            req.send_header("Content-Type", "application/json")
+            req.send_header("Content-Length", str(len(payload)))
+            req.end_headers()
+            req.wfile.write(payload)
+            return
+        if method == "GET" and urlparse(req.path).path == "/qos":
+            # the QoS control plane's live state: shed counters,
+            # adaptive-controller knobs vs target, brownout level,
+            # lane depths — /metrics tells you the node slowed, THIS
+            # tells you what the overload machinery is doing about it
+            try:
+                if self.qos is not None:
+                    payload = json.dumps(self.qos.snapshot()).encode()
+                    status = 200
+                else:
+                    payload = json.dumps(
+                        {"enabled": False,
+                         "error": "qos not wired on this gateway"}
+                    ).encode()
+                    status = 404
+            except Exception as e:   # noqa: BLE001 - defensive render
+                payload = json.dumps(
+                    {"error": f"qos snapshot failed: {e}"}
                 ).encode()
                 status = 500
             req.send_response(status)

@@ -314,6 +314,25 @@ class NotarisationResponse:
     error: Optional[NotaryError]
 
 
+@ser.serializable
+@dataclass(frozen=True)
+class NotarisationRequest:
+    """Deadline-carrying notarisation envelope (node/qos.py): `tx` is
+    the plain payload (SignedTransaction or FilteredTransaction) and
+    `deadline_micros` the absolute wall-clock microseconds after which
+    the requester no longer wants the answer — a QoS-enabled notary
+    sheds the request at its cheapest point (before backchain
+    resolution, pre-stage at the flush) into a typed `shed` error.
+
+    Only sent when the client SET a deadline, so deadline-less traffic
+    keeps the bare payload shape on the wire. Deadlines cross nodes as
+    absolute wall-clock values: meaningful to the tolerance of cluster
+    clock sync, like the notary time-window check itself."""
+
+    tx: Any
+    deadline_micros: int
+
+
 @initiating_flow
 class NotaryFlow(FlowLogic):
     """Client side of notarisation (NotaryFlow.Client, NotaryFlow.kt:
@@ -321,8 +340,17 @@ class NotaryFlow(FlowLogic):
     (validating) or a Merkle tear-off of inputs+timewindow
     (non-validating), verify the returned signature(s)."""
 
-    def __init__(self, stx: SignedTransaction):
+    def __init__(
+        self,
+        stx: SignedTransaction,
+        deadline_micros: Optional[int] = None,
+    ):
+        """`deadline_micros`: optional absolute wall-clock deadline —
+        set it and the request ships in a NotarisationRequest envelope
+        so a QoS-enabled notary can shed it once expired instead of
+        burning batch-verify work on an answer nobody is waiting for."""
         self.stx = stx
+        self.deadline_micros = deadline_micros
 
     def call(self):
         notary = self.stx.wtx.notary
@@ -342,6 +370,8 @@ class NotaryFlow(FlowLogic):
             payload = self.stx.wtx.build_filtered_transaction(
                 lambda c: isinstance(c, (StateRef, Party, TimeWindow))
             )
+        if self.deadline_micros is not None:
+            payload = NotarisationRequest(payload, self.deadline_micros)
         members = self.services.network_map_cache.cluster_members(notary)
         if members:
             resp = yield from self._request_from_cluster(
@@ -415,6 +445,29 @@ class NotaryServiceFlow(FlowLogic):
         if service is None:
             raise FlowException("this node is not a notary")
         payload = yield from self.receive(self.other_party)
+        deadline = None
+        if isinstance(payload, NotarisationRequest):
+            deadline = payload.deadline_micros
+            payload = payload.tx
+        qos = getattr(service, "qos", None)
+        if deadline is not None and qos is not None:
+            # cheapest service-side point: an already-expired request
+            # sheds BEFORE backchain resolution pulls the whole history
+            from ..node import qos as qoslib
+
+            if qoslib.expired(deadline, self.services.clock.now_micros()):
+                qos.count_shed(qoslib.SHED_EXPIRED_INGRESS)
+                yield from self.send(
+                    self.other_party,
+                    NotarisationResponse(
+                        (),
+                        NotaryError(
+                            qoslib.SHED_KIND,
+                            "deadline expired before service dispatch",
+                        ),
+                    ),
+                )
+                return None
         if service.validating:
             if not isinstance(payload, SignedTransaction):
                 raise FlowException("validating notary needs the full tx")
@@ -428,7 +481,9 @@ class NotaryServiceFlow(FlowLogic):
             )
         elif not isinstance(payload, FilteredTransaction):
             raise FlowException("non-validating notary takes a tear-off")
-        result = yield from service.process(payload, self.other_party)
+        result = yield from service.process(
+            payload, self.other_party, deadline=deadline
+        )
         if isinstance(result, NotaryError):
             resp = NotarisationResponse((), result)
         elif isinstance(result, (list, tuple)):

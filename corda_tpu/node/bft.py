@@ -1340,7 +1340,10 @@ class BFTNotaryService:
 
     # -- the NotaryService surface (generator, like the others) --------------
 
-    def process(self, ftx, requester):
+    def process(self, ftx, requester, deadline=None):
+        del deadline   # accepted for flow-call parity; BFT replicas
+        #                order every admitted request (notary.py
+        #                SimpleNotaryService.process note)
         from ..core.transactions import FilteredTransaction
         from ..flows.api import wait_future
         from .notary import NotaryError

@@ -67,6 +67,22 @@ class NodeConfig:
     # long (or the batch fills), trading bounded latency for deeper —
     # faster — flushes (notary.py BatchingNotaryService)
     notary_batch_wait_micros: int = 0
+    # QoS / overload control for the batching notary (node/qos.py):
+    # enabled, the notary gets deadline shedding, a per-client
+    # admission gate on the request path, the adaptive batching
+    # controller (which then treats notary_batch_wait_micros as its
+    # CEILING — it tunes the live window inside [0, that bound]) and
+    # the GET /qos surface on the web gateway; the priority-lane
+    # router additionally engages wherever a ring-seam fabric routes
+    # wire frames through it (messaging.add_ring)
+    qos_enabled: bool = False
+    # the SLO the adaptive controller holds: admitted-request p99
+    # completion latency, microseconds
+    qos_target_p99_micros: int = 50_000
+    # per-client token-bucket admission at the fabric seam: sustained
+    # requests/sec per sender (0 disables) and burst capacity
+    qos_admission_rate_per_sec: int = 0
+    qos_admission_burst: int = 256
     verifier_type: str = "in_memory"
     # which BatchSignatureVerifier backs signature checks: "tpu" (the
     # production batch kernels) or "cpu" (the bit-exact reference —
@@ -126,6 +142,15 @@ class NodeConfig:
             raise ConfigError(
                 "web_port requires at least one [[rpc.users]] entry "
                 "(the gateway connects over RPC)"
+            )
+        if self.qos_enabled and self.qos_target_p99_micros <= 0:
+            raise ConfigError(
+                "qos_target_p99_micros must be positive when qos_enabled"
+            )
+        if self.qos_enabled and self.notary != "batching":
+            raise ConfigError(
+                "qos_enabled requires notary = 'batching' (the QoS "
+                "plane steers the batching notary's flush)"
             )
 
     @property
@@ -218,6 +243,12 @@ def write_config(cfg: NodeConfig, path: str) -> None:
     emit("notary", cfg.notary)
     if cfg.notary_batch_wait_micros:
         emit("notary_batch_wait_micros", cfg.notary_batch_wait_micros)
+    if cfg.qos_enabled:
+        emit("qos_enabled", cfg.qos_enabled)
+        emit("qos_target_p99_micros", cfg.qos_target_p99_micros)
+        if cfg.qos_admission_rate_per_sec:
+            emit("qos_admission_rate_per_sec", cfg.qos_admission_rate_per_sec)
+            emit("qos_admission_burst", cfg.qos_admission_burst)
     emit("verifier_type", cfg.verifier_type)
     emit("verifier_backend", cfg.verifier_backend)
     emit("dev_mode", cfg.dev_mode)

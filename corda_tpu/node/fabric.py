@@ -50,6 +50,7 @@ CREATE TABLE IF NOT EXISTS fabric_out (
     topic   TEXT NOT NULL,
     payload BLOB NOT NULL,
     uid     INTEGER NOT NULL,
+    headers BLOB,
     UNIQUE (peer, uid) ON CONFLICT IGNORE
 );
 CREATE INDEX IF NOT EXISTS fabric_out_peer ON fabric_out (peer, seq);
@@ -60,6 +61,7 @@ CREATE TABLE IF NOT EXISTS fabric_in (
     topic     TEXT NOT NULL,
     payload   BLOB NOT NULL,
     processed INTEGER NOT NULL DEFAULT 0,
+    headers   BLOB,
     PRIMARY KEY (sender, uid)
 );
 CREATE INDEX IF NOT EXISTS fabric_in_pending ON fabric_in (processed, arrival);
@@ -68,6 +70,40 @@ CREATE TABLE IF NOT EXISTS fabric_meta (
     v INTEGER NOT NULL
 );
 """
+
+# pre-headers databases (PR 2 era) lack the column; CREATE IF NOT
+# EXISTS won't add it, so migrate in place — idempotent, and a journal
+# written before the upgrade simply carries NULL headers
+_FABRIC_MIGRATIONS = (
+    "ALTER TABLE fabric_out ADD COLUMN headers BLOB",
+    "ALTER TABLE fabric_in ADD COLUMN headers BLOB",
+)
+
+
+def _encode_headers(trace, deadline) -> Optional[bytes]:
+    """Wire/journal form of the optional message headers: None when
+    there is nothing to carry (the common case costs zero bytes), else
+    one canonical blob of [trace, deadline]."""
+    if trace is None and deadline is None:
+        return None
+    return ser.encode([list(trace) if trace is not None else None, deadline])
+
+
+def _decode_headers(blob) -> tuple[Optional[tuple], Optional[int]]:
+    """Best-effort header decode: headers are QoS/observability
+    metadata, so a malformed blob degrades to no-headers rather than
+    poisoning delivery."""
+    if not blob:
+        return None, None
+    try:
+        trace, deadline = ser.decode(bytes(blob))
+        if trace is not None:
+            trace = tuple(int(x) for x in trace)
+        if deadline is not None:
+            deadline = int(deadline)
+        return trace, deadline
+    except Exception:
+        return None, None
 
 
 def _to_db_uid(uid: int) -> int:
@@ -203,6 +239,17 @@ class FabricEndpoint(MessagingService):
         # host behind NAT or when bound to 0.0.0.0)
         self.advertise_host = advertise_host or host
         db.execute_script(_FABRIC_SCHEMA)
+        import sqlite3
+
+        for migration in _FABRIC_MIGRATIONS:
+            try:
+                db.execute(migration)
+            except sqlite3.OperationalError as e:
+                # only the expected already-migrated case is benign; a
+                # locked/full/corrupt database must fail HERE, not as a
+                # missing-column error on every later send()
+                if "duplicate column" not in str(e).lower():
+                    raise
         self._handlers: dict[str, list[Handler]] = {}
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread: Optional[threading.Thread] = None
@@ -235,6 +282,7 @@ class FabricEndpoint(MessagingService):
         target: str,
         unique_id: Optional[int] = None,
         trace: Optional[tuple] = None,
+        deadline: Optional[int] = None,
     ) -> None:
         """Durably journal, then wake the peer's bridge. uid None mints
         an id from a persistent monotonic counter — NEVER reused, even
@@ -242,20 +290,24 @@ class FabricEndpoint(MessagingService):
         (sender, uid) lives forever: a recycled uid would be silently
         swallowed as a duplicate.
 
-        `trace` (tracing header) is accepted for interface parity but
-        NOT journaled: the durable frame format carries consensus
-        payload only, and a redelivered frame after a crash could not
-        honour a stale trace anyway — across this fabric a trace starts
-        fresh at the receiving frame (best-effort propagation, see
-        MessagingService.send)."""
-        del trace
+        The optional `trace` / `deadline` headers journal alongside the
+        frame and cross the wire in a separate headers blob, so cross-
+        process traces connect end-to-end and the receiver can shed an
+        expired request pre-decode (node/qos.py). Both are metadata:
+        dedupe, ordering and ack semantics key on (peer, uid, payload)
+        exactly as before. Wire-format note: a frame CARRYING headers
+        is a 6-element msg frame, which a pre-headers receiver rejects
+        — both ends of a bridge must run this fabric version before
+        senders attach headers (header-less sends keep the old
+        5-element frame, so the upgrade order is receivers first)."""
+        headers = _encode_headers(trace, deadline)
         with self._db.transaction():
             if unique_id is None:
                 unique_id = self._next_uid()
             self._db.execute(
-                "INSERT INTO fabric_out (peer, topic, payload, uid)"
-                " VALUES (?,?,?,?)",
-                (target, topic, payload, _to_db_uid(unique_id)),
+                "INSERT INTO fabric_out (peer, topic, payload, uid, headers)"
+                " VALUES (?,?,?,?,?)",
+                (target, topic, payload, _to_db_uid(unique_id), headers),
             )
         if self._loop is not None:
             self._loop.call_soon_threadsafe(self._wake_bridge, target)
@@ -397,7 +449,7 @@ class FabricEndpoint(MessagingService):
         for 30s (then close to free the socket) or an error."""
         while self.running:
             rows = self._db.query(
-                "SELECT seq, topic, payload, uid FROM fabric_out"
+                "SELECT seq, topic, payload, uid, headers FROM fabric_out"
                 " WHERE peer=? ORDER BY seq LIMIT 256",
                 (peer,),
             )
@@ -408,11 +460,13 @@ class FabricEndpoint(MessagingService):
                     continue
                 except asyncio.TimeoutError:
                     return   # idle: close connection, journal is empty
-            for seq, topic, payload, uid in rows:
-                _write_frame(
-                    writer,
-                    ["msg", seq, topic, bytes(payload), _from_db_uid(uid)],
-                )
+            for seq, topic, payload, uid, headers in rows:
+                frame = ["msg", seq, topic, bytes(payload), _from_db_uid(uid)]
+                if headers is not None:
+                    # headers ride as a 6th element; pre-headers peers
+                    # never see it (their journals carry NULL)
+                    frame.append(bytes(headers))
+                _write_frame(writer, frame)
             await writer.drain()
             for _ in rows:
                 frame = await asyncio.wait_for(_read_frame(reader), timeout=30)
@@ -475,8 +529,11 @@ class FabricEndpoint(MessagingService):
                 frame = await _read_frame(reader)
                 if frame[0] != "msg":
                     raise ConnectionError(f"unexpected frame {frame[0]!r}")
-                _, seq, topic, payload, uid = frame
-                self._ingest(sender, topic, bytes(payload), uid)
+                if len(frame) not in (5, 6):
+                    raise ConnectionError("malformed msg frame")
+                seq, topic, payload, uid = frame[1:5]
+                headers = bytes(frame[5]) if len(frame) == 6 else None
+                self._ingest(sender, topic, bytes(payload), uid, headers)
                 _write_frame(writer, ["ack", seq])
                 await writer.drain()
         except (
@@ -543,14 +600,27 @@ class FabricEndpoint(MessagingService):
         row = self._db.query("SELECT MAX(arrival) FROM fabric_in")
         return (row[0][0] or 0) + 1
 
-    def _ingest(self, sender: str, topic: str, payload: bytes, uid: int) -> None:
+    def _ingest(
+        self,
+        sender: str,
+        topic: str,
+        payload: bytes,
+        uid: int,
+        headers: Optional[bytes] = None,
+    ) -> None:
         """Durable + deduped BEFORE ack: the PRIMARY KEY swallows
-        duplicates so redelivered frames ack without re-dispatch."""
+        duplicates so redelivered frames ack without re-dispatch.
+        Headers land durably too — a frame redelivered after a crash
+        keeps its trace link and (crucially) its deadline."""
         self._arrival_counter += 1
         self._db.execute(
             "INSERT OR IGNORE INTO fabric_in"
-            " (sender, uid, arrival, topic, payload) VALUES (?,?,?,?,?)",
-            (sender, _to_db_uid(uid), self._arrival_counter, topic, payload),
+            " (sender, uid, arrival, topic, payload, headers)"
+            " VALUES (?,?,?,?,?,?)",
+            (
+                sender, _to_db_uid(uid), self._arrival_counter,
+                topic, payload, headers,
+            ),
         )
         self._pump_wake.set()
 
@@ -571,8 +641,12 @@ class FabricEndpoint(MessagingService):
             rows = self._pending_rows()
             if not rows:
                 break
-            for sender, uid, topic, payload in rows:
-                msg = Message(topic, bytes(payload), sender, _from_db_uid(uid))
+            for sender, uid, topic, payload, headers in rows:
+                trace, deadline = _decode_headers(headers)
+                msg = Message(
+                    topic, bytes(payload), sender, _from_db_uid(uid),
+                    trace, deadline,
+                )
                 try:
                     with self._db.transaction():
                         for h in list(self._handlers.get(topic, ())):
@@ -606,7 +680,7 @@ class FabricEndpoint(MessagingService):
             return []
         placeholders = ",".join("?" * len(topics))
         return self._db.query(
-            "SELECT sender, uid, topic, payload FROM fabric_in"
+            "SELECT sender, uid, topic, payload, headers FROM fabric_in"
             f" WHERE processed=0 AND topic IN ({placeholders})"
             " ORDER BY arrival LIMIT 64",
             tuple(topics),

@@ -92,6 +92,12 @@ class IngestedTx:
     # attach their stage spans under it and END it when the frame's
     # future resolves. None whenever tracing is off.
     span: Any = None
+    # QoS (node/qos.py): the frame's propagated absolute-microsecond
+    # deadline (messaging.Message.deadline). A frame already expired at
+    # ingest is shed PRE-DECODE — error becomes qos.DeadlineExpired and
+    # no decode/id/stage work is spent on it; a live deadline rides
+    # here so the notary flush can shed it later if it dies queued.
+    deadline: Optional[int] = None
 
     @property
     def tx_id(self) -> Optional[SecureHash]:
@@ -371,6 +377,8 @@ class IngestPipeline:
         blobs: list,
         trace_parents: Optional[list] = None,
         end_spans: bool = True,
+        deadlines: Optional[list] = None,
+        now_micros: Optional[int] = None,
     ) -> list[IngestedTx]:
         """Decode + id + stage one batch synchronously (the pipelined
         form below overlaps; this is the building block and the test
@@ -383,21 +391,51 @@ class IngestPipeline:
         leaves the root OPEN and hands ownership downstream: the notary
         flush attaches its phase spans under it and ends it when the
         frame's future resolves — one connected trace per
-        notarisation."""
-        return self._finish(self._start(blobs, trace_parents), end_spans)
+        notarisation.
 
-    def _start(self, blobs: list, trace_parents: Optional[list] = None):
+        QoS: `deadlines[i]` (absolute node-clock micros, None = no
+        deadline) sheds already-expired frames BEFORE the frame-cache
+        probe and the decode pool ever see them — the cheapest possible
+        point; the entry carries `error=qos.DeadlineExpired` in its
+        slot. Live deadlines ride out on `IngestedTx.deadline`."""
+        return self._finish(
+            self._start(blobs, trace_parents, deadlines, now_micros),
+            end_spans,
+        )
+
+    def _start(
+        self,
+        blobs: list,
+        trace_parents: Optional[list] = None,
+        deadlines: Optional[list] = None,
+        now_micros: Optional[int] = None,
+    ):
         """Probe the frame cache, then kick the MISSES off on the
         decode pool. Returns the in-flight handle _finish consumes."""
         t0 = time.perf_counter()
+        shed: dict[int, "IngestedTx"] = {}
+        if deadlines is not None:
+            from .qos import DeadlineExpired, expired
+
+            if now_micros is None:
+                now_micros = time.time_ns() // 1_000
+            for i, d in enumerate(deadlines[: len(blobs)]):
+                if expired(d, now_micros):
+                    shed[i] = IngestedTx(
+                        blobs[i],
+                        error=DeadlineExpired(d, now_micros),
+                        deadline=d,
+                    )
         cache = self.frame_cache
         hits: dict[int, tuple] = {}
-        if cache is None:
+        if cache is None and not shed:
             misses, miss_idx = list(blobs), range(len(blobs))
         else:
             misses, miss_idx = [], []
             for i, b in enumerate(blobs):
-                cached = cache.get(b)
+                if i in shed:
+                    continue
+                cached = cache.get(b) if cache is not None else None
                 if cached is None:
                     misses.append(b)
                     miss_idx.append(i)
@@ -405,11 +443,13 @@ class IngestPipeline:
                     hits[i] = cached
             self.frame_hits += len(hits)
         handle = self.pool.decode_async(misses) if misses else None
-        return blobs, hits, miss_idx, handle, trace_parents, t0
+        return blobs, hits, miss_idx, handle, trace_parents, t0, shed, deadlines
 
     def _finish(self, started, end_spans: bool = True) -> list[IngestedTx]:
-        blobs, hits, miss_idx, handle, parents, t0 = started
+        blobs, hits, miss_idx, handle, parents, t0, shed, deadlines = started
         entries: list[Optional[IngestedTx]] = [None] * len(blobs)
+        for i, e in shed.items():
+            entries[i] = e
         for i, (stx, obj, requests) in hits.items():
             entries[i] = IngestedTx(
                 blobs[i], stx=stx, obj=obj, requests=requests
@@ -458,6 +498,12 @@ class IngestPipeline:
                 e.requests = e.stx.signature_requests()
             if cache is not None:
                 cache.put(e.blob, (e.stx, e.obj, e.requests))
+        if deadlines is not None:
+            # live deadlines ride out per-arrival (cache hits included:
+            # the deadline belongs to THIS arrival, never to the cache)
+            for i, d in enumerate(deadlines[: len(entries)]):
+                if i not in shed and entries[i] is not None:
+                    entries[i].deadline = d
         if tracing_on:
             self._emit_spans(
                 tracer, entries, hits, parents,

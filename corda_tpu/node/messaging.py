@@ -42,6 +42,14 @@ class Message:
     # fabric hop. None (the default) everywhere tracing is off; the
     # field is observability metadata, never consensus input.
     trace: Optional[tuple] = None
+    # OPTIONAL deadline header (node/qos.py): absolute node-clock
+    # microseconds after which the SENDER no longer wants an answer.
+    # Consumers shed expired work at the cheapest point they notice it
+    # (pre-decode at ingress, pre-stage at the notary flush) into a
+    # typed `shed` response. QoS metadata, never consensus input — but
+    # unlike `trace` it IS journaled across the TCP fabric: a frame
+    # redelivered after a crash should still be shed if it expired.
+    deadline: Optional[int] = None
 
 
 Handler = Callable[[Message], None]
@@ -57,10 +65,13 @@ class MessagingService:
         target: str,
         unique_id: Optional[int] = None,
         trace: Optional[tuple] = None,
+        deadline: Optional[int] = None,
     ) -> None:
         """`trace`: optional tracing SpanContext header (see
-        Message.trace); fabrics that cannot carry it drop it — trace
-        propagation is best-effort, delivery semantics are not."""
+        Message.trace); trace propagation is best-effort, delivery
+        semantics are not. `deadline`: optional absolute-microsecond
+        QoS header (Message.deadline) — both ride the fabric as
+        headers, never as payload."""
         raise NotImplementedError
 
     def add_handler(self, topic: str, handler: Handler) -> None:
@@ -184,6 +195,7 @@ class InMemoryMessaging(MessagingService):
         target: str,
         unique_id: Optional[int] = None,
         trace: Optional[tuple] = None,
+        deadline: Optional[int] = None,
     ) -> None:
         """Explicit unique_id lets flows use deterministic ids so that
         replayed sends after checkpoint restore dedupe at the receiver
@@ -192,7 +204,7 @@ class InMemoryMessaging(MessagingService):
         if unique_id is None:
             unique_id = self._next_id
             self._next_id += 1
-        msg = Message(topic, payload, self._name, unique_id, trace)
+        msg = Message(topic, payload, self._name, unique_id, trace, deadline)
         self._network._enqueue(msg, target)
 
     def add_handler(self, topic: str, handler: Handler) -> None:

@@ -227,6 +227,9 @@ class Node:
 
         self.metrics = MetricRegistry()
         self.tracer = tracing.get_tracer()
+        # QoS plane (node/qos.py): installed with the batching notary
+        # when config.qos_enabled; None keeps every hot path unchanged
+        self.qos = None
 
         # -- flows, notary, scheduler ----------------------------------
         # @corda_service instances from the imported cordapps, before
@@ -410,11 +413,41 @@ class Node:
         if kind in ("simple", "validating", "batching"):
             uniqueness = PersistentUniquenessProvider(self.db)
             if kind == "batching":
+                if self.config.qos_enabled:
+                    # SLO plane for the serving path: deadline shedding,
+                    # priority lanes, admission gating and the adaptive
+                    # batching controller, on the node's registry so
+                    # /metrics carries Qos.* and the web gateway serves
+                    # the JSON mirror at GET /qos
+                    from .qos import NotaryQos, QosPolicy
+
+                    # an operator-configured batching window is the
+                    # controller's CEILING (it tunes inside the fence,
+                    # never past the configured bound); unset (0) falls
+                    # back to the policy default ceiling
+                    self.qos = NotaryQos(
+                        QosPolicy(
+                            target_p99_micros=(
+                                self.config.qos_target_p99_micros
+                            ),
+                            max_wait_micros=(
+                                self.config.notary_batch_wait_micros
+                                or QosPolicy.max_wait_micros
+                            ),
+                            admission_rate_per_sec=(
+                                self.config.qos_admission_rate_per_sec
+                            ),
+                            admission_burst=self.config.qos_admission_burst,
+                        ),
+                        clock=self.services.clock,
+                        metrics=self.metrics,
+                    )
                 self.services.notary_service = BatchingNotaryService(
                     self.services,
                     uniqueness,
                     max_wait_micros=self.config.notary_batch_wait_micros,
                     metrics=self.metrics,
+                    qos=self.qos,
                 )
                 return
             cls = {
@@ -632,10 +665,11 @@ class Node:
 
     def webserver(self, username: str, password: str, port: int = 0):
         """Embedded web gateway over the node's own RPC surface, with
-        this node's MetricRegistry at /metrics and the ledger explorer
-        UI at /web/explorer/. The node's pump loop (run()) drives
-        message delivery, so the gateway itself only polls futures
-        (pass a real pump when embedding without run())."""
+        this node's MetricRegistry at /metrics, the flight recorder at
+        /traces and the QoS plane (when enabled) at /qos, plus the
+        ledger explorer UI at /web/explorer/. The node's pump loop
+        (run()) drives message delivery, so the gateway itself only
+        polls futures (pass a real pump when embedding without run())."""
         import corda_tpu.tools.web_explorer  # noqa: F401 - /api/explorer
 
         from ..client.webserver import NodeWebServer
@@ -646,6 +680,7 @@ class Node:
             port=port,
             metrics=self.metrics,
             tracer=self.tracer,
+            qos=self.qos,
         ).start()
 
 

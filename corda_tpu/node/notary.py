@@ -269,7 +269,17 @@ class SimpleNotaryService(NotaryService):
     time window) — privacy-preserving, trusts the requester for contract
     validity (SimpleNotaryService.kt)."""
 
-    def process(self, ftx: FilteredTransaction, requester: Party):
+    def process(
+        self,
+        ftx: FilteredTransaction,
+        requester: Party,
+        deadline: Optional[int] = None,
+    ):
+        # `deadline` (node/qos.py) is accepted on every notary flavour
+        # so the service flow passes it uniformly; only the batching
+        # notary currently sheds on it (this flavour serves per-request
+        # — by the time it runs, answering costs less than shedding)
+        del deadline
         try:
             ftx.verify()
         except TransactionVerificationError as e:
@@ -310,6 +320,14 @@ class _PendingNotarisation:
     # and ENDS it when this request is answered. None when tracing is
     # off — the disabled path costs one falsy check per request.
     span: Any = None
+    # QoS (node/qos.py): the request's propagated absolute-microsecond
+    # deadline and its arrival time on the node clock. A request whose
+    # deadline passed while it queued is shed pre-stage (the flush
+    # answers a typed `shed` NotaryError without spending verify work);
+    # arrival feeds the admitted-latency histogram the adaptive
+    # batching controller steers by. Both None when QoS is off.
+    deadline: Optional[int] = None
+    arrival_micros: Optional[int] = None
 
 
 class BatchingNotaryService(NotaryService):
@@ -344,6 +362,7 @@ class BatchingNotaryService(NotaryService):
         max_batch: int = 512,
         max_wait_micros: int = 0,
         metrics: Optional[MetricRegistry] = None,
+        qos=None,
     ):
         """`max_wait_micros` is the batching DEADLINE (SURVEY §7 hard
         part 4 — latency vs throughput): 0 (default) flushes every pump
@@ -356,12 +375,21 @@ class BatchingNotaryService(NotaryService):
         `metrics`: the node's MetricRegistry — pass it and the batching
         counters, ratio gauge, flush-phase timers and ingest-ring
         gauges all land on the node's /metrics surface; None keeps a
-        private registry (embedded/test rigs)."""
+        private registry (embedded/test rigs).
+
+        `qos`: an optional node/qos.NotaryQos. With one attached,
+        max_batch/max_wait_micros become the STARTING point of its
+        adaptive batching controller (which retunes both each flush to
+        hold the configured p99 target), expired requests are shed
+        pre-stage into typed `shed` errors, and every answered request
+        feeds the admitted-latency histogram the controller steers by.
+        None keeps the static knobs and a zero-cost hot path."""
         super().__init__(
             services, uniqueness, tolerance_micros, service_identity
         )
         self.max_batch = max_batch
         self.max_wait_micros = max_wait_micros
+        self.qos = qos
         self._pending: list[_PendingNotarisation] = []
         self._ingest_ring = None   # attach_ingest: pre-decoded arrivals
         self._oldest_arrival: Optional[int] = None
@@ -413,7 +441,28 @@ class BatchingNotaryService(NotaryService):
         between warm-up and timed reps as before."""
         return self._phase_profile
 
-    def process(self, stx: SignedTransaction, requester: Party):
+    @property
+    def effective_max_batch(self) -> int:
+        """The live flush-depth knob: the adaptive controller's when
+        QoS is attached, the static config otherwise."""
+        qos = self.qos
+        return qos.controller.batch if qos is not None else self.max_batch
+
+    @property
+    def effective_wait_micros(self) -> int:
+        """The live batching-window knob (see effective_max_batch)."""
+        qos = self.qos
+        return (
+            qos.controller.wait_micros if qos is not None
+            else self.max_wait_micros
+        )
+
+    def process(
+        self,
+        stx: SignedTransaction,
+        requester: Party,
+        deadline: Optional[int] = None,
+    ):
         from ..flows.api import FlowFuture, wait_future
 
         if stx.wtx.notary != self.identity:
@@ -421,6 +470,40 @@ class BatchingNotaryService(NotaryService):
                 "wrong-notary",
                 f"tx names notary {stx.wtx.notary}, I am {self.identity}",
             )
+        qos = self.qos
+        arrival = None
+        if qos is not None:
+            from . import qos as qoslib
+
+            arrival = self.services.clock.now_micros()
+            if qoslib.expired(deadline, arrival):
+                # dead on arrival: answer without queuing — the flow
+                # entry's pre-decode-equivalent cheapest point
+                qos.count_shed(qoslib.SHED_EXPIRED_INGRESS)
+                return NotaryError(
+                    qoslib.SHED_KIND,
+                    f"deadline {deadline} already expired at arrival",
+                )
+            # per-client admission gate on the REQUEST path (the same
+            # token bucket the lane router applies at ring-seam
+            # fabrics): one flooding requester is rate-shaped here,
+            # before any queue slot or verify work is spent on it
+            if not qos.admission.admit(requester.name, arrival):
+                qos.count_shed(qoslib.SHED_ADMISSION)
+                return NotaryError(
+                    qoslib.SHED_KIND,
+                    f"admission rate exceeded for {requester.name}",
+                )
+            # brownout on the request path: at level 2 deadline-less
+            # traffic sheds here too — with no SLO to serve it by, it
+            # is the first load the degraded notary stops carrying
+            if qos.brownout_level >= 2 and deadline is None:
+                qos.count_shed(qoslib.SHED_BROWNOUT_NO_DEADLINE)
+                return NotaryError(
+                    qoslib.SHED_KIND,
+                    "brownout: deadline-less requests are being shed",
+                )
+            qos.admitted.inc()
         fut = FlowFuture()
         if not self._pending:
             self._oldest_arrival = self.services.clock.now_micros()
@@ -433,8 +516,13 @@ class BatchingNotaryService(NotaryService):
             span = tracer.start_trace(
                 "notarise.request", tx_id=str(stx.id), requester=requester.name
             )
-        self._pending.append(_PendingNotarisation(stx, requester, fut, span=span))
-        if len(self._pending) >= self.max_batch:
+        self._pending.append(
+            _PendingNotarisation(
+                stx, requester, fut, span=span,
+                deadline=deadline, arrival_micros=arrival,
+            )
+        )
+        if len(self._pending) >= self.effective_max_batch:
             self.flush()
         result = yield from wait_future(fut)
         return result
@@ -474,12 +562,12 @@ class BatchingNotaryService(NotaryService):
         n = len(self._pending)
         if not n:
             return 0
-        if self.max_wait_micros and n < self.max_batch:
+        if self.effective_wait_micros and n < self.effective_max_batch:
             age = (
                 self.services.clock.now_micros()
                 - (self._oldest_arrival or 0)
             )
-            if age < self.max_wait_micros:
+            if age < self.effective_wait_micros:
                 return 0
         self.flush()
         return n
@@ -531,6 +619,11 @@ class BatchingNotaryService(NotaryService):
         self._oldest_arrival = None
         if not pending:
             return
+        if self.qos is not None:
+            pending = self._qos_admit(pending)
+            if not pending:
+                self.qos.observe_flush(0, len(self._pending))
+                return
         # `marks` collects this flush's phase intervals; the finally
         # attributes them to every member frame's trace and ENDS the
         # per-frame root spans — on every exit path (normal, streamed,
@@ -540,6 +633,79 @@ class BatchingNotaryService(NotaryService):
             self._flush_body(pending, marks)
         finally:
             self._emit_flush_trace(pending, marks)
+            if self.qos is not None:
+                self._qos_feedback(pending)
+
+    def _qos_admit(
+        self, pending: list[_PendingNotarisation]
+    ) -> list[_PendingNotarisation]:
+        """Pre-stage QoS pass over one flush's intake: shed requests
+        whose deadline passed while they queued (a typed `shed` answer
+        — the client gave up; verifying it would burn a TPU batch lane
+        on a dead request), then cap the served depth at the adaptive
+        controller's batch so one flush cannot blow the latency budget;
+        the overflow re-queues AHEAD of newer arrivals (FIFO holds)."""
+        from . import qos as qoslib
+
+        qos = self.qos
+        now = self.services.clock.now_micros()
+        live: list[_PendingNotarisation] = []
+        for p in pending:
+            if qoslib.expired(p.deadline, now):
+                qos.count_shed(qoslib.SHED_EXPIRED_FLUSH)
+                if p.span:
+                    # shed events are span events: the trace shows WHY
+                    # this notarisation never reached the dispatch
+                    p.span.add_event(
+                        "qos.shed", reason=qoslib.SHED_EXPIRED_FLUSH
+                    )
+                    p.span.set_attribute("shed", qoslib.SHED_EXPIRED_FLUSH)
+                    p.span.end()
+                p.future.set_result(
+                    NotaryError(
+                        qoslib.SHED_KIND,
+                        f"deadline {p.deadline} expired while queued "
+                        f"(now {now})",
+                    )
+                )
+            else:
+                live.append(p)
+        cap = qos.controller.batch
+        if len(live) > cap:
+            overflow = live[cap:]
+            live = live[:cap]
+            self._pending = overflow + self._pending
+            self._oldest_arrival = (
+                overflow[0].arrival_micros
+                if overflow[0].arrival_micros is not None
+                else now
+            )
+        return live
+
+    def _qos_feedback(self, served: list[_PendingNotarisation]) -> None:
+        """Post-flush QoS pass: admitted-request completion latency
+        (node-clock micros, arrival -> answer) into the histogram the
+        adaptive controller reads, then one controller/brownout
+        observation with the depth served and the backlog left.
+        Futures still open here (distributed-commit consensus resolves
+        them later) record at RESOLUTION via a done callback — slow
+        consensus commits must reach the p99 the controller steers by,
+        or it would stretch the window while the real SLO breaches."""
+        qos = self.qos
+        now = self.services.clock.now_micros()
+        for p in served:
+            if p.arrival_micros is None:
+                continue
+            fut = p.future
+            if getattr(fut, "done", False):
+                qos.record_admitted(now - p.arrival_micros)
+            elif hasattr(fut, "add_done_callback"):
+                fut.add_done_callback(
+                    lambda f, arr=p.arrival_micros, q=qos: q.record_admitted(
+                        q.now_micros() - arr
+                    )
+                )
+        qos.observe_flush(len(served), len(self._pending))
 
     def _emit_flush_trace(self, pending, marks) -> None:
         """Per-frame trace assembly: the flush phases ran batched, so
@@ -963,7 +1129,13 @@ class ValidatingNotaryService(NotaryService):
 
     validating = True
 
-    def process(self, stx: SignedTransaction, requester: Party):
+    def process(
+        self,
+        stx: SignedTransaction,
+        requester: Party,
+        deadline: Optional[int] = None,
+    ):
+        del deadline   # see SimpleNotaryService.process
         if stx.wtx.notary != self.identity:
             return NotaryError(
                 "wrong-notary", f"tx names notary {stx.wtx.notary}, I am "

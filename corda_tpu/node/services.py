@@ -762,22 +762,39 @@ def _owning_key_of(participant):
 class _Future:
     """Tiny synchronous future (the SPI is future-shaped so the out-of-
     process pool in Phase 4 can slot in: OutOfProcessTransaction-
-    VerifierService.kt:19-73)."""
+    VerifierService.kt:19-73). Completion is condition-signalled so a
+    pump-less waiter parks on `wait(timeout)` and wakes the instant the
+    pump thread resolves it — no polling sleep in the await loop."""
 
     def __init__(self):
+        import threading
+
+        self._cond = threading.Condition()
         self._done = False
         self._exc: Optional[BaseException] = None
 
     def set_result(self) -> None:
-        self._done = True
+        with self._cond:
+            self._done = True
+            self._cond.notify_all()
 
     def set_exception(self, exc: BaseException) -> None:
-        self._done = True
-        self._exc = exc
+        with self._cond:
+            self._exc = exc
+            self._done = True
+            self._cond.notify_all()
 
     @property
     def done(self) -> bool:
         return self._done
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until resolved (or `timeout` seconds); True when the
+        future completed. The completing thread notifies, so there is
+        no busy-wait — pump-owning callers keep pumping instead (the
+        pump itself delivers the completion)."""
+        with self._cond:
+            return self._cond.wait_for(lambda: self._done, timeout)
 
     def result(self) -> None:
         if not self._done:

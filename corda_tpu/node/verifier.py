@@ -164,16 +164,24 @@ class OutOfProcessTransactionVerifierService(TransactionVerifierService):
         until that is wired, hub.transaction_verifier stays in-memory
         and this service is driven by dedicated call sites, mirroring
         how the reference gates the choice behind config.verifierType
-        (NodeMessagingClient.kt:116-118)."""
+        (NodeMessagingClient.kt:116-118).
+
+        Pump-less fabrics (the response handler fires on another
+        thread) park on the future's condition variable with the
+        remaining deadline — woken the instant the completion lands,
+        instead of the old 10 ms poll-sleep spin."""
         import time
 
         pump = getattr(self._messaging, "pump", None)
         deadline = time.monotonic() + timeout
-        while not fut.done and time.monotonic() < deadline:
+        while not fut.done:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
             if pump is not None:
-                pump(block=True, timeout=0.1)
+                pump(block=True, timeout=min(0.1, remaining))
             else:
-                time.sleep(0.01)
+                fut.wait(remaining)
         fut.result()
 
     @property
@@ -287,18 +295,28 @@ class VerifierWorker:
         advertised_address: Optional[tuple[str, int]] = None,
         ingest=None,               # Optional[corda_tpu.node.ingest.IngestPipeline]
         ingest_window: int = 8192,
+        clock=None,                # node-clock source for deadline expiry;
+        #                            None = wall clock (production workers —
+        #                            deadlines are minted on wall-clock
+        #                            nodes); simulated-time rigs MUST pass
+        #                            the TestClock that minted theirs
     ):
         self._messaging = messaging
         self._verifier = batch_verifier or default_verifier()
+        self._clock = clock
         self._batch_window = batch_window
         self._queue: list[TxVerificationRequest] = []
         # handler-fed frames awaiting the ingest pipeline, as
-        # (payload, trace header) so propagated trace contexts survive
-        # into the pipeline's per-frame spans
-        self._raw: list[tuple[bytes, Optional[tuple]]] = []
+        # (payload, trace header, deadline header) so propagated trace
+        # contexts survive into the pipeline's per-frame spans and
+        # expired requests shed pre-decode
+        self._raw: list[tuple[bytes, Optional[tuple], Optional[int]]] = []
         self.metrics = metrics or MetricRegistry()
         self._verified = self.metrics.meter("Verifier.Verified")
         self._failed = self.metrics.meter("Verifier.Failed")
+        # deadline-expired frames dropped pre-decode (QoS sheds are not
+        # failures: the sender stopped wanting the answer)
+        self._shed = self.metrics.meter("Verifier.Shed")
         self._batch_sizes = self.metrics.histogram("Verifier.BatchSize")
         self._ingest = ingest
         self._ring = None
@@ -329,7 +347,7 @@ class VerifierWorker:
 
     def _on_request(self, msg: msglib.Message) -> None:
         if self._ingest is not None:
-            self._raw.append((msg.payload, msg.trace))
+            self._raw.append((msg.payload, msg.trace, msg.deadline))
             if len(self._raw) > self._batch_window:
                 self.drain()
             return
@@ -342,26 +360,44 @@ class VerifierWorker:
         the request queue: ring frames first (fabric fast path), then
         handler-fed raw payloads. Each frame's propagated trace header
         (Message.trace) rides into the pipeline so the worker's ingest
-        spans join the sender's trace."""
+        spans join the sender's trace, and its deadline header rides
+        too so an expired request sheds PRE-DECODE (node/qos.py) —
+        the worker never spends CTS/verify work on a request whose
+        node-side future already timed out."""
         payloads: list[bytes] = []
         traces: list = []
+        deadlines: list = []
         if self._ring is not None:
             for m in self._ring.drain():
                 payloads.append(m.payload)
                 traces.append(m.trace)
+                deadlines.append(getattr(m, "deadline", None))
             # frames parked while the ring was full re-enter it for the
             # next drain — the backpressure release valve
             retry = getattr(self._messaging, "retry_parked", None)
             if retry is not None:
                 retry(msglib.TOPIC_VERIFIER_REQ)
         if self._raw:
-            for payload, trace in self._raw:
+            for payload, trace, deadline in self._raw:
                 payloads.append(payload)
                 traces.append(trace)
+                deadlines.append(deadline)
             self._raw = []
         if not payloads:
             return
-        for e in self._ingest.ingest(payloads, trace_parents=traces):
+        from .qos import DeadlineExpired
+
+        for e in self._ingest.ingest(
+            payloads,
+            trace_parents=traces,
+            deadlines=deadlines,
+            now_micros=(
+                self._clock.now_micros() if self._clock is not None else None
+            ),
+        ):
+            if isinstance(e.error, DeadlineExpired):
+                self._shed.mark()     # shed, not failed: QoS drop
+                continue
             if e.error is not None:
                 self._failed.mark()   # malformed frame: its slot only
                 continue

@@ -43,6 +43,8 @@ RUNNABLE = (
     "versioning.md",
     # PR 1: pipelined wire-ingest + notary retry-after-partial-commit
     "serving-notary.md",
+    # PR 4: QoS overload+shed scenario (simulated time, CI-runnable)
+    "loadtest.md",
 )
 
 

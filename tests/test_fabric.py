@@ -197,6 +197,64 @@ def test_impersonation_rejected(net):
     assert eve.pending_outbound == 1      # stuck unacked
 
 
+def test_trace_and_deadline_headers_survive_two_process_hop(net, tmp_path):
+    """PR 4 satellite pin: the trace and deadline headers journal and
+    cross the TCP fabric between two REAL OS processes — the child
+    dials the parent's listen port, sends one framed message carrying
+    both headers plus one bare message, and the parent's pump delivers
+    Message.trace / Message.deadline intact (previously `del trace`
+    dropped the context at every process boundary)."""
+    import os
+    import subprocess
+    import sys
+
+    parent = net.node("parent")
+    child_src = """
+import sys, time
+from corda_tpu.crypto import schemes
+from corda_tpu.node.fabric import FabricEndpoint, PeerAddress
+from corda_tpu.node.persistence import NodeDatabase
+
+port, db_path = int(sys.argv[1]), sys.argv[2]
+addr = PeerAddress("127.0.0.1", port, None)
+ep = FabricEndpoint(
+    "child",
+    schemes.generate_keypair(seed=4242),
+    NodeDatabase(db_path),
+    resolve=lambda peer: addr if peer == "parent" else None,
+)
+ep.start()
+ep.send("qos.t", b"cross-process", "parent", trace=(11, 22), deadline=777_000)
+ep.send("qos.t", b"bare", "parent")
+deadline = time.monotonic() + 20
+while ep.pending_outbound and time.monotonic() < deadline:
+    time.sleep(0.05)
+rc = 0 if ep.pending_outbound == 0 else 1
+ep.stop()
+sys.exit(rc)
+"""
+    env = dict(os.environ)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    got = []
+    parent.add_handler(
+        "qos.t", lambda m: got.append((m.payload, m.trace, m.deadline))
+    )
+    child = subprocess.run(
+        [
+            sys.executable, "-c", child_src,
+            str(parent.listen_port), str(tmp_path / "child.db"),
+        ],
+        env=env, timeout=120, capture_output=True, text=True,
+    )
+    assert child.returncode == 0, child.stderr
+    assert wait_for(lambda: parent.pump() or len(got) == 2)
+    assert got == [
+        (b"cross-process", (11, 22), 777_000),
+        (b"bare", None, None),
+    ]
+
+
 def test_tls_with_pinning(tls_net):
     a = tls_net.node("A")
     b = tls_net.node("B")
