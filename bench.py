@@ -40,6 +40,13 @@ BENCH_METRIC restricts to one measurement:
                     (node/qos.py): goodput and admitted p99 at 2x the
                     measured no-overload capacity, adaptive controller
                     on vs off, shed fraction — CPU fixture, real time
+  health          — health-plane steady-state overhead on the notary
+                    CPU rig (utils/health.py: heartbeats + watchdog +
+                    alert rules ticked every flush, A/B vs the bare
+                    flush) plus a canary round trip proven through the
+                    real hot path (timed separately — the probe's
+                    build+sign cost amortises at the production
+                    cadence, not per flush) — CPU fixture, real time
 
 `python bench.py --quick ingest` runs tiny serial + pipelined ingest
 records in one CPU-safe process (tier-1 smoke of the perf plumbing);
@@ -840,6 +847,134 @@ def _qos_metric(batch: int, iters: int) -> dict:
     }
 
 
+def _health_metric(batch: int, iters: int) -> dict:
+    """Health-plane cost + canary proof (the self-monitoring
+    tentpole's bench leg): the notary CPU rig serves `batch` spends
+    per flush with the health plane OFF (bare tick) vs ON (flush
+    heartbeat beaten, watchdog checked, alert rules walked every
+    tick), interleaved min-of-reps A/B on the same fixture. `value`
+    is the fractional wall overhead the plane adds to a flush — the
+    acceptance line is <= 2% (BENCH_HEALTH_OVERHEAD_MAX). The canary
+    round trip is proven (and its latency recorded) OUTSIDE the timed
+    A/B: one probe through stage -> dispatch -> commit -> sign on a
+    real flush, never touching the uniqueness namespace — in
+    production its build+sign cost amortises at the probe cadence
+    (every canary_interval, default 2 s), not per flush, so folding a
+    per-flush launch into the steady-state number would measure a
+    configuration no node runs."""
+    import gc
+    import time as _time
+
+    from corda_tpu.core import serialization as ser
+    from corda_tpu.flows.api import FlowFuture
+    from corda_tpu.node.notary import (
+        InMemoryUniquenessProvider,
+        _PendingNotarisation,
+    )
+    from corda_tpu.utils.health import (
+        HealthMonitor,
+        HealthPolicy,
+        notary_canary_fn,
+    )
+
+    tile = max(1, int(os.environ.get("BENCH_TILE", "8")))
+    svc, requester, blobs = _trace_fixture(min(tile, batch), batch, cpu=True)
+    spends = [ser.decode(b) for b in blobs]
+    reps = max(2, iters)
+
+    def run_once(monitor) -> float:
+        svc.attach_health(monitor)   # None detaches (the OFF side)
+        svc.uniqueness = InMemoryUniquenessProvider()
+        futs = []
+        t0 = _time.perf_counter()
+        for stx in spends:
+            fut = FlowFuture()
+            futs.append(fut)
+            svc._pending.append(
+                _PendingNotarisation(stx, requester, fut)
+            )
+        svc.tick()                   # flush + heartbeat
+        if monitor is not None:
+            monitor.tick()           # watchdog + rules + canary launch
+        wall = _time.perf_counter() - t0
+        if monitor is not None and svc._pending:
+            # serve the just-launched canary OUTSIDE the timed window:
+            # left pending, the NEXT (baseline) rep would flush it
+            # inside ITS timing and understate the measured overhead
+            svc.tick()
+        for fut in futs:
+            sig = fut.result()
+            if not hasattr(sig, "by"):
+                raise SystemExit(f"health metric notarisation failed: {sig}")
+        return wall
+
+    monitor = HealthMonitor(
+        policy=HealthPolicy(
+            # one canary launch total: the round-trip proof below; the
+            # timed reps then measure the per-tick plane only
+            canary_interval_micros=3_600_000_000,
+            # a slow CPU flush between ticks is not a stall: the bench
+            # measures overhead, the watchdog soak lives in
+            # tests/test_health.py on a TestClock
+            heartbeat_deadline_micros=600_000_000,
+            canary_deadman_micros=3_600_000_000,
+        )
+    )
+    # the canary is the NOTARY's own synthetic traffic: its command
+    # signer must be a key the serving hub holds (svc.identity), not
+    # the remote requester's
+    monitor.attach_canary(notary_canary_fn(svc.services, svc.identity))
+    # canary round-trip proof, untimed: launch + one real flush
+    svc.attach_health(monitor)
+    monitor.tick()
+    svc.tick()
+    if monitor.canary.completed < 1:
+        raise SystemExit(
+            "health metric: no canary round trip completed through the "
+            "real flush path"
+        )
+    run_once(None)                   # warm-up both sides
+    run_once(monitor)
+    walls_off, walls_on = [], []
+    for _ in range(reps):            # interleaved A/B: drift cancels
+        gc.collect()                 # equalise collector debt per rep
+        walls_off.append(run_once(None))
+        gc.collect()
+        walls_on.append(run_once(monitor))
+    svc.attach_health(None)
+    overhead = min(walls_on) / min(walls_off) - 1.0
+    canary = monitor.canary
+    # the canary never touches the real uniqueness namespace: zero
+    # inputs -> vacuous commit, so the final pass's provider holds
+    # exactly the measured spends' (tiled fixture: unique) input refs
+    # and nothing else
+    expected_refs = len(
+        {ref for stx in spends for ref in stx.wtx.inputs}
+    )
+    if len(svc.uniqueness.committed) != expected_refs:
+        raise SystemExit(
+            f"uniqueness map holds {len(svc.uniqueness.committed)} refs, "
+            f"expected {expected_refs} — the canary (or something else) "
+            "leaked in"
+        )
+    ok, _detail = monitor.healthz()
+    return {
+        "metric": "health_plane_overhead",
+        "value": round(max(overhead, 0.0), 4),
+        "unit": "fractional flush-wall overhead of the health plane",
+        "vs_baseline": round(max(overhead, 0.0), 4),
+        "overhead_raw": round(overhead, 4),
+        "batch": batch,
+        "reps": reps,
+        "canary_completed": canary.completed,
+        "canary_latency_ms": round(
+            (canary.last_latency_micros or 0) / 1e3, 3
+        ),
+        "healthy": ok,
+        "alerts_firing": monitor.alerts_firing(),
+    }
+
+
 def _montmul_metric(batch: int, iters: int) -> dict:
     """Interleaved device-resident A/B of the two variable x variable
     Montgomery-multiply formulations (round-3 MXU experiment, VERDICT
@@ -1068,6 +1203,21 @@ def _run_metric(metric: str, batch: int, iters: int) -> dict:
         # Weak #3 — the cap must be visible in the record, not prose)
         if batch > 16384:
             out["batch_requested"] = batch
+            # the cap BINDS: the record measured a shallower flush
+            # than requested. depth_saturation < 1 makes the clamp
+            # attributable inside the record (BENCH_r05 read 16384 vs
+            # 32768 with nothing flagging it) and the stderr line
+            # flags it in the capture.
+            out["depth_saturation"] = round(16384 / batch, 3)
+            print(
+                f"bench: notary flush depth capped at 16384 of the "
+                f"{batch} requested (depth_saturation="
+                f"{out['depth_saturation']}) — the measured rate is a "
+                f"16384-deep flush, not a {batch}-deep one",
+                file=sys.stderr,
+            )
+        else:
+            out["depth_saturation"] = 1.0
         return out
     if metric == "montmul":
         return _montmul_metric(min(batch, 8192), iters)
@@ -1091,6 +1241,11 @@ def _run_metric(metric: str, batch: int, iters: int) -> dict:
     if metric == "qos":
         out = _qos_metric(min(batch, 256), iters)
         if batch > 256:
+            out["batch_requested"] = batch   # cap visible in the record
+        return out
+    if metric == "health":
+        out = _health_metric(min(batch, 512), iters)
+        if batch > 512:
             out["batch_requested"] = batch   # cap visible in the record
         return out
     if metric == "parity":
@@ -1132,11 +1287,11 @@ def _run_child(m: str, env: dict, timeout: float) -> bool:
 
 
 def _quick(metric: str) -> None:
-    """`python bench.py --quick ingest|trace|qos`: tiny, CPU-safe smoke
-    runs so tier-1 (JAX_PLATFORMS=cpu, no device) can assert the perf
-    plumbing emits well-formed records without paying a real
-    measurement. Values from this mode are NOT comparable to the
-    default run's.
+    """`python bench.py --quick ingest|trace|qos|health`: tiny,
+    CPU-safe smoke runs so tier-1 (JAX_PLATFORMS=cpu, no device) can
+    assert the perf plumbing emits well-formed records without paying
+    a real measurement. Values from this mode are NOT comparable to
+    the default run's.
 
       ingest — serial + pipelined ingest metric lines (PR 1).
       trace  — the full hot path with tracing ON: asserts the stage
@@ -1147,7 +1302,33 @@ def _quick(metric: str) -> None:
                on vs off: asserts the plane engaged (sheds happened
                and were counted) and goodput held a healthy fraction
                of the no-overload capacity.
+      health — the health-plane A/B on the notary CPU rig: asserts
+               steady-state overhead <= BENCH_HEALTH_OVERHEAD_MAX
+               (default 2%), that a canary round trip completed
+               through the real flush, and that the plane reads
+               healthy at the end.
     """
+    if metric == "health":
+        batch = int(os.environ.get("BENCH_BATCH", "32"))
+        iters = int(os.environ.get("BENCH_ITERS", "3"))
+        out = _health_metric(batch, iters)
+        out["quick"] = True
+        print(json.dumps(out), flush=True)
+        max_overhead = float(
+            os.environ.get("BENCH_HEALTH_OVERHEAD_MAX", "0.02")
+        )
+        if out["value"] > max_overhead:
+            raise SystemExit(
+                f"health plane overhead {out['value']:.4f} exceeds "
+                f"{max_overhead:.0%} of the flush wall"
+            )
+        if out["canary_completed"] < 1:
+            raise SystemExit("no canary round trip completed")
+        if not out["healthy"]:
+            raise SystemExit(
+                "health plane reads unhealthy on a healthy rig"
+            )
+        return
     if metric == "qos":
         batch = int(os.environ.get("BENCH_BATCH", "24"))
         out = _qos_metric(batch, int(os.environ.get("BENCH_ITERS", "2")))
@@ -1194,7 +1375,8 @@ def _quick(metric: str) -> None:
         return
     if metric != "ingest":
         raise SystemExit(
-            f"--quick supports 'ingest', 'trace' or 'qos', not {metric!r}"
+            f"--quick supports 'ingest', 'trace', 'qos' or 'health', "
+            f"not {metric!r}"
         )
     batch = int(os.environ.get("BENCH_BATCH", "256"))
     iters = int(os.environ.get("BENCH_ITERS", "1"))
@@ -1213,7 +1395,8 @@ def main() -> None:
         return
     if argv:
         raise SystemExit(
-            f"unknown arguments {argv!r} (try --quick ingest|trace|qos)"
+            f"unknown arguments {argv!r} "
+            "(try --quick ingest|trace|qos|health)"
         )
     t_start = time.perf_counter()
     # On a remote-attached TPU the host<->device link latency (~50-100
@@ -1225,7 +1408,7 @@ def main() -> None:
     metric = os.environ.get("BENCH_METRIC", "all")
     known = (
         "all", "p256", "mixed", "merkle", "notary", "ingest",
-        "ingest_pipelined", "trace", "qos", "montmul", "parity",
+        "ingest_pipelined", "trace", "qos", "health", "montmul", "parity",
     )
     if metric not in known:
         # a typo must not record a p256-only rate under another name
@@ -1264,7 +1447,7 @@ def main() -> None:
     # parity runs LAST of the optional work (cheapest to drop), but
     # before the headline so the headline stays the final stdout line
     for m in ("mixed", "merkle", "notary", "ingest", "ingest_pipelined",
-              "trace", "qos", "parity"):
+              "trace", "qos", "health", "parity"):
         avail = left() - reserve
         if avail < 60:
             print(
@@ -1276,7 +1459,7 @@ def main() -> None:
         env = dict(os.environ, BENCH_METRIC=m)
         if avail < 300 and m in (
             "mixed", "merkle", "notary", "ingest", "ingest_pipelined",
-            "trace", "qos",
+            "trace", "qos", "health",
         ):
             # trim before dropping: one timed rep at a shallower batch
             # still yields a usable point for the table

@@ -6,12 +6,21 @@ node via RPC and exposes CorDapp REST APIs + static content. Here the
 stdlib HTTP server exposes the node surface as JSON (client/jackson's
 mapping), one gateway process (or thread) per node.
 
+  GET  /                           endpoint index (what is mounted here)
   GET  /api/status                 identity + clock
   GET  /api/network                network map snapshot
   GET  /api/notaries               notary identities
   GET  /api/vault[?contract=Tag]   unconsumed states
   GET  /api/flows                  registered responder protocols
   POST /api/flows/<FlowClass>      start a flow; JSON body = kwargs
+
+Operational endpoints (wired per gateway): /metrics (Prometheus text),
+/traces (flight recorder), /qos (overload control plane), /healthz
+(orchestrator liveness, 200/503 from watchdog state), /health (full
+health-plane JSON), /cluster (fleet-wide health rollup). Every
+response carries an explicit Content-Type — text/plain for /metrics,
+application/json everywhere else — and unknown paths (any method) get
+a JSON 404 body, never the http.server default stub.
 """
 
 from __future__ import annotations
@@ -105,6 +114,8 @@ class NodeWebServer:
         metrics=None,
         tracer=None,
         qos=None,
+        health=None,
+        cluster=None,
     ):
         """`metrics`: an optional MetricRegistry served at GET /metrics
         in prometheus exposition format (the reference exports
@@ -119,14 +130,54 @@ class NodeWebServer:
         state (adaptive-controller knobs + admitted p99, brownout
         level, Qos.Shed.* counts, lane depths, admission gate) is
         served as JSON at GET /qos — the operator's overload view next
-        to /metrics and /traces."""
+        to /metrics and /traces.
+
+        `health`: an optional utils/health.HealthMonitor — GET /healthz
+        answers 200/503 from live watchdog state (the orchestrator
+        liveness probe) and GET /health serves the full health-plane
+        JSON (heartbeats, alerts with evidence, canary, event-log
+        tail; `?summary=1` for the condensed per-peer form).
+
+        `cluster`: an optional utils/health.ClusterHealth — GET
+        /cluster serves the fleet-wide rollup (per-node summaries,
+        worst-state, stale marking for unreachable peers)."""
         self.client = client
         self.pump = pump
         self.rpc_timeout = rpc_timeout
         self.metrics = metrics
         self.tracer = tracer
         self.qos = qos
+        self.health = health
+        self.cluster = cluster
         self._lock = threading.Lock()   # one RPC conversation at a time
+        # the operational surface: path -> (description, handler(query)
+        # -> (status, content_type, payload bytes)). ONE table drives
+        # dispatch AND the GET / index, so the index can never drift
+        # from what is actually mounted.
+        self._ops = {
+            "/": ("endpoint index", self._serve_index),
+            "/metrics": (
+                "Prometheus text metrics", self._serve_metrics,
+            ),
+            "/traces": (
+                "flight recorder (chrome://tracing JSON + stage "
+                "summary)", self._serve_traces,
+            ),
+            "/qos": ("QoS control-plane state", self._serve_qos),
+            "/healthz": (
+                "liveness probe: 200/503 from watchdog state",
+                self._serve_healthz,
+            ),
+            "/health": (
+                "full health plane: heartbeats, alerts, canary, "
+                "event log (?summary=1 for the condensed form)",
+                self._serve_health,
+            ),
+            "/cluster": (
+                "fleet-wide health rollup over the network-map peers",
+                self._serve_cluster,
+            ),
+        }
         gateway = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -139,6 +190,15 @@ class NodeWebServer:
             def do_POST(self):
                 gateway._handle(self, "POST")
 
+            def do_PUT(self):
+                gateway._reject_method(self, "PUT")
+
+            def do_DELETE(self):
+                gateway._reject_method(self, "DELETE")
+
+            def do_PATCH(self):
+                gateway._reject_method(self, "PATCH")
+
         self._server = ThreadingHTTPServer((host, port), Handler)
         self.port = self._server.server_address[1]
         self._thread: Optional[threading.Thread] = None
@@ -146,26 +206,165 @@ class NodeWebServer:
     # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> "NodeWebServer":
-        self._thread = threading.Thread(
-            target=self._server.serve_forever, daemon=True, name="webserver"
-        )
-        self._thread.start()
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._server.serve_forever, daemon=True,
+                name="webserver",
+            )
+            self._thread.start()
         return self
 
     def stop(self) -> None:
-        self._server.shutdown()
+        # safe on a bound-but-never-started gateway (the node binds
+        # early to learn its port, serves only once fully booted):
+        # shutdown() would block forever waiting for a serve_forever
+        # loop that never ran
         if self._thread is not None:
+            self._server.shutdown()
             self._thread.join(timeout=5)
+            self._thread = None
+        self._server.server_close()
 
     # -- RPC plumbing --------------------------------------------------------
 
     def _wait(self, fut):
         return wait_rpc(fut, self.pump, self.rpc_timeout)
 
+    # -- response plumbing ---------------------------------------------------
+
+    @staticmethod
+    def _send(req, status: int, ctype: str, payload: bytes) -> None:
+        req.send_response(status)
+        req.send_header("Content-Type", ctype)
+        req.send_header("Content-Length", str(len(payload)))
+        req.end_headers()
+        req.wfile.write(payload)
+
+    @staticmethod
+    def _json(status: int, body) -> tuple[int, str, bytes]:
+        return status, "application/json", json.dumps(body).encode()
+
+    def _reject_method(self, req, method: str) -> None:
+        self._send(
+            req, 405, "application/json",
+            json.dumps(
+                {"error": f"method {method} not supported "
+                          f"for {urlparse(req.path).path}"}
+            ).encode(),
+        )
+
+    # -- the operational surface (served without the RPC lock) --------------
+
+    def _serve_index(self, query) -> tuple[int, str, bytes]:
+        wired = {
+            "/metrics": self.metrics, "/traces": self.tracer,
+            "/qos": self.qos, "/healthz": self.health,
+            "/health": self.health, "/cluster": self.cluster,
+        }
+        return self._json(200, {
+            "endpoints": [
+                {
+                    "path": path,
+                    "description": desc,
+                    "enabled": (
+                        wired[path] is not None if path in wired else True
+                    ),
+                }
+                for path, (desc, _) in sorted(self._ops.items())
+            ],
+            "api": [
+                "/api/status", "/api/network", "/api/notaries",
+                "/api/vault", "/api/flows", "/api/plugins",
+            ],
+            "plugins": sorted(_WEB_PLUGINS),
+        })
+
+    def _serve_metrics(self, query) -> tuple[int, str, bytes]:
+        try:
+            text = (
+                self.metrics.to_prometheus()
+                if self.metrics is not None
+                else ""
+            )
+            status = 200 if self.metrics is not None else 404
+        except Exception as e:   # a bad gauge must yield a 500, not
+            text = f"# metrics rendering failed: {e}\n"   # a reset
+            status = 500
+        return status, "text/plain; version=0.0.4", text.encode()
+
+    def _serve_traces(self, query) -> tuple[int, str, bytes]:
+        # hot-path traces: the flight recorder's retained traces
+        # (N slowest + N most recent) as chrome://tracing-loadable
+        # JSON plus the per-stage latency summary — /metrics tells
+        # you THAT serving slowed, this tells you WHICH stage
+        try:
+            if self.tracer is not None:
+                # serialize INSIDE the guard: a non-JSON span
+                # attribute must yield the 500, not a half-written
+                # response (span attributes are caller-typed Any)
+                return self._json(200, self.tracer.export())
+            return self._json(
+                404, {"error": "tracing not wired on this gateway"}
+            )
+        except Exception as e:   # noqa: BLE001 - defensive render
+            return self._json(500, {"error": f"trace export failed: {e}"})
+
+    def _serve_qos(self, query) -> tuple[int, str, bytes]:
+        # the QoS control plane's live state: shed counters,
+        # adaptive-controller knobs vs target, brownout level,
+        # lane depths — /metrics tells you the node slowed, THIS
+        # tells you what the overload machinery is doing about it
+        try:
+            if self.qos is not None:
+                return self._json(200, self.qos.snapshot())
+            return self._json(
+                404,
+                {"enabled": False, "error": "qos not wired on this gateway"},
+            )
+        except Exception as e:   # noqa: BLE001 - defensive render
+            return self._json(500, {"error": f"qos snapshot failed: {e}"})
+
+    def _serve_healthz(self, query) -> tuple[int, str, bytes]:
+        # orchestrator liveness: judged LIVE against the watchdog (the
+        # pump that would have ticked the monitor may be the very
+        # thread that stalled), tiny payload, 200/503
+        try:
+            if self.health is None:
+                return self._json(
+                    404, {"error": "health plane not wired on this gateway"}
+                )
+            ok, detail = self.health.healthz()
+            return self._json(200 if ok else 503, detail)
+        except Exception as e:   # noqa: BLE001 - defensive render
+            return self._json(500, {"error": f"healthz failed: {e}"})
+
+    def _serve_health(self, query) -> tuple[int, str, bytes]:
+        try:
+            if self.health is None:
+                return self._json(
+                    404, {"error": "health plane not wired on this gateway"}
+                )
+            summary = query.get("summary", ["0"])[0] not in ("", "0")
+            return self._json(200, self.health.snapshot(summary=summary))
+        except Exception as e:   # noqa: BLE001 - defensive render
+            return self._json(500, {"error": f"health snapshot failed: {e}"})
+
+    def _serve_cluster(self, query) -> tuple[int, str, bytes]:
+        try:
+            if self.cluster is None:
+                return self._json(
+                    404,
+                    {"error": "cluster rollup not wired on this gateway"},
+                )
+            return self._json(200, self.cluster.snapshot())
+        except Exception as e:   # noqa: BLE001 - defensive render
+            return self._json(500, {"error": f"cluster rollup failed: {e}"})
+
     # -- dispatch ------------------------------------------------------------
 
     def _handle(self, req: BaseHTTPRequestHandler, method: str) -> None:
-        path = urlparse(req.path).path
+        url = urlparse(req.path)
+        path = url.path
         if method == "GET" and path.startswith("/web/"):
             # CorDapp static content: /web/<prefix>/<path>
             parts = [p for p in path.split("/") if p]
@@ -173,88 +372,16 @@ class NodeWebServer:
             if len(parts) >= 2 and parts[1] in _WEB_PLUGINS:
                 hit = _WEB_PLUGINS[parts[1]].static_for("/".join(parts[2:]))
             if hit is None:
-                payload = json.dumps({"error": f"no such content {path}"}).encode()
-                ctype, status = "application/json", 404
-            else:
-                ctype, payload = hit[0], hit[1]
-                status = 200
-            req.send_response(status)
-            req.send_header("Content-Type", ctype)
-            req.send_header("Content-Length", str(len(payload)))
-            req.end_headers()
-            req.wfile.write(payload)
-            return
-        if method == "GET" and urlparse(req.path).path == "/traces":
-            # hot-path traces: the flight recorder's retained traces
-            # (N slowest + N most recent) as chrome://tracing-loadable
-            # JSON plus the per-stage latency summary — /metrics tells
-            # you THAT serving slowed, this tells you WHICH stage
-            try:
-                if self.tracer is not None:
-                    # serialize INSIDE the guard: a non-JSON span
-                    # attribute must yield the 500, not a half-written
-                    # response (span attributes are caller-typed Any)
-                    payload = json.dumps(self.tracer.export()).encode()
-                    status = 200
-                else:
-                    payload = json.dumps(
-                        {"error": "tracing not wired on this gateway"}
-                    ).encode()
-                    status = 404
-            except Exception as e:   # noqa: BLE001 - defensive render
-                payload = json.dumps(
-                    {"error": f"trace export failed: {e}"}
-                ).encode()
-                status = 500
-            req.send_response(status)
-            req.send_header("Content-Type", "application/json")
-            req.send_header("Content-Length", str(len(payload)))
-            req.end_headers()
-            req.wfile.write(payload)
-            return
-        if method == "GET" and urlparse(req.path).path == "/qos":
-            # the QoS control plane's live state: shed counters,
-            # adaptive-controller knobs vs target, brownout level,
-            # lane depths — /metrics tells you the node slowed, THIS
-            # tells you what the overload machinery is doing about it
-            try:
-                if self.qos is not None:
-                    payload = json.dumps(self.qos.snapshot()).encode()
-                    status = 200
-                else:
-                    payload = json.dumps(
-                        {"enabled": False,
-                         "error": "qos not wired on this gateway"}
-                    ).encode()
-                    status = 404
-            except Exception as e:   # noqa: BLE001 - defensive render
-                payload = json.dumps(
-                    {"error": f"qos snapshot failed: {e}"}
-                ).encode()
-                status = 500
-            req.send_response(status)
-            req.send_header("Content-Type", "application/json")
-            req.send_header("Content-Length", str(len(payload)))
-            req.end_headers()
-            req.wfile.write(payload)
-            return
-        if method == "GET" and urlparse(req.path).path == "/metrics":
-            try:
-                text = (
-                    self.metrics.to_prometheus()
-                    if self.metrics is not None
-                    else ""
+                status, ctype, payload = self._json(
+                    404, {"error": f"no such content {path}"}
                 )
-                status = 200 if self.metrics is not None else 404
-            except Exception as e:   # a bad gauge must yield a 500, not
-                text = f"# metrics rendering failed: {e}\n"   # a reset
-                status = 500
-            payload = text.encode()
-            req.send_response(status)
-            req.send_header("Content-Type", "text/plain; version=0.0.4")
-            req.send_header("Content-Length", str(len(payload)))
-            req.end_headers()
-            req.wfile.write(payload)
+            else:
+                status, ctype, payload = 200, hit[0], hit[1]
+            self._send(req, status, ctype, payload)
+            return
+        if method == "GET" and path in self._ops:
+            status, ctype, payload = self._ops[path][1](parse_qs(url.query))
+            self._send(req, status, ctype, payload)
             return
         try:
             with self._lock:

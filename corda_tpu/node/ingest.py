@@ -581,18 +581,26 @@ class IngestPipeline:
         self,
         batches: Iterable[list],
         wrap: Optional[Callable[[list[IngestedTx]], Any]] = None,
+        heartbeat=None,
     ) -> threading.Thread:
         """Producer loop on its own thread: ingest each batch and
         `put` it on self.ring, BLOCKING when the ring is full — the
         backpressure path the notary flush drains
         (BatchingNotaryService.attach_ingest). `wrap` maps each entry
-        batch before the put (e.g. to _PendingNotarisation lists)."""
+        batch before the put (e.g. to _PendingNotarisation lists).
+
+        `heartbeat`: an optional utils/health.Heartbeat beaten once
+        per produced batch (progress = frames ingested), so a wedged
+        decode pool — or a feed thread parked forever on a full ring
+        nobody drains — trips the health plane's watchdog."""
 
         def run() -> None:
             for entries in self.pipeline(batches):
                 item = wrap(entries) if wrap is not None else entries
                 if not self.ring.put(item):
                     break   # ring closed: consumer shut down
+                if heartbeat is not None:
+                    heartbeat.beat(progress=len(entries))
 
         t = threading.Thread(target=run, daemon=True)
         t.start()

@@ -223,6 +223,7 @@ class Node:
         # node's scrape surface. The tracer is the process default:
         # disabled unless CORDA_TPU_TRACE=1 (utils/tracing.py).
         from ..utils import tracing
+        from ..utils.health import ClusterHealth, HealthMonitor
         from ..utils.metrics import MetricRegistry
 
         self.metrics = MetricRegistry()
@@ -230,6 +231,28 @@ class Node:
         # QoS plane (node/qos.py): installed with the batching notary
         # when config.qos_enabled; None keeps every hot path unchanged
         self.qos = None
+        # health plane (utils/health.py): watchdog over every long-
+        # lived loop, SLO/shed/ring alert rules, the canary probe and
+        # the JSON-lines event log — served at GET /healthz + /health,
+        # rolled up fleet-wide at GET /cluster. Created BEFORE the
+        # notary so the flush loop can register its heartbeat.
+        self.health = HealthMonitor(
+            clock=self.services.clock,
+            metrics=self.metrics,
+            tracer=self.tracer,
+            event_log_path=os.path.join(
+                config.base_dir, "health_events.jsonl"
+            ),
+        )
+        self._hb_pump = self.health.heartbeat("messaging.pump")
+        self._hb_raft = self._hb_bft = None
+        self._canary_fn = None
+        self.cluster_health = ClusterHealth(
+            config.name,
+            lambda: self.health.snapshot(summary=True),
+            self._health_peer_urls,
+            clock_fn=self.services.clock.now_micros,
+        )
 
         # -- flows, notary, scheduler ----------------------------------
         # @corda_service instances from the imported cordapps, before
@@ -402,6 +425,35 @@ class Node:
         )
         return rows[0][0]
 
+    # -- health plane ---------------------------------------------------------
+
+    def _health_peer_urls(self) -> dict:
+        """The cluster rollup's peer list: every network-map node that
+        advertises a web gateway (NodeInfo.web_port) answers
+        GET /health?summary=1 there."""
+        out: dict[str, str] = {}
+        for info in self.services.network_map_cache.all_nodes():
+            name = info.legal_identity.name
+            if name == self.config.name:
+                continue
+            if info.host and info.web_port:
+                out[name] = (
+                    f"http://{info.host}:{info.web_port}/health?summary=1"
+                )
+        return out
+
+    def _launch_canary(self, complete) -> None:
+        """One canary notarisation through the REAL flush path
+        (utils/health.py notary_canary_fn does the work; this indirection
+        exists so the probe always sees the CURRENT notary service)."""
+        from ..utils.health import notary_canary_fn
+
+        if self._canary_fn is None:
+            self._canary_fn = notary_canary_fn(
+                self.services, self.party, tracer=self.tracer
+            )
+        self._canary_fn(complete)
+
     # -- notary ---------------------------------------------------------------
 
     def _install_notary(self) -> None:
@@ -449,6 +501,13 @@ class Node:
                     metrics=self.metrics,
                     qos=self.qos,
                 )
+                # health plane over the serving path: the flush loop's
+                # heartbeat, the SLO burn-rate + shed-ratio rules (when
+                # QoS is on), and the canary probe riding real flushes
+                self.services.notary_service.attach_health(self.health)
+                if self.qos is not None:
+                    self.health.watch_qos(self.qos)
+                self.health.attach_canary(self._launch_canary)
                 return
             cls = {
                 "simple": SimpleNotaryService,
@@ -534,9 +593,28 @@ class Node:
         import dataclasses
 
         self.messaging.start()
+        # web gateway bound BEFORE the NodeInfo freezes (its port is
+        # advertised through the network map so peers can pull
+        # GET /health for the /cluster rollup) but not yet SERVING:
+        # answering /healthz during a slow boot (checkpoint restore,
+        # map registration) would feed an orchestrator 503s and
+        # restart-loop exactly the slow-starting nodes. A bind failure
+        # (port taken) must not strand a half-started node.
+        self.web = None
+        if self.config.web_port >= 0:
+            u = self.config.rpc_users[0]
+            try:
+                self.web = self._build_webserver(
+                    u.username, u.password, port=self.config.web_port
+                )
+            except Exception:
+                self.stop()
+                raise
         # the fabric bound its listen port; advertise the real one
         self.info = dataclasses.replace(
-            self.info, port=self.messaging.listen_port
+            self.info,
+            port=self.messaging.listen_port,
+            web_port=self.web.port if self.web is not None else None,
         )
         self.services.my_info = self.info
         self.services.network_map_cache.add_node(self.info)
@@ -568,19 +646,12 @@ class Node:
                 "restored %d checkpointed flows", restored
             )
         self.running = True
-        if self.config.web_port >= 0:
-            # gateway over the node's own RPC surface; the pump loop
-            # (run()) delivers, so the gateway only polls futures. A
-            # bind failure (port taken) must not strand a half-started
-            # node: tear everything down and surface the error
-            u = self.config.rpc_users[0]
-            try:
-                self.web = self.webserver(
-                    u.username, u.password, port=self.config.web_port
-                )
-            except Exception:
-                self.stop()
-                raise
+        if self.web is not None:
+            self.web.start()
+        # boot work (map registration, checkpoint restore) may exceed
+        # the watchdog deadline: the pump loop starts NOW, so its
+        # heartbeat clock does too
+        self._hb_pump.beat()
         return self
 
     def _tick_services(self) -> None:
@@ -592,13 +663,22 @@ class Node:
             # queued since the last pump shares one SPI dispatch
             notary.tick()
         if self.raft is not None:
+            if self._hb_raft is None:
+                self._hb_raft = self.health.heartbeat("raft.driver")
             self.raft.tick()
+            self._hb_raft.beat()
         if self.bft is not None:
+            if self._hb_bft is None:
+                self._hb_bft = self.health.heartbeat("bft.driver")
             self.bft.tick()
+            self._hb_bft.beat()
         if self.network_map_client is not None:
             # liveness heartbeat: periodic map re-registration keeps
             # the explorer's last-seen column meaningful
             self.network_map_client.tick()
+        # health plane last: the watchdog judges the beats this tick
+        # just made, the canary launches, alert rules walk their states
+        self.health.tick()
 
     def run(self) -> None:
         """The pump loop — the single server thread (Node.kt:344)."""
@@ -607,7 +687,8 @@ class Node:
         self._run_thread = threading.current_thread()
         try:
             while self.running:
-                self.messaging.pump(block=True, timeout=0.2)
+                n = self.messaging.pump(block=True, timeout=0.2)
+                self._hb_pump.beat(progress=n)
                 self._tick_services()
         finally:
             self._run_thread = None
@@ -615,6 +696,7 @@ class Node:
     def pump(self, timeout: float = 0.0) -> int:
         """One pump step (embedded/driver use)."""
         n = self.messaging.pump(block=timeout > 0, timeout=timeout)
+        self._hb_pump.beat(progress=n)
         self._tick_services()
         return n
 
@@ -666,10 +748,18 @@ class Node:
     def webserver(self, username: str, password: str, port: int = 0):
         """Embedded web gateway over the node's own RPC surface, with
         this node's MetricRegistry at /metrics, the flight recorder at
-        /traces and the QoS plane (when enabled) at /qos, plus the
-        ledger explorer UI at /web/explorer/. The node's pump loop
-        (run()) drives message delivery, so the gateway itself only
-        polls futures (pass a real pump when embedding without run())."""
+        /traces, the QoS plane (when enabled) at /qos, the health
+        plane at /healthz + /health, the fleet rollup at /cluster,
+        plus the ledger explorer UI at /web/explorer/. The node's pump
+        loop (run()) drives message delivery, so the gateway itself
+        only polls futures (pass a real pump when embedding without
+        run())."""
+        return self._build_webserver(username, password, port).start()
+
+    def _build_webserver(self, username: str, password: str, port: int = 0):
+        """Bind the gateway without serving yet — start() begins the
+        accept loop once the node is fully booted (the bound port is
+        what NodeInfo.web_port advertises)."""
         import corda_tpu.tools.web_explorer  # noqa: F401 - /api/explorer
 
         from ..client.webserver import NodeWebServer
@@ -681,7 +771,9 @@ class Node:
             metrics=self.metrics,
             tracer=self.tracer,
             qos=self.qos,
-        ).start()
+            health=self.health,
+            cluster=self.cluster_health,
+        )
 
 
 def banner(config: NodeConfig) -> str:

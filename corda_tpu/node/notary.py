@@ -393,6 +393,7 @@ class BatchingNotaryService(NotaryService):
         self._pending: list[_PendingNotarisation] = []
         self._ingest_ring = None   # attach_ingest: pre-decoded arrivals
         self._oldest_arrival: Optional[int] = None
+        self._health_heartbeat = None   # attach_health: flush-loop liveness
         # registry-backed metrics (scrapeable at /metrics, unlike the
         # bare ints they replace): dispatches vs requests IS the
         # batching ratio, exported as its own gauge
@@ -544,6 +545,27 @@ class BatchingNotaryService(NotaryService):
 
         register_ring_gauges(self.metrics, "notary", ring)
 
+    def attach_health(self, monitor) -> None:
+        """Register this notary's flush loop on the health plane
+        (utils/health.py): a `notary.flush` heartbeat beaten every
+        tick, carrying requests answered as progress and the live
+        queue depth (pending + ingest ring) for livelock detection —
+        a flush loop that ticks forever while its queue sits full and
+        nothing resolves is wedged in a way the stall detector can't
+        see. Pass None to detach (bench A/B rigs)."""
+        if monitor is None:
+            self._health_heartbeat = None
+            return
+        self._health_heartbeat = monitor.heartbeat(
+            "notary.flush",
+            queue_depth=lambda: len(self._pending)
+            + (
+                len(self._ingest_ring)
+                if self._ingest_ring is not None
+                else 0
+            ),
+        )
+
     def _drain_ingest(self) -> None:
         ring = self._ingest_ring
         if ring is not None:
@@ -559,8 +581,11 @@ class BatchingNotaryService(NotaryService):
         has been reached yet. Returns requests answered (0 = held or
         quiescent)."""
         self._drain_ingest()
+        hb = self._health_heartbeat
         n = len(self._pending)
         if not n:
+            if hb is not None:
+                hb.beat()
             return 0
         if self.effective_wait_micros and n < self.effective_max_batch:
             age = (
@@ -568,8 +593,15 @@ class BatchingNotaryService(NotaryService):
                 - (self._oldest_arrival or 0)
             )
             if age < self.effective_wait_micros:
+                # held, not wedged: the loop is alive (beat), it just
+                # chose to wait — zero progress, which is exactly what
+                # livelock detection should see while a batch forms
+                if hb is not None:
+                    hb.beat()
                 return 0
         self.flush()
+        if hb is not None:
+            hb.beat(progress=n)
         return n
 
     def _mark(

@@ -320,6 +320,11 @@ class NodeInfo:
     # identity; notary-demo Raft/BFT clusters). Transactions name the
     # cluster party as their notary; any member answers for it.
     cluster_identity: Optional[Party] = None
+    # the node's web-gateway port (None = no gateway): how peers reach
+    # GET /health for the cluster-wide rollup (utils/health.py
+    # ClusterHealth) — advertised through the network map like the
+    # fabric port, never consensus input
+    web_port: Optional[int] = None
 
     @property
     def notary_identity(self) -> Party:

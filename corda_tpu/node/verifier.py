@@ -300,6 +300,12 @@ class VerifierWorker:
         #                            deadlines are minted on wall-clock
         #                            nodes); simulated-time rigs MUST pass
         #                            the TestClock that minted theirs
+        health=None,               # Optional[utils.health.HealthMonitor]:
+        #                            registers a `verifier.drain` heartbeat
+        #                            the drain loop beats (progress =
+        #                            requests answered, queue depth = ring
+        #                            + handler backlog) so a wedged drain
+        #                            thread trips the watchdog
     ):
         self._messaging = messaging
         self._verifier = batch_verifier or default_verifier()
@@ -335,6 +341,28 @@ class VerifierWorker:
                 # fabric has no ring seam: the handler path below still
                 # feeds the pipeline via self._raw
                 pass
+        self._heartbeat = None
+        if health is not None:
+            self._heartbeat = health.heartbeat(
+                "verifier.drain",
+                queue_depth=lambda: len(self._queue)
+                + len(self._raw)
+                + (len(self._ring) if self._ring is not None else 0),
+            )
+            if self._ring is not None:
+                # ring saturation / parked-frame growth alerting over
+                # the backpressure seam (the gauges made it visible on
+                # /metrics; this makes it PAGE)
+                parked = getattr(self._messaging, "parked_count", None)
+                health.watch_ring(
+                    msglib.TOPIC_VERIFIER_REQ,
+                    lambda: len(self._ring),
+                    self._ring.depth,
+                    parked_fn=(
+                        (lambda: parked(msglib.TOPIC_VERIFIER_REQ))
+                        if parked is not None else None
+                    ),
+                )
         messaging.add_handler(msglib.TOPIC_VERIFIER_REQ, self._on_request)
         # announce attachment so buffered requests flush to us; over TCP
         # the advertised address lets the node bridge back
@@ -410,6 +438,8 @@ class VerifierWorker:
             self._pull_ingested()
         pending, self._queue = self._queue, []
         if not pending:
+            if self._heartbeat is not None:
+                self._heartbeat.beat()
             return 0
         sig_reqs, spans = [], []
         for req in pending:
@@ -478,6 +508,8 @@ class VerifierWorker:
                 ser.encode(TxVerificationResponse(req.nonce, error)),
                 req.response_address,
             )
+        if self._heartbeat is not None:
+            self._heartbeat.beat(progress=len(pending))
         return len(pending)
 
 
@@ -552,6 +584,14 @@ def main(argv: Optional[list[str]] = None) -> None:
         if args.ingest_shards
         else None
     )
+    # the production worker watches itself: the drain heartbeat +
+    # ring rule live on a real HealthMonitor ticked by the pump loop,
+    # so a wedged drain is visible in-process (and on the worker's
+    # registry as Health.* gauges), not only when node-side futures
+    # start timing out
+    from ..utils.health import HealthMonitor
+
+    health = HealthMonitor()
     worker = VerifierWorker(
         ep,
         args.node,
@@ -559,11 +599,13 @@ def main(argv: Optional[list[str]] = None) -> None:
         batch_window=args.batch_window,
         advertised_address=("127.0.0.1", ep.listen_port),
         ingest=ingest,
+        health=health,
     )
     try:
         while True:
             ep.pump(block=True, timeout=1.0)
             worker.drain()
+            health.tick()
     except KeyboardInterrupt:
         pass
     finally:

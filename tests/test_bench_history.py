@@ -1,0 +1,153 @@
+"""tools/bench_history.py: the bench-trajectory diff + regression gate.
+
+BENCH_r05 shipped two headline metrics at 0.55x/0.34x of baseline with
+nothing in-repo flagging it; the CLI under test is that flag. Fixture
+records mirror the real driver capture shape: a JSON document whose
+`tail` text interleaves per-metric JSON lines with warning chatter.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from tools import bench_history as bh  # noqa: E402
+
+
+def _write_record(directory, filename, n, metric_lines):
+    doc = {
+        "n": n,
+        "cmd": "python bench.py",
+        "rc": 0,
+        "tail": "\n".join(
+            ["WARNING: Platform 'axon' is experimental"]
+            + metric_lines
+            + ["bench: headline link_rtt 104.99 ms — retrying once"]
+        ),
+    }
+    path = os.path.join(directory, filename)
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return path
+
+
+def _metric(name, value, vs=None, **extra):
+    rec = {"metric": name, "value": value, "unit": "x/s"}
+    if vs is not None:
+        rec["vs_baseline"] = vs
+    rec.update(extra)
+    return json.dumps(rec)
+
+
+@pytest.fixture
+def rounds(tmp_path):
+    _write_record(
+        tmp_path, "BENCH_r01.json", 1,
+        [
+            _metric("ecdsa_p256_verifies_per_sec_via_spi", 80_000.0, 1.6),
+            _metric("batching_notary_notarisations_per_sec", 40_000.0, 0.8),
+            _metric("wire_ingest_decode_id_stage_per_sec", 50_000.0, 1.0),
+        ],
+    )
+    _write_record(
+        tmp_path, "BENCH_r02.json", 2,
+        [
+            _metric("ecdsa_p256_verifies_per_sec_via_spi", 85_000.0, 1.7),
+            # the regression the gate exists for: -31%
+            _metric("batching_notary_notarisations_per_sec", 27_500.0, 0.55),
+            # a metric the newest round skipped (budget) stays non-fatal
+        ],
+    )
+    return str(tmp_path)
+
+
+def test_discovery_orders_by_round_number(rounds):
+    # a 2-digit round sorts after a 9 lexically only if ordered by the
+    # numeric key, not the string
+    _write_record(
+        rounds, "BENCH_r10.json", 10,
+        [_metric("ecdsa_p256_verifies_per_sec_via_spi", 90_000.0)],
+    )
+    names = [os.path.basename(p) for p in bh.discover(rounds)]
+    assert names == ["BENCH_r01.json", "BENCH_r02.json", "BENCH_r10.json"]
+
+
+def test_parse_record_skips_noise_and_keeps_last_line_per_metric(tmp_path):
+    path = _write_record(
+        tmp_path, "BENCH_r03.json", 3,
+        [
+            "not json at all",
+            _metric("m", 1.0),
+            '{"no_metric_key": true}',
+            _metric("m", 2.0),   # a retry reprinted the line: last wins
+        ],
+    )
+    parsed = bh.parse_record(path)
+    assert parsed == {
+        "m": {"metric": "m", "value": 2.0, "unit": "x/s"}
+    }
+
+
+def test_diff_reports_deltas_and_missing_metrics(rounds):
+    old, new = [bh.parse_record(p) for p in bh.discover(rounds)]
+    rows = {r["metric"]: r for r in bh.diff(old, new)}
+    assert rows["ecdsa_p256_verifies_per_sec_via_spi"]["delta_pct"] == 6.25
+    assert rows["batching_notary_notarisations_per_sec"]["delta_pct"] == (
+        -31.25
+    )
+    assert rows["batching_notary_notarisations_per_sec"]["vs_baseline"] == (
+        0.55
+    )
+    missing = rows["wire_ingest_decode_id_stage_per_sec"]
+    assert missing["new"] is None and missing["delta_pct"] is None
+
+
+def test_main_prints_diff_and_gate_verdicts(rounds, capsys):
+    assert bh.main(["--dir", rounds]) == 0
+    out = capsys.readouterr().out
+    assert "BENCH_r01.json -> BENCH_r02.json" in out
+    assert "batching_notary_notarisations_per_sec" in out
+    assert "-31.25%" in out
+
+    # gate wide enough: the -31% notary drop passes a 40% gate
+    assert bh.main(["--dir", rounds, "--gate", "40"]) == 0
+    # gate at 10%: the regression trips it, the missing metric doesn't
+    assert bh.main(["--dir", rounds, "--gate", "10"]) == 1
+    err = capsys.readouterr().err
+    assert "GATE batching_notary_notarisations_per_sec" in err
+    assert "wire_ingest" not in err
+
+
+def test_main_needs_two_records(tmp_path, capsys):
+    assert bh.main(["--dir", str(tmp_path)]) == 2
+    _write_record(tmp_path, "BENCH_r01.json", 1, [_metric("m", 1.0)])
+    assert bh.main(["--dir", str(tmp_path)]) == 2
+
+
+def test_all_walks_the_whole_trajectory(rounds, capsys):
+    _write_record(
+        rounds, "BENCH_r03.json", 3,
+        [_metric("ecdsa_p256_verifies_per_sec_via_spi", 88_000.0, 1.76)],
+    )
+    assert bh.main(["--dir", rounds, "--all"]) == 0
+    out = capsys.readouterr().out
+    assert "BENCH_r01.json -> BENCH_r02.json" in out
+    assert "BENCH_r02.json -> BENCH_r03.json" in out
+
+
+def test_real_repo_trajectory_parses():
+    """The committed BENCH_r*.json records (when present) parse and
+    diff without error — the fixture shape IS the driver's shape."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = bh.discover(repo)
+    if len(paths) < 2:
+        pytest.skip("no committed bench trajectory")
+    old, new = bh.parse_record(paths[-2]), bh.parse_record(paths[-1])
+    assert old and new, "committed records carry no metric lines?"
+    rows = bh.diff(old, new)
+    assert any(r["delta_pct"] is not None for r in rows)

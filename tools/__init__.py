@@ -1,0 +1,2 @@
+"""Repo-level operator tooling (bench trajectory analysis etc.) —
+distinct from corda_tpu.tools, which ships with the package."""

@@ -1,0 +1,188 @@
+"""Diff the bench trajectory: BENCH_r*.json records, metric by metric.
+
+The driver appends one BENCH_r<NN>.json per round — a capture record
+whose `tail` text carries the per-metric JSON lines bench.py printed
+(`{"metric": ..., "value": ..., "vs_baseline": ...}`). Nothing in-repo
+compares consecutive rounds, which is how BENCH_r05 shipped two
+headline metrics at 0.55x/0.34x of baseline with no flag anywhere.
+This CLI is that comparison:
+
+    python tools/bench_history.py                 # newest two rounds
+    python tools/bench_history.py --all           # full trajectory
+    python tools/bench_history.py --gate 10       # exit 1 on any
+                                                  # metric down >10%
+
+Per metric it prints old -> new value, the delta percent, and the
+newest vs_baseline; `--gate <pct>` turns a regression beyond the
+threshold into a non-zero exit so CI can hold the line. Metrics are
+throughput-shaped (higher is better) throughout the table; a metric
+missing from the newest round is reported but never gates (a trimmed
+or skipped secondary is a budget decision, not a regression).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Optional
+
+_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+def discover(directory: str) -> list[str]:
+    """BENCH_r*.json paths in round order (the numeric suffix; the
+    in-file `n` key wins when present and disagrees)."""
+    paths = glob.glob(os.path.join(directory, "BENCH_r*.json"))
+
+    def round_of(path: str) -> int:
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+            if isinstance(doc, dict) and isinstance(doc.get("n"), int):
+                return doc["n"]
+        except (OSError, ValueError):
+            pass
+        m = _ROUND_RE.search(os.path.basename(path))
+        return int(m.group(1)) if m else -1
+
+    return sorted(paths, key=round_of)
+
+
+def parse_record(path: str) -> dict[str, dict]:
+    """metric name -> the metric's JSON record, pulled from the capture
+    `tail` (bench.py prints one JSON object per line; later lines win,
+    matching how the driver's tail-line parser reads the capture).
+    Warnings and profile chatter interleave with the metric lines, so
+    anything that doesn't parse as a dict with a `metric` key is
+    skipped."""
+    with open(path) as f:
+        doc = json.load(f)
+    metrics: dict[str, dict] = {}
+    tail = doc.get("tail", "") if isinstance(doc, dict) else ""
+    for line in tail.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict) and "metric" in rec and "value" in rec:
+            metrics[rec["metric"]] = rec
+    # belt and braces: the driver's own parsed tail line
+    parsed = doc.get("parsed") if isinstance(doc, dict) else None
+    if isinstance(parsed, dict) and "metric" in parsed and "value" in parsed:
+        metrics.setdefault(parsed["metric"], parsed)
+    return metrics
+
+
+def diff(old: dict[str, dict], new: dict[str, dict]) -> list[dict]:
+    """One row per metric in either round, sorted by name:
+    {"metric", "old", "new", "delta_pct", "vs_baseline"} — delta_pct
+    is None when the metric is missing from one side."""
+    rows = []
+    for name in sorted(set(old) | set(new)):
+        o = old.get(name, {}).get("value")
+        n = new.get(name, {}).get("value")
+        delta: Optional[float] = None
+        if o is not None and n is not None and o != 0:
+            delta = round(100.0 * (n - o) / abs(o), 2)
+        rows.append({
+            "metric": name,
+            "old": o,
+            "new": n,
+            "delta_pct": delta,
+            "vs_baseline": new.get(name, {}).get("vs_baseline"),
+        })
+    return rows
+
+
+def format_rows(rows: list[dict], old_label: str, new_label: str) -> str:
+    out = [f"bench diff: {old_label} -> {new_label}"]
+    width = max([len(r["metric"]) for r in rows] or [6])
+    for r in rows:
+        o = "-" if r["old"] is None else f"{r['old']:g}"
+        n = "-" if r["new"] is None else f"{r['new']:g}"
+        d = (
+            "      " if r["delta_pct"] is None
+            else f"{r['delta_pct']:+7.2f}%"
+        )
+        vs = (
+            "" if r["vs_baseline"] is None
+            else f"  (vs_baseline {r['vs_baseline']:g})"
+        )
+        out.append(f"  {r['metric']:<{width}}  {o:>12} -> {n:>12}  {d}{vs}")
+    return "\n".join(out)
+
+
+def gate_failures(rows: list[dict], gate_pct: float) -> list[dict]:
+    """Rows regressing beyond the threshold (new < old by > gate_pct).
+    Missing-in-new metrics don't gate — bench trims/skips secondaries
+    under a tight budget, and that must not read as a regression."""
+    return [
+        r for r in rows
+        if r["delta_pct"] is not None and r["delta_pct"] < -gate_pct
+    ]
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        description="diff the two newest BENCH_r*.json records per metric"
+    )
+    p.add_argument(
+        "--dir",
+        default=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        help="directory holding BENCH_r*.json (default: the repo root)",
+    )
+    p.add_argument(
+        "--all",
+        action="store_true",
+        help="print every consecutive pair in the trajectory, not just "
+        "the newest two",
+    )
+    p.add_argument(
+        "--gate",
+        type=float,
+        default=None,
+        metavar="PCT",
+        help="exit non-zero when any metric in the newest diff dropped "
+        "more than PCT percent",
+    )
+    args = p.parse_args(argv)
+
+    paths = discover(args.dir)
+    if len(paths) < 2:
+        print(
+            f"bench_history: need at least two BENCH_r*.json under "
+            f"{args.dir}, found {len(paths)}",
+            file=sys.stderr,
+        )
+        return 2
+    records = [(os.path.basename(p_), parse_record(p_)) for p_ in paths]
+    pairs = (
+        list(zip(records, records[1:])) if args.all
+        else [(records[-2], records[-1])]
+    )
+    newest_rows: list[dict] = []
+    for (old_label, old), (new_label, new) in pairs:
+        newest_rows = diff(old, new)
+        print(format_rows(newest_rows, old_label, new_label))
+    if args.gate is not None:
+        bad = gate_failures(newest_rows, args.gate)
+        if bad:
+            for r in bad:
+                print(
+                    f"bench_history: GATE {r['metric']} regressed "
+                    f"{r['delta_pct']}% (> {args.gate}% allowed)",
+                    file=sys.stderr,
+                )
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
