@@ -150,9 +150,18 @@ class TpuBatchVerifier(BatchSignatureVerifier):
         batch_sizes: tuple[int, ...] = (128, 1024, 4096),
         mesh: Optional[object] = None,
         donate: bool = True,
+        device: Optional[object] = None,
     ):
+        """`device` pins every dispatch to ONE jax device (the sharded
+        notary's per-device verify path: shard k's whole batch lands on
+        device k instead of data-parallel-sharding one batch over the
+        mesh). Mutually exclusive with `mesh` — a pinned verifier runs
+        the unsharded single-device program on its device."""
+        if device is not None and mesh is not None:
+            raise ValueError("device= and mesh= are mutually exclusive")
         self.batch_sizes = tuple(sorted(batch_sizes))
         self.mesh = mesh
+        self.device = device
         self._cpu = CpuBatchVerifier()
         self._kernels = {}
         del donate  # reserved
@@ -279,6 +288,16 @@ class TpuBatchVerifier(BatchSignatureVerifier):
                     )
                     for k, v in staged.items()
                 }
+            elif self.device is not None:
+                # per-device dispatch (sharded notary): commit the
+                # operands to THIS verifier's device so the jitted
+                # program executes there — N shard pipelines then keep
+                # N chips busy concurrently instead of queueing on the
+                # default device
+                staged = {
+                    k: jax.device_put(v, self.device)
+                    for k, v in staged.items()
+                }
             # TraceAnnotation (null context off-jax-profiler): names
             # this kernel launch in an XLA profiler capture so the
             # host-side dispatch spans line up with device timelines
@@ -402,6 +421,36 @@ SCHEME_KERNELS = frozenset(
         schemes.EDDSA_ED25519_SHA512,
     }
 )
+
+
+def per_shard_verifiers(
+    n_shards: int,
+    batch_sizes: tuple[int, ...] = (128, 1024, 4096),
+    devices: Optional[Sequence] = None,
+) -> list[TpuBatchVerifier]:
+    """One device-pinned TpuBatchVerifier per commit-plane shard
+    (notary.py BatchingNotaryService shard_verifiers=): shard k pins to
+    device k mod len(devices), so N shard flush pipelines drive N chips
+    concurrently — the per-device half of the round-6 sharded notary.
+    With ONE device every shard shares it (dispatches still interleave
+    usefully: shard k+1's staging overlaps shard k's device compute).
+    Compiled programs are shared across the verifiers per (scheme,
+    batch) via the persistent compile cache, so N shards do not pay N
+    cold compiles."""
+    if devices is None:
+        devices = jax.devices()
+    if not devices:
+        raise RuntimeError("no jax devices for per-shard verifiers")
+    out = []
+    for k in range(max(1, n_shards)):
+        dev = devices[k % len(devices)]
+        out.append(
+            TpuBatchVerifier(
+                batch_sizes=batch_sizes,
+                device=dev if len(devices) > 1 else None,
+            )
+        )
+    return out
 
 
 _default: Optional[BatchSignatureVerifier] = None

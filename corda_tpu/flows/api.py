@@ -19,6 +19,7 @@ price of not having a fiber serializer.
 from __future__ import annotations
 
 import inspect
+import threading
 from dataclasses import dataclass
 from typing import Any, Callable, Optional, Type
 
@@ -97,27 +98,38 @@ class _WaitFuture:
 class FlowFuture:
     """Completable future resolved on the node's pump thread (services
     that finish later — Raft quorum, worker pools — hand these to
-    flows; CordaFuture's role in the reference)."""
+    flows; CordaFuture's role in the reference). Registration and
+    resolution are lock-protected: the sharded notary's worker threads
+    add done-callbacks (qos latency, span end) while the pump thread
+    resolves, and an unlocked append racing the callback swap would
+    silently drop the callback."""
 
     def __init__(self):
         self.done = False
         self._value: Any = None
         self._exc: Optional[BaseException] = None
         self._callbacks: list[Callable[["FlowFuture"], None]] = []
+        self._lock = threading.Lock()
 
     def set_result(self, value: Any) -> None:
-        if self.done:
-            return
-        self.done = True
-        self._value = value
-        self._fire()
+        with self._lock:
+            if self.done:
+                return
+            self.done = True
+            self._value = value
+            cbs, self._callbacks = self._callbacks, []
+        for cb in cbs:
+            cb(self)
 
     def set_exception(self, exc: BaseException) -> None:
-        if self.done:
-            return
-        self.done = True
-        self._exc = exc
-        self._fire()
+        with self._lock:
+            if self.done:
+                return
+            self.done = True
+            self._exc = exc
+            cbs, self._callbacks = self._callbacks, []
+        for cb in cbs:
+            cb(self)
 
     def result(self) -> Any:
         if not self.done:
@@ -127,15 +139,11 @@ class FlowFuture:
         return self._value
 
     def add_done_callback(self, cb: Callable[["FlowFuture"], None]) -> None:
-        if self.done:
-            cb(self)
-        else:
-            self._callbacks.append(cb)
-
-    def _fire(self) -> None:
-        cbs, self._callbacks = self._callbacks, []
-        for cb in cbs:
-            cb(self)
+        with self._lock:
+            if not self.done:
+                self._callbacks.append(cb)
+                return
+        cb(self)
 
 
 def wait_future(future: FlowFuture):

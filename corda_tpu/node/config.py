@@ -67,6 +67,18 @@ class NodeConfig:
     # long (or the batch fills), trading bounded latency for deeper —
     # faster — flushes (notary.py BatchingNotaryService)
     notary_batch_wait_micros: int = 0
+    # sharded commit plane (batching notary only): partition the
+    # uniqueness namespace by state-ref prefix into this many shards,
+    # each with its own bounded pending queue, flush pipeline,
+    # partition table and (devices permitting) device-pinned verify
+    # dispatch. 0/1 = the classic single-queue plane. A count change is
+    # a safe boot-time migration (rows re-route into the new partition
+    # tables).
+    notary_shards: int = 0
+    # give every shard a dedicated flush worker thread (the pump then
+    # only routes and resolves answers); False flushes shards from the
+    # pump tick as a dispatch-all-then-consume wave
+    notary_shard_workers: bool = False
     # QoS / overload control for the batching notary (node/qos.py):
     # enabled, the notary gets deadline shedding, a per-client
     # admission gate on the request path, the adaptive batching
@@ -151,6 +163,17 @@ class NodeConfig:
             raise ConfigError(
                 "qos_enabled requires notary = 'batching' (the QoS "
                 "plane steers the batching notary's flush)"
+            )
+        if self.notary_shards < 0:
+            raise ConfigError("notary_shards must be >= 0")
+        if self.notary_shards > 1 and self.notary != "batching":
+            raise ConfigError(
+                "notary_shards requires notary = 'batching' (only the "
+                "batching notary has a sharded commit plane)"
+            )
+        if self.notary_shard_workers and self.notary_shards <= 1:
+            raise ConfigError(
+                "notary_shard_workers requires notary_shards > 1"
             )
 
     @property
@@ -243,6 +266,10 @@ def write_config(cfg: NodeConfig, path: str) -> None:
     emit("notary", cfg.notary)
     if cfg.notary_batch_wait_micros:
         emit("notary_batch_wait_micros", cfg.notary_batch_wait_micros)
+    if cfg.notary_shards:
+        emit("notary_shards", cfg.notary_shards)
+        if cfg.notary_shard_workers:
+            emit("notary_shard_workers", cfg.notary_shard_workers)
     if cfg.qos_enabled:
         emit("qos_enabled", cfg.qos_enabled)
         emit("qos_target_p99_micros", cfg.qos_target_p99_micros)

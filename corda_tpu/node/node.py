@@ -463,8 +463,66 @@ class Node:
         if kind == "":
             return
         if kind in ("simple", "validating", "batching"):
-            uniqueness = PersistentUniquenessProvider(self.db)
+            # sharded commit plane (round 6): >1 shard — or a node
+            # whose DB already migrated to partition tables (the
+            # layout is STICKY: once rows live in notary_commits_s<k>,
+            # EVERY notary kind must read the partitions — a revert to
+            # the legacy provider would consult the emptied legacy
+            # table and silently accept double-spends of already
+            # consumed states)
+            from .persistence import ShardedPersistentUniquenessProvider
+
+            shards = self.config.notary_shards
+            stored = PersistentKVStore(
+                self.db, ShardedPersistentUniquenessProvider._META_SPACE
+            ).get(b"shards")
+            if kind == "batching" and shards > 1:
+                pass                           # explicit sharded plane
+            elif stored is not None:
+                if kind == "batching" and shards >= 1:
+                    # an explicit count on a partitioned DB is a retune
+                    # — 1 included, which migrates the rows back DOWN
+                    # into a single partition
+                    shards = max(shards, 1)
+                else:
+                    # unset (0) or a non-batching kind: keep the stored
+                    # partition count — re-partitioning every boot
+                    # would churn the rows for nothing, and reading the
+                    # emptied legacy table instead would silently
+                    # accept double-spends
+                    shards = max(int.from_bytes(stored, "big"), 1)
+            else:
+                shards = 0                     # classic legacy layout
+            if shards:
+                uniqueness = ShardedPersistentUniquenessProvider(
+                    self.db, shards
+                )
+            else:
+                uniqueness = PersistentUniquenessProvider(self.db)
             if kind == "batching":
+                shard_verifiers = None
+                if (
+                    shards > 1
+                    and self.config.verifier_backend != "cpu"
+                ):
+                    # per-device verify dispatch — only worth building
+                    # when this process actually sees several devices
+                    # (N unpinned copies on one chip would just pay N
+                    # jit caches for the same dispatch queue)
+                    try:
+                        import jax
+
+                        from ..crypto.batch_verifier import (
+                            per_shard_verifiers,
+                        )
+
+                        devices = jax.devices()
+                        if len(devices) > 1:
+                            shard_verifiers = per_shard_verifiers(
+                                shards, devices=devices
+                            )
+                    except Exception:
+                        shard_verifiers = None   # shared SPI verifier
                 if self.config.qos_enabled:
                     # SLO plane for the serving path: deadline shedding,
                     # priority lanes, admission gating and the adaptive
@@ -500,6 +558,9 @@ class Node:
                     max_wait_micros=self.config.notary_batch_wait_micros,
                     metrics=self.metrics,
                     qos=self.qos,
+                    shards=max(shards, 1),
+                    shard_workers=self.config.notary_shard_workers,
+                    shard_verifiers=shard_verifiers,
                 )
                 # health plane over the serving path: the flush loop's
                 # heartbeat, the SLO burn-rate + shed-ratio rules (when
@@ -724,6 +785,9 @@ class Node:
             run_thread.join(timeout=5)
         self.scheduler.stop()
         self.smm.stop()
+        notary = getattr(self.services, "notary_service", None)
+        if isinstance(notary, BatchingNotaryService):
+            notary.stop()   # shard worker threads, when running
         if self.raft is not None:
             self.raft.stop()
         if self.bft is not None:

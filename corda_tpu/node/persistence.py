@@ -30,7 +30,11 @@ from ..core.identity import Party
 from ..core.transactions import SignedTransaction
 from ..crypto import schemes
 from ..crypto.hashes import SecureHash
-from .notary import UniquenessConflict, UniquenessProvider
+from .notary import (
+    ShardedUniquenessProvider,
+    UniquenessConflict,
+    UniquenessProvider,
+)
 from .services import (
     AttachmentStorage,
     CheckpointStorage,
@@ -389,6 +393,149 @@ class PersistentUniquenessProvider(UniquenessProvider):
     @property
     def committed_count(self) -> int:
         return self._db.query("SELECT COUNT(*) FROM notary_commits")[0][0]
+
+
+class ShardedPersistentUniquenessProvider(ShardedUniquenessProvider):
+    """The sharded notary's committed-state registry on sqlite: the
+    uniqueness namespace partitioned by state-ref prefix into one table
+    per shard (`notary_commits_s<k>`), so every shard flush pipeline
+    commits against ITS OWN table while cross-shard transactions take
+    the provider's two-phase reserve→commit (notary.py
+    ShardedUniquenessProvider — the reserve maps stay in memory: a
+    crash releases every reservation, and a partially-written
+    cross-shard commit completes on the client's idempotent same-tx
+    re-commit, the retry invariant docs/serving-notary.md pins).
+
+    Shard-count changes are a MIGRATION, not a reinterpretation: the
+    layout's shard count persists in node_meta kv; on mismatch (first
+    sharded boot over a legacy `notary_commits`, or an operator
+    re-tuning the shard knob) every committed row is re-routed into
+    the new partition tables inside one DB transaction — a ref checked
+    against the wrong partition would silently miss the commit that
+    conflicts it."""
+
+    _META_SPACE = "notary_sharding"
+
+    def __init__(
+        self, db: NodeDatabase, n_shards: int = 1,
+        record_decisions: bool = False,
+    ):
+        super().__init__(n_shards, record_decisions)
+        self._db = db
+        self._ensure_layout()
+
+    def _table(self, shard: int) -> str:
+        return f"notary_commits_s{shard}"
+
+    def _ensure_layout(self) -> None:
+        meta = PersistentKVStore(self._db, self._META_SPACE)
+        stored = meta.get(b"shards")
+        stored_n = int.from_bytes(stored, "big") if stored else None
+        ddl = "\n".join(
+            f"CREATE TABLE IF NOT EXISTS {self._table(k)} ("
+            " ref_tx BLOB NOT NULL, ref_index INTEGER NOT NULL,"
+            " consumer BLOB NOT NULL, requester TEXT NOT NULL,"
+            " PRIMARY KEY (ref_tx, ref_index));"
+            for k in range(self.n_shards)
+        )
+        self._db.execute_script(ddl)
+        if stored_n == self.n_shards:
+            return
+        # gather every committed row from the old layout: the legacy
+        # single table (first sharded boot) plus any previous shard
+        # tables (shard-count retune)
+        rows: list[tuple] = []
+        old_tables = ["notary_commits"]
+        if stored_n:
+            old_tables += [self._table(k) for k in range(stored_n)]
+        with self._db.transaction() as conn:
+            for table in old_tables:
+                try:
+                    rows.extend(
+                        conn.execute(
+                            f"SELECT ref_tx, ref_index, consumer,"
+                            f" requester FROM {table}"
+                        ).fetchall()
+                    )
+                except sqlite3.OperationalError:
+                    continue   # table from a layout that never existed
+            by_shard: dict[int, list[tuple]] = {}
+            for (ref_tx, ref_index, consumer, requester) in rows:
+                ref = StateRef(SecureHash(bytes(ref_tx)), ref_index)
+                by_shard.setdefault(self.shard_of(ref), []).append(
+                    (bytes(ref_tx), ref_index, bytes(consumer), requester)
+                )
+            for k in range(self.n_shards):
+                conn.execute(f"DELETE FROM {self._table(k)}")
+                batch = by_shard.get(k)
+                if batch:
+                    conn.executemany(
+                        f"INSERT OR IGNORE INTO {self._table(k)}"
+                        " (ref_tx, ref_index, consumer, requester)"
+                        " VALUES (?,?,?,?)",
+                        batch,
+                    )
+            # the legacy table's rows now live in the partitions; clear
+            # it so nothing double-reads a stale copy
+            conn.execute("DELETE FROM notary_commits")
+            # the meta row commits WITH the moved rows: written outside
+            # this transaction, a crash between the two would replay
+            # the migration on next boot against the already-emptied
+            # source tables and DELETE every committed row
+            meta.put(b"shards", self.n_shards.to_bytes(4, "big"))
+
+    # -- storage backend overrides (called under the partition cond) -------
+
+    def _prior_consumer(self, shard: int, ref):
+        row = self._db.query(
+            f"SELECT consumer FROM {self._table(shard)}"
+            " WHERE ref_tx=? AND ref_index=?",
+            (ref.txhash.bytes_, ref.index),
+        )
+        return SecureHash(bytes(row[0][0])) if row else None
+
+    def _write_shard(self, shard: int, refs, tx_id, requester) -> None:
+        self._write_rows(shard, [(ref, tx_id, requester) for ref in refs])
+
+    def _write_rows(self, shard: int, rows) -> None:
+        with self._db.transaction() as conn:
+            conn.executemany(
+                f"INSERT OR IGNORE INTO {self._table(shard)}"
+                " (ref_tx, ref_index, consumer, requester)"
+                " VALUES (?,?,?,?)",
+                [
+                    (ref.txhash.bytes_, ref.index, tx_id.bytes_,
+                     requester.name)
+                    for ref, tx_id, requester in rows
+                ],
+            )
+
+    @property
+    def committed_count(self) -> int:
+        return sum(
+            self._db.query(f"SELECT COUNT(*) FROM {self._table(k)}")[0][0]
+            for k in range(self.n_shards)
+        )
+
+    @property
+    def committed(self) -> dict:
+        """Merged StateRef -> consuming-tx view across the partition
+        tables (tests, snapshots) — the base class reads its in-memory
+        partitions, which this subclass leaves empty."""
+        out: dict = {}
+        for k in range(self.n_shards):
+            for (ref_tx, ref_index, consumer) in self._db.query(
+                f"SELECT ref_tx, ref_index, consumer FROM {self._table(k)}"
+            ):
+                out[StateRef(SecureHash(bytes(ref_tx)), ref_index)] = (
+                    SecureHash(bytes(consumer))
+                )
+        return out
+
+    def partition_depth(self, shard: int) -> int:
+        return self._db.query(
+            f"SELECT COUNT(*) FROM {self._table(shard)}"
+        )[0][0]
 
 
 class PersistentKeyManagementService(KeyManagementService):

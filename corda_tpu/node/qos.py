@@ -359,6 +359,13 @@ class NotaryQos:
         self._backlog_trend = 0       # +k growing / -k shrinking streak
         self._last_backlog = 0
         self._lock = threading.Lock()
+        # sharded commit plane (round 6): one AIMD controller + admitted
+        # latency histogram PER SHARD, created by ensure_shards — a hot
+        # shard (one partition's refs contended or deep) then collapses
+        # ITS batching window without browning out its siblings. The
+        # global controller stays as the unsharded/back-compat lane.
+        self.shard_controllers: list[AdaptiveBatchController] = []
+        self._shard_latency: list[Histogram] = []
         self.metrics.gauge(
             "Qos.Controller.WaitMicros", lambda: self.controller.wait_micros
         )
@@ -395,16 +402,70 @@ class NotaryQos:
             counters = list(self._shed.values())
         return sum(c.count for c in counters)
 
+    # -- per-shard lanes (round 6) -------------------------------------------
+
+    def ensure_shards(self, n: int) -> None:
+        """Create the per-shard controller lanes (idempotent; called by
+        the sharded BatchingNotaryService with its shard count). Each
+        lane = its own AIMD controller over its own
+        Qos.Shard<k>.AdmittedLatencyMicros histogram, fenced by the SAME
+        policy — so per-shard tuning can never escape the operator's
+        latency floor/ceiling either."""
+        while len(self.shard_controllers) < n:
+            k = len(self.shard_controllers)
+            hist = self.metrics.histogram(
+                f"Qos.Shard{k}.AdmittedLatencyMicros"
+            )
+            self._shard_latency.append(hist)
+            self.shard_controllers.append(
+                AdaptiveBatchController(self.policy, hist)
+            )
+            self.metrics.gauge(
+                f"Qos.Shard{k}.Batch",
+                (lambda c=self.shard_controllers[k]: c.batch),
+            )
+            self.metrics.gauge(
+                f"Qos.Shard{k}.WaitMicros",
+                (lambda c=self.shard_controllers[k]: c.wait_micros),
+            )
+
+    def controller_for(self, shard: Optional[int]):
+        """The AIMD lane steering one shard's flush (the global
+        controller when unsharded or for an unknown shard id)."""
+        if shard is None or shard >= len(self.shard_controllers):
+            return self.controller
+        return self.shard_controllers[shard]
+
+    def observe_shard_flush(
+        self, shard: int, batch_size: int, backlog: int
+    ) -> None:
+        """Per-shard flush feedback: retunes THAT shard's lane only.
+        Brownout deliberately does not walk here — one hot shard must
+        not brown out the whole node; the notary tick feeds the
+        aggregate backlog to observe_backlog once per pump round."""
+        self.controller_for(shard).observe_flush(batch_size, backlog)
+
     # -- flush feedback ------------------------------------------------------
 
-    def record_admitted(self, latency_micros: int) -> None:
+    def record_admitted(
+        self, latency_micros: int, shard: Optional[int] = None
+    ) -> None:
         self.answered.inc()
         self.admitted_latency.update(max(0, latency_micros))
+        if shard is not None and shard < len(self._shard_latency):
+            self._shard_latency[shard].update(max(0, latency_micros))
 
     def observe_flush(self, batch_size: int, backlog: int) -> None:
         """One call per notary flush: feeds the controller and walks
         the brownout state machine on the backlog trend."""
         self.controller.observe_flush(batch_size, backlog)
+        self.observe_backlog(backlog)
+
+    def observe_backlog(self, backlog: int) -> None:
+        """Walk the brownout state machine on the (aggregate) backlog
+        trend — split from observe_flush so the sharded notary can feed
+        per-shard controller observations separately from the ONE
+        node-level backlog observation per pump round."""
         pol = self.policy
         with self._lock:
             # "growing" means NOT draining: a backlog holding level or
@@ -443,9 +504,15 @@ class NotaryQos:
             # webserver thread must not iterate a dict the pump thread
             # is growing mid-overload (the exact moment /qos matters)
             shed = dict(self._shed)
+        shard_lanes = [
+            c.snapshot() for c in list(self.shard_controllers)
+        ]
         return {
             "enabled": True,
             "controller": self.controller.snapshot(),
+            # per-shard AIMD lanes (round 6): one entry per commit-plane
+            # shard, in shard order — empty when unsharded
+            "shards": shard_lanes,
             "brownout": {
                 "level": self._brownout_level,
                 "trend": self._backlog_trend,

@@ -53,6 +53,7 @@ class MockNode:
         notary: Optional[str] = None,     # None | "simple" | "validating"
         scheme_id: int = schemes.DEFAULT_SCHEME,
         keypair: Optional[schemes.KeyPair] = None,
+        notary_shards: int = 1,           # batching: sharded commit plane
     ):
         self.network = network
         self.name = name
@@ -117,9 +118,18 @@ class MockNode:
                 self.services, uniqueness()
             )
         elif notary == "batching":
-            self.services.notary_service = BatchingNotaryService(
-                self.services, uniqueness()
-            )
+            if notary_shards > 1:
+                from ..node.notary import ShardedUniquenessProvider
+
+                self.services.notary_service = BatchingNotaryService(
+                    self.services,
+                    ShardedUniquenessProvider(notary_shards),
+                    shards=notary_shards,
+                )
+            else:
+                self.services.notary_service = BatchingNotaryService(
+                    self.services, uniqueness()
+                )
         self.scheduler = NodeSchedulerService(
             self.services, self.smm.start_flow
         )
@@ -183,13 +193,17 @@ class MockNetwork:
         name: str = "Notary",
         validating: bool = False,
         batching: bool = False,
+        shards: int = 1,
     ):
+        """`shards` > 1 (batching only) builds the sharded commit
+        plane: per-shard flush pipelines over a partitioned in-memory
+        uniqueness provider (node/notary.py round 6)."""
         kind = (
             "batching" if batching
             else "validating" if validating
             else "simple"
         )
-        return self.create_node(name, notary=kind)
+        return self.create_node(name, notary=kind, notary_shards=shards)
 
     def create_raft_notary_cluster(
         self,

@@ -150,4 +150,30 @@ def test_real_repo_trajectory_parses():
     old, new = bh.parse_record(paths[-2]), bh.parse_record(paths[-1])
     assert old and new, "committed records carry no metric lines?"
     rows = bh.diff(old, new)
-    assert any(r["delta_pct"] is not None for r in rows)
+    assert rows, "newest records diff to nothing"
+    # somewhere in the trajectory, consecutive rounds overlap on at
+    # least one metric (the newest pair alone may not: a CPU-only
+    # round records different instruments than a device round)
+    records = [bh.parse_record(p) for p in paths]
+    assert any(
+        set(a) & set(b) for a, b in zip(records, records[1:])
+    ), "no two consecutive rounds share any metric"
+
+
+def test_committed_trajectory_passes_regression_gate():
+    """Round 6: `bench_history --gate` IS part of the tier-1 story.
+    The newest two committed BENCH_r*.json records must not show a
+    >10% regression on any metric present in both — this is how a
+    reclaimed headline metric STAYS reclaimed: a future round that
+    regresses the notary (or any other) line past 10% turns this test
+    red instead of shipping silently, the exact failure mode BENCH_r05
+    demonstrated (notary at 0.55x with nothing in-repo flagging it)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if len(bh.discover(repo)) < 2:
+        pytest.skip("no committed bench trajectory")
+    rc = bh.main(["--dir", repo, "--gate", "10"])
+    assert rc == 0, (
+        "a committed bench round regressed a metric by more than 10% — "
+        "see the GATE lines above; either reclaim the metric or record "
+        "why the regression is accepted"
+    )
