@@ -47,6 +47,11 @@ BENCH_METRIC restricts to one measurement:
                     real hot path (timed separately — the probe's
                     build+sign cost amortises at the production
                     cadence, not per flush) — CPU fixture, real time
+  perf            — perf-attribution plane (utils/perf.py): sampling-
+                    profiler overhead A/B on the notary CPU flush
+                    (acceptance <= 2%) plus the jit-retrace counter
+                    proven stable-at-zero on warm shapes and counting
+                    a forced fresh-shape retrace — CPU fixture
 
 `python bench.py --quick ingest` runs tiny serial + pipelined ingest
 records in one CPU-safe process (tier-1 smoke of the perf plumbing);
@@ -859,6 +864,13 @@ def _trace_metric(batch: int, iters: int, cpu: bool = False) -> dict:
         "unit": "fraction of traced wall attributed to stages",
         "vs_baseline": round(coverage, 3),
         "stages_seconds": {k: round(v, 6) for k, v in stages.items()},
+        # first-class per-stage gate keys: tools/bench_history.py
+        # explodes every dict named here into
+        # hot_path_stage_breakdown.stages_seconds.<stage> rows diffed
+        # in the LOWER-is-better direction, so a stage-level
+        # regression (commit 2x slower under an unchanged headline)
+        # fails `--gate` on its own line
+        "gate_lower_is_better": ["stages_seconds"],
         "wall_seconds": round(wall, 6),
         "untraced_wall_seconds": round(_median(walls_off), 6),
         "tracing_overhead": round(overhead, 4),
@@ -1123,6 +1135,9 @@ def _health_metric(batch: int, iters: int) -> dict:
         "metric": "health_plane_overhead",
         "value": round(max(overhead, 0.0), 4),
         "unit": "fractional flush-wall overhead of the health plane",
+        # direction marker (see perf_plane_overhead): overhead gates
+        # when it grows, not when it improves
+        "lower_is_better": True,
         "vs_baseline": round(max(overhead, 0.0), 4),
         "overhead_raw": round(overhead, 4),
         "batch": batch,
@@ -1133,6 +1148,104 @@ def _health_metric(batch: int, iters: int) -> dict:
         ),
         "healthy": ok,
         "alerts_firing": monitor.alerts_firing(),
+    }
+
+
+def _perf_metric(batch: int, iters: int) -> dict:
+    """Perf-attribution plane cost + retrace proof (the round-7
+    tentpole's bench leg): the notary CPU rig serves `batch` spends
+    per flush with the sampling profiler OFF vs ON (utils/perf.py
+    SamplingProfiler at BENCH_PERF_HZ, default 19 Hz, watching every
+    thread), interleaved min-of-reps A/B on the same fixture. `value`
+    is the fractional wall overhead continuous profiling adds to a
+    flush — the acceptance line is <= 2% (BENCH_PERF_OVERHEAD_MAX) —
+    cross-checked against the profiler's own measured self-overhead
+    gauge. The jit-retrace counter is proven on a real jitted
+    function: two warm-up shapes compile, `mark_warm()` arms the
+    counter, a repeat call stays at zero retraces and a deliberately
+    NEW shape increments it — the same KernelAccounting.timed_call
+    bookkeeping the TpuBatchVerifier dispatch path records through,
+    so the proof and production cannot fork."""
+    import gc
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    from corda_tpu.core import serialization as ser
+    from corda_tpu.flows.api import FlowFuture
+    from corda_tpu.node.notary import (
+        InMemoryUniquenessProvider,
+        _PendingNotarisation,
+    )
+    from corda_tpu.utils.perf import KernelAccounting, SamplingProfiler
+
+    # -- retrace proof (tiny jit, real trace-per-shape) --------------------
+    acct = KernelAccounting()
+    fn = jax.jit(lambda x: (x * 2 + 1).sum())
+    for shape in (8, 16):                       # warmup: two shapes
+        acct.timed_call(0, shape, fn, jnp.zeros(shape, jnp.float32))
+    acct.mark_warm()
+    acct.timed_call(0, 8, fn, jnp.zeros(8, jnp.float32))    # warm hit
+    stable_after_warm = acct.retraces == 0
+    acct.timed_call(0, 32, fn, jnp.zeros(32, jnp.float32))  # forced miss
+    retrace_counted = acct.retraces == 1
+
+    # -- profiler overhead A/B on the notary CPU flush rig -----------------
+    tile = max(1, int(os.environ.get("BENCH_TILE", "8")))
+    svc, requester, blobs = _trace_fixture(min(tile, batch), batch, cpu=True)
+    spends = [ser.decode(b) for b in blobs]
+    reps = max(2, iters)
+    hz = float(os.environ.get("BENCH_PERF_HZ", "19"))
+    prof = SamplingProfiler(hz=hz)
+
+    def run_once() -> float:
+        svc.uniqueness = InMemoryUniquenessProvider()
+        futs = []
+        t0 = _time.perf_counter()
+        for stx in spends:
+            fut = FlowFuture()
+            futs.append(fut)
+            svc._pending.append(_PendingNotarisation(stx, requester, fut))
+        svc.flush()
+        wall = _time.perf_counter() - t0
+        for fut in futs:
+            sig = fut.result()
+            if not hasattr(sig, "by"):
+                raise SystemExit(f"perf metric notarisation failed: {sig}")
+        return wall
+
+    run_once()                       # warm-up (bytecode, caches)
+    walls_off, walls_on = [], []
+    for _ in range(reps):            # interleaved A/B: drift cancels
+        gc.collect()                 # equalise collector debt per rep
+        walls_off.append(run_once())
+        gc.collect()
+        prof.start()
+        try:
+            walls_on.append(run_once())
+        finally:
+            prof.stop()
+    overhead = min(walls_on) / min(walls_off) - 1.0
+    collapsed_lines = len(prof.collapsed().splitlines())
+    return {
+        "metric": "perf_plane_overhead",
+        "value": round(max(overhead, 0.0), 4),
+        "unit": "fractional flush-wall overhead of continuous profiling",
+        # direction marker for tools/bench_history.py: an overhead
+        # headline gates when it GROWS — higher-is-better gating would
+        # fail the trajectory on an improvement
+        "lower_is_better": True,
+        "vs_baseline": round(max(overhead, 0.0), 4),
+        "overhead_raw": round(overhead, 4),
+        "profiler_hz": hz,
+        "profiler_samples": prof.samples,
+        "profiler_self_overhead": round(prof.overhead(), 5),
+        "collapsed_stacks": collapsed_lines,
+        "retrace_stable_after_warmup": stable_after_warm,
+        "retrace_counted": retrace_counted,
+        "batch": batch,
+        "reps": reps,
     }
 
 
@@ -1389,6 +1502,11 @@ def _run_metric(metric: str, batch: int, iters: int) -> dict:
         if batch > 512:
             out["batch_requested"] = batch   # cap visible in the record
         return out
+    if metric == "perf":
+        out = _perf_metric(min(batch, 512), iters)
+        if batch > 512:
+            out["batch_requested"] = batch   # cap visible in the record
+        return out
     if metric == "parity":
         return _parity_metric(batch, iters)
     return _spi_metric(metric, batch, iters)
@@ -1454,6 +1572,14 @@ def _quick(metric: str) -> None:
                (inline wave AND worker threads) and that the sweep
                record is well-formed — the deterministic correctness
                gate is tests/test_sharded_notary.py.
+      perf   — the perf-attribution plane (round 7): asserts the
+               sampling profiler's measured overhead stays <=
+               BENCH_PERF_OVERHEAD_MAX (default 2%) of the notary CPU
+               flush wall (interleaved A/B, the health-smoke
+               discipline), that the profiler actually sampled, that
+               the retrace counter held ZERO on a warm repeat shape,
+               and that a forced jit retrace (a deliberately new
+               shape after mark_warm) was counted.
     """
     if metric == "shards":
         # force the smoke's sweep shape: the assertions below pin
@@ -1494,6 +1620,50 @@ def _quick(metric: str) -> None:
         if not out["healthy"]:
             raise SystemExit(
                 "health plane reads unhealthy on a healthy rig"
+            )
+        return
+    if metric == "perf":
+        batch = int(os.environ.get("BENCH_BATCH", "32"))
+        iters = int(os.environ.get("BENCH_ITERS", "3"))
+        out = _perf_metric(batch, iters)
+        max_overhead = float(
+            os.environ.get("BENCH_PERF_OVERHEAD_MAX", "0.02")
+        )
+        if out["value"] > max_overhead:
+            # one retry before failing (the _attempt_with_retry
+            # discipline): a co-scheduled process landing on the ON
+            # reps inflates min-of-reps A/B on a shared CI box, and
+            # the real signal — the profiler's measured self-overhead
+            # — sits an order of magnitude under the gate
+            print(
+                f"bench: perf overhead {out['value']:.4f} over the "
+                f"{max_overhead:.0%} gate — noisy box? retrying once",
+                file=sys.stderr,
+            )
+            retry = _perf_metric(batch, iters)
+            if retry["value"] < out["value"]:
+                retry["first_attempt_overhead"] = out["value"]
+                out = retry
+        out["quick"] = True
+        print(json.dumps(out), flush=True)
+        if out["value"] > max_overhead:
+            raise SystemExit(
+                f"profiler overhead {out['value']:.4f} exceeds "
+                f"{max_overhead:.0%} of the flush wall"
+            )
+        if out["profiler_samples"] < 1 or out["collapsed_stacks"] < 1:
+            raise SystemExit(
+                "profiler took no samples during the timed flushes"
+            )
+        if not out["retrace_stable_after_warmup"]:
+            raise SystemExit(
+                "retrace counter moved on a WARM shape — a repeat "
+                "dispatch must not read as a jit cache miss"
+            )
+        if not out["retrace_counted"]:
+            raise SystemExit(
+                "forced jit retrace (fresh shape after warmup) was "
+                "not counted"
             )
         return
     if metric == "qos":
@@ -1542,8 +1712,8 @@ def _quick(metric: str) -> None:
         return
     if metric != "ingest":
         raise SystemExit(
-            f"--quick supports 'ingest', 'trace', 'qos', 'health' or "
-            f"'shards', not {metric!r}"
+            f"--quick supports 'ingest', 'trace', 'qos', 'health', "
+            f"'perf' or 'shards', not {metric!r}"
         )
     batch = int(os.environ.get("BENCH_BATCH", "256"))
     iters = int(os.environ.get("BENCH_ITERS", "1"))
@@ -1563,7 +1733,7 @@ def main() -> None:
     if argv:
         raise SystemExit(
             f"unknown arguments {argv!r} "
-            "(try --quick ingest|trace|qos|health)"
+            "(try --quick ingest|trace|qos|health|perf|shards)"
         )
     t_start = time.perf_counter()
     # On a remote-attached TPU the host<->device link latency (~50-100
@@ -1575,8 +1745,8 @@ def main() -> None:
     metric = os.environ.get("BENCH_METRIC", "all")
     known = (
         "all", "p256", "mixed", "merkle", "notary", "notary_commit_plane",
-        "ingest", "ingest_pipelined", "trace", "qos", "health", "montmul",
-        "parity",
+        "ingest", "ingest_pipelined", "trace", "qos", "health", "perf",
+        "montmul", "parity",
     )
     if metric not in known:
         # a typo must not record a p256-only rate under another name
@@ -1615,7 +1785,7 @@ def main() -> None:
     # parity runs LAST of the optional work (cheapest to drop), but
     # before the headline so the headline stays the final stdout line
     for m in ("mixed", "merkle", "notary", "ingest", "ingest_pipelined",
-              "trace", "qos", "health", "parity"):
+              "trace", "qos", "health", "perf", "parity"):
         avail = left() - reserve
         if avail < 60:
             print(
@@ -1627,7 +1797,7 @@ def main() -> None:
         env = dict(os.environ, BENCH_METRIC=m)
         if avail < 300 and m in (
             "mixed", "merkle", "notary", "ingest", "ingest_pipelined",
-            "trace", "qos", "health",
+            "trace", "qos", "health", "perf",
         ):
             # trim before dropping: one timed rep at a shallower batch
             # still yields a usable point for the table

@@ -116,6 +116,7 @@ class NodeWebServer:
         qos=None,
         health=None,
         cluster=None,
+        perf=None,
     ):
         """`metrics`: an optional MetricRegistry served at GET /metrics
         in prometheus exposition format (the reference exports
@@ -140,7 +141,22 @@ class NodeWebServer:
 
         `cluster`: an optional utils/health.ClusterHealth — GET
         /cluster serves the fleet-wide rollup (per-node summaries,
-        worst-state, stale marking for unreachable peers)."""
+        worst-state, stale marking for unreachable peers).
+
+        `perf`: an optional utils/perf.PerfPlane — GET /perf serves
+        the attribution snapshot (kernel compile-vs-execute split,
+        host stage seconds, per-shard skew, wave overlap efficiency,
+        the in-process history + BENCH baseline diff) and GET /profile
+        serves the sampling profiler's collapsed stacks in the
+        flamegraph.pl folded format (`?seconds=N` runs an on-demand
+        capture when the continuous sampler is off; `?reset=1` clears
+        the table after serving).
+
+        Every operational endpoint honours `?ts=1`: the payload gains
+        a shared process-monotonic `ts_micros` stamp (a trailing
+        `# ts_micros` comment on /metrics text), so cross-endpoint
+        snapshots — each built under its own lock with its own
+        staleness — can be correlated in tests and dashboards."""
         self.client = client
         self.pump = pump
         self.rpc_timeout = rpc_timeout
@@ -149,6 +165,11 @@ class NodeWebServer:
         self.qos = qos
         self.health = health
         self.cluster = cluster
+        self.perf = perf
+        # serializes /profile on-demand captures and resets: without
+        # it a second ?seconds=N request returns a partial table and
+        # a concurrent ?reset=1 wipes an in-flight capture
+        self._profile_lock = threading.Lock()
         self._lock = threading.Lock()   # one RPC conversation at a time
         # the operational surface: path -> (description, handler(query)
         # -> (status, content_type, payload bytes)). ONE table drives
@@ -176,6 +197,16 @@ class NodeWebServer:
             "/cluster": (
                 "fleet-wide health rollup over the network-map peers",
                 self._serve_cluster,
+            ),
+            "/perf": (
+                "performance attribution: kernel compile/execute "
+                "split, host stages, shard skew, history + baseline "
+                "diff", self._serve_perf,
+            ),
+            "/profile": (
+                "sampling profiler collapsed stacks (flamegraph.pl "
+                "folded; ?seconds=N on-demand capture, ?reset=1 "
+                "clears)", self._serve_profile,
             ),
         }
         gateway = self
@@ -244,6 +275,27 @@ class NodeWebServer:
     def _json(status: int, body) -> tuple[int, str, bytes]:
         return status, "application/json", json.dumps(body).encode()
 
+    @staticmethod
+    def _stamp_ts(ctype: str, payload: bytes) -> bytes:
+        """The shared `?ts=1` echo: every operational endpoint builds
+        its payload under its OWN lock with its own staleness, so
+        correlating a /metrics scrape with a /qos or /perf snapshot
+        needs a common clock IN the payload. One process-monotonic
+        stamp (time.monotonic_ns, immune to wall-clock steps): JSON
+        object payloads gain a top-level `ts_micros`, text payloads
+        (/metrics, /profile) a trailing `# ts_micros` comment line."""
+        ts = time.monotonic_ns() // 1_000
+        if ctype.startswith("application/json"):
+            try:
+                body = json.loads(payload)
+            except ValueError:
+                return payload
+            if isinstance(body, dict):
+                body["ts_micros"] = ts
+                return json.dumps(body).encode()
+            return payload
+        return payload.rstrip(b"\n") + f"\n# ts_micros {ts}\n".encode()
+
     def _reject_method(self, req, method: str) -> None:
         self._send(
             req, 405, "application/json",
@@ -260,6 +312,7 @@ class NodeWebServer:
             "/metrics": self.metrics, "/traces": self.tracer,
             "/qos": self.qos, "/healthz": self.health,
             "/health": self.health, "/cluster": self.cluster,
+            "/perf": self.perf, "/profile": self.perf,
         }
         return self._json(200, {
             "endpoints": [
@@ -360,6 +413,54 @@ class NodeWebServer:
         except Exception as e:   # noqa: BLE001 - defensive render
             return self._json(500, {"error": f"cluster rollup failed: {e}"})
 
+    def _serve_perf(self, query) -> tuple[int, str, bytes]:
+        # the attribution snapshot: /metrics tells you THAT serving
+        # slowed, /traces WHICH request was slow — this tells you WHY:
+        # which host stage, which kernel shape (compile vs execute),
+        # which shard, and whether the node already regressed vs its
+        # committed bench baseline
+        try:
+            if self.perf is None:
+                return self._json(
+                    404, {"error": "perf plane not wired on this gateway"}
+                )
+            return self._json(200, self.perf.snapshot())
+        except Exception as e:   # noqa: BLE001 - defensive render
+            return self._json(500, {"error": f"perf snapshot failed: {e}"})
+
+    def _serve_profile(self, query) -> tuple[int, str, bytes]:
+        # folded collapsed stacks — pipe straight into flamegraph.pl /
+        # speedscope. With the continuous sampler off, ?seconds=N runs
+        # a blocking on-demand capture on this request thread (the
+        # gateway is a ThreadingHTTPServer: other endpoints keep
+        # answering meanwhile).
+        try:
+            if self.perf is None:
+                return self._json(
+                    404, {"error": "perf plane not wired on this gateway"}
+                )
+            prof = self.perf.profiler
+            seconds = float(query.get("seconds", ["0"])[0] or 0)
+            with self._profile_lock:
+                # under the lock a concurrent ?seconds=N waits for the
+                # in-flight capture (then reads the FULL table) and a
+                # ?reset=1 cannot wipe a capture mid-flight
+                if seconds > 0 and not prof.running:
+                    prof.start()
+                    time.sleep(min(seconds, 60.0))
+                    prof.stop()
+                text = prof.collapsed()
+                if not text:
+                    text = (
+                        "# no samples (profiler not started; try "
+                        "?seconds=2)"
+                    )
+                if query.get("reset", ["0"])[0] not in ("", "0"):
+                    prof.clear()
+            return 200, "text/plain", (text + "\n").encode()
+        except Exception as e:   # noqa: BLE001 - defensive render
+            return self._json(500, {"error": f"profile export failed: {e}"})
+
     # -- dispatch ------------------------------------------------------------
 
     def _handle(self, req: BaseHTTPRequestHandler, method: str) -> None:
@@ -380,7 +481,10 @@ class NodeWebServer:
             self._send(req, status, ctype, payload)
             return
         if method == "GET" and path in self._ops:
-            status, ctype, payload = self._ops[path][1](parse_qs(url.query))
+            query = parse_qs(url.query)
+            status, ctype, payload = self._ops[path][1](query)
+            if query.get("ts", ["0"])[0] not in ("", "0"):
+                payload = self._stamp_ts(ctype, payload)
             self._send(req, status, ctype, payload)
             return
         try:

@@ -19,6 +19,7 @@ Implementations:
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 from functools import partial
 from typing import Optional, Sequence
@@ -29,6 +30,7 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from ..parallel import mesh as meshlib
+from ..utils import perf as perflib
 from ..utils import tracing
 from . import encodings, schemes
 from .curves import SECP256K1, SECP256R1
@@ -151,19 +153,36 @@ class TpuBatchVerifier(BatchSignatureVerifier):
         mesh: Optional[object] = None,
         donate: bool = True,
         device: Optional[object] = None,
+        perf=None,
     ):
         """`device` pins every dispatch to ONE jax device (the sharded
         notary's per-device verify path: shard k's whole batch lands on
         device k instead of data-parallel-sharding one batch over the
         mesh). Mutually exclusive with `mesh` — a pinned verifier runs
-        the unsharded single-device program on its device."""
+        the unsharded single-device program on its device.
+
+        `perf`: a utils/perf.KernelAccounting this verifier records
+        its per-(scheme, batch-shape) compile-vs-execute timings,
+        retraces and host→device transfer bytes into; None records
+        into the process default (perf.get_kernel_accounting()) — the
+        node's PerfPlane installs its own there, so GET /perf carries
+        the split without per-verifier wiring."""
         if device is not None and mesh is not None:
             raise ValueError("device= and mesh= are mutually exclusive")
         self.batch_sizes = tuple(sorted(batch_sizes))
         self.mesh = mesh
         self.device = device
+        self.perf = perf
         self._cpu = CpuBatchVerifier()
         self._kernels = {}
+        # first-call-per-shape is judged per VERIFIER, not on the
+        # (possibly process-shared) accounting: jit caches live on
+        # THIS instance's wrappers, so with per-shard verifiers each
+        # instance's first dispatch per shape really does pay its own
+        # trace+lower (or AOT load) and must record as a compile —
+        # keyed on the shared ledger it would masquerade as a
+        # multi-second "execute" and dodge the retrace counter
+        self._warm_shapes: set = set()
         del donate  # reserved
         # the EC ladder kernels cost 20-350 s to compile per (scheme,
         # batch, backend); every process constructing this verifier
@@ -281,6 +300,20 @@ class TpuBatchVerifier(BatchSignatureVerifier):
                     curve, chunk, batch
                 )
                 staged = {"packed": packed, "valid_in": valid}
+            # perf attribution (utils/perf.py): the staged operand
+            # payload headed over the link, and the call wall split
+            # compile-vs-execute — the FIRST call per (scheme, batch)
+            # key in this process is where jax traces+lowers (or loads
+            # the AOT artifact); every later call is the async
+            # dispatch. A first call on an already-warm accounting is
+            # a RETRACE — the jit cache miss the perf alert pages on.
+            acct = (
+                self.perf if self.perf is not None
+                else perflib.get_kernel_accounting()
+            )
+            nbytes = sum(
+                int(getattr(v, "nbytes", 0) or 0) for v in staged.values()
+            )
             if self.mesh is not None:
                 staged = {
                     k: meshlib.shard_operand(
@@ -293,18 +326,33 @@ class TpuBatchVerifier(BatchSignatureVerifier):
                 # operands to THIS verifier's device so the jitted
                 # program executes there — N shard pipelines then keep
                 # N chips busy concurrently instead of queueing on the
-                # default device
+                # default device. The explicit transfer is timed into
+                # the accounting (device_put is where the link cost is
+                # visible to the host on this path).
+                t_put = time.perf_counter()
                 staged = {
                     k: jax.device_put(v, self.device)
                     for k, v in staged.items()
                 }
+                acct.record_transfer(
+                    scheme_id, batch, nbytes,
+                    time.perf_counter() - t_put,
+                )
+                nbytes = 0   # charged above, not again on the call row
             # TraceAnnotation (null context off-jax-profiler): names
             # this kernel launch in an XLA profiler capture so the
             # host-side dispatch spans line up with device timelines
+            first = (scheme_id, batch) not in self._warm_shapes
+            t_call = time.perf_counter()
             with tracing.annotate(
                 f"corda_tpu.verify_dispatch.s{scheme_id}.b{batch}"
             ):
                 res = self._kernel(scheme_id, batch)(**staged)
+            self._warm_shapes.add((scheme_id, batch))
+            acct.record_call(
+                scheme_id, batch, time.perf_counter() - t_call,
+                first=first, transfer_bytes=nbytes,
+            )
             pending.append((res, idxs[off : off + len(chunk)], len(chunk)))
         return pending
 

@@ -95,6 +95,21 @@ class NodeConfig:
     # requests/sec per sender (0 disables) and burst capacity
     qos_admission_rate_per_sec: int = 0
     qos_admission_burst: int = 256
+    # performance-attribution plane (utils/perf.py): kernel
+    # compile-vs-execute accounting, per-shard skew telemetry, the
+    # in-process bench history and the GET /perf surface. On by
+    # default — the telemetry is passive counters; only the sampling
+    # profiler costs anything, and it stays unstarted at hz 0.
+    perf_enabled: bool = True
+    # continuous sampling profiler rate over the node's long-lived
+    # threads, in samples/sec (0 = no sampler thread; GET /profile can
+    # still run an on-demand capture). 19 Hz measures <1% of the flush
+    # wall — keep it off round pump cadences to avoid aliasing.
+    perf_profile_hz: float = 0.0
+    # committed BENCH_r*.json record the node diffs its own sustained
+    # throughput history against ("notarisations/s regressed 12% vs
+    # BENCH_r06" without an offline bench run); empty = no baseline
+    perf_baseline: str = ""
     verifier_type: str = "in_memory"
     # which BatchSignatureVerifier backs signature checks: "tpu" (the
     # production batch kernels) or "cpu" (the bit-exact reference —
@@ -175,6 +190,15 @@ class NodeConfig:
             raise ConfigError(
                 "notary_shard_workers requires notary_shards > 1"
             )
+        if self.perf_profile_hz < 0:
+            raise ConfigError("perf_profile_hz must be >= 0")
+        if not self.perf_enabled and (
+            self.perf_profile_hz > 0 or self.perf_baseline
+        ):
+            raise ConfigError(
+                "perf_profile_hz / perf_baseline require perf_enabled "
+                "(the profiler and baseline diff live on the perf plane)"
+            )
 
     @property
     def scheme_id(self) -> int:
@@ -249,7 +273,7 @@ def write_config(cfg: NodeConfig, path: str) -> None:
     def emit(key, value):
         if isinstance(value, bool):
             lines.append(f"{key} = {'true' if value else 'false'}")
-        elif isinstance(value, int):
+        elif isinstance(value, (int, float)):
             lines.append(f"{key} = {value}")
         else:
             lines.append(f"{key} = {quote(value)}")
@@ -276,6 +300,12 @@ def write_config(cfg: NodeConfig, path: str) -> None:
         if cfg.qos_admission_rate_per_sec:
             emit("qos_admission_rate_per_sec", cfg.qos_admission_rate_per_sec)
             emit("qos_admission_burst", cfg.qos_admission_burst)
+    if not cfg.perf_enabled:
+        emit("perf_enabled", cfg.perf_enabled)
+    if cfg.perf_profile_hz:
+        emit("perf_profile_hz", cfg.perf_profile_hz)
+    if cfg.perf_baseline:
+        emit("perf_baseline", cfg.perf_baseline)
     emit("verifier_type", cfg.verifier_type)
     emit("verifier_backend", cfg.verifier_backend)
     emit("dev_mode", cfg.dev_mode)

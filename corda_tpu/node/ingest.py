@@ -349,7 +349,14 @@ class IngestPipeline:
         frame_cache_size: int = 8192,
         stage: bool = True,
         tracer=None,
+        perf=None,
     ):
+        """`perf`: an optional utils/perf.PerfPlane. Every finished
+        batch reports its frame count and per-stage (decode /
+        merkle-id / staging) host seconds, so GET /perf attributes the
+        pre-flush host work — and the plane's
+        `wire_ingest_pipelined_per_sec` history key (the same key
+        bench.py records) tracks the live ingest rate in-process."""
         self.pool = DecodePool(shards, decode)
         self.ring = IngestRing(ring_depth)
         self.leaf_cache = DigestCache(leaf_cache_size)
@@ -366,6 +373,7 @@ class IngestPipeline:
         # explicit tracer, or the process default resolved per batch
         # (None here so a later set_tracer()/env enable is honoured)
         self.tracer = tracer
+        self.perf = perf
 
     def _tracer(self):
         return self.tracer if self.tracer is not None else tracing.get_tracer()
@@ -459,7 +467,8 @@ class IngestPipeline:
         results = handle.result() if handle is not None else []
         tracer = self._tracer()
         tracing_on = tracer.enabled
-        t_decode = time.perf_counter() if tracing_on else 0.0
+        timing = tracing_on or self.perf is not None
+        t_decode = time.perf_counter() if timing else 0.0
         for i, obj in zip(miss_idx, results):
             blob = blobs[i]
             if isinstance(obj, Exception):
@@ -489,7 +498,7 @@ class IngestPipeline:
         install_tx_ids(
             [s.wtx for s in stxs], self.leaf_cache, self.root_cache
         )
-        t_id = time.perf_counter() if tracing_on else 0.0
+        t_id = time.perf_counter() if timing else 0.0
         cache = self.frame_cache
         for e in fresh:
             if self._stage and e.stx is not None:
@@ -504,10 +513,21 @@ class IngestPipeline:
             for i, d in enumerate(deadlines[: len(entries)]):
                 if i not in shed and entries[i] is not None:
                     entries[i].deadline = d
+        t_stage = time.perf_counter() if timing else 0.0
+        if self.perf is not None:
+            # per-batch host-stage seconds (decode includes any overlap
+            # waited out at handle.result(); hits skipped both) + frame
+            # count into the plane's ingest-rate history key
+            self.perf.observe_ingest(
+                len(entries),
+                max(0.0, t_decode - t0),
+                max(0.0, t_id - t_decode),
+                max(0.0, t_stage - t_id),
+            )
         if tracing_on:
             self._emit_spans(
                 tracer, entries, hits, parents,
-                t0, t_decode, t_id, time.perf_counter(), end_spans,
+                t0, t_decode, t_id, t_stage, end_spans,
             )
         return entries
 

@@ -225,9 +225,28 @@ class Node:
         from ..utils import tracing
         from ..utils.health import ClusterHealth, HealthMonitor
         from ..utils.metrics import MetricRegistry
+        from ..utils.perf import PerfPlane, PerfPolicy
 
         self.metrics = MetricRegistry()
         self.tracer = tracing.get_tracer()
+        # performance-attribution plane (utils/perf.py): kernel
+        # compile-vs-execute accounting (installed as the process
+        # default, so every TpuBatchVerifier this node constructs
+        # records into it), per-shard skew telemetry, the in-process
+        # bench history + baseline diff, and the sampling profiler —
+        # served at GET /perf + /profile. Created BEFORE the notary so
+        # attach_perf can wire the flush feeds.
+        self.perf = None
+        if config.perf_enabled:
+            self.perf = PerfPlane(
+                clock=self.services.clock,
+                metrics=self.metrics,
+                tracer=self.tracer,
+                policy=PerfPolicy(
+                    profile_hz=config.perf_profile_hz or 19.0
+                ),
+                baseline_path=config.perf_baseline or None,
+            )
         # QoS plane (node/qos.py): installed with the batching notary
         # when config.qos_enabled; None keeps every hot path unchanged
         self.qos = None
@@ -569,6 +588,13 @@ class Node:
                 if self.qos is not None:
                     self.health.watch_qos(self.qos)
                 self.health.attach_canary(self._launch_canary)
+                # perf plane over the same path: flush phase marks feed
+                # the skew/overlap telemetry, the served-request counter
+                # becomes the in-process notarisations/s history, and
+                # the retrace + skew alerts land on the health monitor
+                if self.perf is not None:
+                    self.services.notary_service.attach_perf(self.perf)
+                    self.health.watch_perf(self.perf)
                 return
             cls = {
                 "simple": SimpleNotaryService,
@@ -709,6 +735,11 @@ class Node:
         self.running = True
         if self.web is not None:
             self.web.start()
+        if self.perf is not None and self.config.perf_profile_hz > 0:
+            # continuous profiling over this node's long-lived threads
+            # (everything but the sampler itself); started only after
+            # boot so warmup compiles don't dominate the first capture
+            self.perf.profiler.start()
         # boot work (map registration, checkpoint restore) may exceed
         # the watchdog deadline: the pump loop starts NOW, so its
         # heartbeat clock does too
@@ -740,6 +771,10 @@ class Node:
         # health plane last: the watchdog judges the beats this tick
         # just made, the canary launches, alert rules walk their states
         self.health.tick()
+        if self.perf is not None:
+            # history sampling rides the same cadence (self-throttled
+            # to the perf policy's sample gap)
+            self.perf.tick()
 
     def run(self) -> None:
         """The pump loop — the single server thread (Node.kt:344)."""
@@ -775,6 +810,9 @@ class Node:
         web = getattr(self, "web", None)
         if web is not None:
             web.stop()
+        perf = getattr(self, "perf", None)
+        if perf is not None:
+            perf.profiler.stop()
         # an embedded run() thread must drain its current pump before
         # the database closes under it
         run_thread = getattr(self, "_run_thread", None)
@@ -814,7 +852,8 @@ class Node:
         this node's MetricRegistry at /metrics, the flight recorder at
         /traces, the QoS plane (when enabled) at /qos, the health
         plane at /healthz + /health, the fleet rollup at /cluster,
-        plus the ledger explorer UI at /web/explorer/. The node's pump
+        the perf-attribution plane at /perf (+ folded profiler stacks
+        at /profile), plus the ledger explorer UI at /web/explorer/. The node's pump
         loop (run()) drives message delivery, so the gateway itself
         only polls futures (pass a real pump when embedding without
         run())."""
@@ -837,6 +876,7 @@ class Node:
             qos=self.qos,
             health=self.health,
             cluster=self.cluster_health,
+            perf=self.perf,
         )
 
 

@@ -845,6 +845,7 @@ class BatchingNotaryService(NotaryService):
         self._ingest_ring = None   # attach_ingest: pre-decoded arrivals
         self._oldest_arrival: Optional[int] = None
         self._health_heartbeat = None   # attach_health: flush-loop liveness
+        self._perf = None               # attach_perf: attribution plane
         # registry-backed metrics (scrapeable at /metrics, unlike the
         # bare ints they replace): dispatches vs requests IS the
         # batching ratio, exported as its own gauge
@@ -1015,14 +1016,12 @@ class BatchingNotaryService(NotaryService):
             stx, requester, fut, span=span,
             deadline=deadline, arrival_micros=arrival,
         )
-        if self._shards is not None:
-            self._enqueue_sharded(p)
-        else:
-            if not self._pending:
-                self._oldest_arrival = self.services.clock.now_micros()
-            self._pending.append(p)
-            if len(self._pending) >= self.effective_max_batch:
-                self.flush()
+        self.enqueue_pending(p)
+        if (
+            self._shards is None
+            and len(self._pending) >= self.effective_max_batch
+        ):
+            self.flush()
         result = yield from wait_future(fut)
         return result
 
@@ -1046,13 +1045,27 @@ class BatchingNotaryService(NotaryService):
             stx, requester, fut,
             deadline=deadline, arrival_micros=arrival_micros,
         )
+        self.enqueue_pending(p)
+        return fut
+
+    def enqueue_pending(self, p: _PendingNotarisation) -> None:
+        """THE queue-routing step every intake path shares (process,
+        submit, the canary probe): the owning shard on the sharded
+        plane, the single pending queue — with its oldest-arrival
+        stamp — otherwise. The canary (utils/health.notary_canary_fn)
+        MUST come through here: a bare `_pending.append` starves
+        forever on a sharded notary, whose tick only drains the shard
+        queues (the deadman would fire on a perfectly healthy node).
+        Full-batch flush triggers stay with the callers: process()
+        flushes the unsharded queue at effective_max_batch, the shard
+        router flushes a full shard itself, submit() never flushes
+        (bench rigs fill the whole plane first)."""
         if self._shards is not None:
             self._enqueue_sharded(p)
-        else:
-            if not self._pending:
-                self._oldest_arrival = self.services.clock.now_micros()
-            self._pending.append(p)
-        return fut
+            return
+        if not self._pending:
+            self._oldest_arrival = self.services.clock.now_micros()
+        self._pending.append(p)
 
     # -- shard routing (round 6) --------------------------------------------
 
@@ -1152,6 +1165,31 @@ class BatchingNotaryService(NotaryService):
                     f"notary.shard{shard.id}.flush",
                     queue_depth=(lambda s=shard: s.depth()),
                 )
+
+    def attach_perf(self, plane) -> None:
+        """Wire the performance-attribution plane (utils/perf.py):
+        every flush feeds its phase marks in — per-shard flush wall +
+        request counts for the skew window, link-blocked time for the
+        wave overlap-efficiency gauge — and the notary's served-request
+        counter becomes the plane's in-process
+        `batching_notary_notarisations_per_sec` history key (the same
+        key bench.py records, so the node can diff itself against the
+        committed BENCH baseline between offline rounds). Pass None to
+        detach (bench A/B rigs)."""
+        self._perf = plane
+        if plane is None:
+            return
+        if self._shards is not None:
+            plane.attach_shards(
+                self.n_shards,
+                [(lambda s=shard: s.depth()) for shard in self._shards],
+            )
+        else:
+            plane.attach_shards(1, [lambda: len(self._pending)])
+        plane.watch_rate(
+            "batching_notary_notarisations_per_sec",
+            lambda: self._requests_counter.count,
+        )
 
     def _drain_ingest(self) -> None:
         ring = self._ingest_ring
@@ -1397,11 +1435,22 @@ class BatchingNotaryService(NotaryService):
                     if ctx is not None:
                         self._consume_flush(ctx, marks, shard)
                 finally:
-                    self._emit_flush_trace(pending, marks)
+                    self._emit_flush_trace(pending, marks, shard)
                     if self.qos is not None:
                         self._qos_feedback(pending, shard)
                     self._shard_done(shard, len(pending))
                 total += len(pending)
+            if self._perf is not None and staged:
+                # one wave observation: per-shard skew feeds plus the
+                # dispatch-vs-consume overlap efficiency (the wave's
+                # reason to exist — device compute of shard k+1 under
+                # host consume of shard k)
+                self._perf.observe_wave(
+                    [
+                        (shard.id, len(pending), marks)
+                        for shard, pending, marks, _ctx in staged
+                    ]
+                )
         finally:
             self._gc_resume()
         return total
@@ -1425,7 +1474,9 @@ class BatchingNotaryService(NotaryService):
                 if ctx is not None:
                     self._consume_flush(ctx, marks, shard)
             finally:
-                self._emit_flush_trace(pending, marks)
+                self._emit_flush_trace(pending, marks, shard)
+                if self._perf is not None:
+                    self._perf.observe_flush(shard.id, len(pending), marks)
                 if self.qos is not None:
                     self._qos_feedback(pending, shard)
                 self._shard_done(shard, len(pending))
@@ -1510,6 +1561,8 @@ class BatchingNotaryService(NotaryService):
             self._flush_body(pending, marks)
         finally:
             self._emit_flush_trace(pending, marks)
+            if self._perf is not None:
+                self._perf.observe_flush(0, len(pending), marks)
             if self.qos is not None:
                 self._qos_feedback(pending)
 
@@ -1602,13 +1655,17 @@ class BatchingNotaryService(NotaryService):
         else:
             qos.observe_flush(len(served), len(self._pending))
 
-    def _emit_flush_trace(self, pending, marks) -> None:
+    def _emit_flush_trace(self, pending, marks, shard=None) -> None:
         """Per-frame trace assembly: the flush phases ran batched, so
         each interval is shared across the batch and stamped into every
-        traced member's tree (batch size as an attribute). Spans are
-        emitted on the tracer that OWNS the frame's root span, so mixed
-        tracer setups still assemble whole traces."""
+        traced member's tree (batch size as an attribute; the owning
+        shard id too on the sharded plane, so per-shard alert evidence
+        — the perf plane's skew rule — can cite the traces that
+        touched the hot shard). Spans are emitted on the tracer that
+        OWNS the frame's root span, so mixed tracer setups still
+        assemble whole traces."""
         n = len(pending)
+        sid = shard.id if shard is not None else None
         for p in pending:
             span = p.span
             if not span or span.ended:
@@ -1619,8 +1676,18 @@ class BatchingNotaryService(NotaryService):
                 continue
             tracer = getattr(span, "_tracer", None)
             if tracer is not None:
+                if sid is not None:
+                    span.set_attribute("shard", sid)
                 for phase, t0, t1 in marks:
-                    tracer.span_at("notary." + phase, span, t0, t1, batch=n)
+                    if sid is not None:
+                        tracer.span_at(
+                            "notary." + phase, span, t0, t1,
+                            batch=n, shard=sid,
+                        )
+                    else:
+                        tracer.span_at(
+                            "notary." + phase, span, t0, t1, batch=n
+                        )
             # the root ends when the request is ANSWERED: on the
             # synchronous paths every future resolved inside the flush
             # body, but a distributed provider's commit_async resolves
@@ -1714,7 +1781,11 @@ class BatchingNotaryService(NotaryService):
                     except Exception as e:   # noqa: BLE001 - rethrown below
                         box["error"] = e
 
-                collector = threading.Thread(target=_collect, daemon=True)
+                # named so the sampling profiler (utils/perf.py)
+                # attributes the link wait to this thread, not Thread-N
+                collector = threading.Thread(
+                    target=_collect, name="notary-collect", daemon=True
+                )
                 collector.start()
             t = self._mark("dispatch", t, marks)
         except Exception as e:

@@ -306,6 +306,12 @@ class VerifierWorker:
         #                            requests answered, queue depth = ring
         #                            + handler backlog) so a wedged drain
         #                            thread trips the watchdog
+        perf=None,                 # Optional[utils.perf.PerfPlane]: the
+        #                            worker's verified-request counter
+        #                            becomes an in-process rate history
+        #                            key, and an ingest pipeline built
+        #                            with the same plane reports its
+        #                            stage seconds there
     ):
         self._messaging = messaging
         self._verifier = batch_verifier or default_verifier()
@@ -341,6 +347,13 @@ class VerifierWorker:
                 # fabric has no ring seam: the handler path below still
                 # feeds the pipeline via self._raw
                 pass
+        if perf is not None:
+            perf.watch_rate(
+                "verifier_worker_verified_per_sec",
+                lambda: self._verified.count,
+            )
+            if ingest is not None and getattr(ingest, "perf", None) is None:
+                ingest.perf = perf
         self._heartbeat = None
         if health is not None:
             self._heartbeat = health.heartbeat(
@@ -526,6 +539,7 @@ def main(argv: Optional[list[str]] = None) -> None:
     reference's standalone verifier jar.
     """
     import argparse
+    import sys
 
     from ..crypto import schemes
     from ..crypto.batch_verifier import CpuBatchVerifier, TpuBatchVerifier
@@ -549,6 +563,20 @@ def main(argv: Optional[list[str]] = None) -> None:
         default=0,
         help="enable the pipelined wire-ingest path with this many "
         "decode shards (0 = per-message decode, the default)",
+    )
+    p.add_argument(
+        "--profile-hz",
+        type=float,
+        default=0.0,
+        help="continuous sampling-profiler rate over this worker's "
+        "threads (utils/perf.py; 0 = off). Folded stacks are written "
+        "to --profile-out on shutdown",
+    )
+    p.add_argument(
+        "--profile-out",
+        default="",
+        help="where the folded collapsed stacks land on shutdown "
+        "(flamegraph.pl format; default <db>.folded)",
     )
     p.add_argument(
         "--app",
@@ -590,8 +618,18 @@ def main(argv: Optional[list[str]] = None) -> None:
     # registry as Health.* gauges), not only when node-side futures
     # start timing out
     from ..utils.health import HealthMonitor
+    from ..utils.perf import PerfPlane, PerfPolicy
 
     health = HealthMonitor()
+    # the production worker attributes itself too: kernel
+    # compile-vs-execute accounting (the TPU verifier records into the
+    # plane's process-default), drain-rate history, and — with
+    # --profile-hz — continuous folded-stack profiling of the pump /
+    # decode-pool threads
+    perf = PerfPlane(policy=PerfPolicy(profile_hz=args.profile_hz or 19.0))
+    health.watch_perf(perf)
+    if args.profile_hz:
+        perf.profiler.start()
     worker = VerifierWorker(
         ep,
         args.node,
@@ -600,15 +638,30 @@ def main(argv: Optional[list[str]] = None) -> None:
         advertised_address=("127.0.0.1", ep.listen_port),
         ingest=ingest,
         health=health,
+        perf=perf,
     )
     try:
         while True:
             ep.pump(block=True, timeout=1.0)
             worker.drain()
             health.tick()
+            perf.tick()
     except KeyboardInterrupt:
         pass
     finally:
+        perf.profiler.stop()
+        if args.profile_hz and perf.profiler.samples:
+            # the capture must land somewhere retrievable — the worker
+            # CLI has no web gateway to serve /profile from
+            out_path = args.profile_out or (args.db + ".folded")
+            try:
+                with open(out_path, "w") as f:
+                    f.write(perf.profiler.collapsed() + "\n")
+                print(f"profile: folded stacks -> {out_path}",
+                      file=sys.stderr)
+            except OSError as e:
+                print(f"profile: could not write {out_path}: {e}",
+                      file=sys.stderr)
         ep.stop()
         db.close()
 

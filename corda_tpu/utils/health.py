@@ -270,8 +270,12 @@ class AlertRule:
         self.severity = severity
         self.for_micros = for_micros
         self.clear_for_micros = clear_for_micros
-        # evidence: only flight-recorder traces whose root name contains
-        # this substring are attached (None = the slowest overall)
+        # evidence: only flight-recorder traces matching this token
+        # (span-name substring, or a `shard<k>` span attribute — see
+        # tracing.Trace.matches) are attached; None = the slowest
+        # overall. A CALLABLE resolves at capture time, so a rule whose
+        # subject moves (the perf plane's skew rule: the hot shard of
+        # the moment) cites the traces that touched the CURRENT one.
         self.trace_filter = trace_filter
 
 
@@ -643,6 +647,13 @@ class HealthMonitor:
             )
         )
 
+    def watch_perf(self, perf) -> None:
+        """Install the performance-attribution rules over a
+        utils/perf.PerfPlane: jit-retrace-after-warmup and per-shard
+        skew (utils/perf.py `_perf_rules` — the plane owns the
+        telemetry, this monitor owns the alert walks + evidence)."""
+        perf.install_rules(self)
+
     def watch_ring(
         self,
         name: str,
@@ -798,9 +809,13 @@ class HealthMonitor:
         recorder = getattr(self.tracer, "recorder", None)
         if recorder is not None:
             try:
+                filt = rule.trace_filter
+                if callable(filt):
+                    filt = filt()
                 for t in recorder.slowest():
-                    if rule.trace_filter and not any(
-                        rule.trace_filter in s.name for s in t.spans
+                    if filt and not (
+                        t.matches(filt) if hasattr(t, "matches")
+                        else any(filt in s.name for s in t.spans)
                     ):
                         continue
                     traces.append({
@@ -1143,8 +1158,15 @@ def notary_canary_fn(services, requester_party, tracer=None):
             span = tracer.start_trace(
                 "health.canary", canary=True, seq=state["seq"]
             )
-        svc._pending.append(
-            _PendingNotarisation(stx, requester_party, fut, span=span)
-        )
+        p = _PendingNotarisation(stx, requester_party, fut, span=span)
+        enqueue = getattr(svc, "enqueue_pending", None)
+        if enqueue is not None:
+            # routes to the owning SHARD on a sharded plane — a bare
+            # _pending.append would starve there (the sharded tick
+            # only drains shard queues) and trip the deadman on a
+            # healthy node
+            enqueue(p)
+        else:
+            svc._pending.append(p)
 
     return fn

@@ -212,8 +212,11 @@ class MetricRegistry:
         return self._get_or_create(name, Histogram)
 
     def gauge(self, name: str, fn: Callable[[], float]) -> None:
+        # the error counter exists before the lock is taken (counter()
+        # acquires it too — the registry lock is not reentrant)
+        errors = self.counter(GAUGE_ERRORS)
         with self._lock:
-            self._metrics[name] = _Gauge(fn)
+            self._metrics[name] = _Gauge(fn, name=name, errors=errors)
 
     def get(self, name: str) -> Any:
         return self._metrics.get(name)
@@ -260,12 +263,37 @@ def _histo_lines(p: str, h: Histogram) -> list[str]:
     ]
 
 
+# a gauge whose fn raises still renders (NaN), but the failure is no
+# longer silent: this counter moves on /metrics and the FIRST failure
+# per gauge logs with the exception — a dashboard of quiet NaNs
+# otherwise looks exactly like "nothing to report", forever
+GAUGE_ERRORS = "Metrics.GaugeErrors"
+
+
 class _Gauge:
-    def __init__(self, fn: Callable[[], float]):
+    def __init__(
+        self,
+        fn: Callable[[], float],
+        name: str = "",
+        errors: Optional[Counter] = None,
+    ):
         self._fn = fn
+        self._name = name
+        self._errors = errors
+        self._logged = False
 
     def value(self) -> float:
         try:
             return float(self._fn())
-        except Exception:
+        except Exception as e:
+            if self._errors is not None:
+                self._errors.inc()
+            if not self._logged:
+                self._logged = True   # first failure only: no log storm
+                import logging
+
+                logging.getLogger("corda_tpu.metrics").warning(
+                    "gauge %s failed (returning NaN): %r",
+                    self._name or "<unnamed>", e,
+                )
             return float("nan")

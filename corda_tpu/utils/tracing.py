@@ -218,6 +218,21 @@ class Trace:
     def duration_s(self) -> float:
         return self.root.duration_s
 
+    def matches(self, token: str) -> bool:
+        """Does any span in this trace carry `token` — as a substring
+        of its name, or as `shard<k>` when a flush stamped a `shard`
+        attribute on its phase spans? The health plane's alert
+        evidence filters on this, so a per-shard alert (one hot shard
+        on the PR 6 commit plane) cites the slowest traces that
+        actually touched that shard."""
+        for s in self.spans:
+            if token in s.name:
+                return True
+            shard = s.attributes.get("shard")
+            if shard is not None and token == f"shard{shard}":
+                return True
+        return False
+
     def __len__(self) -> int:
         return len(self.spans)
 

@@ -160,6 +160,110 @@ def test_real_repo_trajectory_parses():
     ), "no two consecutive rounds share any metric"
 
 
+def test_nested_stage_keys_diff_and_gate_lower_is_better(tmp_path, capsys):
+    """The trace metric's stages_seconds breakdown diffs per-stage
+    (hot_path_stage_breakdown.stages_seconds.<k> rows, LOWER is
+    better) and a stage-level regression fails the gate even when the
+    headline value HELD — the exact blind spot the satellite closes:
+    a 2x slower commit hidden behind a faster dispatch."""
+    old_stages = {
+        "decode": 0.010, "dispatch": 0.040, "commit": 0.020,
+    }
+    new_stages = {
+        "decode": 0.010, "dispatch": 0.020, "commit": 0.044,  # +120%
+    }
+    _write_record(
+        tmp_path, "BENCH_r01.json", 1,
+        [_metric(
+            "hot_path_stage_breakdown", 0.98, 0.98,
+            stages_seconds=old_stages,
+            gate_lower_is_better=["stages_seconds"],
+        )],
+    )
+    _write_record(
+        tmp_path, "BENCH_r02.json", 2,
+        [_metric(
+            "hot_path_stage_breakdown", 0.98, 0.98,   # headline holds
+            stages_seconds=new_stages,
+            gate_lower_is_better=["stages_seconds"],
+        )],
+    )
+    old, new = [bh.parse_record(p) for p in bh.discover(str(tmp_path))]
+    rows = {r["metric"]: r for r in bh.diff(old, new)}
+    commit = rows["hot_path_stage_breakdown.stages_seconds.commit"]
+    assert commit["better"] == "lower"
+    assert commit["delta_pct"] == 120.0
+    # dispatch IMPROVED (smaller seconds): never a gate failure
+    dispatch = rows["hot_path_stage_breakdown.stages_seconds.dispatch"]
+    assert dispatch["delta_pct"] == -50.0
+    bad = bh.gate_failures(list(rows.values()), 10.0)
+    assert [r["metric"] for r in bad] == [
+        "hot_path_stage_breakdown.stages_seconds.commit"
+    ]
+    # end to end through main(): the headline held, the stage gates
+    assert bh.main(["--dir", str(tmp_path), "--gate", "10"]) == 1
+    err = capsys.readouterr().err
+    assert "stages_seconds.commit" in err
+
+
+def test_lower_is_better_headline_gates_on_growth_not_improvement(tmp_path):
+    """Overhead-shaped headlines (perf/health plane cost) declare
+    `lower_is_better`: an improvement must pass the gate, growth must
+    fail it — the opposite of throughput rows."""
+    _write_record(
+        tmp_path, "BENCH_r01.json", 1,
+        [_metric("perf_plane_overhead", 0.010, lower_is_better=True),
+         _metric("health_plane_overhead", 0.004, lower_is_better=True)],
+    )
+    _write_record(
+        tmp_path, "BENCH_r02.json", 2,
+        [_metric("perf_plane_overhead", 0.005, lower_is_better=True),
+         _metric("health_plane_overhead", 0.016, lower_is_better=True)],
+    )
+    old, new = [bh.parse_record(p) for p in bh.discover(str(tmp_path))]
+    rows = bh.diff(old, new)
+    bad = bh.gate_failures(rows, 10.0)
+    # the 50% improvement passes; the 4x growth fails
+    assert [r["metric"] for r in bad] == ["health_plane_overhead"]
+
+
+def test_lower_is_better_growth_from_zero_still_gates(tmp_path):
+    """The overhead metrics clamp at 0.0 on a quiet box; a later
+    regression from that 0.0 has an undefined delta percent and used
+    to slip the gate silently. Growth past the absolute floor gates;
+    micro-noise above literal zero does not."""
+    _write_record(
+        tmp_path, "BENCH_r01.json", 1,
+        [_metric("perf_plane_overhead", 0.0, lower_is_better=True),
+         _metric("health_plane_overhead", 0.0, lower_is_better=True)],
+    )
+    _write_record(
+        tmp_path, "BENCH_r02.json", 2,
+        [_metric("perf_plane_overhead", 0.05, lower_is_better=True),
+         _metric("health_plane_overhead", 0.0005, lower_is_better=True)],
+    )
+    old, new = [bh.parse_record(p) for p in bh.discover(str(tmp_path))]
+    bad = bh.gate_failures(bh.diff(old, new), 10.0)
+    assert [r["metric"] for r in bad] == ["perf_plane_overhead"]
+
+
+def test_nested_keys_explode_without_marker_for_old_records(tmp_path):
+    """Records written before the marker existed still explode their
+    stages_seconds via the built-in default, so the committed
+    trajectory gains stage rows as soon as both sides carry them."""
+    _write_record(
+        tmp_path, "BENCH_r01.json", 1,
+        [_metric("hot_path_stage_breakdown", 1.0,
+                 stages_seconds={"commit": 0.02})],
+    )
+    parsed = bh.parse_record(bh.discover(str(tmp_path))[0])
+    rows = {r["metric"]: r for r in bh.diff(parsed, parsed)}
+    assert (
+        rows["hot_path_stage_breakdown.stages_seconds.commit"]["delta_pct"]
+        == 0.0
+    )
+
+
 def test_committed_trajectory_passes_regression_gate():
     """Round 6: `bench_history --gate` IS part of the tier-1 story.
     The newest two committed BENCH_r*.json records must not show a

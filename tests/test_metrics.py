@@ -1,9 +1,13 @@
 """Metrics registry unit tests (reference role: dropwizard MetricRegistry
 held by MonitoringService, node/.../services/api/MonitoringService.kt)."""
 
+import logging
+import math
+import re
+
 import pytest
 
-from corda_tpu.utils.metrics import MetricRegistry
+from corda_tpu.utils.metrics import GAUGE_ERRORS, MetricRegistry
 
 
 def test_counter_and_gauge():
@@ -79,3 +83,134 @@ def test_meter_rates():
     m.mark(10)
     assert m.count == 10
     assert m.mean_rate > 0
+
+
+def test_broken_gauge_counts_errors_and_logs_first_failure(caplog):
+    """A gauge whose fn raises used to return NaN silently, forever —
+    a dashboard of quiet NaNs is indistinguishable from 'nothing to
+    report'. Now every failure moves Metrics.GaugeErrors and the FIRST
+    failure per gauge logs with the exception (no log storm after)."""
+    reg = MetricRegistry()
+    reg.gauge("good", lambda: 1.0)
+    reg.gauge("broken", lambda: 1 / 0)
+    errors = reg.get(GAUGE_ERRORS)
+    assert errors.count == 0
+    with caplog.at_level(logging.WARNING, logger="corda_tpu.metrics"):
+        v1 = reg.get("broken").value()
+        v2 = reg.get("broken").value()
+    assert math.isnan(v1) and math.isnan(v2)      # still renders
+    assert errors.count == 2                      # every failure counted
+    logged = [r for r in caplog.records if "broken" in r.getMessage()]
+    assert len(logged) == 1                       # first failure only
+    assert "ZeroDivisionError" in logged[0].getMessage()
+    # the healthy gauge neither counts nor logs
+    assert reg.get("good").value() == 1.0
+    assert errors.count == 2
+    # the counter itself is on the scrape surface
+    assert "Metrics_GaugeErrors 2" in reg.to_prometheus()
+
+
+# ---------------------------------------------------------------------------
+# strict exposition-format parse of to_prometheus()
+
+_NAME = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_SAMPLE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?"
+    r" (?P<value>NaN|nan|[+-]?(?:\d+\.?\d*(?:[eE][+-]?\d+)?|inf))$"
+)
+_LABEL = re.compile(r'^[a-zA-Z_][a-zA-Z0-9_]*="[^"\\\n]*"$')
+
+
+def _parse_exposition(text: str) -> dict:
+    """Strict line-walk of the Prometheus text format: every sample
+    line must parse, every sample's metric FAMILY must have been
+    declared by a preceding # TYPE, labels must be well-formed.
+    Returns {family: {"type": ..., "samples": [(name, labels, value)]}}."""
+    assert text.endswith("\n"), "exposition must end with a newline"
+    families: dict = {}
+    current = None
+    for line in text.splitlines():
+        assert line == line.strip(), f"stray whitespace: {line!r}"
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            fam, _, kind = rest.partition(" ")
+            assert _NAME.match(fam), f"bad family name {fam!r}"
+            assert kind in ("counter", "gauge", "summary", "histogram"), (
+                f"unknown TYPE {kind!r}"
+            )
+            assert fam not in families, f"duplicate TYPE for {fam!r}"
+            families[fam] = {"type": kind, "samples": []}
+            current = fam
+            continue
+        assert not line.startswith("#"), f"unexpected comment {line!r}"
+        m = _SAMPLE.match(line)
+        assert m, f"unparseable sample line {line!r}"
+        name = m.group("name")
+        labels = m.group("labels")
+        if labels:
+            for part in labels.split(","):
+                assert _LABEL.match(part), f"bad label {part!r} in {line!r}"
+        # a sample belongs to the most recent TYPE'd family; summaries
+        # emit <fam>_sum/<fam>_count under the family's TYPE line
+        fam = current
+        assert fam is not None, f"sample {line!r} before any TYPE"
+        assert name == fam or (
+            name.startswith(fam) and name[len(fam):] in ("_sum", "_count")
+        ), f"sample {name!r} does not belong to family {fam!r}"
+        families[fam]["samples"].append((name, labels, m.group("value")))
+    return families
+
+
+def test_prometheus_exposition_is_strictly_wellformed():
+    """Every metric kind renders with a TYPE line, sanitized names, and
+    parseable samples — including the empty-histogram edge (zero count,
+    quantile lines still well-formed) and dotted/dashed/leading-digit
+    registration names escaped by _sanitize."""
+    reg = MetricRegistry()
+    reg.counter("Notary.BatchesDispatched").inc(3)
+    reg.gauge("Qos.Controller-Batch", lambda: 12)     # dash escapes
+    reg.gauge("0weird.name", lambda: 1)               # leading digit
+    reg.meter("Verifier.Verified").mark(5)
+    h = reg.histogram("Qos.AdmittedLatencyMicros")
+    h.update(5.0)
+    h.update(7.0)
+    reg.histogram("Empty.Histogram")                  # zero updates
+    reg.timer("Notary.FlushPhase.stage").update(0.25)
+    text = reg.to_prometheus()
+    fams = _parse_exposition(text)
+
+    assert fams["Notary_BatchesDispatched"]["type"] == "counter"
+    assert fams["Notary_BatchesDispatched"]["samples"] == [
+        ("Notary_BatchesDispatched", None, "3")
+    ]
+    # _sanitize: non-alnum -> _, leading digit prefixed
+    assert "Qos_Controller_Batch" in fams
+    assert "_0weird_name" in fams
+    # meters: _total counter + _rate_1m gauge, each with its own TYPE
+    assert fams["Verifier_Verified_total"]["type"] == "counter"
+    assert fams["Verifier_Verified_rate_1m"]["type"] == "gauge"
+    # histogram summary: quantile labels + _sum/_count
+    summ = fams["Qos_AdmittedLatencyMicros"]
+    assert summ["type"] == "summary"
+    quantiles = [
+        labels for name, labels, _ in summ["samples"]
+        if name == "Qos_AdmittedLatencyMicros"
+    ]
+    assert quantiles == [
+        'quantile="0.5"', 'quantile="0.95"', 'quantile="0.99"'
+    ]
+    by_name = {n: v for n, _, v in summ["samples"]}
+    assert float(by_name["Qos_AdmittedLatencyMicros_sum"]) == 12.0
+    assert by_name["Qos_AdmittedLatencyMicros_count"] == "2"
+    # the EMPTY histogram still renders a complete, well-formed summary
+    empty = fams["Empty_Histogram"]
+    empty_by_name = {n: v for n, _, v in empty["samples"]}
+    assert empty_by_name["Empty_Histogram_count"] == "0"
+    assert float(empty_by_name["Empty_Histogram_sum"]) == 0.0
+    assert len(empty["samples"]) == 5      # 3 quantiles + sum + count
+    # timers: _total counter + _seconds summary
+    assert fams["Notary_FlushPhase_stage_total"]["type"] == "counter"
+    assert fams["Notary_FlushPhase_stage_seconds"]["type"] == "summary"

@@ -14,8 +14,16 @@ This CLI is that comparison:
 
 Per metric it prints old -> new value, the delta percent, and the
 newest vs_baseline; `--gate <pct>` turns a regression beyond the
-threshold into a non-zero exit so CI can hold the line. Metrics are
-throughput-shaped (higher is better) throughout the table; a metric
+threshold into a non-zero exit so CI can hold the line. Headline
+metrics are throughput-shaped (higher is better); NESTED per-stage
+keys (the trace metric's `stages_seconds` breakdown — decode / merkle
+/ stage / dispatch / kernel / commit seconds, promoted to first-class
+gate keys by bench.py) diff as their own `metric.stages_seconds.<k>`
+rows and gate in the LOWER-is-better direction — a stage-level
+regression fails the gate even when the headline number holds (a 2x
+slower commit phase hidden by a 2x faster dispatch is still a
+regression someone should read). A record may extend the nested set
+by naming dict-valued keys in `gate_lower_is_better`. A metric
 missing from the newest round is reported but never gates (a trimmed
 or skipped secondary is a budget decision, not a regression).
 """
@@ -80,23 +88,74 @@ def parse_record(path: str) -> dict[str, dict]:
     return metrics
 
 
+# nested dict-valued record keys that diff per-entry in the
+# LOWER-is-better direction (seconds). Records may extend this set by
+# listing key names under `gate_lower_is_better` (bench.py's trace
+# metric does) — old records without the marker still explode via
+# this default, so the committed trajectory gains stage rows the
+# moment both sides of a diff carry them.
+_NESTED_LOWER = ("stages_seconds",)
+
+
+def _explode(metrics: dict[str, dict]) -> dict[str, dict]:
+    """Flatten each metric record to gateable rows: the headline value
+    (higher-better) plus one `metric.key.sub` row per entry of every
+    lower-is-better nested dict it carries."""
+    out: dict[str, dict] = {}
+    for name, rec in metrics.items():
+        out[name] = {
+            "value": rec.get("value"),
+            "vs_baseline": rec.get("vs_baseline"),
+            # overhead-shaped headlines (perf/health plane cost)
+            # declare themselves: gating them higher-is-better would
+            # fire on improvements and wave regressions through
+            "better": "lower" if rec.get("lower_is_better") else "higher",
+        }
+        declared = rec.get("gate_lower_is_better")
+        keys = set(_NESTED_LOWER)
+        if isinstance(declared, (list, tuple)):
+            keys |= {str(k) for k in declared}
+        for key in sorted(keys):
+            sub = rec.get(key)
+            if not isinstance(sub, dict):
+                continue
+            for k, v in sub.items():
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    out[f"{name}.{key}.{k}"] = {
+                        "value": v,
+                        "vs_baseline": None,
+                        "better": "lower",
+                    }
+    return out
+
+
 def diff(old: dict[str, dict], new: dict[str, dict]) -> list[dict]:
-    """One row per metric in either round, sorted by name:
-    {"metric", "old", "new", "delta_pct", "vs_baseline"} — delta_pct
-    is None when the metric is missing from one side."""
+    """One row per (possibly nested) metric key in either round,
+    sorted by name: {"metric", "old", "new", "delta_pct",
+    "vs_baseline", "better"} — delta_pct is None when the key is
+    missing from one side; `better` says which direction is an
+    improvement ("higher" for throughput, "lower" for the per-stage
+    seconds rows)."""
+    old_x, new_x = _explode(old), _explode(new)
     rows = []
-    for name in sorted(set(old) | set(new)):
-        o = old.get(name, {}).get("value")
-        n = new.get(name, {}).get("value")
+    for name in sorted(set(old_x) | set(new_x)):
+        o = old_x.get(name, {}).get("value")
+        n = new_x.get(name, {}).get("value")
         delta: Optional[float] = None
         if o is not None and n is not None and o != 0:
             delta = round(100.0 * (n - o) / abs(o), 2)
+        better = (
+            new_x.get(name, {}).get("better")
+            or old_x.get(name, {}).get("better")
+            or "higher"
+        )
         rows.append({
             "metric": name,
             "old": o,
             "new": n,
             "delta_pct": delta,
-            "vs_baseline": new.get(name, {}).get("vs_baseline"),
+            "vs_baseline": new_x.get(name, {}).get("vs_baseline"),
+            "better": better,
         })
     return rows
 
@@ -115,18 +174,46 @@ def format_rows(rows: list[dict], old_label: str, new_label: str) -> str:
             "" if r["vs_baseline"] is None
             else f"  (vs_baseline {r['vs_baseline']:g})"
         )
-        out.append(f"  {r['metric']:<{width}}  {o:>12} -> {n:>12}  {d}{vs}")
+        lo = "  [lower is better]" if r.get("better") == "lower" else ""
+        out.append(
+            f"  {r['metric']:<{width}}  {o:>12} -> {n:>12}  {d}{vs}{lo}"
+        )
     return "\n".join(out)
 
 
+# growth-from-zero floor for lower-is-better rows: a 0.0 old value
+# (the overhead metrics clamp at 0.0 on a quiet box; a stage can round
+# to 0) makes delta_pct undefined, which must not wave a real
+# regression through — but micro-noise above literal zero must not
+# page either. These rows are seconds / overhead fractions, where
+# 1e-3 (1 ms / 0.1%) is comfortably below anything worth gating.
+ZERO_GROWTH_FLOOR = 1e-3
+
+
+def _regressed(row: dict, gate_pct: float) -> bool:
+    delta = row["delta_pct"]
+    if row.get("better") == "lower":
+        if delta is None:
+            # old == 0: any delta percent is undefined — gate on the
+            # absolute growth floor instead of silently passing
+            return (
+                row.get("old") == 0
+                and row.get("new") is not None
+                and row["new"] > ZERO_GROWTH_FLOOR
+            )
+        # seconds rows regress by GROWING — a stage that got slower
+        return delta > gate_pct
+    if delta is None:
+        return False
+    return delta < -gate_pct
+
+
 def gate_failures(rows: list[dict], gate_pct: float) -> list[dict]:
-    """Rows regressing beyond the threshold (new < old by > gate_pct).
+    """Rows regressing beyond the threshold — throughput rows by
+    dropping, lower-is-better (per-stage seconds) rows by growing.
     Missing-in-new metrics don't gate — bench trims/skips secondaries
     under a tight budget, and that must not read as a regression."""
-    return [
-        r for r in rows
-        if r["delta_pct"] is not None and r["delta_pct"] < -gate_pct
-    ]
+    return [r for r in rows if _regressed(r, gate_pct)]
 
 
 def main(argv: Optional[list[str]] = None) -> int:
