@@ -1431,6 +1431,96 @@ def _spi_metric(metric: str, batch: int, iters: int) -> dict:
     return out
 
 
+def _fleet_metric(batch: int, iters: int) -> dict:
+    """Fleet soak (round 8): the simulated-time fleet simulator
+    (corda_tpu/testing/fleet.py) drives a QoS batching notary through a
+    ramp -> steady -> 3x spike -> recovery arc with a wedged-pump
+    freeze mid-steady and injected double-spends, then reconciles the
+    ledger against the model. `value` is simulated-time goodput
+    (signed notarisations per simulated second under churn); the
+    record's `reconciled` and `slo_held` verdicts are REQUIRED-TRUE
+    gate keys for tools/bench_history.py — a soak that stops
+    reconciling fails the gate no matter what the headline says."""
+    from corda_tpu.node import qos as qoslib
+    from corda_tpu.testing import fleet as fl
+
+    R = 20_000
+    cap = max(4, min(batch, 16))
+    clients = int(os.environ.get("BENCH_FLEET_CLIENTS", "256"))
+    steady = max(8, 4 * iters)
+    slo_micros = 5 * R
+    mix = fl.TrafficMix(deadline_micros=6 * R, conflict_fraction=0.06)
+    scenario = fl.FleetScenario(
+        clients=clients,
+        phases=(
+            fl.Phase("ramp", 2, max(1, cap // 2), mix),
+            fl.Phase("steady", steady, cap, mix),
+            fl.Phase("spike", 4, 3 * cap, fl.TrafficMix(
+                deadline_micros=6 * R, bulk_fraction=0.34,
+                conflict_fraction=0.06,
+            )),
+            fl.Phase("steady2", 6, max(1, cap - 1), mix),
+        ),
+        round_micros=R, drain_rounds=60, seed=17,
+    )
+    sim = fl.FleetSim(
+        scenario, "batching",
+        chaos=(fl.freeze(0, at=0.15, until=0.30),),
+        qos_policy=qoslib.QosPolicy(
+            target_p99_micros=slo_micros,
+            min_batch=cap, max_batch=cap, max_wait_micros=0,
+            brownout_after_flushes=3,
+        ),
+    )
+    report = sim.run()
+    checker = fl.InvariantChecker(report)
+    reconcile_error = slo_error = None
+    try:
+        checker.check_replica_agreement()
+        checker.check_ledger_vs_answers()
+        checker.check_exactly_one_winner()
+        checker.check_no_admitted_then_expired()
+        checker.check_lost_bounded()
+        checker.check_brownout_classes()
+        checker.check_health_story()
+        reconciled = True
+    except AssertionError as e:
+        reconciled, reconcile_error = False, str(e)
+    try:
+        checker.check_slo(slo_micros)
+        slo_held = True
+    except AssertionError as e:
+        slo_held, slo_error = False, str(e)
+    outcomes = report.outcomes()
+    goodput = outcomes.get(fl.OUT_SIGNED, 0) / max(
+        report.sim_seconds, 1e-9
+    )
+    return {
+        "metric": "fleet_soak_goodput",
+        "value": round(goodput, 3),
+        "unit": "signed notarisations per SIMULATED second under churn",
+        "vs_baseline": None,
+        # bench_history --gate: these keys must be true in the newest
+        # record — throughput without reconciliation is just a number
+        "gate_required_true": ["reconciled", "slo_held"],
+        "reconciled": reconciled,
+        "slo_held": slo_held,
+        "reconcile_error": reconcile_error,
+        "slo_error": slo_error,
+        "clients": clients,
+        "distinct_clients": report.distinct_clients,
+        "requests": len(report.records),
+        "outcomes": outcomes,
+        "shed_counters": dict(report.qos.snapshot()["shed"]),
+        "bulk_offered": report.bulk_offered,
+        "bulk_shed_brownout": report.bulk_shed_brownout,
+        "faults_injected": len(report.chaos_log),
+        "faults": [e["name"] for e in report.chaos_log],
+        "sim_seconds": round(report.sim_seconds, 6),
+        "slo_target_ms": round(slo_micros / 1e3, 3),
+    }
+
+
 def _parity_metric(batch: int, iters: int) -> dict:
     """Reduced-n refresh of the windowed+plain kernel-parity artifact
     (VERDICT r3 #8): regenerates KERNEL_PARITY.json from the default
@@ -1507,6 +1597,11 @@ def _run_metric(metric: str, batch: int, iters: int) -> dict:
         if batch > 512:
             out["batch_requested"] = batch   # cap visible in the record
         return out
+    if metric == "fleet":
+        out = _fleet_metric(min(batch, 16), iters)
+        if batch > 16:
+            out["batch_requested"] = batch   # cap visible in the record
+        return out
     if metric == "parity":
         return _parity_metric(batch, iters)
     return _spi_metric(metric, batch, iters)
@@ -1546,7 +1641,7 @@ def _run_child(m: str, env: dict, timeout: float) -> bool:
 
 
 def _quick(metric: str) -> None:
-    """`python bench.py --quick ingest|trace|qos|health`: tiny,
+    """`python bench.py --quick ingest|trace|qos|health|fleet`: tiny,
     CPU-safe smoke runs so tier-1 (JAX_PLATFORMS=cpu, no device) can
     assert the perf plumbing emits well-formed records without paying
     a real measurement. Values from this mode are NOT comparable to
@@ -1572,6 +1667,13 @@ def _quick(metric: str) -> None:
                (inline wave AND worker threads) and that the sweep
                record is well-formed — the deterministic correctness
                gate is tests/test_sharded_notary.py.
+      fleet  — the simulated-time fleet soak (round 8): a small
+               chaos-and-reconcile arc on the CPU rig; asserts the
+               soak reconciled bit-exact vs the model, held the SLO
+               through steady state, shed during the spike, and that
+               the chaos plane injected (and recovered from) its
+               fault — the full-shape deterministic gate is
+               tests/test_fleet.py.
       perf   — the perf-attribution plane (round 7): asserts the
                sampling profiler's measured overhead stays <=
                BENCH_PERF_OVERHEAD_MAX (default 2%) of the notary CPU
@@ -1666,6 +1768,29 @@ def _quick(metric: str) -> None:
                 "not counted"
             )
         return
+    if metric == "fleet":
+        batch = int(os.environ.get("BENCH_BATCH", "8"))
+        iters = int(os.environ.get("BENCH_ITERS", "1"))
+        out = _fleet_metric(batch, iters)
+        out["quick"] = True
+        print(json.dumps(out), flush=True)
+        if not out["reconciled"]:
+            raise SystemExit(
+                f"fleet soak failed reconciliation: "
+                f"{out['reconcile_error']}"
+            )
+        if not out["slo_held"]:
+            raise SystemExit(
+                f"fleet soak breached the steady-state SLO: "
+                f"{out['slo_error']}"
+            )
+        if out["outcomes"].get("shed", 0) <= 0:
+            raise SystemExit("the 3x spike shed nothing")
+        if out["faults_injected"] < 1:
+            raise SystemExit("the chaos plane injected no fault")
+        if out["value"] <= 0:
+            raise SystemExit("zero goodput through the soak")
+        return
     if metric == "qos":
         batch = int(os.environ.get("BENCH_BATCH", "24"))
         out = _qos_metric(batch, int(os.environ.get("BENCH_ITERS", "2")))
@@ -1713,7 +1838,7 @@ def _quick(metric: str) -> None:
     if metric != "ingest":
         raise SystemExit(
             f"--quick supports 'ingest', 'trace', 'qos', 'health', "
-            f"'perf' or 'shards', not {metric!r}"
+            f"'perf', 'fleet' or 'shards', not {metric!r}"
         )
     batch = int(os.environ.get("BENCH_BATCH", "256"))
     iters = int(os.environ.get("BENCH_ITERS", "1"))
@@ -1733,7 +1858,7 @@ def main() -> None:
     if argv:
         raise SystemExit(
             f"unknown arguments {argv!r} "
-            "(try --quick ingest|trace|qos|health|perf|shards)"
+            "(try --quick ingest|trace|qos|health|perf|fleet|shards)"
         )
     t_start = time.perf_counter()
     # On a remote-attached TPU the host<->device link latency (~50-100
@@ -1746,7 +1871,7 @@ def main() -> None:
     known = (
         "all", "p256", "mixed", "merkle", "notary", "notary_commit_plane",
         "ingest", "ingest_pipelined", "trace", "qos", "health", "perf",
-        "montmul", "parity",
+        "fleet", "montmul", "parity",
     )
     if metric not in known:
         # a typo must not record a p256-only rate under another name
@@ -1785,7 +1910,7 @@ def main() -> None:
     # parity runs LAST of the optional work (cheapest to drop), but
     # before the headline so the headline stays the final stdout line
     for m in ("mixed", "merkle", "notary", "ingest", "ingest_pipelined",
-              "trace", "qos", "health", "perf", "parity"):
+              "trace", "qos", "health", "perf", "fleet", "parity"):
         avail = left() - reserve
         if avail < 60:
             print(
@@ -1797,7 +1922,7 @@ def main() -> None:
         env = dict(os.environ, BENCH_METRIC=m)
         if avail < 300 and m in (
             "mixed", "merkle", "notary", "ingest", "ingest_pipelined",
-            "trace", "qos", "health", "perf",
+            "trace", "qos", "health", "perf", "fleet",
         ):
             # trim before dropping: one timed rep at a shallower batch
             # still yields a usable point for the table

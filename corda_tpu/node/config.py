@@ -212,11 +212,67 @@ class NodeConfig:
 def load_config(path: str) -> NodeConfig:
     """Parse a TOML node config; strict about unknown keys (typos in a
     config must fail loudly at boot, not silently default)."""
-    import tomllib
-
-    with open(path, "rb") as f:
-        raw = tomllib.load(f)
+    try:
+        import tomllib
+    except ModuleNotFoundError:
+        # Python < 3.11 has no stdlib TOML parser and the container
+        # bakes no third-party one in; fall back to the subset reader
+        # below, which covers exactly the dialect write_config emits
+        raw = _load_toml_subset(path)
+    else:
+        with open(path, "rb") as f:
+            raw = tomllib.load(f)
     return config_from_dict(raw)
+
+
+def _load_toml_subset(path: str) -> dict:
+    """Minimal TOML reader for the config dialect this codebase
+    round-trips (`write_config`): `[section]` / `[[section.array]]`
+    headers and `key = value` pairs whose values are JSON-compatible
+    TOML — basic strings, integers, floats, booleans and arrays of
+    strings (true/false and string escaping are shared between the two
+    grammars, so each value parses with json.loads). Anything outside
+    that subset raises ConfigError naming the line, the same fail-loud
+    contract the strict binding gives typos."""
+    import json
+
+    root: dict = {}
+    current: dict = root
+    with open(path, encoding="utf-8") as f:
+        for lineno, raw_line in enumerate(f, 1):
+            line = raw_line.strip()
+            if not line or line.startswith("#"):
+                continue
+            if line.startswith("[[") and line.endswith("]]"):
+                parts = line[2:-2].strip().split(".")
+                parent = root
+                for key in parts[:-1]:
+                    parent = parent.setdefault(key, {})
+                current = {}
+                parent.setdefault(parts[-1], []).append(current)
+                continue
+            if line.startswith("[") and line.endswith("]"):
+                parts = line[1:-1].strip().split(".")
+                parent = root
+                for key in parts[:-1]:
+                    parent = parent.setdefault(key, {})
+                current = parent.setdefault(parts[-1], {})
+                continue
+            key, sep, value = line.partition("=")
+            if not sep:
+                raise ConfigError(
+                    f"{path}:{lineno}: expected 'key = value', got "
+                    f"{line!r}"
+                )
+            try:
+                current[key.strip()] = json.loads(value.strip())
+            except ValueError:
+                raise ConfigError(
+                    f"{path}:{lineno}: unsupported TOML value "
+                    f"{value.strip()!r} (the no-tomllib fallback reads "
+                    f"only strings, numbers, booleans and string arrays)"
+                )
+    return root
 
 
 def config_from_dict(raw: dict) -> NodeConfig:

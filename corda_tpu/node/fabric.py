@@ -41,7 +41,7 @@ from typing import Callable, Optional
 
 from ..core import serialization as ser
 from ..crypto import schemes
-from .messaging import Handler, Message, MessagingService
+from .messaging import FabricFaults, Handler, Message, MessagingService
 
 _FABRIC_SCHEMA = """
 CREATE TABLE IF NOT EXISTS fabric_out (
@@ -227,6 +227,7 @@ class FabricEndpoint(MessagingService):
         port: int = 0,
         tls: Optional[TlsIdentity] = None,
         advertise_host: Optional[str] = None,
+        faults: Optional[FabricFaults] = None,
     ):
         self._name = name
         self._keypair = keypair
@@ -235,6 +236,14 @@ class FabricEndpoint(MessagingService):
         self._host = host
         self._port = port
         self._tls = tls
+        # first-class fault-injection seam (messaging.FabricFaults):
+        # consulted at bridge-connect, accept and per-frame ingest time.
+        # Durability does the heavy lifting — a blocked/dropped frame
+        # stays journaled and redelivers on heal, a duplicated ingest is
+        # absorbed by the (sender, uid) PRIMARY KEY — so chaos tests
+        # exercise the SAME recovery paths a real outage would. None
+        # (production default) costs one attribute check per frame.
+        self.faults = faults
         # the address peers should dial back (differs from the bind
         # host behind NAT or when bound to 0.0.0.0)
         self.advertise_host = advertise_host or host
@@ -423,6 +432,15 @@ class FabricEndpoint(MessagingService):
                     await asyncio.wait_for(wake.wait(), timeout=30)
                 except asyncio.TimeoutError:
                     continue
+            if self.faults is not None and self.faults.blocked(
+                self._name, peer
+            ):
+                # partitioned / peer down: hold the journal and retry —
+                # the SAME backoff loop an unreachable peer exercises,
+                # without burning a connect attempt
+                await asyncio.sleep(min(backoff, 5.0))
+                backoff = min(backoff * 2, 5.0)
+                continue
             addr = self._resolve(peer) or self.learned_peers.get(peer)
             if addr is None:
                 await asyncio.sleep(min(backoff, 5.0))
@@ -525,6 +543,11 @@ class FabricEndpoint(MessagingService):
     async def _on_connection(self, reader, writer) -> None:
         try:
             sender = await self._auth_server(reader, writer)
+            faults = self.faults
+            if faults is not None and faults.blocked(sender, self._name):
+                # inbound partition: refuse the authenticated peer —
+                # its journal holds the frames for redelivery on heal
+                raise ConnectionError("fault: partitioned")
             while True:
                 frame = await _read_frame(reader)
                 if frame[0] != "msg":
@@ -533,7 +556,28 @@ class FabricEndpoint(MessagingService):
                     raise ConnectionError("malformed msg frame")
                 seq, topic, payload, uid = frame[1:5]
                 headers = bytes(frame[5]) if len(frame) == 6 else None
+                faults = self.faults
+                if faults is not None:
+                    if faults.blocked(sender, self._name):
+                        # partition landed mid-stream: sever BEFORE the
+                        # ack so the sender's journal keeps the row
+                        raise ConnectionError("fault: partitioned")
+                    delay = faults.delay_micros(sender, self._name)
+                    if delay:
+                        # slow peer: real seconds on the real fabric
+                        await asyncio.sleep(delay / 1e6)
+                    if faults.should_drop(sender, self._name):
+                        # frame lost on the wire: unacked, so the
+                        # bridge re-sends it after reconnect/backoff —
+                        # at-least-once does the healing
+                        raise ConnectionError("fault: frame dropped")
                 self._ingest(sender, topic, bytes(payload), uid, headers)
+                if faults is not None and faults.should_duplicate(
+                    sender, self._name
+                ):
+                    # wire duplication: the (sender, uid) PRIMARY KEY
+                    # swallows the copy before it can re-dispatch
+                    self._ingest(sender, topic, bytes(payload), uid, headers)
                 _write_frame(writer, ["ack", seq])
                 await writer.drain()
         except (

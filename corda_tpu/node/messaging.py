@@ -19,6 +19,7 @@ surface in Ring-3 tests, not in production.
 from __future__ import annotations
 
 import random
+import threading
 from collections import deque
 from dataclasses import dataclass
 from typing import Callable, Optional
@@ -110,6 +111,203 @@ def register_ring_gauges(metrics, topic: str, ring, parked_count=None) -> None:
         metrics.gauge(f"Ingest.{topic}.Parked", parked_count)
 
 
+class FabricFaults:
+    """First-class fault-injection seam shared by BOTH fabrics.
+
+    The chaos plane (testing/fleet.py) needs to break the network the
+    way production breaks — partitions, dead nodes, slow links, frame
+    drop/duplication — WITHOUT monkeypatching fabric internals. This
+    object is the injection point: the in-memory fabric consults it at
+    delivery time (simulated-time delays on the shared TestClock), the
+    TCP fabric (node/fabric.py) consults it at bridge-connect, accept
+    and per-frame ingest time (real-time delays). Both fabrics keep
+    their delivery guarantees UNDER the faults — a blocked or delayed
+    frame stays queued/journaled and redelivers on heal, a duplicated
+    frame is absorbed by (sender, uid) dedupe — so chaos tests exercise
+    the same code paths a real outage would.
+
+    Every control-plane call appends to `log` with a fault-clock
+    timestamp: the "injected reality" an invariant checker compares the
+    health/cluster story against. Thread-safe: the TCP fabric reads
+    from its loop thread while a test thread injects.
+    """
+
+    def __init__(self, clock=None, seed: int = 0):
+        self._clock = clock
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._groups: tuple[frozenset, ...] = ()   # partition groups
+        self._down: set[str] = set()               # killed nodes
+        self._delay: dict[tuple[str, str], int] = {}    # directional us
+        self._drop: dict[tuple[str, str], float] = {}   # drop probability
+        self._dup: dict[tuple[str, str], float] = {}    # dup probability
+        self.log: list[dict] = []
+
+    def now_micros(self) -> int:
+        if self._clock is not None:
+            return self._clock.now_micros()
+        import time
+
+        return time.time_ns() // 1_000
+
+    def _record(self, action: str, **detail) -> None:
+        self.log.append(
+            {"at_micros": self.now_micros(), "action": action, **detail}
+        )
+
+    # -- control plane (the chaos side) --------------------------------------
+
+    def partition(self, *groups) -> None:
+        """Split the network: links BETWEEN groups are blocked (both
+        directions), links within a group stay up. Nodes in no group
+        are unreachable from every group — `partition({"A","B"})`
+        isolates everyone else from A and B. Replaces any previous
+        partition; `heal()` removes it."""
+        with self._lock:
+            self._groups = tuple(frozenset(g) for g in groups)
+        self._record("partition", groups=[sorted(g) for g in groups])
+
+    def heal(self) -> None:
+        with self._lock:
+            self._groups = ()
+        self._record("heal")
+
+    def kill(self, name: str) -> None:
+        """Mark a node down: nothing reaches it, nothing leaves it.
+        Frames addressed to it stay queued (in-memory) / journaled
+        (TCP) and deliver after `revive` — the store-and-forward
+        semantics a real crash exercises."""
+        with self._lock:
+            self._down.add(name)
+        self._record("kill", node=name)
+
+    def revive(self, name: str) -> None:
+        with self._lock:
+            self._down.discard(name)
+        self._record("revive", node=name)
+
+    def slow_link(
+        self, a: str, b: str, delay_micros: int, symmetric: bool = True
+    ) -> None:
+        """Add per-frame latency on a link (0 clears it). The in-memory
+        fabric holds frames until the TestClock passes send+delay; the
+        TCP fabric sleeps the same interval before acking."""
+        with self._lock:
+            for pair in ((a, b), (b, a)) if symmetric else ((a, b),):
+                if delay_micros > 0:
+                    self._delay[pair] = int(delay_micros)
+                else:
+                    self._delay.pop(pair, None)
+        self._record(
+            "slow_link", a=a, b=b,
+            delay_micros=int(delay_micros), symmetric=symmetric,
+        )
+
+    def slow_peer(self, name: str, delay_micros: int, peers=()) -> None:
+        """Slow EVERY link touching `name` (both directions). With a
+        known peer set, pass it; the wildcard key slows links to/from
+        unknown peers too."""
+        with self._lock:
+            for key in (("*", name), (name, "*")):
+                if delay_micros > 0:
+                    self._delay[key] = int(delay_micros)
+                else:
+                    self._delay.pop(key, None)
+        for p in peers:
+            self.slow_link(name, p, delay_micros)
+        if not peers:
+            self._record(
+                "slow_peer", node=name, delay_micros=int(delay_micros)
+            )
+
+    def drop_link(
+        self, a: str, b: str, rate: float, symmetric: bool = True
+    ) -> None:
+        """Drop frames on a link with probability `rate` (0 clears).
+        Safe only for traffic with an upstream retry (consensus
+        heartbeats, the TCP fabric's journaled bridges) — the seeded
+        RNG keeps runs deterministic."""
+        with self._lock:
+            for pair in ((a, b), (b, a)) if symmetric else ((a, b),):
+                if rate > 0:
+                    self._drop[pair] = float(rate)
+                else:
+                    self._drop.pop(pair, None)
+        self._record("drop_link", a=a, b=b, rate=rate, symmetric=symmetric)
+
+    def duplicate_link(
+        self, a: str, b: str, rate: float, symmetric: bool = True
+    ) -> None:
+        """Deliver frames twice with probability `rate` (0 clears) —
+        the receiver's (sender, uid) dedupe must absorb the copy."""
+        with self._lock:
+            for pair in ((a, b), (b, a)) if symmetric else ((a, b),):
+                if rate > 0:
+                    self._dup[pair] = float(rate)
+                else:
+                    self._dup.pop(pair, None)
+        self._record(
+            "duplicate_link", a=a, b=b, rate=rate, symmetric=symmetric
+        )
+
+    # -- query plane (the fabric side) ---------------------------------------
+
+    def down(self, name: str) -> bool:
+        with self._lock:
+            return name in self._down
+
+    def blocked(self, sender: str, target: str) -> bool:
+        """True when no frame may move sender -> target right now:
+        either end is down, or a partition separates them."""
+        with self._lock:
+            if sender in self._down or target in self._down:
+                return True
+            if not self._groups:
+                return False
+            ga = gb = None
+            for g in self._groups:
+                if sender in g:
+                    ga = g
+                if target in g:
+                    gb = g
+            return ga is not gb or ga is None
+
+    def delay_micros(self, sender: str, target: str) -> int:
+        with self._lock:
+            return max(
+                self._delay.get((sender, target), 0),
+                self._delay.get(("*", target), 0),
+                self._delay.get((sender, "*"), 0),
+            )
+
+    def should_drop(self, sender: str, target: str) -> bool:
+        with self._lock:
+            rate = self._drop.get((sender, target), 0.0)
+            return rate > 0 and self._rng.random() < rate
+
+    def should_duplicate(self, sender: str, target: str) -> bool:
+        with self._lock:
+            rate = self._dup.get((sender, target), 0.0)
+            return rate > 0 and self._rng.random() < rate
+
+    def snapshot(self) -> dict:
+        """JSON-safe view of the ACTIVE faults (the log has history)."""
+        with self._lock:
+            return {
+                "partition": [sorted(g) for g in self._groups],
+                "down": sorted(self._down),
+                "slow_links": {
+                    f"{a}->{b}": d for (a, b), d in sorted(self._delay.items())
+                },
+                "drop_links": {
+                    f"{a}->{b}": r for (a, b), r in sorted(self._drop.items())
+                },
+                "duplicate_links": {
+                    f"{a}->{b}": r for (a, b), r in sorted(self._dup.items())
+                },
+            }
+
+
 class InMemoryMessagingNetwork:
     """Shared fabric for Ring-3 tests: deterministic, manually pumped.
 
@@ -119,14 +317,36 @@ class InMemoryMessagingNetwork:
     interleaving *between* pair-queues (never reordering within one) to
     surface cross-peer races deterministically — the reference's
     pumpSend/pumpReceive + runNetwork loop.
+
+    With a `FabricFaults` plane (and the clock it shares), delivery
+    becomes fault-aware: frames across a partition or to a down node
+    stay QUEUED (they deliver after heal/revive — store-and-forward,
+    not loss), slow links hold frames until the TestClock passes
+    send-time + delay, and drop/duplicate rates apply at delivery with
+    the plane's seeded RNG. Per-pair FIFO order holds under every
+    fault: only the HEAD of a pair queue is ever eligible.
     """
 
-    def __init__(self):
-        self._queues: dict[tuple[str, str], deque[Message]] = {}
+    def __init__(self, clock=None, faults: Optional[FabricFaults] = None):
+        # queue entries are (msg, ready_at_micros)
+        self._queues: dict[tuple[str, str], deque] = {}
         self._order: deque[tuple[str, str]] = deque()
         self._endpoints: dict[str, "InMemoryMessaging"] = {}
         self._dropped: list[Message] = []
         self.sent_count = 0
+        self._clock = clock
+        self.faults = faults
+
+    def _now(self) -> int:
+        if self._clock is not None:
+            return self._clock.now_micros()
+        if self.faults is not None:
+            # no network clock: judge slow-link delays on the fault
+            # plane's clock (its wall-clock fallback keeps delayed
+            # frames DELIVERABLE eventually — a ready_at computed
+            # against a clock pinned at 0 would strand them forever)
+            return self.faults.now_micros()
+        return 0
 
     def endpoint(self, name: str) -> "InMemoryMessaging":
         if name not in self._endpoints:
@@ -136,11 +356,48 @@ class InMemoryMessagingNetwork:
     def _enqueue(self, msg: Message, target: str) -> None:
         self.sent_count += 1
         pair = (msg.sender, target)
-        self._queues.setdefault(pair, deque()).append(msg)
+        ready_at = 0
+        if self.faults is not None:
+            delay = self.faults.delay_micros(msg.sender, target)
+            if delay:
+                ready_at = self._now() + delay
+        self._queues.setdefault(pair, deque()).append((msg, ready_at))
         self._order.append(pair)
 
+    def _deliverable_pairs(self) -> list[tuple[str, str]]:
+        """Pairs whose HEAD frame may deliver now, in earliest-send
+        order (faults mode only)."""
+        now = self._now()
+        faults = self.faults
+        seen = set()
+        out = []
+        for pair in self._order:
+            if pair in seen:
+                continue
+            seen.add(pair)
+            q = self._queues.get(pair)
+            if not q:
+                continue
+            _, ready_at = q[0]
+            if ready_at > now:
+                continue
+            if faults.blocked(pair[0], pair[1]):
+                continue
+            ep = self._endpoints.get(pair[1])
+            if ep is None or not ep.running:
+                # a dead endpoint under chaos is a DOWN node: keep the
+                # frame queued for redelivery after restart (the
+                # durable fabric's store-and-forward analogue)
+                continue
+            out.append(pair)
+        return out
+
     def pump(self, n: int = 1, rng: Optional[random.Random] = None) -> int:
-        """Deliver up to n messages; returns how many were delivered."""
+        """Deliver up to n messages; returns how many were delivered.
+        In faults mode only deliverable frames move — blocked/unready
+        ones stay queued and pump returns short."""
+        if self.faults is not None:
+            return self._pump_faulty(n, rng)
         delivered = 0
         while self._order and delivered < n:
             if rng is None:
@@ -149,7 +406,7 @@ class InMemoryMessagingNetwork:
                 live = [p for p, q in self._queues.items() if q]
                 pair = live[rng.randrange(len(live))]
                 self._order.remove(pair)   # earliest occurrence
-            msg = self._queues[pair].popleft()
+            msg, _ = self._queues[pair].popleft()
             ep = self._endpoints.get(pair[1])
             if ep is None or not ep.running:
                 self._dropped.append(msg)
@@ -158,17 +415,51 @@ class InMemoryMessagingNetwork:
             delivered += 1
         return delivered
 
+    def _pump_faulty(self, n: int, rng: Optional[random.Random]) -> int:
+        faults = self.faults
+        delivered = 0
+        while delivered < n:
+            live = self._deliverable_pairs()
+            if not live:
+                break
+            pair = live[0] if rng is None else live[rng.randrange(len(live))]
+            self._order.remove(pair)   # earliest occurrence
+            msg, _ = self._queues[pair].popleft()
+            if faults.should_drop(pair[0], pair[1]):
+                self._dropped.append(msg)
+            else:
+                ep = self._endpoints[pair[1]]
+                ep._deliver(msg)
+                if faults.should_duplicate(pair[0], pair[1]):
+                    ep._deliver(msg)   # (sender, uid) dedupe absorbs
+            delivered += 1
+        return delivered
+
     def run(self, seed: Optional[int] = None) -> int:
-        """Pump until quiescent. Returns total messages delivered."""
+        """Pump until quiescent (nothing DELIVERABLE left — blocked or
+        delayed frames stay queued). Returns total delivered."""
         rng = random.Random(seed) if seed is not None else None
         total = 0
-        while self._order:
-            total += self.pump(1, rng)
-        return total
+        while True:
+            got = self.pump(1, rng)
+            if not got:
+                return total
+            total += got
 
     @property
     def pending(self) -> int:
         return len(self._order)
+
+    @property
+    def deliverable(self) -> int:
+        """Pairs with a deliverable HEAD frame right now (a quiescence
+        signal: nonzero iff pump(1) would move something) — `pending`
+        without a fault plane; under faults, blocked/delayed frames
+        don't count (quiescence must not wait on them). One scan of
+        the order deque, no per-queue walk."""
+        if self.faults is None:
+            return len(self._order)
+        return len(self._deliverable_pairs())
 
 
 class InMemoryMessaging(MessagingService):

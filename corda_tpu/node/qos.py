@@ -358,6 +358,12 @@ class NotaryQos:
         self._brownout_level = 0
         self._backlog_trend = 0       # +k growing / -k shrinking streak
         self._last_backlog = 0
+        # every brownout level change, as (node-clock micros, new
+        # level): the assertion surface chaos rigs reconcile against —
+        # "brownout engaged during the spike and ONLY during the
+        # spike" needs the transition times, not just the live level.
+        # Bounded (an oscillation bug must not grow memory forever).
+        self.brownout_transitions: list[tuple[int, int]] = []
         self._lock = threading.Lock()
         # sharded commit plane (round 6): one AIMD controller + admitted
         # latency histogram PER SHARD, created by ensure_shards — a hot
@@ -481,11 +487,21 @@ class NotaryQos:
             if self._backlog_trend >= pol.brownout_after_flushes:
                 if self._brownout_level < 2:
                     self._brownout_level += 1
+                    self._note_transition()
                 self._backlog_trend = 0
             elif self._backlog_trend <= -pol.brownout_after_flushes:
                 if self._brownout_level > 0:
                     self._brownout_level -= 1
+                    self._note_transition()
                 self._backlog_trend = 0
+
+    def _note_transition(self) -> None:
+        """Record one brownout level change (caller holds the lock)."""
+        self.brownout_transitions.append(
+            (self.now_micros(), self._brownout_level)
+        )
+        if len(self.brownout_transitions) > 256:
+            del self.brownout_transitions[:128]
 
     @property
     def brownout_level(self) -> int:
@@ -517,6 +533,11 @@ class NotaryQos:
                 "level": self._brownout_level,
                 "trend": self._backlog_trend,
                 "after_flushes": self.policy.brownout_after_flushes,
+                # (at_micros, level) history — the chaos-rig assertion
+                # surface (tail only; the live level is above)
+                "transitions": [
+                    list(t) for t in self.brownout_transitions[-16:]
+                ],
             },
             "shed": {
                 reason: counter.count

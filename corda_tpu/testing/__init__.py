@@ -1,5 +1,25 @@
-"""Test kit: MockNetwork (Ring 3), test identities, ledger DSL."""
+"""Test kit: MockNetwork (Ring 3), test identities, ledger DSL, and
+the simulated-time fleet soak (fleet.py)."""
 
+from .fleet import (
+    ChaosEvent,
+    ChaosPlane,
+    FleetScenario,
+    FleetSim,
+    InvariantChecker,
+    Phase,
+    TrafficMix,
+)
 from .mock_network import MockNetwork, MockNode
 
-__all__ = ["MockNetwork", "MockNode"]
+__all__ = [
+    "ChaosEvent",
+    "ChaosPlane",
+    "FleetScenario",
+    "FleetSim",
+    "InvariantChecker",
+    "MockNetwork",
+    "MockNode",
+    "Phase",
+    "TrafficMix",
+]

@@ -1,0 +1,1388 @@
+"""Fleet soak: a deterministic, simulated-time fleet simulator.
+
+The serving control plane grew piecewise — deadlines/admission/brownout
+(node/qos.py), watchdogs/SLO alerts//cluster (utils/health.py), the
+sharded commit plane (node/notary.py), perf attribution (utils/perf.py)
+— but nothing drove them TOGETHER at production shape. This module is
+that driver, the ROADMAP's "acceptance bar for 'millions of users'
+claims, runnable in CI": thousands of client identities multiplexed
+against a multi-node notary cluster in all three flavours (batching
+single-node, Raft, BFT), with churn injected through first-class fabric
+hooks and the ledger reconciled bit-exact against a model afterwards.
+
+Reference shape: `tools/loadtest` (LoadTest.kt's generate/apply/gather/
+reconcile loop, Disruption.kt's kill/restart/slow interleavings,
+CrossCashTest's invariant) — but where the reference drives real
+processes over SSH for minutes, this runs on the shared `TestClock`:
+a thousand-node-second soak executes in CI seconds, deterministically.
+
+Three cooperating pieces:
+
+  `FleetSim` — the scenario engine. A declarative `FleetScenario`
+      (client count, phases of ramp/steady/spike traffic, a
+      `TrafficMix` of deadline distributions, bulk traffic, injected
+      double-spends and cross-shard conflicts) executes round by
+      round: each round submits through the REAL notary entry points
+      (`NotaryService.process` generators, stepped exactly the way the
+      flow state machine steps them), pumps the fabric to quiescence,
+      beats/ticks every member's health plane, samples the
+      healthz//cluster story into a timeline, and advances the clock.
+
+  `ChaosPlane` — fault scheduling at stream fractions (the
+      `Disruption.at_fraction` idiom). Faults act through the
+      first-class seams — `messaging.FabricFaults` for partitions/
+      slow links/drops, member kill+rebuild for crash-restart — never
+      by monkeypatching. Every application/revert is logged with its
+      simulated-time window: the "injected reality" the invariant
+      checker reconciles the control plane's story against.
+
+  `InvariantChecker` — reconciliation. After the soak: every alive
+      replica's committed map must agree; every injected double-spend
+      must have exactly one winner ON THE LEDGER; signed answers must
+      match the ledger exactly (no phantom commits, no lost value);
+      nothing admitted-then-expired; the steady-state admitted p99
+      must hold the SLO; brownout must have shed ONLY bulk/
+      deadline-less traffic; and the health plane must have told the
+      truth — healthz flipped while the fault was live, /cluster
+      marked the victim, both recovered after the heal.
+
+Throughput with reconciliation is a claim; without it, just a number.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from ..core.contracts import StateRef
+from ..core.identity import Party
+from ..core.transactions import WireTransaction
+from ..crypto import schemes
+from ..crypto.hashes import SecureHash
+from ..node import qos as qoslib
+from ..node.messaging import FabricFaults, Message
+from ..node.notary import NotaryError
+from ..utils.health import AlertRule, ClusterHealth, HealthMonitor, HealthPolicy
+from .mock_network import MockNetwork
+
+# outcome vocabulary — one set for records, reports and assertions
+OUT_SIGNED = "signed"
+OUT_CONFLICT = "conflict"
+OUT_SHED = "shed"
+OUT_UNAVAILABLE = "unavailable"
+OUT_LOST = "lost"          # future never resolved (in flight at a kill)
+
+FLAVOURS = ("batching", "raft", "bft")
+
+
+# ---------------------------------------------------------------------------
+# scenario DSL
+
+
+@dataclass(frozen=True)
+class TrafficMix:
+    """What one phase's offered traffic looks like.
+
+    `bulk_fraction` of the offer is deadline-less bulk (resolution-
+    flood-shaped) traffic routed through the QoS lane seam (batching
+    flavour only — cluster flavours have no lane router and ignore
+    it). `conflict_fraction` of interactive spends ALSO submit a rival
+    transaction claiming the same input — the injected double-spends
+    the ledger must resolve to exactly one winner. `cross_shard_
+    fraction` of spends carry two inputs routed to different commit-
+    plane shards (sharded batching only)."""
+
+    deadline_micros: int = 60_000
+    deadline_jitter_micros: int = 0
+    bulk_fraction: float = 0.0
+    conflict_fraction: float = 0.0
+    cross_shard_fraction: float = 0.0
+
+
+@dataclass(frozen=True)
+class Phase:
+    """One traffic phase: `offered_per_round` requests injected each of
+    `rounds` rounds. Ramp/steady/spike arcs are just phase sequences."""
+
+    name: str
+    rounds: int
+    offered_per_round: int
+    mix: Optional[TrafficMix] = None     # None = the scenario default
+
+
+@dataclass(frozen=True)
+class FleetScenario:
+    """The declarative soak: who offers how much, when, for how long.
+
+    `clients` identities are minted up front (names `fleet-c<k>` over a
+    small keypair pool — non-validating notaries authenticate requesters
+    by name, so the pool keeps thousand-client fleets cheap) and
+    round-robined through the traffic, so a long enough stream touches
+    EVERY identity. `round_micros` is the simulated wall step between
+    delivery rounds; total simulated soak time is
+    sum(phase rounds) * round_micros."""
+
+    clients: int = 1000
+    phases: tuple[Phase, ...] = (
+        Phase("ramp", 4, 8),
+        Phase("steady", 12, 16),
+        Phase("spike", 4, 48),
+        Phase("steady2", 8, 16),
+    )
+    mix: TrafficMix = field(default_factory=TrafficMix)
+    round_micros: int = 20_000
+    drain_rounds: int = 60
+    # rounds run AFTER the last answer lands: consensus followers
+    # apply the replicated tail (raft commit-index propagation, BFT
+    # checkpoint execution) so the replica-agreement reconciliation
+    # reads converged ledgers, and health alerts get room to resolve
+    settle_rounds: int = 10
+    seed: int = 0
+    key_pool: int = 8
+
+    def total_offered(self) -> int:
+        return sum(p.rounds * p.offered_per_round for p in self.phases)
+
+    def mix_of(self, phase: Phase) -> TrafficMix:
+        return phase.mix or self.mix
+
+
+@dataclass
+class FleetClient:
+    name: str
+    party: Party
+    submitted: int = 0
+
+
+@dataclass
+class RequestRecord:
+    """One request's life, model-side: what was asked, what came back,
+    when — the reconciliation input."""
+
+    rid: int
+    client: str
+    tx_id: Any
+    inputs: tuple
+    kind: str                  # "interactive" | "rival"
+    phase: str
+    member: str                # gateway member it was submitted to
+    deadline: Optional[int]
+    submitted_at: int
+    answered_at: Optional[int] = None
+    outcome: Optional[str] = None
+    shed_reason: Optional[str] = None
+    rival_of: Optional[int] = None   # rid of the spend this one contests
+
+
+# ---------------------------------------------------------------------------
+# chaos plane
+
+
+@dataclass
+class ChaosEvent:
+    """One fault: `apply(sim)` fires when the offered stream crosses
+    `at_fraction` (Disruption.kt's scheduling), `revert(sim)` when it
+    crosses `revert_at_fraction` (None = never — one-shot actions).
+    `member` names the victim by cluster index; the plane resolves it
+    to a member name in the injected-reality log."""
+
+    name: str
+    kind: str                  # "kill" | "partition" | "slow" | custom
+    at_fraction: float
+    apply: Callable[["FleetSim"], None]
+    revert_at_fraction: Optional[float] = None
+    revert: Optional[Callable[["FleetSim"], None]] = None
+    member: Optional[int] = None
+
+
+def kill_restart(member: int, at: float, restart_at: float) -> ChaosEvent:
+    """SIGKILL member `member` (by cluster index) at `at` of the
+    stream; boot a replacement over the same fabric endpoint at
+    `restart_at`. The replacement starts EMPTY and must be restored by
+    the cluster's own state transfer; the endpoint's dedupe set
+    survives, so frames redelivered across the outage are absorbed."""
+
+    return ChaosEvent(
+        f"kill-restart[{member}]", "kill", at,
+        lambda sim: sim.kill_member(member),
+        restart_at,
+        lambda sim: sim.restart_member(member),
+        member=member,
+    )
+
+
+def partition(member: int, at: float, heal_at: float) -> ChaosEvent:
+    """Split member `member` away from the rest of the fleet (minority
+    partition) at `at`; heal at `heal_at`. Queued frames redeliver on
+    heal — nothing is lost, consensus just waited."""
+
+    def apply(sim: "FleetSim") -> None:
+        victim = sim.members[member].name
+        rest = {n.name for n in sim.net.nodes if n.name != victim}
+        sim.faults.partition({victim}, rest)
+        sim._partitioned = victim
+
+    def revert(sim: "FleetSim") -> None:
+        sim.faults.heal()
+        sim._partitioned = None
+
+    return ChaosEvent(
+        f"partition[{member}]", "partition", at, apply, heal_at, revert,
+        member=member,
+    )
+
+
+def freeze(member: int, at: float, until: float) -> ChaosEvent:
+    """Wedge member `member`'s serving loop (the SIGSTOP/stuck-flush
+    analogue): the node stays reachable and consensus keeps running,
+    but its pump heartbeat stops beating — the watchdog must flip its
+    /healthz to unhealthy within one deadline and recover after."""
+
+    def apply(sim: "FleetSim") -> None:
+        sim.frozen.add(sim.members[member].name)
+
+    def thaw(sim: "FleetSim") -> None:
+        sim.frozen.discard(sim.members[member].name)
+
+    return ChaosEvent(
+        f"freeze[{member}]", "freeze", at, apply, until, thaw, member=member
+    )
+
+
+def slow_peer(
+    member: int, at: float, until: float, delay_micros: int = 60_000
+) -> ChaosEvent:
+    """Add `delay_micros` of per-frame latency on every link touching
+    member `member` between `at` and `until` of the stream — the
+    straggler replica that lags consensus without ever dying."""
+
+    return ChaosEvent(
+        f"slow-peer[{member}]", "slow", at,
+        lambda sim: sim.faults.slow_peer(
+            sim.members[member].name, delay_micros
+        ),
+        until,
+        lambda sim: sim.faults.slow_peer(sim.members[member].name, 0),
+        member=member,
+    )
+
+
+class ChaosPlane:
+    """Applies scheduled faults as the stream crosses their fractions
+    and records each one's simulated-time window — the injected-reality
+    log `InvariantChecker.check_health_story` reconciles against."""
+
+    def __init__(self, events: tuple[ChaosEvent, ...] = ()):
+        self.events = sorted(events, key=lambda e: e.at_fraction)
+        self.log: list[dict] = []
+        self._applied: list[tuple[ChaosEvent, dict]] = []
+
+    def step(self, sim: "FleetSim", fraction: float) -> None:
+        while self.events and fraction >= self.events[0].at_fraction:
+            ev = self.events.pop(0)
+            ev.apply(sim)
+            entry = {
+                "name": ev.name,
+                "kind": ev.kind,
+                "target": (
+                    sim.members[ev.member].name
+                    if ev.member is not None else None
+                ),
+                "applied_at_micros": sim.now(),
+                "applied_round": sim.round_no,
+                "reverted_at_micros": None,
+                "reverted_round": None,
+                "revert_at_fraction": ev.revert_at_fraction,
+            }
+            self.log.append(entry)
+            if ev.revert is not None:
+                self._applied.append((ev, entry))
+        for ev, entry in list(self._applied):
+            revert_at = (
+                ev.revert_at_fraction
+                if ev.revert_at_fraction is not None else float("inf")
+            )
+            if fraction >= revert_at:
+                ev.revert(sim)
+                entry["reverted_at_micros"] = sim.now()
+                entry["reverted_round"] = sim.round_no
+                self._applied.remove((ev, entry))
+
+    def finish(self, sim: "FleetSim") -> None:
+        """Revert anything still live (drain must run on a healed
+        fleet) and apply anything never reached."""
+        self.step(sim, float("inf"))
+
+
+# ---------------------------------------------------------------------------
+# traffic sources
+
+
+class TearOffSource:
+    """Synthetic non-validating traffic: per-client coins as fabricated
+    StateRefs, spent via minimal WireTransactions torn off for the
+    notary (inputs + notary + meta revealed — everything a
+    non-validating flavour checks). Cheap enough to mint thousands in
+    CI; the uniqueness semantics are EXACTLY production's, because the
+    notary never sees more than the tear-off either way."""
+
+    def __init__(self, notary_party: Party, seed: int = 0):
+        self.notary = notary_party
+        self._counter = 0
+        self._rng = random.Random(seed)
+
+    def _wtx(self, ref: StateRef, nonce: bytes) -> WireTransaction:
+        return WireTransaction(
+            inputs=(ref,),
+            outputs=(),
+            commands=(),
+            # the attachment hash is a pure nonce: two rivals spending
+            # the same ref need DIFFERENT transaction ids
+            attachments=(SecureHash.sha256(nonce),),
+            notary=self.notary,
+            time_window=None,
+        )
+
+    def spend(self, client: FleetClient):
+        """(ftx, inputs, tx_id) consuming a fresh client-owned coin."""
+        self._counter += 1
+        ref = StateRef(
+            SecureHash.sha256(
+                f"fleet:{client.name}:coin:{client.submitted}".encode()
+            ),
+            0,
+        )
+        wtx = self._wtx(ref, b"spend:%d" % self._counter)
+        return (
+            wtx.build_filtered_transaction(lambda c: True),
+            wtx.inputs,
+            wtx.id,
+        )
+
+    def rival(self, inputs: tuple):
+        """A DIFFERENT transaction claiming the same inputs — the
+        injected double-spend."""
+        self._counter += 1
+        wtx = WireTransaction(
+            inputs=tuple(inputs),
+            outputs=(),
+            commands=(),
+            attachments=(SecureHash.sha256(b"rival:%d" % self._counter),),
+            notary=self.notary,
+            time_window=None,
+        )
+        return (
+            wtx.build_filtered_transaction(lambda c: True),
+            wtx.inputs,
+            wtx.id,
+        )
+
+
+class CashSpendSource:
+    """Real signed cash spends for the VALIDATING batching flavour —
+    issues recorded at the notary, spends signed by the owner, rivals
+    built against the same issue (tests/test_qos.py's `_rig`
+    discipline), plus two-input cross-shard spends for the sharded
+    commit plane."""
+
+    def __init__(
+        self,
+        net: MockNetwork,
+        notary_node,
+        count: int,
+        cross_shard_fraction: float = 0.0,
+        seed: int = 0,
+    ):
+        from ..core.contracts import Amount, Issued
+        from ..core.identity import PartyAndReference
+        from ..core.transactions import TransactionBuilder
+        from ..finance.cash import CASH_CONTRACT, CashIssue, CashState
+
+        self._rng = random.Random(seed)
+        bank = net.create_node(
+            "FleetBank", scheme_id=schemes.ECDSA_SECP256R1_SHA256
+        )
+        owner = net.create_node(
+            "FleetOwner", scheme_id=schemes.ECDSA_SECP256R1_SHA256
+        )
+        self.bank, self.owner = bank, owner
+        self.notary_node = notary_node
+        token = Issued(PartyAndReference(bank.party, b"\x01"), "USD")
+        self._token = token
+        self._issues = []
+        # a two-input (cross-shard) spend consumes TWO issues for one
+        # request: provision the extras up front
+        n_cross = int(count * cross_shard_fraction) // 2
+        count = count + n_cross
+        for i in range(count):
+            ib = TransactionBuilder(notary_node.party)
+            ib.add_output_state(
+                CashState(Amount(100 + i, token), owner.party.owning_key),
+                CASH_CONTRACT,
+            )
+            ib.add_command(CashIssue(i + 1), bank.party.owning_key)
+            issue = bank.services.sign_initial_transaction(ib)
+            notary_node.services.record_transactions([issue])
+            owner.services.record_transactions([issue])
+            self._issues.append(issue)
+        self._next = 0
+        self._cross_budget = n_cross
+
+    def _spend_of(self, issues: list):
+        from ..core.contracts import Amount
+        from ..core.transactions import TransactionBuilder
+        from ..finance.cash import CASH_CONTRACT, CashMove, CashState
+
+        sb = TransactionBuilder(self.notary_node.party)
+        total = 0
+        for issue in issues:
+            sb.add_input_state(
+                self.owner.vault.state_and_ref(StateRef(issue.id, 0))
+            )
+            total += issue.wtx.outputs[0].data.amount.quantity
+        sb.add_output_state(
+            CashState(
+                Amount(total, self._token), self.bank.party.owning_key
+            ),
+            CASH_CONTRACT,
+            self.notary_node.party,
+        )
+        sb.add_command(CashMove(), self.owner.party.owning_key)
+        return self.owner.services.sign_initial_transaction(sb)
+
+    def spend(self, client: FleetClient):
+        """(stx, inputs, tx_id): the next prebuilt issue spent — a
+        two-input spend while the cross-shard budget lasts."""
+        take = 2 if self._cross_budget > 0 and self._next + 1 < len(
+            self._issues
+        ) and self._rng.random() < 0.5 else 1
+        if self._next + take > len(self._issues):
+            raise RuntimeError(
+                "CashSpendSource exhausted: size the fixture to the "
+                "scenario's total interactive offer"
+            )
+        issues = self._issues[self._next:self._next + take]
+        self._next += take
+        if take == 2:
+            self._cross_budget -= 1
+        stx = self._spend_of(issues)
+        return stx, stx.wtx.inputs, stx.id
+
+    def rival(self, inputs: tuple):
+        """A contract-VALID double spend: same inputs, value conserved,
+        but paid back to the owner instead of the bank — a different
+        transaction id claiming the same states, so only the
+        uniqueness layer can reject it."""
+        from ..core.contracts import Amount
+        from ..core.transactions import TransactionBuilder
+        from ..finance.cash import CASH_CONTRACT, CashMove, CashState
+
+        sb = TransactionBuilder(self.notary_node.party)
+        total = 0
+        for ref in inputs:
+            sar = self.owner.vault.state_and_ref(ref)
+            sb.add_input_state(sar)
+            total += sar.state.data.amount.quantity
+        sb.add_output_state(
+            CashState(
+                Amount(total, self._token), self.owner.party.owning_key
+            ),
+            CASH_CONTRACT,
+            self.notary_node.party,
+        )
+        sb.add_command(CashMove(), self.owner.party.owning_key)
+        stx = self.owner.services.sign_initial_transaction(sb)
+        return stx, stx.wtx.inputs, stx.id
+
+
+# ---------------------------------------------------------------------------
+# the simulator
+
+
+@dataclass
+class FleetReport:
+    """Everything the invariant checker (and bench) reads."""
+
+    flavour: str
+    scenario: FleetScenario
+    records: list
+    timeline: list
+    chaos_log: list
+    ledgers: dict            # member name -> {StateRef: tx_id}
+    members: list            # member names, cluster order
+    monitors: dict           # member name -> HealthMonitor
+    qos: Optional[qoslib.NotaryQos]
+    started_micros: int
+    finished_micros: int
+    bulk_offered: int = 0
+    bulk_shed_brownout: int = 0
+    bulk_served: int = 0
+    distinct_clients: int = 0
+
+    @property
+    def sim_seconds(self) -> float:
+        return (self.finished_micros - self.started_micros) / 1e6
+
+    def outcomes(self, kind: Optional[str] = None) -> dict:
+        out: dict[str, int] = {}
+        for r in self.records:
+            if kind is not None and r.kind != kind:
+                continue
+            out[r.outcome or "?"] = out.get(r.outcome or "?", 0) + 1
+        return out
+
+
+class FleetSim:
+    """Scenario engine: one soak = `FleetSim(scenario, flavour,
+    chaos=...).run()` -> FleetReport. See the module docstring."""
+
+    def __init__(
+        self,
+        scenario: FleetScenario,
+        flavour: str = "batching",
+        chaos: tuple[ChaosEvent, ...] = (),
+        cluster_size: Optional[int] = None,
+        notary_shards: int = 1,
+        qos_policy: Optional[qoslib.QosPolicy] = None,
+        heartbeat_deadline_rounds: int = 3,
+        lag_alert_threshold: int = 8,
+    ):
+        if flavour not in FLAVOURS:
+            raise ValueError(f"unknown fleet flavour {flavour!r}")
+        self.scenario = scenario
+        self.flavour = flavour
+        self.chaos = ChaosPlane(chaos)
+        self.faults = FabricFaults(seed=scenario.seed)
+        self.net = MockNetwork(seed=scenario.seed, faults=self.faults)
+        self.round_no = 0
+        self._partitioned: Optional[str] = None
+        self._rng = random.Random(scenario.seed ^ 0x5EED)
+        scheme = schemes.ECDSA_SECP256R1_SHA256
+
+        # -- the cluster ----------------------------------------------------
+        if flavour == "batching":
+            notary = self.net.create_notary(
+                "FleetNotary", batching=True, shards=notary_shards
+            )
+            self.members = [notary]
+            self.service_party = notary.party
+            svc = notary.services.notary_service
+            self.qos = qoslib.NotaryQos(
+                qos_policy or qoslib.QosPolicy(), clock=self.net.clock
+            )
+            if notary_shards > 1:
+                self.qos.ensure_shards(notary_shards)
+            svc.qos = self.qos
+            # THE capacity model: the sim's round is the pump tick.
+            # MockNetwork.run()'s tick-until-quiescent loop would hand
+            # the notary unbounded flushes per simulated instant —
+            # infinite hardware, no backlog, no overload, nothing for
+            # the QoS plane to do. Pull the tick out of the run loop
+            # and drive it ONCE per round instead (the loadtest.md
+            # overload-scenario discipline): served depth per round is
+            # then the adaptive controller's batch, and sustained
+            # over-offer builds the real backlog brownout walks on.
+            notary.ticks = [t for t in notary.ticks if t != svc.tick]
+            self._drive_tick = svc.tick
+        elif flavour == "raft":
+            self.service_party, self.members = (
+                self.net.create_raft_notary_cluster(
+                    cluster_size or 3, scheme_id=scheme
+                )
+            )
+            self.qos = None
+            self._drive_tick = None
+            self.net.elect(self.members)
+        else:
+            self.service_party, self.members = (
+                self.net.create_bft_notary_cluster(
+                    cluster_size or 4, scheme_id=scheme
+                )
+            )
+            self.qos = None
+            self._drive_tick = None
+        self.alive = {m.name: True for m in self.members}
+        self.frozen: set[str] = set()   # wedged-pump members (freeze())
+
+        # -- client identities ----------------------------------------------
+        # a small keypair pool shared across many NAMED identities:
+        # non-validating notaries record the requester by identity, and
+        # admission gates key on the name, so the pool keeps a
+        # thousand-client fleet's keygen cost negligible
+        pool = [
+            schemes.generate_keypair(scheme, seed=scenario.seed * 7919 + k)
+            for k in range(max(1, scenario.key_pool))
+        ]
+        self.clients = [
+            FleetClient(
+                f"fleet-c{k:04d}", Party(f"fleet-c{k:04d}", pool[k % len(pool)].public)
+            )
+            for k in range(scenario.clients)
+        ]
+
+        # -- traffic source -------------------------------------------------
+        if flavour == "batching":
+            self.source = CashSpendSource(
+                self.net,
+                self.members[0],
+                self._interactive_budget(),
+                cross_shard_fraction=max(
+                    scenario.mix_of(p).cross_shard_fraction
+                    for p in scenario.phases
+                ),
+                seed=scenario.seed,
+            )
+        else:
+            self.source = TearOffSource(self.service_party, scenario.seed)
+
+        # -- health plane ---------------------------------------------------
+        hb_deadline = heartbeat_deadline_rounds * scenario.round_micros
+        policy = HealthPolicy(
+            heartbeat_deadline_micros=hb_deadline,
+            livelock_deadline_micros=4 * hb_deadline,
+            alert_for_micros=scenario.round_micros,
+            alert_clear_for_micros=scenario.round_micros,
+        )
+        self.monitors: dict[str, HealthMonitor] = {}
+        self._beats = {}
+        for m in self.members:
+            mon = HealthMonitor(clock=self.net.clock, policy=policy)
+            self.monitors[m.name] = mon
+            self._beats[m.name] = mon.heartbeat(f"{m.name}.pump")
+            if self.flavour in ("raft", "bft"):
+                mon.add_rule(
+                    AlertRule(
+                        "consensus.lag",
+                        check=(
+                            lambda now, _name=m.name: self._lag_check(
+                                _name, lag_alert_threshold
+                            )
+                        ),
+                        for_micros=scenario.round_micros,
+                        clear_for_micros=scenario.round_micros,
+                    )
+                )
+        rollup_home = self.members[0].name
+        self.cluster = ClusterHealth(
+            rollup_home,
+            local_summary=lambda: self.monitors[rollup_home].snapshot(
+                summary=True
+            ),
+            peers_fn=lambda: {
+                m.name: f"fleet://{m.name}/health?summary=1"
+                for m in self.members
+            },
+            fetch=self._fetch_peer_summary,
+            clock_fn=self.net.clock.now_micros,
+            cache_ttl_micros=0,      # every sample is a fresh pull
+        )
+
+        # -- bookkeeping ----------------------------------------------------
+        self.records: list[RequestRecord] = []
+        self.timeline: list[dict] = []
+        self._live: list[list] = []   # [generator, parked _WaitFuture, record]
+        self._next_rid = 0
+        self._next_uid = 1
+        # interactive traffic round-robins the WHOLE fleet: a stream at
+        # least `clients` long touches every identity exactly once per
+        # lap (rivals draw from a shifted cursor so they never skew it)
+        self._client_cursor = 0
+        self.bulk_offered = 0
+        self.bulk_served = 0
+
+    # -- plumbing ------------------------------------------------------------
+
+    def now(self) -> int:
+        return self.net.clock.now_micros()
+
+    def _interactive_budget(self) -> int:
+        """Upper bound of interactive spends the scenario can ask for
+        (sizes the batching cash fixture; rivals reuse rival-builders,
+        not fresh issues)."""
+        s = self.scenario
+        total = 0
+        for p in s.phases:
+            mix = s.mix_of(p)
+            total += p.rounds * max(
+                0, p.offered_per_round - int(
+                    p.offered_per_round * mix.bulk_fraction
+                )
+            )
+        return total + 2
+
+    def _fetch_peer_summary(self, url: str) -> dict:
+        """The /cluster transport, simulated: a down or partitioned-
+        away peer is unreachable exactly as HTTP would be."""
+        name = url.split("//", 1)[1].split("/", 1)[0]
+        home = self.cluster.self_name
+        if not self.alive.get(name, False):
+            raise ConnectionError(f"{name} is down")
+        if self.faults.blocked(home, name) or self.faults.blocked(name, home):
+            raise ConnectionError(f"{name} unreachable from {home}")
+        return self.monitors[name].snapshot(summary=True)
+
+    def _lag_check(self, name: str, threshold: int):
+        lag = self.consensus_lag(name)
+        return lag is not None and lag > threshold, {"lag": lag}
+
+    def consensus_lag(self, name: str) -> Optional[int]:
+        """How far member `name`'s applied state trails the fleet's
+        front — entries for raft, executed sequence numbers for BFT."""
+        node = next(m for m in self.members if m.name == name)
+        if self.flavour == "raft":
+            front = max(
+                m.raft.commit_index
+                for m in self.members
+                if self.alive[m.name]
+            )
+            return front - node.raft.last_applied
+        if self.flavour == "bft":
+            front = max(
+                m.bft.exec_seq for m in self.members if self.alive[m.name]
+            )
+            return front - node.bft.exec_seq
+        return None
+
+    # -- chaos actions (called by ChaosEvents) --------------------------------
+
+    def kill_member(self, idx: int) -> None:
+        if self.flavour == "batching":
+            raise ValueError(
+                "kill_restart needs a cluster flavour (raft/bft): the "
+                "batching sim is single-node — use freeze() for the "
+                "wedged-pump fault"
+            )
+        node = self.members[idx]
+        self.faults.kill(node.name)
+        node.messaging.running = False
+        if getattr(node, "raft", None) is not None:
+            node.raft.stop()
+        if getattr(node, "bft", None) is not None:
+            node.bft.stop()
+        self.alive[node.name] = False
+
+    def restart_member(self, idx: int) -> None:
+        """Boot a replacement state machine over the same endpoint: the
+        consensus layer restores it (AppendEntries/InstallSnapshot for
+        raft, checkpoint catch-up for BFT); the endpoint's dedupe set
+        absorbs frames redelivered across the outage."""
+        node = self.members[idx]
+        rebuild = getattr(node, "rebuild_cluster_member", None)
+        if rebuild is None:
+            raise ValueError(
+                f"{node.name} is not a cluster member — only raft/bft "
+                f"members carry a rebuild seam"
+            )
+        old = getattr(node, "raft", None) or getattr(node, "bft", None)
+        if old is not None:
+            node.ticks = [
+                t for t in node.ticks
+                if getattr(t, "__self__", None) is not old
+            ]
+        rebuild()
+        node.messaging.running = True
+        self.faults.revive(node.name)
+        self.alive[node.name] = True
+        # a restarted process reports live from its first pump
+        self._beats[node.name].beat()
+
+    # -- submission ----------------------------------------------------------
+
+    def _gateway(self, k: int):
+        alive = [m for m in self.members if self.alive[m.name]]
+        return alive[k % len(alive)]
+
+    def _submit(self, client, kind, phase, deadline, payload, rival_of=None):
+        ftx, inputs, tx_id = payload
+        member = self._gateway(self._next_rid)
+        rec = RequestRecord(
+            rid=self._next_rid,
+            client=client.name,
+            tx_id=tx_id,
+            inputs=tuple(inputs),
+            kind=kind,
+            phase=phase,
+            member=member.name,
+            deadline=deadline,
+            submitted_at=self.now(),
+            rival_of=rival_of,
+        )
+        self._next_rid += 1
+        self.records.append(rec)
+        if self.flavour == "batching":
+            # the embedded-driver entry: enqueue without the flow
+            # machinery (the flow-path entry gates are pinned by
+            # tests/test_qos.py; here the round-rationed tick IS the
+            # capacity model, and process()'s flush-at-full-batch
+            # fast path would defeat it in zero-cost simulated time)
+            fut = member.services.notary_service.submit(
+                ftx, client.party,
+                deadline=deadline, arrival_micros=self.now(),
+            )
+            self._live.append([None, fut, rec])
+        else:
+            gen = member.services.notary_service.process(
+                ftx, client.party, deadline=deadline
+            )
+            self._live.append([gen, None, rec])
+        client.submitted += 1
+        return rec
+
+    def _inject_round(self, phase: Phase) -> None:
+        s = self.scenario
+        mix = s.mix_of(phase)
+        n_bulk = int(phase.offered_per_round * mix.bulk_fraction)
+        n_interactive = phase.offered_per_round - n_bulk
+        now = self.now()
+        for _ in range(n_interactive):
+            client = self.clients[self._client_cursor % len(self.clients)]
+            self._client_cursor += 1
+            jitter = (
+                self._rng.randrange(mix.deadline_jitter_micros + 1)
+                if mix.deadline_jitter_micros else 0
+            )
+            deadline = now + mix.deadline_micros + jitter
+            payload = self.source.spend(client)
+            rec = self._submit(client, "interactive", phase.name, deadline, payload)
+            # deterministic injection: every floor(1/fraction)-th spend
+            # gets a rival, so the double-spend count never flakes
+            if mix.conflict_fraction and (
+                self._next_rid % max(1, round(1 / mix.conflict_fraction)) == 0
+            ):
+                rival_client = self.clients[
+                    (self._next_rid * 31 + 7) % len(self.clients)
+                ]
+                self._submit(
+                    rival_client, "rival", phase.name, deadline,
+                    self.source.rival(payload[1]), rival_of=rec.rid,
+                )
+        for _ in range(n_bulk):
+            self._offer_bulk(phase)
+
+    def _offer_bulk(self, phase: Phase) -> None:
+        """Bulk (resolution-flood-shaped) traffic enters at the QoS
+        lane seam — deadline-less by definition, so brownout level 1
+        sheds it there. Batching flavour only."""
+        if self.qos is None:
+            return
+        client = self.clients[self._rng.randrange(len(self.clients))]
+        self.bulk_offered += 1
+        self._next_uid += 1
+        self.qos.lanes.offer(
+            Message("tx.resolution", b"", client.name, self._next_uid)
+        )
+
+    # -- the loop ------------------------------------------------------------
+
+    def _step_generators(self) -> None:
+        from ..flows.api import _WaitFuture
+
+        still = []
+        for entry in self._live:
+            gen, wait, rec = entry
+            if gen is None:
+                # future-parked (batching submit path)
+                if wait.done:
+                    try:
+                        self._record_answer(rec, wait.result())
+                    except Exception as e:   # noqa: BLE001
+                        self._record_answer(
+                            rec, NotaryError("unavailable", repr(e))
+                        )
+                else:
+                    still.append(entry)
+                continue
+            try:
+                if wait is None:
+                    step = gen.send(None)
+                elif wait.future.done:
+                    try:
+                        value = wait.future.result()
+                    except Exception as e:   # noqa: BLE001 - flow-shaped
+                        step = gen.throw(e)
+                    else:
+                        step = gen.send(value)
+                else:
+                    still.append(entry)
+                    continue
+                if isinstance(step, _WaitFuture):
+                    entry[1] = step
+                    still.append(entry)
+                else:
+                    # notary process() generators only ever park on
+                    # futures; anything else is a service bug
+                    gen.close()
+                    self._record_answer(
+                        rec,
+                        NotaryError(
+                            "unavailable", f"unexpected yield {step!r}"
+                        ),
+                    )
+            except StopIteration as stop:
+                self._record_answer(rec, stop.value)
+            except Exception as e:   # noqa: BLE001 - service-side failure
+                self._record_answer(
+                    rec, NotaryError("unavailable", repr(e))
+                )
+        self._live = still
+
+    def _record_answer(self, rec: RequestRecord, value) -> None:
+        rec.answered_at = self.now()
+        if isinstance(value, NotaryError):
+            if value.kind == qoslib.SHED_KIND:
+                rec.outcome = OUT_SHED
+                msg = value.message.lower()
+                if "brownout" in msg:
+                    rec.shed_reason = "brownout"
+                elif "admission" in msg:
+                    rec.shed_reason = "admission"
+                else:
+                    rec.shed_reason = "expired"
+            elif value.kind == "conflict":
+                rec.outcome = OUT_CONFLICT
+            else:
+                rec.outcome = OUT_UNAVAILABLE
+                rec.shed_reason = value.kind
+        elif value is None:
+            rec.outcome = OUT_UNAVAILABLE
+        else:
+            # TransactionSignature (simple/raft) or [sigs] (bft)
+            rec.outcome = OUT_SIGNED
+
+    def _sample(self, phase_name: str) -> None:
+        healthz = {}
+        alerts = {}
+        for name, mon in self.monitors.items():
+            if self.alive[name]:
+                ok, _ = mon.healthz()
+                healthz[name] = ok
+                alerts[name] = mon.alerts_firing()
+            else:
+                healthz[name] = False     # a dead node serves nothing
+                alerts[name] = None
+        rollup = self.cluster.snapshot()
+        self.timeline.append({
+            "round": self.round_no,
+            "at_micros": self.now(),
+            "phase": phase_name,
+            "healthz": healthz,
+            "alerts_firing": alerts,
+            "cluster_worst": rollup["worst"],
+            "cluster_stale": rollup["stale_peers"],
+            "cluster_alerts": rollup["alerts_firing"],
+            "brownout_level": (
+                self.qos.brownout_level if self.qos is not None else None
+            ),
+            "lag": {
+                m.name: self.consensus_lag(m.name) for m in self.members
+            } if self.flavour != "batching" else {},
+        })
+
+    def _round(self, phase_name: str) -> None:
+        self._step_generators()
+        if self._drive_tick is not None and (
+            self.members[0].name not in self.frozen
+        ):
+            # the batching notary's pump tick, exactly once per round
+            # (see __init__: the round IS the pump cadence); a frozen
+            # pump flushes nothing — requests queue, and anything whose
+            # deadline passes while wedged sheds at the thaw
+            self._drive_tick()
+        self.net.run()
+        self._step_generators()
+        if self.qos is not None:
+            # the lane consumer: drain what a real ring consumer would
+            self.bulk_served += len(self.qos.lanes.drain(budget=64))
+        for name, hb in self._beats.items():
+            if self.alive[name] and name not in self.frozen:
+                hb.beat(progress=1)
+        for name, mon in self.monitors.items():
+            if self.alive[name]:
+                mon.tick()
+        self._sample(phase_name)
+        self.net.clock.advance(self.scenario.round_micros)
+        self.round_no += 1
+
+    def run(self) -> FleetReport:
+        s = self.scenario
+        started = self.now()
+        total = float(s.total_offered())
+        offered = 0
+        for phase in s.phases:
+            for _ in range(phase.rounds):
+                self.chaos.step(self, offered / total)
+                self._inject_round(phase)
+                offered += phase.offered_per_round
+                self._round(phase.name)
+        self.chaos.finish(self)
+        for _ in range(s.drain_rounds):
+            self._round("drain")
+            if not self._live:
+                break
+        for gen, wait, rec in self._live:
+            rec.outcome = OUT_LOST
+        self._live = []
+        for _ in range(s.settle_rounds):
+            self._round("settle")
+        shed_brownout = 0
+        if self.qos is not None:
+            shed_brownout = self.qos.snapshot()["shed"].get(
+                qoslib.SHED_BROWNOUT_BULK, 0
+            )
+        return FleetReport(
+            flavour=self.flavour,
+            scenario=s,
+            records=self.records,
+            timeline=self.timeline,
+            chaos_log=self.chaos.log,
+            ledgers=self.gather_ledgers(),
+            members=[m.name for m in self.members],
+            monitors=dict(self.monitors),
+            qos=self.qos,
+            started_micros=started,
+            finished_micros=self.now(),
+            bulk_offered=self.bulk_offered,
+            bulk_shed_brownout=shed_brownout,
+            bulk_served=self.bulk_served,
+            distinct_clients=len(
+                {r.client for r in self.records}
+            ),
+        )
+
+    # -- reconciliation inputs ----------------------------------------------
+
+    def gather_ledgers(self) -> dict:
+        """Every ALIVE member's committed map (the reference's
+        gather-state step). Batching reads the uniqueness provider;
+        raft reads each member's replicated provider map; BFT reads
+        each replica's service map."""
+        out = {}
+        for m in self.members:
+            if not self.alive[m.name]:
+                continue
+            svc = m.services.notary_service
+            if self.flavour == "bft":
+                out[m.name] = dict(svc.committed)
+            else:
+                out[m.name] = dict(svc.uniqueness.committed)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# invariant checking
+
+
+class InvariantChecker:
+    """Reconciles a FleetReport against the model: the CrossCash
+    discipline (value neither lost nor duplicated), extended with the
+    control-plane truth checks the ROADMAP calls for. Each method
+    raises AssertionError with enough detail to debug; `check_all`
+    runs the set that applies to the report's flavour."""
+
+    def __init__(self, report: FleetReport):
+        self.report = report
+
+    # -- ledger --------------------------------------------------------------
+
+    def check_replica_agreement(self) -> None:
+        """Every alive replica holds the SAME committed map after the
+        drain — kill/restart, partition and slow links included."""
+        ledgers = self.report.ledgers
+        names = sorted(ledgers)
+        base = ledgers[names[0]]
+        for name in names[1:]:
+            if ledgers[name] != base:
+                only_a = set(base) - set(ledgers[name])
+                only_b = set(ledgers[name]) - set(base)
+                raise AssertionError(
+                    f"replica ledgers diverged: {names[0]} has "
+                    f"{len(base)} entries, {name} has "
+                    f"{len(ledgers[name])}; only-{names[0]}={only_a!r} "
+                    f"only-{name}={only_b!r}"
+                )
+
+    def _ledger(self) -> dict:
+        names = sorted(self.report.ledgers)
+        return self.report.ledgers[names[0]]
+
+    def check_ledger_vs_answers(self) -> None:
+        """Signed answers and the ledger agree EXACTLY:
+        - every signed tx's inputs are consumed by that tx on-ledger;
+        - every conflict answer's tx is NOT on the ledger;
+        - every on-ledger consumer is a transaction somebody submitted
+          (no phantom commits);
+        - no input consumed by two transactions (no double-spend)."""
+        ledger = self._ledger()
+        submitted = {r.tx_id for r in self.report.records}
+        for ref, tx in ledger.items():
+            assert tx in submitted, (
+                f"phantom commit: {ref} consumed by never-submitted {tx}"
+            )
+        for r in self.report.records:
+            if r.outcome == OUT_SIGNED:
+                for ref in r.inputs:
+                    got = ledger.get(ref)
+                    assert got == r.tx_id, (
+                        f"signed {r.tx_id} but ledger consumes {ref} "
+                        f"by {got}"
+                    )
+            elif r.outcome == OUT_CONFLICT:
+                on_ledger = [
+                    ref for ref in r.inputs if ledger.get(ref) == r.tx_id
+                ]
+                assert not on_ledger, (
+                    f"conflict answered for {r.tx_id} yet it consumed "
+                    f"{on_ledger} on-ledger"
+                )
+            elif r.outcome == OUT_SHED:
+                committed = [
+                    ref for ref in r.inputs if ledger.get(ref) == r.tx_id
+                ]
+                assert not committed, (
+                    f"shed {r.tx_id} still committed {committed} — a "
+                    f"shed must never spend verify/commit work"
+                )
+
+    def check_exactly_one_winner(self) -> None:
+        """Every injected double-spend resolved to EXACTLY one winner
+        on the ledger, and at most one of the rivals was signed."""
+        ledger = self._ledger()
+        by_rid = {r.rid: r for r in self.report.records}
+        pairs = [
+            (by_rid[r.rival_of], r)
+            for r in self.report.records
+            if r.rival_of is not None
+        ]
+        assert pairs, "scenario injected no double-spends to check"
+        for orig, rival in pairs:
+            contested = set(orig.inputs) & set(rival.inputs)
+            assert contested, "rival shares no input with its original"
+            for ref in contested:
+                winner = ledger.get(ref)
+                # both shed is legal (overload); both COMMITTED is not
+                assert winner in (orig.tx_id, rival.tx_id, None), (
+                    f"{ref} consumed by a third transaction {winner}"
+                )
+            signed = [
+                r for r in (orig, rival) if r.outcome == OUT_SIGNED
+            ]
+            assert len(signed) <= 1, (
+                f"double-spend double-signed: {orig.tx_id} AND "
+                f"{rival.tx_id}"
+            )
+
+    # -- QoS -----------------------------------------------------------------
+
+    def check_no_admitted_then_expired(self) -> None:
+        """A signed answer at or before its deadline, always — nothing
+        verified-then-useless."""
+        for r in self.report.records:
+            if r.outcome == OUT_SIGNED and r.deadline is not None:
+                assert r.answered_at <= r.deadline, (
+                    f"admitted-then-expired: {r.tx_id} signed at "
+                    f"{r.answered_at}, deadline {r.deadline}"
+                )
+
+    def check_slo(
+        self, target_p99_micros: int, phases: tuple[str, ...] = ("steady",)
+    ) -> None:
+        """Admitted p99 (simulated time) within the SLO for requests
+        submitted during the named phases."""
+        lat = sorted(
+            r.answered_at - r.submitted_at
+            for r in self.report.records
+            if r.outcome == OUT_SIGNED
+            and any(r.phase.startswith(p) for p in phases)
+        )
+        assert lat, f"no signed steady-state traffic in phases {phases}"
+        p99 = lat[min(len(lat) - 1, int(0.99 * len(lat)))]
+        assert p99 <= target_p99_micros, (
+            f"steady-state admitted p99 {p99} us exceeds the "
+            f"{target_p99_micros} us SLO"
+        )
+
+    def check_brownout_classes(self) -> None:
+        """Brownout shed ONLY the right traffic: bulk at the lane seam
+        and deadline-less requests at entry — never an interactive
+        request that carried a deadline."""
+        for r in self.report.records:
+            if r.shed_reason == "brownout":
+                assert r.deadline is None, (
+                    f"brownout shed deadline-carrying {r.kind} request "
+                    f"{r.tx_id}"
+                )
+        qos = self.report.qos
+        assert qos is not None, "brownout check needs the QoS flavour"
+        shed = qos.snapshot()["shed"]
+        brownout_sheds = {
+            k: v for k, v in shed.items() if k.startswith("Brownout")
+        }
+        assert set(brownout_sheds) <= {
+            qoslib.SHED_BROWNOUT_BULK, qoslib.SHED_BROWNOUT_NO_DEADLINE
+        }
+        assert brownout_sheds, "the spike browned nothing out"
+
+    def check_brownout_engaged_during_spike(self) -> None:
+        """The brownout level rose during the spike phase and returned
+        to 0 by the end of the drain (the transition history is the
+        assertion surface, node/qos.py)."""
+        spike = [
+            t for t in self.report.timeline if t["phase"].startswith("spike")
+        ]
+        after = self.report.timeline[-1]
+        assert any(t["brownout_level"] >= 1 for t in spike), (
+            "brownout never engaged during the spike"
+        )
+        assert after["brownout_level"] == 0, (
+            f"brownout stuck at level {after['brownout_level']} after "
+            f"recovery"
+        )
+        assert self.report.qos.brownout_transitions, (
+            "no brownout transitions recorded"
+        )
+
+    # -- health truth --------------------------------------------------------
+
+    def _window(self, entry: dict) -> tuple[int, Optional[int]]:
+        return entry["applied_at_micros"], entry["reverted_at_micros"]
+
+    def _samples_between(self, start, end):
+        return [
+            t for t in self.report.timeline
+            if t["at_micros"] >= start and (
+                end is None or t["at_micros"] < end
+            )
+        ]
+
+    def check_health_story(self) -> None:
+        """The control plane told the truth about every injected fault:
+
+          kill      — the victim read unhealthy and /cluster marked it
+                      stale while down; both recovered after restart.
+          freeze    — the victim's WATCHDOG flipped its healthz to
+                      unhealthy while its pump was wedged (the node
+                      was still reachable — this is the true 503
+                      path), and it recovered after the thaw.
+          partition — /cluster (served from the majority side) marked
+                      the minority member stale during the split and
+                      fresh after heal.
+          slow      — the victim's consensus-lag alert fired during
+                      the window and resolved after.
+        """
+        tl = self.report.timeline
+        assert tl, "no timeline samples"
+        final = tl[-1]
+        for entry in self.report.chaos_log:
+            start, end = self._window(entry)
+            during = self._samples_between(start, end)
+            victim = entry.get("target")
+            if entry["kind"] == "kill":
+                assert during, f"no samples during {entry['name']}"
+                assert any(
+                    not t["healthz"].get(victim, True) for t in during
+                ), f"{entry['name']}: victim {victim} never read unhealthy"
+                assert any(
+                    victim in t["cluster_stale"] for t in during
+                ), f"{entry['name']}: /cluster never marked {victim} stale"
+            elif entry["kind"] == "freeze":
+                assert any(
+                    not t["healthz"].get(victim, True) for t in during
+                ), (
+                    f"{entry['name']}: the watchdog never flipped "
+                    f"{victim}'s healthz while its pump was wedged"
+                )
+                # the victim's own event log carries the flip — the
+                # health plane's forensic surface (utils/health.py)
+                events = [
+                    e for e in self.report.monitors[victim].events.tail(64)
+                    if e.get("event") == "healthz"
+                ] if self.report.monitors else []
+                if self.report.monitors:
+                    assert any(not e["ok"] for e in events), (
+                        f"{victim}'s health event log never recorded "
+                        f"the healthz flip"
+                    )
+            elif entry["kind"] == "partition":
+                if victim == self.report.members[0]:
+                    # the rollup is SERVED from the victim: the split
+                    # shows as everyone ELSE going stale in its view
+                    assert any(t["cluster_stale"] for t in during), (
+                        f"{entry['name']}: the minority-side /cluster "
+                        f"never marked the majority stale"
+                    )
+                else:
+                    assert any(
+                        victim in t["cluster_stale"] for t in during
+                    ), (
+                        f"{entry['name']}: /cluster never marked the "
+                        f"minority {victim} stale"
+                    )
+            elif entry["kind"] == "slow":
+                assert any(
+                    (t["cluster_alerts"].get(victim) or 0) > 0
+                    or (t["alerts_firing"].get(victim) or 0) > 0
+                    for t in during
+                ), (
+                    f"{entry['name']}: the lag alert never fired for "
+                    f"{victim}"
+                )
+            # recovery: the LAST sample shows a clean fleet
+            if victim is not None:
+                assert final["healthz"].get(victim, False), (
+                    f"{victim} still unhealthy after {entry['name']} "
+                    f"reverted"
+                )
+                assert victim not in final["cluster_stale"], (
+                    f"/cluster still stale on {victim} after "
+                    f"{entry['name']} reverted"
+                )
+
+    def check_lost_bounded(self, max_fraction: float = 0.05) -> None:
+        """Requests in flight at a kill may lose their reply; the
+        fraction must stay small and the ledger invariants above
+        already bound their effect."""
+        lost = sum(1 for r in self.report.records if r.outcome == OUT_LOST)
+        frac = lost / max(1, len(self.report.records))
+        assert frac <= max_fraction, (
+            f"{lost}/{len(self.report.records)} requests lost "
+            f"({frac:.1%} > {max_fraction:.1%})"
+        )
+
+    # -- the bundle ----------------------------------------------------------
+
+    def check_all(
+        self,
+        slo_p99_micros: Optional[int] = None,
+        expect_conflicts: bool = True,
+        expect_brownout: bool = False,
+    ) -> dict:
+        """The full reconciliation; returns a JSON-safe verdict dict
+        (bench.py's fleet metric embeds it)."""
+        self.check_replica_agreement()
+        self.check_ledger_vs_answers()
+        if expect_conflicts:
+            self.check_exactly_one_winner()
+        self.check_no_admitted_then_expired()
+        self.check_lost_bounded()
+        if slo_p99_micros is not None:
+            self.check_slo(slo_p99_micros)
+        if expect_brownout:
+            self.check_brownout_classes()
+            self.check_brownout_engaged_during_spike()
+        if self.report.chaos_log:
+            self.check_health_story()
+        out = self.report.outcomes()
+        return {
+            "reconciled": True,
+            "flavour": self.report.flavour,
+            "requests": len(self.report.records),
+            "distinct_clients": self.report.distinct_clients,
+            "outcomes": out,
+            "sim_seconds": round(self.report.sim_seconds, 6),
+            "goodput_per_sim_sec": round(
+                out.get(OUT_SIGNED, 0) / max(self.report.sim_seconds, 1e-9),
+                3,
+            ),
+            "faults": [e["name"] for e in self.report.chaos_log],
+        }

@@ -169,11 +169,22 @@ class MockNetwork:
         batch_verifier: Optional[BatchSignatureVerifier] = None,
         shuffle_delivery: bool = False,
         db_dir: Optional[str] = None,
+        faults: Optional[msglib.FabricFaults] = None,
     ):
+        """`faults`: an optional FabricFaults plane (messaging.py) —
+        the chaos-injection seam the fleet simulator drives. It shares
+        this network's TestClock so slow-link delays advance in
+        simulated time; run() then treats blocked/delayed frames as
+        quiescent instead of livelocking on them."""
         self.db_dir = db_dir
         self.rng = random.Random(seed)
-        self.fabric = msglib.InMemoryMessagingNetwork()
         self.clock = TestClock()
+        if faults is not None and faults._clock is None:
+            faults._clock = self.clock
+        self.faults = faults
+        self.fabric = msglib.InMemoryMessagingNetwork(
+            clock=self.clock, faults=faults
+        )
         self.batch_verifier = batch_verifier or CpuBatchVerifier()
         self.nodes: list[MockNode] = []
         self._shuffle_seed = (
@@ -210,24 +221,30 @@ class MockNetwork:
         n: int = 3,
         name: str = "RaftNotary",
         validating: bool = False,
+        scheme_id: int = schemes.DEFAULT_SCHEME,
     ):
         """n MockNodes forming one Raft notary cluster behind a shared
         service identity (reference: notary-demo Raft cluster,
         RaftUniquenessProvider.kt). Returns (service_party, members).
         Elect a leader before notarising: run() + advance_clock loops
-        (see tests/test_raft_notary.py drive helper)."""
+        (see tests/test_raft_notary.py drive helper). `scheme_id` picks
+        the member/service signature scheme — fleet soaks use secp256r1
+        (cheap pure-python keygen/sign) so thousand-request runs fit in
+        CI seconds."""
         import random as _random
 
         from ..core.identity import Party
         from ..node.notary import SimpleNotaryService, ValidatingNotaryService
         from ..node.raft import RaftNode, RaftUniquenessProvider
 
-        shared_kp = schemes.generate_keypair(seed=self.rng.getrandbits(256))
+        shared_kp = schemes.generate_keypair(
+            scheme_id, seed=self.rng.getrandbits(256)
+        )
         service_party = Party(name, shared_kp.public)
         member_names = [f"{name}-{i}" for i in range(n)]
         members = []
         for mname in member_names:
-            node = self.create_node(mname)
+            node = self.create_node(mname, scheme_id=scheme_id)
             node.services.key_management.register_keypair(shared_kp)
             node.info = NodeInfo(
                 mname,
@@ -252,20 +269,43 @@ class MockNetwork:
                 _node.ticks.append(raft.tick)
                 return raft
 
-            provider = RaftUniquenessProvider(factory)
-            cls = ValidatingNotaryService if validating else SimpleNotaryService
-            node.services.notary_service = cls(
-                node.services, provider, service_identity=service_party
-            )
+            def rebuild(_node=node, _factory=factory):
+                """Kill/restart seam (testing/fleet.py): discard the
+                member's raft state machine and provider, build fresh
+                ones over the SAME fabric endpoint (dedupe set and
+                journal survive, like a real node restarting over its
+                database), and let the cluster's own state transfer —
+                AppendEntries replay / InstallSnapshot — restore the
+                committed map. The previous raft instance must be
+                stop()ped first (handler removal)."""
+                provider = RaftUniquenessProvider(_factory)
+                cls = (
+                    ValidatingNotaryService if validating
+                    else SimpleNotaryService
+                )
+                _node.services.notary_service = cls(
+                    _node.services, provider, service_identity=service_party
+                )
+                return _node.services.notary_service
+
+            node.rebuild_cluster_member = rebuild
+            rebuild()
             members.append(node)
         self._sync_directories()
         return service_party, members
 
-    def create_bft_notary_cluster(self, n: int = 4, name: str = "BFTNotary"):
+    def create_bft_notary_cluster(
+        self,
+        n: int = 4,
+        name: str = "BFTNotary",
+        scheme_id: int = schemes.DEFAULT_SCHEME,
+    ):
         """3f+1 MockNodes forming a BFT notary cluster. The service
         identity is a CompositeKey(threshold=f+1) over the member keys
         (reference: BFTNonValidatingNotaryService.kt:29 + the cluster
-        composite identity in BFTSMaRt.kt). Returns (party, members)."""
+        composite identity in BFTSMaRt.kt). Returns (party, members).
+        `scheme_id` picks the member scheme (fleet soaks: secp256r1,
+        the cheap pure-python path)."""
         import random as _random
 
         from ..core.identity import Party
@@ -273,12 +313,15 @@ class MockNetwork:
         from ..node.bft import BftReplica, BFTNotaryService
 
         member_names = [f"{name}-{i}" for i in range(n)]
-        members = [self.create_node(m) for m in member_names]
+        members = [
+            self.create_node(m, scheme_id=scheme_id) for m in member_names
+        ]
         f = (n - 1) // 3
         composite = CompositeKey.build(
             [m.party.owning_key for m in members], threshold=f + 1
         )
         service_party = Party(name, composite)
+        member_keys = {m.name: m.party.owning_key for m in members}
         for node in members:
             node.info = NodeInfo(
                 node.name,
@@ -287,25 +330,34 @@ class MockNetwork:
                 cluster_identity=service_party,
             )
             node.services.my_info = node.info
-            replica = BftReplica(
-                node.name,
-                member_names,
-                node.messaging,
-                lambda cmd, ts: (None, None),   # rewired by the service
-                self.clock,
-                cluster=name,
-                rng=_random.Random(self.rng.getrandbits(32)),
-            )
-            node.bft = replica
-            node.ticks.append(replica.tick)
-            node.services.notary_service = BFTNotaryService(
-                node.services,
-                replica,
-                service_party,
-                member_keys={
-                    m.name: m.party.owning_key for m in members
-                },
-            )
+
+            def rebuild(_node=node):
+                """Kill/restart seam (testing/fleet.py): a FRESH replica
+                over the same endpoint — empty uniqueness map, exec_seq
+                1 — restored by the cluster's own catch-up/state-
+                transfer machinery (CatchUpRequest -> _restore). The
+                previous replica must be stop()ped first."""
+                replica = BftReplica(
+                    _node.name,
+                    member_names,
+                    _node.messaging,
+                    lambda cmd, ts: (None, None),   # rewired by the service
+                    self.clock,
+                    cluster=name,
+                    rng=_random.Random(self.rng.getrandbits(32)),
+                )
+                _node.bft = replica
+                _node.ticks.append(replica.tick)
+                _node.services.notary_service = BFTNotaryService(
+                    _node.services,
+                    replica,
+                    service_party,
+                    member_keys=member_keys,
+                )
+                return _node.services.notary_service
+
+            node.rebuild_cluster_member = rebuild
+            rebuild()
         self._sync_directories()
         return service_party, members
 
@@ -372,8 +424,11 @@ class MockNetwork:
         total = 0
         rounds = 0
         while True:
-            while self.fabric.pending:
-                total += self.fabric.pump(1, rng)
+            while True:
+                got = self.fabric.pump(1, rng)
+                if not got:
+                    break   # drained, or frames blocked/delayed by faults
+                total += got
                 if total > pump_limit:
                     raise RuntimeError("network did not quiesce (livelock?)")
             # quiescent on messages: fire any due scheduled activities
@@ -383,7 +438,7 @@ class MockNetwork:
             actions = sum(n.scheduler.tick() for n in self.nodes)
             actions += sum(t() for n in self.nodes for t in n.ticks)
             actions += sum(n.smm.tick() for n in self.nodes)
-            if not actions and not self.fabric.pending:
+            if not actions and not self.fabric.deliverable:
                 return total
             rounds += 1
             if rounds > pump_limit:

@@ -585,6 +585,12 @@ class HealthMonitor:
         self._rules_lock = threading.Lock()
         self._alerts: dict[str, _Alert] = {}
         self.canary: Optional[CanaryProbe] = None
+        # last liveness verdict seen by tick(): healthz FLIPS land in
+        # the event log as first-class records, so post-hoc forensics
+        # (and chaos-rig invariant checkers) can reconcile "when did
+        # /healthz go 503 and when did it recover" against injected
+        # reality without having polled the endpoint at the right time
+        self._last_healthz_ok: Optional[bool] = None
         self.canary_latency = self.metrics.histogram(
             "Health.CanaryLatencyMicros"
         )
@@ -707,6 +713,19 @@ class HealthMonitor:
         if now is None:
             now = self.now_micros()
         states = self.watchdog.check(now)
+        ok = all(st["state"] == HB_OK for st in states.values())
+        if ok != self._last_healthz_ok:
+            if self._last_healthz_ok is not None:
+                self.events.append({
+                    "at_micros": now,
+                    "event": "healthz",
+                    "ok": ok,
+                    "unhealthy": sorted(
+                        n for n, st in states.items()
+                        if st["state"] != HB_OK
+                    ),
+                })
+            self._last_healthz_ok = ok
         for name, st in states.items():
             alert = self._alert_for_watchdog(name)
             self._walk(

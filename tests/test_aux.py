@@ -5,6 +5,11 @@ import io
 
 import pytest
 
+# utils.x509 builds real certificates on the `cryptography` package —
+# absent (it's an optional dep), this module cannot even import, so
+# skip at collection instead of erroring the whole tier-1 collect
+pytest.importorskip("cryptography")
+
 from corda_tpu.experimental import determinism
 from corda_tpu.flows.api import ProgressTracker
 from corda_tpu.node.audit import InMemoryAuditService, PersistentAuditService

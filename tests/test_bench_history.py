@@ -247,6 +247,55 @@ def test_lower_is_better_growth_from_zero_still_gates(tmp_path):
     assert [r["metric"] for r in bad] == ["perf_plane_overhead"]
 
 
+def test_required_true_verdict_keys_gate(tmp_path, capsys):
+    """PR 8: the fleet soak's `gate_required_true` keys. A newest
+    record whose `reconciled` (or `slo_held`) verdict is false fails
+    the gate regardless of the goodput headline; truthy verdicts pass;
+    a missing fleet metric (budget-trimmed round) never gates."""
+    _write_record(
+        tmp_path, "BENCH_r01.json", 1,
+        [_metric(
+            "fleet_soak_goodput", 200.0,
+            gate_required_true=["reconciled", "slo_held"],
+            reconciled=True, slo_held=True,
+        )],
+    )
+    _write_record(
+        tmp_path, "BENCH_r02.json", 2,
+        [_metric(
+            # headline IMPROVED — and the soak stopped reconciling
+            "fleet_soak_goodput", 250.0,
+            gate_required_true=["reconciled", "slo_held"],
+            reconciled=False, slo_held=True,
+        )],
+    )
+    old, new = [bh.parse_record(p) for p in bh.discover(str(tmp_path))]
+    rows = {r["metric"]: r for r in bh.diff(old, new)}
+    assert rows["fleet_soak_goodput.reconciled"]["better"] == "required"
+    bad = bh.gate_failures(list(rows.values()), 10.0)
+    assert [r["metric"] for r in bad] == ["fleet_soak_goodput.reconciled"]
+    assert bh.main(["--dir", str(tmp_path), "--gate", "10"]) == 1
+    err = capsys.readouterr().err
+    assert "fleet_soak_goodput.reconciled" in err
+
+    # both verdicts true: the gate passes
+    _write_record(
+        tmp_path, "BENCH_r03.json", 3,
+        [_metric(
+            "fleet_soak_goodput", 190.0,
+            gate_required_true=["reconciled", "slo_held"],
+            reconciled=True, slo_held=True,
+        )],
+    )
+    assert bh.main(["--dir", str(tmp_path), "--gate", "50"]) == 0
+    # and a round that dropped the fleet metric entirely doesn't gate
+    _write_record(
+        tmp_path, "BENCH_r04.json", 4,
+        [_metric("ecdsa_p256_verifies_per_sec_via_spi", 80_000.0)],
+    )
+    assert bh.main(["--dir", str(tmp_path), "--gate", "50"]) == 0
+
+
 def test_nested_keys_explode_without_marker_for_old_records(tmp_path):
     """Records written before the marker existed still explode their
     stages_seconds via the built-in default, so the committed

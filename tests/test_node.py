@@ -196,13 +196,20 @@ def test_config_rejects_unknown_keys():
 
 def test_cli_entry(tmp_path):
     """`python -m corda_tpu.node` boots from a TOML file and prints its
-    port; SIGTERM shuts it down cleanly."""
+    port; SIGTERM shuts it down cleanly. TLS material needs the
+    optional `cryptography` package — without it the config disables
+    TLS so the CLI boot/shutdown arc (what this test pins) still runs."""
+    import importlib.util
     import os
     import signal
     import subprocess
     import sys
 
-    cfg = NodeConfig(name="Solo", base_dir=str(tmp_path / "solo"))
+    cfg = NodeConfig(
+        name="Solo",
+        base_dir=str(tmp_path / "solo"),
+        use_tls=importlib.util.find_spec("cryptography") is not None,
+    )
     path = str(tmp_path / "solo.toml")
     write_config(cfg, path)
     env = dict(os.environ)

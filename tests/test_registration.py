@@ -11,6 +11,11 @@ import time
 
 import pytest
 
+# the registration arc mints and validates real X.509 chains via
+# utils.x509, which needs the optional `cryptography` package — skip at
+# collection rather than erroring tier-1's collect
+pytest.importorskip("cryptography")
+
 from corda_tpu.node.registration import (
     CertificateRequestException,
     Doorman,

@@ -23,9 +23,13 @@ rows and gate in the LOWER-is-better direction — a stage-level
 regression fails the gate even when the headline number holds (a 2x
 slower commit phase hidden by a 2x faster dispatch is still a
 regression someone should read). A record may extend the nested set
-by naming dict-valued keys in `gate_lower_is_better`. A metric
-missing from the newest round is reported but never gates (a trimmed
-or skipped secondary is a budget decision, not a regression).
+by naming dict-valued keys in `gate_lower_is_better`. A record may
+also declare verdict keys in `gate_required_true` (the fleet soak's
+`reconciled` / `slo_held`): each becomes a 0/1 row that fails the
+gate whenever the newest record carries it falsy — a soak that stops
+reconciling fails CI no matter what its goodput headline says. A
+metric missing from the newest round is reported but never gates (a
+trimmed or skipped secondary is a budget decision, not a regression).
 """
 
 from __future__ import annotations
@@ -111,6 +115,19 @@ def _explode(metrics: dict[str, dict]) -> dict[str, dict]:
             # fire on improvements and wave regressions through
             "better": "lower" if rec.get("lower_is_better") else "higher",
         }
+        # verdict keys a record declares REQUIRED TRUE (the fleet
+        # metric's `reconciled`/`slo_held`): each becomes a 0/1 row
+        # that fails the gate whenever the newest record carries it
+        # falsy — throughput with a broken reconciliation must not
+        # ride a healthy-looking headline through CI
+        required = rec.get("gate_required_true")
+        if isinstance(required, (list, tuple)):
+            for k in required:
+                out[f"{name}.{k}"] = {
+                    "value": 1.0 if rec.get(k) else 0.0,
+                    "vs_baseline": None,
+                    "better": "required",
+                }
         declared = rec.get("gate_lower_is_better")
         keys = set(_NESTED_LOWER)
         if isinstance(declared, (list, tuple)):
@@ -174,7 +191,11 @@ def format_rows(rows: list[dict], old_label: str, new_label: str) -> str:
             "" if r["vs_baseline"] is None
             else f"  (vs_baseline {r['vs_baseline']:g})"
         )
-        lo = "  [lower is better]" if r.get("better") == "lower" else ""
+        lo = (
+            "  [lower is better]" if r.get("better") == "lower"
+            else "  [required true]" if r.get("better") == "required"
+            else ""
+        )
         out.append(
             f"  {r['metric']:<{width}}  {o:>12} -> {n:>12}  {d}{vs}{lo}"
         )
@@ -192,6 +213,11 @@ ZERO_GROWTH_FLOOR = 1e-3
 
 def _regressed(row: dict, gate_pct: float) -> bool:
     delta = row["delta_pct"]
+    if row.get("better") == "required":
+        # required-true verdict rows: the newest record must carry the
+        # key truthy; missing-in-new stays a budget decision, not a
+        # regression
+        return row.get("new") == 0.0
     if row.get("better") == "lower":
         if delta is None:
             # old == 0: any delta percent is undefined — gate on the
