@@ -1521,6 +1521,228 @@ def _fleet_metric(batch: int, iters: int) -> dict:
     }
 
 
+def _faults_metric(batch: int, iters: int) -> dict:
+    """Fault-tolerance plane (round 9): what the self-healing costs
+    when nothing is broken, and whether it actually recovers when
+    something is. Three interleaved A/B measurements on the CPU rig:
+
+      - WAL append overhead: notarisations/s with the intent journal
+        on a real (fsynced, WAL-mode) file vs without — the `value`
+        headline is the WAL-on rate, `wal_overhead_fraction` the cost.
+      - degraded-flush CPU-fallback throughput: flush wall with the
+        dispatch-seam injector forcing retry->CPU-reference fallback
+        vs the clean path, same spends.
+      - redispatch latency penalty: wall time for a pool of verify
+        round trips to ALL resolve with one of two workers killed
+        mid-stream (lease expiry -> redispatch) vs unkilled.
+
+    The record's recovery verdicts are REQUIRED-TRUE gate keys for
+    tools/bench_history.py: a build whose degraded flush stops
+    committing, whose WAL replay loses a request, or whose redispatch
+    strands a future fails the gate no matter what the rates say."""
+    import tempfile
+
+    from corda_tpu.crypto.batch_verifier import (
+        CpuBatchVerifier,
+        DispatchFaultInjector,
+    )
+    from corda_tpu.node.notary import (
+        BatchingNotaryService,
+        InMemoryUniquenessProvider,
+    )
+    from corda_tpu.node.persistence import NodeDatabase, NotaryIntentJournal
+
+    # hard cap: every flush here runs PURE-PYTHON reference crypto
+    # (that is the point — the degraded path), so depth is latency
+    batch = max(16, min(batch, 128))
+    net, notary, alice, spends = _notary_fixture(
+        batch, batch_verifier=CpuBatchVerifier()
+    )
+    requester = alice.party
+    tmp = tempfile.mkdtemp(prefix="bench_faults_")
+    dbs: list = []
+
+    def flush_wall(intent_wal: bool, inject: bool) -> tuple[float, dict]:
+        """One full submit-all + flush through a fresh service;
+        returns (wall seconds, outcome summary)."""
+        injector = DispatchFaultInjector(CpuBatchVerifier())
+        notary.services._batch_verifier = injector
+        journal = None
+        if intent_wal:
+            db = NodeDatabase(
+                os.path.join(tmp, f"wal{len(dbs)}.db")
+            )
+            dbs.append(db)
+            journal = NotaryIntentJournal(db)
+        svc = BatchingNotaryService(
+            notary.services, InMemoryUniquenessProvider(),
+            intent_journal=journal,
+        )
+        if inject:
+            injector.arm(2)    # dispatch + retry fail -> CPU fallback
+        t0 = time.perf_counter()
+        futs = [svc.submit(stx, requester) for stx in spends]
+        svc.flush()
+        svc.tick()             # group-commit the WAL deletes
+        wall = time.perf_counter() - t0
+        signed = sum(
+            1 for f in futs if f.done and hasattr(f.result(), "by")
+        )
+        return wall, {
+            "signed": signed,
+            "answered": sum(1 for f in futs if f.done),
+            "degraded": svc.degraded,
+            "degraded_flushes": svc.metrics.counter(
+                "Notary.DegradedFlushes"
+            ).count,
+            "wal_unresolved": (
+                journal.unresolved_count if journal is not None else 0
+            ),
+        }
+
+    # interleaved A/B, min-of-reps: wal-off / wal-on / degraded
+    reps = max(2, iters)
+    wal_off = wal_on = degraded = float("inf")
+    wal_on_info = degraded_info = {}
+    for _ in range(reps):
+        w, _info = flush_wall(intent_wal=False, inject=False)
+        wal_off = min(wal_off, w)
+        w, info = flush_wall(intent_wal=True, inject=False)
+        if w < wal_on:
+            wal_on, wal_on_info = w, info
+        w, info = flush_wall(intent_wal=False, inject=True)
+        if w < degraded:
+            degraded, degraded_info = w, info
+    degraded_recovered = (
+        degraded_info["answered"] == batch
+        and degraded_info["signed"] == batch
+        and degraded_info["degraded_flushes"] >= 1
+    )
+    wal_ok = (
+        wal_on_info["signed"] == batch
+        and wal_on_info["wal_unresolved"] == 0
+    )
+
+    # WAL kill/replay: admit without flushing, "crash", reopen, replay
+    path = os.path.join(tmp, "replay.db")
+    db = NodeDatabase(path)
+    journal = NotaryIntentJournal(db)
+    notary.services._batch_verifier = CpuBatchVerifier()
+    uniq = InMemoryUniquenessProvider()
+    svc = BatchingNotaryService(
+        notary.services, uniq, intent_journal=journal
+    )
+    n_replay = min(64, batch)
+    for stx in spends[:n_replay]:
+        svc.submit(stx, requester)    # admitted, never flushed
+    db.close()                        # process death
+    db2 = NodeDatabase(path)
+    journal2 = NotaryIntentJournal(db2)
+    svc2 = BatchingNotaryService(
+        notary.services, uniq, intent_journal=journal2
+    )
+    replayed = svc2.replay_intents()
+    svc2.flush()
+    svc2.tick()
+    wal_zero_loss = (
+        len(replayed) == n_replay
+        and all(f.done for _s, _t, f in replayed)
+        and journal2.unresolved_count == 0
+        and wal_ok
+    )
+    for db_ in dbs:
+        db_.close()
+    db2.close()
+
+    # redispatch penalty: real-time two-worker pool, one killed
+    # mid-stream vs none (node/verifier.py lease/redispatch walk)
+    from corda_tpu.node.messaging import FabricFaults
+    from corda_tpu.node.verifier import (
+        OutOfProcessTransactionVerifierService,
+        RedispatchPolicy,
+        VerifierWorker,
+    )
+    from corda_tpu.testing.mock_network import MockNetwork
+
+    def pool_wall(kill: bool) -> tuple[float, bool]:
+        faults = FabricFaults()
+        pnet = MockNetwork(
+            seed=7, faults=faults, batch_verifier=CpuBatchVerifier()
+        )
+        pnotary = pnet.create_notary()
+        node = pnet.create_node("PoolNode")
+        from corda_tpu.finance import CashIssueFlow
+
+        stx = node.run_flow(
+            CashIssueFlow(9, "USD", node.party, pnotary.party)
+        )
+        ltx = node.services.resolve_transaction(stx.wtx)
+        pool = OutOfProcessTransactionVerifierService(
+            node.messaging,
+            policy=RedispatchPolicy(
+                lease_micros=60_000,
+                backoff_base_micros=10_000,
+                backoff_cap_micros=40_000,
+                request_timeout_micros=20_000_000,
+            ),
+        )
+        workers = [
+            VerifierWorker(
+                pnet.fabric.endpoint(f"pw{k}"), "PoolNode",
+                batch_verifier=CpuBatchVerifier(),
+                heartbeat_micros=20_000,
+            )
+            for k in range(2)
+        ]
+        pnet.fabric.run()
+        t0 = time.perf_counter()
+        futs = [pool.verify(ltx, stx) for _ in range(16)]
+        if kill:
+            faults.kill("pw0")
+            pnet.fabric.endpoint("pw0").running = False
+        deadline = t0 + 30.0
+        while (
+            not all(f.done for f in futs)
+            and time.perf_counter() < deadline
+        ):
+            pnet.fabric.run()
+            for k, w in enumerate(workers):
+                if not (kill and k == 0):
+                    w.drain()
+            pool.tick()
+            time.sleep(0.002)
+        return time.perf_counter() - t0, all(f.done for f in futs)
+
+    pool_wall(kill=False)   # warmup: imports + first-rig costs out
+    base_wall, base_ok = pool_wall(kill=False)
+    kill_wall, kill_ok = pool_wall(kill=True)
+    redispatch_recovered = base_ok and kill_ok
+
+    return {
+        "metric": "fault_tolerance_plane",
+        "value": round(batch / wal_on, 3),
+        "unit": "notarisations/s through a WAL-journaled CPU flush",
+        "vs_baseline": None,
+        "gate_required_true": [
+            "redispatch_recovered", "degraded_recovered", "wal_zero_loss",
+        ],
+        "redispatch_recovered": redispatch_recovered,
+        "degraded_recovered": degraded_recovered,
+        "wal_zero_loss": wal_zero_loss,
+        "batch": batch,
+        "wal_off_per_sec": round(batch / wal_off, 3),
+        "wal_overhead_fraction": round(max(0.0, wal_on / wal_off - 1), 4),
+        "degraded_fallback_per_sec": round(batch / degraded, 3),
+        "degraded_throughput_ratio": round(wal_off / degraded, 4),
+        "redispatch_base_ms": round(base_wall * 1e3, 3),
+        "redispatch_kill_ms": round(kill_wall * 1e3, 3),
+        "redispatch_penalty_ms": round(
+            max(0.0, kill_wall - base_wall) * 1e3, 3
+        ),
+        "replayed": len(replayed),
+    }
+
+
 def _parity_metric(batch: int, iters: int) -> dict:
     """Reduced-n refresh of the windowed+plain kernel-parity artifact
     (VERDICT r3 #8): regenerates KERNEL_PARITY.json from the default
@@ -1600,6 +1822,11 @@ def _run_metric(metric: str, batch: int, iters: int) -> dict:
     if metric == "fleet":
         out = _fleet_metric(min(batch, 16), iters)
         if batch > 16:
+            out["batch_requested"] = batch   # cap visible in the record
+        return out
+    if metric == "faults":
+        out = _faults_metric(min(batch, 128), iters)
+        if batch > 128:
             out["batch_requested"] = batch   # cap visible in the record
         return out
     if metric == "parity":
@@ -1791,6 +2018,30 @@ def _quick(metric: str) -> None:
         if out["value"] <= 0:
             raise SystemExit("zero goodput through the soak")
         return
+    if metric == "faults":
+        batch = int(os.environ.get("BENCH_BATCH", "32"))
+        iters = int(os.environ.get("BENCH_ITERS", "1"))
+        out = _faults_metric(batch, iters)
+        out["quick"] = True
+        print(json.dumps(out), flush=True)
+        if not out["redispatch_recovered"]:
+            raise SystemExit(
+                "a killed worker's in-flight verifications never all "
+                "resolved — redispatch is stranding futures"
+            )
+        if not out["degraded_recovered"]:
+            raise SystemExit(
+                "the degraded CPU-fallback flush did not sign every "
+                "request (device-fault recovery broken)"
+            )
+        if not out["wal_zero_loss"]:
+            raise SystemExit(
+                "intent-WAL replay lost an admitted request "
+                "(kill-with-pending must recover ALL of them)"
+            )
+        if out["value"] <= 0:
+            raise SystemExit("zero throughput through the WAL flush")
+        return
     if metric == "qos":
         batch = int(os.environ.get("BENCH_BATCH", "24"))
         out = _qos_metric(batch, int(os.environ.get("BENCH_ITERS", "2")))
@@ -1838,7 +2089,7 @@ def _quick(metric: str) -> None:
     if metric != "ingest":
         raise SystemExit(
             f"--quick supports 'ingest', 'trace', 'qos', 'health', "
-            f"'perf', 'fleet' or 'shards', not {metric!r}"
+            f"'perf', 'fleet', 'faults' or 'shards', not {metric!r}"
         )
     batch = int(os.environ.get("BENCH_BATCH", "256"))
     iters = int(os.environ.get("BENCH_ITERS", "1"))
@@ -1858,7 +2109,7 @@ def main() -> None:
     if argv:
         raise SystemExit(
             f"unknown arguments {argv!r} "
-            "(try --quick ingest|trace|qos|health|perf|fleet|shards)"
+            "(try --quick ingest|trace|qos|health|perf|fleet|faults|shards)"
         )
     t_start = time.perf_counter()
     # On a remote-attached TPU the host<->device link latency (~50-100
@@ -1871,7 +2122,7 @@ def main() -> None:
     known = (
         "all", "p256", "mixed", "merkle", "notary", "notary_commit_plane",
         "ingest", "ingest_pipelined", "trace", "qos", "health", "perf",
-        "fleet", "montmul", "parity",
+        "fleet", "faults", "montmul", "parity",
     )
     if metric not in known:
         # a typo must not record a p256-only rate under another name
@@ -1910,7 +2161,8 @@ def main() -> None:
     # parity runs LAST of the optional work (cheapest to drop), but
     # before the headline so the headline stays the final stdout line
     for m in ("mixed", "merkle", "notary", "ingest", "ingest_pipelined",
-              "trace", "qos", "health", "perf", "fleet", "parity"):
+              "trace", "qos", "health", "perf", "fleet", "faults",
+              "parity"):
         avail = left() - reserve
         if avail < 60:
             print(
@@ -1922,7 +2174,7 @@ def main() -> None:
         env = dict(os.environ, BENCH_METRIC=m)
         if avail < 300 and m in (
             "mixed", "merkle", "notary", "ingest", "ingest_pipelined",
-            "trace", "qos", "health", "perf", "fleet",
+            "trace", "qos", "health", "perf", "fleet", "faults",
         ):
             # trim before dropping: one timed rep at a shallower batch
             # still yields a usable point for the table

@@ -471,6 +471,69 @@ SCHEME_KERNELS = frozenset(
 )
 
 
+class DeviceFaultError(RuntimeError):
+    """A device/kernel dispatch failed (XLA error, device lost, link
+    down). The batching notary's degraded-mode seam catches exactly
+    this class of failure: retry once on the device, then fall back to
+    the CPU reference verifier for the flush."""
+
+
+class DispatchFaultInjector(BatchSignatureVerifier):
+    """First-class fault seam at the verify dispatch (the chaos plane's
+    `device_fault` event arms it; bench/tests use it directly): while
+    armed, the next `failures_left` dispatches raise a DeviceFaultError
+    instead of reaching the device — after that every call passes
+    through to the wrapped verifier untouched, which is what lets the
+    notary's auto-recovery probe re-arm the device path. Never
+    monkeypatching: the injector IS the installed verifier, so the
+    production guard code runs exactly as a real XLA failure would
+    drive it."""
+
+    def __init__(self, inner: BatchSignatureVerifier):
+        self.inner = inner
+        self.failures_left = 0
+        self.faults_raised = 0
+        self._exc_factory = None
+
+    def arm(self, failures: int = 1, exc_factory=None) -> None:
+        """The next `failures` dispatches raise (DeviceFaultError by
+        default, or `exc_factory()`); later ones pass through."""
+        self.failures_left = int(failures)
+        self._exc_factory = exc_factory
+
+    def disarm(self) -> None:
+        self.failures_left = 0
+
+    @property
+    def armed(self) -> bool:
+        return self.failures_left > 0
+
+    def _maybe_fault(self) -> None:
+        if self.failures_left > 0:
+            self.failures_left -= 1
+            self.faults_raised += 1
+            raise (
+                self._exc_factory()
+                if self._exc_factory is not None
+                else DeviceFaultError(
+                    "injected device fault (dispatch seam)"
+                )
+            )
+
+    def verify_batch(self, requests: Sequence[VerificationRequest]) -> list[bool]:
+        self._maybe_fault()
+        return self.inner.verify_batch(requests)
+
+    def verify_batch_async(self, requests: Sequence[VerificationRequest]):
+        self._maybe_fault()
+        inner_async = getattr(self.inner, "verify_batch_async", None)
+        if inner_async is not None:
+            return inner_async(requests)
+        # sync inner: wrap the completed results in a handle so callers
+        # written against the async SPI see one code path
+        return PendingVerification(self.inner.verify_batch(requests), [])
+
+
 def per_shard_verifiers(
     n_shards: int,
     batch_sizes: tuple[int, ...] = (128, 1024, 4096),

@@ -79,6 +79,22 @@ class NodeConfig:
     # only routes and resolves answers); False flushes shards from the
     # pump tick as a dispatch-all-then-consume wave
     notary_shard_workers: bool = False
+    # durable intake WAL (batching notary only, round 9): admitted
+    # requests journal to a sqlite intent table BEFORE queueing and
+    # replay through the normal flush path on boot — in-flight-at-kill
+    # loss goes to zero (persistence.py NotaryIntentJournal)
+    notary_intent_wal: bool = False
+    # degraded-mode verify (batching notary): a device/kernel failure
+    # at the dispatch seam retries once, then serves the flush through
+    # the CPU reference verifier (bit-exact) with the
+    # notary.degraded_mode alert firing until a device probe succeeds
+    notary_degraded_fallback: bool = True
+    # out-of-process verifier pool self-healing (node/verifier.py):
+    # worker lease TTL — a worker silent past this window detaches and
+    # its in-flight work re-dispatches to a survivor
+    verifier_lease_micros: int = 10_000_000
+    # base of the capped exponential redispatch backoff, microseconds
+    verifier_redispatch_backoff: int = 100_000
     # QoS / overload control for the batching notary (node/qos.py):
     # enabled, the notary gets deadline shedding, a per-client
     # admission gate on the request path, the adaptive batching
@@ -189,6 +205,17 @@ class NodeConfig:
         if self.notary_shard_workers and self.notary_shards <= 1:
             raise ConfigError(
                 "notary_shard_workers requires notary_shards > 1"
+            )
+        if self.notary_intent_wal and self.notary != "batching":
+            raise ConfigError(
+                "notary_intent_wal requires notary = 'batching' (only "
+                "the batching notary has a durable intake queue)"
+            )
+        if self.verifier_lease_micros <= 0:
+            raise ConfigError("verifier_lease_micros must be positive")
+        if self.verifier_redispatch_backoff < 0:
+            raise ConfigError(
+                "verifier_redispatch_backoff must be >= 0"
             )
         if self.perf_profile_hz < 0:
             raise ConfigError("perf_profile_hz must be >= 0")
@@ -350,6 +377,14 @@ def write_config(cfg: NodeConfig, path: str) -> None:
         emit("notary_shards", cfg.notary_shards)
         if cfg.notary_shard_workers:
             emit("notary_shard_workers", cfg.notary_shard_workers)
+    if cfg.notary_intent_wal:
+        emit("notary_intent_wal", cfg.notary_intent_wal)
+    if not cfg.notary_degraded_fallback:
+        emit("notary_degraded_fallback", cfg.notary_degraded_fallback)
+    if cfg.verifier_lease_micros != 10_000_000:
+        emit("verifier_lease_micros", cfg.verifier_lease_micros)
+    if cfg.verifier_redispatch_backoff != 100_000:
+        emit("verifier_redispatch_backoff", cfg.verifier_redispatch_backoff)
     if cfg.qos_enabled:
         emit("qos_enabled", cfg.qos_enabled)
         emit("qos_target_p99_micros", cfg.qos_target_p99_micros)

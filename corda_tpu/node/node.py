@@ -289,14 +289,25 @@ class Node:
         # -- verifier offload ------------------------------------------
         self.verifier_service = None
         if config.verifier_type == "out_of_process":
-            from .verifier import OutOfProcessTransactionVerifierService
+            from .verifier import (
+                OutOfProcessTransactionVerifierService,
+                RedispatchPolicy,
+            )
 
             self.verifier_service = OutOfProcessTransactionVerifierService(
                 self.messaging,
                 metrics=self.metrics,
                 register_peer=self._register_worker_peer,
+                clock=self.services.clock,
+                policy=RedispatchPolicy(
+                    lease_micros=config.verifier_lease_micros,
+                    backoff_base_micros=config.verifier_redispatch_backoff,
+                ),
             )
             self.services.transaction_verifier = self.verifier_service
+            # pool-degraded alerting: a lost worker (or a starved
+            # pool) pages before client timeouts do
+            self.verifier_service.watch_health(self.health)
 
         # -- RPC --------------------------------------------------------
         users = [
@@ -571,6 +582,14 @@ class Node:
                         clock=self.services.clock,
                         metrics=self.metrics,
                     )
+                intent_journal = None
+                if self.config.notary_intent_wal:
+                    # durable intake (round 9): intents share the node
+                    # database (same file, same WAL-mode fsync
+                    # discipline as the fabric journals)
+                    from .persistence import NotaryIntentJournal
+
+                    intent_journal = NotaryIntentJournal(self.db)
                 self.services.notary_service = BatchingNotaryService(
                     self.services,
                     uniqueness,
@@ -580,7 +599,14 @@ class Node:
                     shards=max(shards, 1),
                     shard_workers=self.config.notary_shard_workers,
                     shard_verifiers=shard_verifiers,
+                    degraded_fallback=self.config.notary_degraded_fallback,
+                    intent_journal=intent_journal,
                 )
+                if intent_journal is not None:
+                    # boot replay: requests admitted-but-in-flight at
+                    # the last crash re-enter the normal flush path;
+                    # uniqueness dedupe absorbs already-committed ones
+                    self.services.notary_service.replay_intents()
                 # health plane over the serving path: the flush loop's
                 # heartbeat, the SLO burn-rate + shed-ratio rules (when
                 # QoS is on), and the canary probe riding real flushes
@@ -754,6 +780,10 @@ class Node:
             # the pump interval is the batch deadline: everything that
             # queued since the last pump shares one SPI dispatch
             notary.tick()
+        if self.verifier_service is not None:
+            # pool self-healing: lease expiry, redispatch backoff and
+            # hedging all walk on the pump cadence
+            self.verifier_service.tick()
         if self.raft is not None:
             if self._hb_raft is None:
                 self._hb_raft = self.health.heartbeat("raft.driver")

@@ -693,6 +693,13 @@ class _PendingNotarisation:
     # batching controller steers by. Both None when QoS is off.
     deadline: Optional[int] = None
     arrival_micros: Optional[int] = None
+    # durable intake (round 9): this request's row id in the intent
+    # WAL. Set by enqueue_pending when a journal is attached (or by
+    # replay_intents re-enqueueing an unresolved intent — which must
+    # NOT append a second row); the resolution callback deletes the
+    # row when the future answers. None when the WAL is off. The
+    # sentinel -1 means "synthetic, never journal" (the health canary).
+    intent_seq: Optional[int] = None
 
 
 class _ShardAnswer:
@@ -793,6 +800,8 @@ class BatchingNotaryService(NotaryService):
         shard_workers: bool = False,
         shard_verifiers: Optional[list] = None,
         shard_queue_depth: int = 0,
+        degraded_fallback: bool = True,
+        intent_journal=None,
     ):
         """`max_wait_micros` is the batching DEADLINE (SURVEY §7 hard
         part 4 — latency vs throughput): 0 (default) flushes every pump
@@ -834,7 +843,29 @@ class BatchingNotaryService(NotaryService):
         shards. `shard_queue_depth` bounds each shard's pending queue
         (0 = 4x max_batch); a full queue triggers that shard's flush.
         shards == 1 keeps the original single-queue hot path
-        bit-for-bit."""
+        bit-for-bit.
+
+        `degraded_fallback` (round-9 fault plane): a device/kernel
+        exception at the verify dispatch seam retries once on the
+        device, then serves THAT flush through the CPU reference
+        verifier (bit-exact semantics — CpuBatchVerifier is the
+        correctness anchor the kernels are pinned against), counting
+        Notary.DegradedFlushes and firing the `notary.degraded_mode`
+        alert; every later flush's device attempt doubles as the
+        recovery probe that re-arms the device path and auto-resolves
+        the alert. A batch that fails DETERMINISTICALLY (CPU fallback
+        raises too) is bisected to isolate the poison transaction(s),
+        which are quarantined with a typed answer while the rest of
+        the batch commits normally. False restores the old behaviour
+        (one dispatch failure fails the whole flush).
+
+        `intent_journal` (round-9 durable intake): a
+        persistence.NotaryIntentJournal — every admitted request is
+        appended BEFORE it enters the pending queue and deleted when
+        its future resolves; `replay_intents()` re-enqueues unresolved
+        intents on boot through the normal flush path (uniqueness
+        dedupe absorbs already-committed replays), taking
+        in-flight-at-kill loss to zero."""
         super().__init__(
             services, uniqueness, tolerance_micros, service_identity
         )
@@ -876,6 +907,27 @@ class BatchingNotaryService(NotaryService):
         self._phase_profile: Optional[dict] = (
             {} if os.environ.get("CORDA_TPU_NOTARY_PROFILE") else None
         )
+        # -- fault-tolerance plane (round 9) ----------------------------
+        self.degraded_fallback = degraded_fallback
+        self.intent_journal = intent_journal
+        self._degraded = False         # device path currently distrusted
+        self._degraded_last: dict = {}     # evidence: error, at_micros
+        self._cpu_reference = None         # lazy CpuBatchVerifier
+        self._degraded_counter = self.metrics.counter(
+            "Notary.DegradedFlushes"
+        )
+        self._quarantined_counter = self.metrics.counter(
+            "Notary.Quarantined"
+        )
+        self.quarantined: list = []        # poison tx ids, boot-scoped
+        self.metrics.gauge(
+            "Notary.DegradedMode", lambda: 1 if self._degraded else 0
+        )
+        if intent_journal is not None:
+            self.metrics.gauge(
+                "Notary.IntentUnresolved",
+                lambda: intent_journal.unresolved_count,
+            )
         # -- sharded commit plane (round 6) ----------------------------
         self.n_shards = max(1, int(shards))
         self._shards: Optional[list[_NotaryShard]] = None
@@ -1060,12 +1112,58 @@ class BatchingNotaryService(NotaryService):
         flushes the unsharded queue at effective_max_batch, the shard
         router flushes a full shard itself, submit() never flushes
         (bench rigs fill the whole plane first)."""
+        journal = self.intent_journal
+        if journal is not None and p.intent_seq is None:
+            # durable intake: the intent row lands BEFORE the request
+            # can enter any queue — from here on a crash replays it
+            # instead of losing it. Resolution (any answer: signature,
+            # conflict, shed, unavailable) deletes the row; the delete
+            # itself is group-committed per flush tick.
+            p.intent_seq = journal.append(p.stx, p.requester, p.deadline)
+            p.future.add_done_callback(
+                lambda f, j=journal, s=p.intent_seq: j.mark_resolved(s)
+            )
         if self._shards is not None:
             self._enqueue_sharded(p)
             return
         if not self._pending:
             self._oldest_arrival = self.services.clock.now_micros()
         self._pending.append(p)
+
+    def attach_intent_journal(self, journal) -> None:
+        """Wire (or detach, with None) the durable intake WAL after
+        construction — the embedded/sim seam (node.py passes it at
+        build time)."""
+        self.intent_journal = journal
+
+    def replay_intents(self) -> list:
+        """Boot-time recovery: re-enqueue every unresolved intent from
+        the WAL through the NORMAL intake path with a fresh future.
+        Already-committed replays (the answer raced the crash) are
+        absorbed by the uniqueness provider's same-tx idempotent
+        re-commit; genuinely lost requests flush as if they had just
+        arrived. Returns [(seq, tx_id, future)] so an embedding driver
+        can re-attach waiters it still holds for those transactions."""
+        journal = self.intent_journal
+        if journal is None:
+            return []
+        from ..flows.api import FlowFuture
+
+        out = []
+        now = self.services.clock.now_micros()
+        for seq, stx, requester, deadline in journal.unresolved():
+            fut = FlowFuture()
+            fut.add_done_callback(
+                lambda f, j=journal, s=seq: j.mark_resolved(s)
+            )
+            p = _PendingNotarisation(
+                stx, requester, fut,
+                deadline=deadline, arrival_micros=now, intent_seq=seq,
+            )
+            self.enqueue_pending(p)
+            journal.replayed += 1
+            out.append((seq, stx.id, fut))
+        return out
 
     # -- shard routing (round 6) --------------------------------------------
 
@@ -1165,6 +1263,24 @@ class BatchingNotaryService(NotaryService):
                     f"notary.shard{shard.id}.flush",
                     queue_depth=(lambda s=shard: s.depth()),
                 )
+        # degraded-mode alert (round 9): fires while the device verify
+        # path is distrusted (a flush fell back to the CPU reference),
+        # carrying the triggering error + slowest matching traces as
+        # evidence; auto-resolves when a later flush's device probe
+        # succeeds. for/clear 0: entering and leaving degraded mode
+        # already encode their own duration (one whole flush each way).
+        from ..utils.health import AlertRule
+
+        monitor.add_rule(
+            AlertRule(
+                "notary.degraded_mode",
+                lambda now: (self._degraded, self.degraded_evidence),
+                severity="critical",
+                for_micros=0,
+                clear_for_micros=0,
+                trace_filter="notar",
+            )
+        )
 
     def attach_perf(self, plane) -> None:
         """Wire the performance-attribution plane (utils/perf.py):
@@ -1211,6 +1327,11 @@ class BatchingNotaryService(NotaryService):
         unless a batching deadline is set and neither it nor max_batch
         has been reached yet. Returns requests answered (0 = held or
         quiescent)."""
+        if self.intent_journal is not None:
+            # group-commit the WAL's resolution deletes once per tick
+            # (the fsync discipline of the fabric journals): answers
+            # buffered since the last tick clear in ONE transaction
+            self.intent_journal.flush_resolved()
         if self._shards is not None:
             return self._tick_sharded()
         self._drain_ingest()
@@ -1362,6 +1483,8 @@ class BatchingNotaryService(NotaryService):
         wave, or — with worker threads — by waking every shard and
         blocking until they go idle, then resolving the completions on
         the calling thread (which acts as the pump)."""
+        if self.intent_journal is not None:
+            self.intent_journal.flush_resolved()
         self._drain_ingest()   # pre-ingested arrivals join this flush
         if self._shards is not None:
             if self._workers:
@@ -1744,6 +1867,7 @@ class BatchingNotaryService(NotaryService):
             if shard is not None and shard.verifier is not None
             else self.services.batch_verifier
         )
+        poison: set = set()
         try:
             collector: Optional[threading.Thread] = None
             box: dict = {}
@@ -1752,11 +1876,43 @@ class BatchingNotaryService(NotaryService):
             # TraceAnnotation (when jax provides it): the dispatch span
             # becomes a named region in an XLA profiler capture, so
             # host-side traces line up with the device timeline
-            with tracing.annotate("corda_tpu.notary.batch_verify_dispatch"):
-                if hasattr(verifier, "verify_batch_async"):
-                    handle = verifier.verify_batch_async(reqs)
+            try:
+                with tracing.annotate(
+                    "corda_tpu.notary.batch_verify_dispatch"
+                ):
+                    if hasattr(verifier, "verify_batch_async"):
+                        handle = verifier.verify_batch_async(reqs)
+                    else:
+                        results = verifier.verify_batch(reqs)
+                if self._degraded and results is not None:
+                    # the recovery probe: a degraded notary keeps
+                    # attempting the device each flush — one success
+                    # re-arms the device path and resolves the alert.
+                    # ONLY a synchronous dispatch proves anything here:
+                    # an async handle's real device fault surfaces at
+                    # consume/collector time, so the consume path owns
+                    # the exit for handles (a broken device must not
+                    # "recover" at every dispatch and re-degrade at
+                    # every consume).
+                    self._exit_degraded()
+            except Exception as first_err:
+                if not self.degraded_fallback:
+                    raise
+                handle = None
+                if not self._degraded:
+                    # transient blip? one device retry before degrading
+                    try:
+                        results = verifier.verify_batch(reqs)
+                    except Exception:
+                        results, poison = self._degraded_verify(
+                            pending, spans, reqs, first_err
+                        )
                 else:
-                    results = verifier.verify_batch(reqs)
+                    # already degraded: the probe above just failed —
+                    # no second device attempt, straight to the CPU
+                    results, poison = self._degraded_verify(
+                        pending, spans, reqs, first_err
+                    )
             # STREAMING tail (round-5): when the handle's per-chunk
             # transfers were queued at dispatch and the uniqueness
             # provider commits synchronously, chunk k's transactions
@@ -1806,7 +1962,106 @@ class BatchingNotaryService(NotaryService):
             "box": box,
             "stream_ok": stream_ok,
             "t": t,
+            "reqs": reqs,
+            "poison": poison,
         }
+
+    # -- degraded-mode verify (round 9) --------------------------------------
+
+    @property
+    def degraded(self) -> bool:
+        """True while the device verify path is distrusted (the last
+        flush fell back to the CPU reference and no probe has
+        succeeded since) — the `notary.degraded_mode` alert condition."""
+        return self._degraded
+
+    @property
+    def degraded_evidence(self) -> dict:
+        return dict(self._degraded_last)
+
+    def _cpu_ref(self):
+        if self._cpu_reference is None:
+            from ..crypto.batch_verifier import CpuBatchVerifier
+
+            self._cpu_reference = CpuBatchVerifier()
+        return self._cpu_reference
+
+    def _enter_degraded(self, error) -> None:
+        self._degraded_counter.inc()
+        self._degraded_last = {
+            "error": f"{type(error).__name__}: {error}",
+            "at_micros": self.services.clock.now_micros(),
+            "degraded_flushes": self._degraded_counter.count,
+        }
+        self._degraded = True
+
+    def _exit_degraded(self) -> None:
+        if self._degraded:
+            self._degraded = False
+            self._degraded_last = dict(
+                self._degraded_last,
+                recovered_at_micros=self.services.clock.now_micros(),
+            )
+
+    def _degraded_verify(self, pending, spans, reqs, error):
+        """One flush's CPU-reference fallback after the device path
+        failed twice: bit-exact semantics (CpuBatchVerifier is the
+        correctness anchor the kernels are pinned against), so the
+        degraded flush commits EXACTLY the answers the device path
+        would. When even the CPU pass raises — the failure is
+        deterministic, i.e. a poison transaction, not a dead device —
+        bisect by transaction to isolate it: the poison indices are
+        returned for quarantine and every other transaction still gets
+        real results. Returns (results, poison_tx_indices)."""
+        self._enter_degraded(error)
+        cpu = self._cpu_ref()
+        try:
+            return list(cpu.verify_batch(reqs)), set()
+        except Exception:
+            pass
+        results: list = [False] * len(reqs)
+        poison: set[int] = set()
+
+        def attempt(lo: int, hi: int) -> None:
+            o0 = spans[lo][0]
+            o1 = spans[hi - 1][0] + spans[hi - 1][1]
+            if o1 == o0:
+                return   # no signature rows: cannot be the poison
+            try:
+                sub = cpu.verify_batch(reqs[o0:o1])
+            except Exception:
+                if hi - lo == 1:
+                    poison.add(lo)
+                    return
+                mid = (lo + hi) // 2
+                attempt(lo, mid)
+                attempt(mid, hi)
+                return
+            results[o0:o1] = sub
+
+        # seed with the two halves: the full range just FAILED above —
+        # re-verifying it whole would repeat the most expensive pass
+        n = len(pending)
+        if n == 1:
+            poison.add(0)
+        else:
+            attempt(0, n // 2)
+            attempt(n // 2, n)
+        return results, poison
+
+    def _quarantine(self, p: _PendingNotarisation) -> None:
+        """Answer a poison transaction with its typed error and record
+        it — the rest of its batch commits normally around it."""
+        self._quarantined_counter.inc()
+        self.quarantined.append(p.stx.id)
+        p.future.set_result(
+            NotaryError(
+                "poison-quarantined",
+                f"transaction {p.stx.id} deterministically crashed the "
+                f"batch verifier and was quarantined "
+                f"({self._degraded_last.get('error', 'no detail')})",
+            )
+        )
 
     def _consume_flush(self, ctx, marks, shard=None) -> None:
         """Phase B of a flush: host-side resolve+contract pass, then
@@ -1823,6 +2078,8 @@ class BatchingNotaryService(NotaryService):
         box = ctx["box"]
         stream_ok = ctx["stream_ok"]
         t = ctx["t"]
+        poison = ctx.get("poison") or set()
+        contract_errs = deferred_ltx = None
         try:
             # overlap: contract execution (host Python) runs while the
             # device computes the signature batch and the collector
@@ -1858,6 +2115,7 @@ class BatchingNotaryService(NotaryService):
                 self._stream_tail(
                     pending, spans, contract_errs, deferred_ltx,
                     handle, tv, tv_sync, t, marks,
+                    reqs=ctx.get("reqs"), poison=poison,
                 )
                 return
             if collector is not None:
@@ -1865,16 +2123,46 @@ class BatchingNotaryService(NotaryService):
                 if "error" in box:
                     raise box["error"]
                 results = box["results"]
+                if self._degraded:
+                    # async probe success: the handle's results really
+                    # came back from the device — NOW it has recovered
+                    self._exit_degraded()
             t = self._mark("link_wait", t, marks)
         except Exception as e:
-            # a failed dispatch (unsupported scheme in the batch, device
-            # unavailable) must answer every waiting requester, not
-            # strand them and crash the pump tick
-            for p in pending:
-                p.future.set_result(
-                    NotaryError("verification-unavailable", str(e))
-                )
-            return
+            # the device batch died AFTER dispatch (collector fetch /
+            # link failure): same degraded seam as the dispatch guard,
+            # minus the retry — the in-flight compute is gone, so the
+            # CPU reference serves this flush (bit-exact) and the next
+            # flush's device attempt is the recovery probe. Host-side
+            # resolve failures (contract_errs still unset) are NOT a
+            # device fault — re-verifying signatures cannot fix them.
+            if (
+                self.degraded_fallback
+                and contract_errs is not None
+                and ctx.get("reqs") is not None
+            ):
+                try:
+                    results, late_poison = self._degraded_verify(
+                        pending, spans, ctx["reqs"], e
+                    )
+                    poison = poison | late_poison
+                    t = self._mark("link_wait", t, marks)
+                except Exception as e2:   # noqa: BLE001 - answer, not strand
+                    for p in pending:
+                        p.future.set_result(
+                            NotaryError("verification-unavailable", str(e2))
+                        )
+                    return
+            else:
+                # a failed dispatch (unsupported scheme in the batch,
+                # device unavailable with fallback off) must answer
+                # every waiting requester, not strand them and crash
+                # the pump tick
+                for p in pending:
+                    p.future.set_result(
+                        NotaryError("verification-unavailable", str(e))
+                    )
+                return
         self._batches_counter.inc()
         self._requests_counter.inc(len(pending))
         # phase 2 — per-tx validation in arrival order
@@ -1882,6 +2170,11 @@ class BatchingNotaryService(NotaryService):
         for i, (p, (off, n), cerr) in enumerate(
             zip(pending, spans, contract_errs)
         ):
+            if i in poison:
+                # deterministic verifier crash isolated to THIS tx: a
+                # typed quarantine answer; its batchmates commit
+                self._quarantine(p)
+                continue
             if not self._validate_one(p, results[off : off + n], cerr):
                 continue
             dltx = deferred_ltx.get(i)
@@ -2000,7 +2293,7 @@ class BatchingNotaryService(NotaryService):
 
     def _stream_tail(
         self, pending, spans, contract_errs, deferred_ltx,
-        handle, tv, tv_sync, t, marks=None,
+        handle, tv, tv_sync, t, marks=None, reqs=None, poison=None,
     ) -> None:
         """Streaming validate+commit (round-5): consume the SPI's
         per-chunk results as each chunk's device compute completes,
@@ -2015,6 +2308,7 @@ class BatchingNotaryService(NotaryService):
         committed: dict[int, _PendingNotarisation] = {}
         state = {"ptr": 0}
         n_pend = len(pending)
+        poison = set() if poison is None else set(poison)
         # counted at dispatch like the join path (line above phase 2):
         # a batch that later fails mid-stream was still dispatched
         self._batches_counter.inc()
@@ -2033,6 +2327,9 @@ class BatchingNotaryService(NotaryService):
                     break
                 i, p = ptr, pending[ptr]
                 ptr += 1
+                if i in poison:
+                    self._quarantine(p)   # typed answer, batchmates live
+                    continue
                 if not self._validate_one(p, row, contract_errs[i]):
                     continue
                 dltx = deferred_ltx.get(i)
@@ -2089,15 +2386,38 @@ class BatchingNotaryService(NotaryService):
             # all-CPU batches have no device chunks: drain once more
             if state["ptr"] < n_pend and not drain():
                 return
+            if self._degraded:
+                # streamed probe success: every chunk consumed from
+                # the device — the degraded path has recovered
+                self._exit_degraded()
         except Exception as e:   # noqa: BLE001 - device/link failure
-            # a failed chunk fetch must answer every waiting requester,
-            # not strand them and crash the pump tick (set_result on an
-            # already-answered future is a no-op)
-            for p in pending:
-                p.future.set_result(
-                    NotaryError("verification-unavailable", str(e))
-                )
-            return
+            recovered = False
+            if self.degraded_fallback and reqs is not None:
+                # mid-stream device failure: transactions already
+                # committed keep their answers (the monotonic pointer
+                # never revisits them); the CPU reference fills every
+                # UNRESOLVED row bit-exact and the drain completes the
+                # flush in the same arrival order
+                try:
+                    fb, late_poison = self._degraded_verify(
+                        pending, spans, reqs, e
+                    )
+                    poison.update(late_poison)
+                    for j, v in enumerate(results):
+                        if v is None:
+                            results[j] = fb[j]
+                    recovered = drain()
+                except Exception:   # noqa: BLE001 - fall through to answer
+                    recovered = False
+            if not recovered:
+                # a failed chunk fetch must answer every waiting
+                # requester, not strand them and crash the pump tick
+                # (set_result on an already-answered future is a no-op)
+                for p in pending:
+                    p.future.set_result(
+                        NotaryError("verification-unavailable", str(e))
+                    )
+                return
         t = self._mark("stream_commit", t, marks)
         self._finalize_sign(committed)
         self._mark("sign_scatter", t, marks)

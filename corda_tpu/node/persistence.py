@@ -538,6 +538,146 @@ class ShardedPersistentUniquenessProvider(ShardedUniquenessProvider):
         )[0][0]
 
 
+class NotaryIntentJournal:
+    """Durable intake WAL for the batching notary (round 9).
+
+    Every ADMITTED notarisation request appends one intent row —
+    transaction, requester, deadline — BEFORE it enters the pending
+    queue, and the row is deleted when the request's future resolves
+    (any answer counts: signature, conflict, shed, unavailable). The
+    table lives in the node's WAL-mode sqlite database under the same
+    fsync discipline as the fabric journals: appends are WAL writes
+    (synchronous=NORMAL — no per-row fsync), resolution deletes are
+    buffered in memory and group-committed once per flush tick.
+
+    On boot, `BatchingNotaryService.replay_intents` re-enqueues every
+    row still present — requests that were admitted but in flight when
+    the process died — through the normal flush path. Replays of
+    requests that had actually committed before the crash (the answer
+    raced the buffered delete) are absorbed by the uniqueness
+    provider's idempotent same-tx re-commit, so the replay can only
+    ADD answers, never change one: in-flight-at-kill loss goes to
+    zero and the fleet checker's loss bound tightens to an equality.
+    """
+
+    _SCHEMA = """
+    CREATE TABLE IF NOT EXISTS notary_intents (
+        seq       INTEGER PRIMARY KEY AUTOINCREMENT,
+        tx_id     BLOB NOT NULL,
+        data      BLOB NOT NULL,
+        requester BLOB NOT NULL,
+        deadline  INTEGER
+    );
+    """
+
+    def __init__(self, db: NodeDatabase):
+        self._db = db
+        db.execute_script(self._SCHEMA)
+        self._lock = threading.Lock()
+        self._resolved_buf: list[int] = []
+        self.appended = 0
+        self.resolved = 0
+        self.replayed = 0
+        # intents whose payload no longer decodes (a cordapp removed
+        # between boots): kept in the table, surfaced here, never
+        # allowed to crash the boot replay
+        self.undecodable: list[int] = []
+
+    def append(self, stx, requester: Party, deadline: Optional[int]) -> int:
+        """Journal one admitted request; returns its intent seq. The
+        row is on the WAL before this returns — from here a crash
+        replays the request instead of losing it."""
+        cur = self._db.execute(
+            "INSERT INTO notary_intents (tx_id, data, requester, deadline)"
+            " VALUES (?,?,?,?)",
+            (
+                stx.id.bytes_,
+                ser.encode(stx),
+                ser.encode(requester),
+                deadline,
+            ),
+        )
+        self.appended += 1
+        return cur.lastrowid
+
+    def mark_resolved(self, seq: int) -> None:
+        """Buffer one intent's resolution (called from the answer
+        path's done-callback — cheap, lock-only). The delete lands in
+        the next `flush_resolved` group commit; a crash inside that
+        window replays an already-answered request, which the
+        uniqueness dedupe absorbs."""
+        with self._lock:
+            self._resolved_buf.append(seq)
+
+    def flush_resolved(self) -> int:
+        """Group-commit every buffered resolution in ONE transaction
+        (the per-flush-tick fsync discipline). Returns rows cleared."""
+        with self._lock:
+            buf, self._resolved_buf = self._resolved_buf, []
+        if not buf:
+            return 0
+        with self._db.transaction() as conn:
+            conn.executemany(
+                "DELETE FROM notary_intents WHERE seq=?",
+                [(s,) for s in buf],
+            )
+        self.resolved += len(buf)
+        return len(buf)
+
+    def lose_unflushed_resolutions(self) -> int:
+        """Crash simulation (testing/fleet.py kill_notary): a real
+        process death loses the in-memory resolution buffer — those
+        answered-but-undeleted intents must REPLAY on boot (and be
+        absorbed by uniqueness dedupe). Drops the buffer; returns how
+        many resolutions were lost."""
+        with self._lock:
+            n, self._resolved_buf = len(self._resolved_buf), []
+        return n
+
+    def unresolved(self) -> list:
+        """Every intent not yet resolved, oldest first, decoded:
+        [(seq, stx, requester_party, deadline)]. Buffered-but-unflushed
+        resolutions are excluded — they ARE answered, only their
+        delete is pending."""
+        with self._lock:
+            buffered = set(self._resolved_buf)
+        out = []
+        self.undecodable = []
+        for seq, data, requester, deadline in self._db.query(
+            "SELECT seq, data, requester, deadline FROM notary_intents"
+            " ORDER BY seq"
+        ):
+            if seq in buffered:
+                continue
+            try:
+                stx = ser.decode(bytes(data))
+                who = ser.decode(bytes(requester))
+            except Exception as e:   # noqa: BLE001 - surfaced, not fatal
+                # a state/contract class registered when this intent
+                # was journaled but absent now (cordapp change between
+                # boots) must not crash the boot: keep the row, tell
+                # the operator, replay the rest
+                import logging
+
+                self.undecodable.append(seq)
+                logging.getLogger("corda_tpu.notary").warning(
+                    "intent %d does not decode (%s: %s); kept in the "
+                    "WAL, skipped by replay", seq, type(e).__name__, e,
+                )
+                continue
+            out.append((seq, stx, who, deadline))
+        return out
+
+    @property
+    def unresolved_count(self) -> int:
+        with self._lock:
+            buffered = len(self._resolved_buf)
+        return (
+            self._db.query("SELECT COUNT(*) FROM notary_intents")[0][0]
+            - buffered
+        )
+
+
 class PersistentKeyManagementService(KeyManagementService):
     """PersistentKeyManagementService: fresh (anonymous) keys persist so
     confidential identities survive a node restart."""

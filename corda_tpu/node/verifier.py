@@ -24,7 +24,9 @@ are buffered and flushed on the first `verifier.ready`.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+import random
+import time
+from dataclasses import dataclass, replace
 from typing import Optional
 
 from ..core import serialization as ser
@@ -49,22 +51,33 @@ class TxVerificationRequest:
     `ltx` is the resolved transaction (contract execution input); when
     `stx` is present the worker additionally batch-verifies its attached
     signatures on the TPU — the redesign's widening of the reference
-    seam (which ships only the LedgerTransaction)."""
+    seam (which ships only the LedgerTransaction).
+
+    `attempt` is the node-side dispatch incarnation of this nonce: a
+    re-dispatch after a worker loss or timeout bumps it, the worker
+    echoes it back, and the answer path only accepts the CURRENT
+    incarnation — the at-least-once dedupe that lets the node safely
+    re-send in-flight work to a survivor."""
 
     nonce: int
     ltx: LedgerTransaction
     response_address: str
     stx: Optional[SignedTransaction] = None
+    attempt: int = 0
 
 
 @ser.serializable
 @dataclass(frozen=True)
 class TxVerificationResponse:
     """Worker's reply: error is None on success, else `Type: message`
-    (reference ships the serialized Throwable)."""
+    (reference ships the serialized Throwable). `attempt` echoes the
+    request's dispatch incarnation so a stale answer (computed by a
+    worker the nonce was already re-dispatched away from) is rejected
+    instead of racing the live one."""
 
     nonce: int
     error: Optional[str] = None
+    attempt: int = 0
 
 
 @ser.serializable
@@ -90,13 +103,113 @@ class VerificationFailedError(Exception):
     """Worker reported the transaction invalid."""
 
 
+class VerificationTimeoutError(Exception):
+    """The nonce's answer never arrived inside its deadline. Names the
+    nonce, the worker it was last bound to and the elapsed time — the
+    typed replacement for the old silent fall-through to a bare
+    incomplete-future error."""
+
+    def __init__(self, nonce: int, worker: Optional[str], elapsed_micros: int):
+        self.nonce = nonce
+        self.worker = worker
+        self.elapsed_micros = elapsed_micros
+        super().__init__(
+            f"verification of nonce {nonce} timed out after "
+            f"{elapsed_micros / 1e6:.3f}s (last bound to worker "
+            f"{worker or '<none attached>'})"
+        )
+
+
+class WorkerLostError(Exception):
+    """Every dispatch attempt for this nonce died with its worker: the
+    pool lost the workers faster than redispatch could recover."""
+
+    def __init__(self, nonce: int, workers: list, attempts: int):
+        self.nonce = nonce
+        self.workers = list(workers)
+        self.attempts = attempts
+        super().__init__(
+            f"nonce {nonce} lost {attempts} dispatch attempt(s) to dead "
+            f"workers {self.workers}"
+        )
+
+
+@dataclass(frozen=True)
+class RedispatchPolicy:
+    """Self-healing knobs for the out-of-process pool.
+
+    `lease_micros` — a worker that has not re-announced `WorkerReady`
+    within this window is considered dead and detached (its in-flight
+    nonces re-dispatch to survivors). `attempt_timeout_micros` — one
+    dispatch's answer deadline: past it the nonce re-dispatches (the
+    bound worker may be alive but its answer lost, or it restarted
+    within its lease), bumping the attempt so the late original answer
+    is rejected. `request_timeout_micros` — the OVERALL per-nonce
+    deadline; past it the future fails with a typed error instead of
+    hanging. Redispatch after a worker LOSS waits a capped exponential
+    backoff with +/- `backoff_jitter` (seeded, deterministic) so a
+    flapping pool is not hammered in lockstep. `hedge_quantile` > 0
+    additionally duplicates straggler nonces (older than that quantile
+    of the observed duration histogram, floored at
+    `hedge_min_micros`) onto a second worker — first valid answer
+    wins."""
+
+    lease_micros: int = 10_000_000
+    attempt_timeout_micros: int = 5_000_000
+    request_timeout_micros: int = 30_000_000
+    backoff_base_micros: int = 100_000
+    backoff_cap_micros: int = 2_000_000
+    backoff_jitter: float = 0.25
+    max_attempts: int = 4
+    hedge_quantile: float = 0.0
+    hedge_min_micros: int = 50_000
+
+
+class _PendingVerify:
+    """One in-flight nonce: its future, the full request (kept so a
+    worker loss can re-dispatch it), the worker+attempt binding the
+    answer path authenticates against, and the retry/hedge state the
+    tick loop walks."""
+
+    __slots__ = (
+        "req", "fut", "t0", "enqueued_micros", "dispatched_micros",
+        "worker", "attempt", "dispatches", "retry_at_micros",
+        "hedged_to", "lost_workers",
+    )
+
+    def __init__(self, req, fut, t0: float, now_micros: int):
+        self.req = req
+        self.fut = fut
+        self.t0 = t0
+        self.enqueued_micros = now_micros
+        self.dispatched_micros: Optional[int] = None
+        self.worker: Optional[str] = None
+        self.attempt = 0
+        self.dispatches = 0
+        self.retry_at_micros: Optional[int] = None
+        self.hedged_to: Optional[str] = None
+        self.lost_workers: list[str] = []
+
+
 class OutOfProcessTransactionVerifierService(TransactionVerifierService):
-    """Nonce→future handle map over the message fabric.
+    """Nonce→future handle map over the message fabric, self-healing.
 
     Reference: OutOfProcessTransactionVerifierService.kt:19-73 — same
     dropwizard metric set: duration timer, success/failure meters,
     in-flight gauge (:34-46). Futures complete on the node's message
     pump thread when the matching response arrives.
+
+    Where the reference leans on the Artemis broker to rebalance
+    consumers when a worker dies, this point-to-point port heals
+    itself: workers hold LEASES renewed by periodic `WorkerReady`
+    heartbeats (the worker's pump loop re-sends them); `tick()` —
+    driven by the node pump — detaches lease-expired workers and
+    re-dispatches their in-flight nonces to survivors with capped
+    exponential backoff + jitter, answers are deduped by
+    nonce→attempt binding (a stale incarnation's answer is rejected),
+    stragglers can be hedged onto a second worker, and a nonce that
+    exhausts its deadline fails with a typed
+    VerificationTimeoutError/WorkerLostError instead of stranding.
     """
 
     def __init__(
@@ -105,6 +218,10 @@ class OutOfProcessTransactionVerifierService(TransactionVerifierService):
         metrics: Optional[MetricRegistry] = None,
         register_peer=None,   # Callable[[str, host, port], None] for TCP fabrics
         allowed_workers: Optional[set[str]] = None,
+        clock=None,           # node clock for lease/timeout judgement;
+        #                       None = wall micros (production). Rigs on
+        #                       a TestClock MUST pass it.
+        policy: Optional[RedispatchPolicy] = None,
     ):
         self._messaging = messaging
         self._register_peer = register_peer
@@ -113,11 +230,17 @@ class OutOfProcessTransactionVerifierService(TransactionVerifierService):
         # peer names may join the pool; None admits any authenticated
         # peer (dev mode).
         self._allowed_workers = allowed_workers
-        self._pending: dict[int, list] = {}   # nonce -> [fut, t0, worker]
-        self._workers: list[str] = []
+        self._clock = clock
+        self.policy = policy or RedispatchPolicy()
+        self._rng = random.Random(0xFA17)   # jitter: seeded, deterministic
+        self._pending: dict[int, _PendingVerify] = {}
+        self._workers: list[str] = []              # attach order (RR)
+        self._leases: dict[str, int] = {}          # worker -> last-ready us
+        self._incarnations: dict[str, int] = {}    # worker -> attach count
         self._rr = 0
-        self._buffer: list[TxVerificationRequest] = []
+        self._buffer: list[_PendingVerify] = []    # store-and-forward
         self._nonce = 0
+        self._last_lost_micros: Optional[int] = None
         self.metrics = metrics or MetricRegistry()
         self._duration = self.metrics.timer(
             "TransactionVerifierService.Verification.Duration"
@@ -128,12 +251,26 @@ class OutOfProcessTransactionVerifierService(TransactionVerifierService):
         self._failure = self.metrics.meter(
             "TransactionVerifierService.Verification.Failure"
         )
+        self._redispatched = self.metrics.meter("Verifier.Redispatched")
+        self._hedged_meter = self.metrics.meter("Verifier.Hedged")
+        self._workers_lost = self.metrics.meter("Verifier.WorkersLost")
         self.metrics.gauge(
             "TransactionVerifierService.VerificationsInFlight",
             lambda: len(self._pending),
         )
+        # the previously-invisible pool state, as gauges next to the
+        # duration histogram: live /metrics answers "is the pool
+        # draining, buffering, or starved?" without a debugger
+        self.metrics.gauge("Verifier.InFlight", lambda: len(self._pending))
+        self.metrics.gauge("Verifier.Buffered", lambda: len(self._buffer))
+        self.metrics.gauge("Verifier.Workers", lambda: len(self._workers))
         messaging.add_handler(msglib.TOPIC_VERIFIER_RES, self._on_response)
         messaging.add_handler(TOPIC_READY, self._on_ready)
+
+    def _now_micros(self) -> int:
+        if self._clock is not None:
+            return self._clock.now_micros()
+        return time.time_ns() // 1_000
 
     # -- SPI ---------------------------------------------------------------
 
@@ -143,16 +280,18 @@ class OutOfProcessTransactionVerifierService(TransactionVerifierService):
         """Ship `ltx` (and optionally the signature batch) to a worker.
         The returned future completes when the response message is
         pumped; callers in flows should re-check it per pump cycle."""
-        import time
-
         self._nonce += 1
         nonce = self._nonce
         fut = _Future()
-        self._pending[nonce] = [fut, time.perf_counter(), None]
+        fut.nonce = nonce   # wait() names the nonce in its typed timeout
         req = TxVerificationRequest(
             nonce, ltx, self._messaging.my_address, stx
         )
-        self._dispatch(req)
+        entry = _PendingVerify(
+            req, fut, time.perf_counter(), self._now_micros()
+        )
+        self._pending[nonce] = entry
+        self._dispatch(entry)
         return fut
 
     def wait(self, fut: _Future, timeout: float = 30.0) -> None:
@@ -168,20 +307,31 @@ class OutOfProcessTransactionVerifierService(TransactionVerifierService):
 
         Pump-less fabrics (the response handler fires on another
         thread) park on the future's condition variable with the
-        remaining deadline — woken the instant the completion lands,
-        instead of the old 10 ms poll-sleep spin."""
-        import time
-
+        remaining deadline — woken the instant the completion lands.
+        On deadline the wait raises a typed VerificationTimeoutError
+        naming the nonce, its bound worker and the elapsed time —
+        never `fut.result()` on an incomplete future, whose bare
+        "still pending" error says nothing about WHAT timed out."""
         pump = getattr(self._messaging, "pump", None)
-        deadline = time.monotonic() + timeout
+        t_start = time.monotonic()
+        deadline = t_start + timeout
         while not fut.done:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 break
             if pump is not None:
                 pump(block=True, timeout=min(0.1, remaining))
+                self.tick()
             else:
                 fut.wait(remaining)
+        if not fut.done:
+            nonce = getattr(fut, "nonce", -1)
+            entry = self._pending.get(nonce)
+            raise VerificationTimeoutError(
+                nonce,
+                entry.worker if entry is not None else None,
+                int((time.monotonic() - t_start) * 1e6),
+            )
         fut.result()
 
     @property
@@ -192,19 +342,201 @@ class OutOfProcessTransactionVerifierService(TransactionVerifierService):
     def in_flight(self) -> int:
         return len(self._pending)
 
+    @property
+    def buffered(self) -> int:
+        return len(self._buffer)
+
+    def incarnation_of(self, worker: str) -> int:
+        """How many times `worker` has attached (0 = never seen)."""
+        return self._incarnations.get(worker, 0)
+
+    # -- self-healing ------------------------------------------------------
+
+    def tick(self, now: Optional[int] = None) -> None:
+        """One self-healing pass, driven by the node pump (or a test
+        clock): expire worker leases (detaching the dead and
+        re-dispatching their in-flight nonces), time out / retry
+        pending nonces, and hedge stragglers."""
+        if now is None:
+            now = self._now_micros()
+        pol = self.policy
+        # 1 — lease expiry: a worker silent past its lease is dead
+        for worker in [
+            w for w in self._workers
+            if now - self._leases.get(w, now) > pol.lease_micros
+        ]:
+            self._detach_worker(worker, now)
+        # 2 — per-nonce deadlines, retries, hedging
+        hedge_after = self._hedge_after_micros()
+        for nonce, entry in list(self._pending.items()):
+            elapsed = now - entry.enqueued_micros
+            if elapsed > pol.request_timeout_micros:
+                self._fail_entry(nonce, entry, elapsed)
+                continue
+            if entry.worker is None:
+                # unbound (its worker died, or it never had one): retry
+                # once the backoff window passes and a worker exists
+                if (
+                    self._workers
+                    and (
+                        entry.retry_at_micros is None
+                        or now >= entry.retry_at_micros
+                    )
+                ):
+                    if entry.dispatches >= pol.max_attempts:
+                        self._fail_entry(nonce, entry, elapsed)
+                    else:
+                        self._redispatched.mark()
+                        self._dispatch(entry, exclude=entry.lost_workers)
+                continue
+            if (
+                pol.attempt_timeout_micros
+                and entry.dispatched_micros is not None
+                and now - entry.dispatched_micros
+                > pol.attempt_timeout_micros
+            ):
+                # the bound worker is (or looks) alive but this
+                # attempt's answer never came — lost frame, or a
+                # same-name restart inside the lease. Re-dispatch NOW
+                # (prefer a different worker); the attempt bump
+                # rejects the original answer if it limps in later.
+                if entry.dispatches >= pol.max_attempts:
+                    self._fail_entry(nonce, entry, elapsed)
+                else:
+                    self._redispatched.mark()
+                    self._dispatch(
+                        entry,
+                        exclude=entry.lost_workers + [entry.worker],
+                    )
+                continue
+            if (
+                hedge_after is not None
+                and entry.hedged_to is None
+                and len(self._workers) > 1
+                and entry.dispatched_micros is not None
+                and now - entry.dispatched_micros >= hedge_after
+            ):
+                self._hedge(entry)
+
+    def _hedge_after_micros(self) -> Optional[int]:
+        pol = self.policy
+        if pol.hedge_quantile <= 0:
+            return None
+        q = 0.0
+        hist = getattr(self._duration, "histogram", None)
+        if hist is not None and hist.count:
+            q = float(hist.quantile(pol.hedge_quantile)) * 1e6
+        return max(int(q), pol.hedge_min_micros)
+
+    def _hedge(self, entry: _PendingVerify) -> None:
+        """Duplicate a straggler onto a different worker, SAME attempt:
+        either copy's answer is valid, the first one wins, the other is
+        deduped by the nonce having left the pending map."""
+        others = [w for w in self._workers if w != entry.worker]
+        if not others:
+            return
+        worker = others[self._rr % len(others)]
+        self._rr += 1
+        entry.hedged_to = worker
+        self._hedged_meter.mark()
+        self._messaging.send(
+            msglib.TOPIC_VERIFIER_REQ, ser.encode(entry.req), worker
+        )
+
+    def _detach_worker(self, worker: str, now: int) -> None:
+        self._workers.remove(worker)
+        self._leases.pop(worker, None)
+        self._workers_lost.mark()
+        self._last_lost_micros = now
+        pol = self.policy
+        for entry in self._pending.values():
+            touched = entry.worker == worker
+            if entry.hedged_to == worker:
+                entry.hedged_to = None
+            if not touched:
+                continue
+            entry.worker = None
+            entry.lost_workers.append(worker)
+            retries = len(entry.lost_workers)
+            backoff = min(
+                pol.backoff_cap_micros,
+                pol.backoff_base_micros * (1 << (retries - 1)),
+            )
+            jitter = 1.0 + pol.backoff_jitter * (2 * self._rng.random() - 1)
+            entry.retry_at_micros = now + int(backoff * jitter)
+
+    def _fail_entry(self, nonce: int, entry: _PendingVerify, elapsed: int) -> None:
+        del self._pending[nonce]
+        if entry in self._buffer:
+            self._buffer.remove(entry)
+        self._failure.mark()
+        if entry.lost_workers and entry.worker is None:
+            entry.fut.set_exception(
+                WorkerLostError(nonce, entry.lost_workers, entry.dispatches)
+            )
+        else:
+            entry.fut.set_exception(
+                VerificationTimeoutError(nonce, entry.worker, elapsed)
+            )
+
+    def watch_health(self, monitor) -> None:
+        """Register the `verifier.pool_degraded` rule on a
+        HealthMonitor (utils/health.py): fires while work is waiting
+        with NO live worker, or within one lease window of a worker
+        loss — the pool is healing (or starved) and an operator should
+        know before client timeouts say so."""
+        from ..utils.health import AlertRule
+
+        def check(now: int):
+            starved = (
+                not self._workers
+                and (self._pending or self._buffer)
+            )
+            healing = (
+                self._last_lost_micros is not None
+                and now - self._last_lost_micros <= self.policy.lease_micros
+            )
+            return bool(starved or healing), {
+                "workers": len(self._workers),
+                "in_flight": len(self._pending),
+                "buffered": len(self._buffer),
+                "workers_lost": self._workers_lost.count,
+                "redispatched": self._redispatched.count,
+            }
+
+        monitor.add_rule(
+            AlertRule(
+                "verifier.pool_degraded", check,
+                for_micros=0, clear_for_micros=0,
+            )
+        )
+
     # -- internals ---------------------------------------------------------
 
-    def _dispatch(self, req: TxVerificationRequest) -> None:
+    def _dispatch(
+        self, entry: _PendingVerify, exclude: Optional[list] = None
+    ) -> None:
         if not self._workers:
-            self._buffer.append(req)   # store-and-forward until attach
+            if entry not in self._buffer:
+                self._buffer.append(entry)   # store-and-forward
             return
-        worker = self._workers[self._rr % len(self._workers)]
+        candidates = (
+            [w for w in self._workers if w not in exclude] if exclude else []
+        ) or self._workers
+        worker = candidates[self._rr % len(candidates)]
         self._rr += 1
-        entry = self._pending.get(req.nonce)
-        if entry is not None:
-            entry[2] = worker   # bind nonce to its worker for auth below
+        if entry.dispatches:
+            # a RE-dispatch is a new incarnation of the nonce: bump the
+            # attempt so the previous worker's late answer is rejected
+            entry.attempt += 1
+            entry.req = replace(entry.req, attempt=entry.attempt)
+        entry.worker = worker
+        entry.hedged_to = None
+        entry.dispatches += 1
+        entry.dispatched_micros = self._now_micros()
+        entry.retry_at_micros = None
         self._messaging.send(
-            msglib.TOPIC_VERIFIER_REQ, ser.encode(req), worker
+            msglib.TOPIC_VERIFIER_REQ, ser.encode(entry.req), worker
         )
 
     def _on_ready(self, msg: msglib.Message) -> None:
@@ -220,32 +552,49 @@ class OutOfProcessTransactionVerifierService(TransactionVerifierService):
             and ready.worker not in self._allowed_workers
         ):
             return
+        now = self._now_micros()
+        self._leases[ready.worker] = now   # heartbeat = lease renewal
         if ready.host and self._register_peer is not None:
+            # EVERY announcement refreshes the dial-back address, not
+            # just the first: a worker that restarts on a new port
+            # within its lease would otherwise keep renewing the lease
+            # while dispatches bridge to its dead old address
             self._register_peer(ready.worker, ready.host, ready.port)
-        if ready.worker not in self._workers:
-            self._workers.append(ready.worker)
+        if ready.worker in self._workers:
+            return
+        self._workers.append(ready.worker)
+        self._incarnations[ready.worker] = (
+            self._incarnations.get(ready.worker, 0) + 1
+        )
+        # fresh capacity: flush the store-and-forward buffer, then give
+        # any orphaned in-flight nonce (its worker died while the pool
+        # was empty) a home without waiting for the next tick
         buffered, self._buffer = self._buffer, []
-        for req in buffered:
-            self._dispatch(req)
+        for entry in buffered:
+            self._dispatch(entry)
+        for entry in self._pending.values():
+            if entry.worker is None and entry not in self._buffer:
+                if entry.dispatches:
+                    self._redispatched.mark()
+                self._dispatch(entry, exclude=entry.lost_workers)
 
     def _on_response(self, msg: msglib.Message) -> None:
-        import time
-
         res: TxVerificationResponse = ser.decode(msg.payload)
         entry = self._pending.get(res.nonce)
         if entry is None:
-            return   # duplicate / unknown (at-least-once upstream)
-        fut, t0, worker = entry
-        if worker is None or msg.sender != worker:
-            return   # only the worker this nonce was dispatched to may answer
+            return   # duplicate / already answered (at-least-once upstream)
+        if getattr(res, "attempt", 0) != entry.attempt:
+            return   # stale incarnation: the nonce was re-dispatched since
+        if msg.sender not in (entry.worker, entry.hedged_to):
+            return   # only the bound (or hedge) worker may answer
         del self._pending[res.nonce]
-        self._duration.update(time.perf_counter() - t0)
+        self._duration.update(time.perf_counter() - entry.t0)
         if res.error is None:
             self._success.mark()
-            fut.set_result()
+            entry.fut.set_result()
         else:
             self._failure.mark()
-            fut.set_exception(VerificationFailedError(res.error))
+            entry.fut.set_exception(VerificationFailedError(res.error))
 
 
 # ---------------------------------------------------------------------------
@@ -295,6 +644,9 @@ class VerifierWorker:
         advertised_address: Optional[tuple[str, int]] = None,
         ingest=None,               # Optional[corda_tpu.node.ingest.IngestPipeline]
         ingest_window: int = 8192,
+        heartbeat_micros: int = 2_000_000,   # WorkerReady re-announce
+        #                            cadence (lease renewal on the node
+        #                            side); 0 disables heartbeats
         clock=None,                # node-clock source for deadline expiry;
         #                            None = wall clock (production workers —
         #                            deadlines are minted on wall-clock
@@ -378,13 +730,45 @@ class VerifierWorker:
                 )
         messaging.add_handler(msglib.TOPIC_VERIFIER_REQ, self._on_request)
         # announce attachment so buffered requests flush to us; over TCP
-        # the advertised address lets the node bridge back
-        host, port = advertised_address or ("", 0)
-        messaging.send(
+        # the advertised address lets the node bridge back. The SAME
+        # announcement doubles as the lease heartbeat: the pump loop
+        # re-sends it every `heartbeat_micros` (maybe_heartbeat), and a
+        # node that stops hearing it detaches us and re-dispatches our
+        # in-flight work to a survivor.
+        self._node_address = node_address
+        self._advertised = advertised_address or ("", 0)
+        self._heartbeat_micros = heartbeat_micros
+        self._last_ready_micros = self._now_micros()
+        self._send_ready()
+
+    def _now_micros(self) -> int:
+        if self._clock is not None:
+            return self._clock.now_micros()
+        import time
+
+        return time.time_ns() // 1_000
+
+    def _send_ready(self) -> None:
+        host, port = self._advertised
+        self._messaging.send(
             TOPIC_READY,
-            ser.encode(WorkerReady(messaging.my_address, host, port)),
-            node_address,
+            ser.encode(WorkerReady(self._messaging.my_address, host, port)),
+            self._node_address,
         )
+
+    def maybe_heartbeat(self, now: Optional[int] = None) -> bool:
+        """Re-announce WorkerReady when the heartbeat cadence is due
+        (lease renewal). Called from the drain/pump loop; returns True
+        when a heartbeat was sent."""
+        if not self._heartbeat_micros:
+            return False
+        if now is None:
+            now = self._now_micros()
+        if now - self._last_ready_micros < self._heartbeat_micros:
+            return False
+        self._last_ready_micros = now
+        self._send_ready()
+        return True
 
     def _on_request(self, msg: msglib.Message) -> None:
         if self._ingest is not None:
@@ -447,6 +831,7 @@ class VerifierWorker:
     def drain(self) -> int:
         """Process every queued request; one signature-batch dispatch
         covers all of them. Returns how many were processed."""
+        self.maybe_heartbeat()
         if self._ingest is not None:
             self._pull_ingested()
         pending, self._queue = self._queue, []
@@ -518,7 +903,11 @@ class VerifierWorker:
                 self._failed.mark()
             self._messaging.send(
                 msglib.TOPIC_VERIFIER_RES,
-                ser.encode(TxVerificationResponse(req.nonce, error)),
+                ser.encode(
+                    TxVerificationResponse(
+                        req.nonce, error, getattr(req, "attempt", 0)
+                    )
+                ),
                 req.response_address,
             )
         if self._heartbeat is not None:

@@ -268,6 +268,64 @@ def slow_peer(
     )
 
 
+def kill_verifier(worker: int, at: float, revive_at: Optional[float] = None) -> ChaosEvent:
+    """Kill out-of-process verifier worker `worker` (by pool index) at
+    `at` of the stream, mid-batch — its in-flight nonces must
+    re-dispatch to a survivor via the lease/redispatch machinery
+    (node/verifier.py round 9) and every verify future still resolve.
+    `revive_at` optionally brings the worker back (re-attaching under
+    the same name; stale answers from before the kill are rejected by
+    the attempt binding). Requires FleetSim(verifier_pool=N>=2)."""
+
+    return ChaosEvent(
+        f"kill-verifier[{worker}]", "kill_verifier", at,
+        lambda sim: sim.kill_verifier_worker(worker),
+        revive_at,
+        (lambda sim: sim.revive_verifier_worker(worker))
+        if revive_at is not None else None,
+        member=0,
+    )
+
+
+def device_fault(
+    at: float, heal_at: Optional[float] = None, flushes: int = 2
+) -> ChaosEvent:
+    """Inject a device/XLA failure into the notary's verify dispatch
+    for the next `flushes` dispatches (the DispatchFaultInjector seam,
+    crypto/batch_verifier.py) — the degraded-mode guard must retry,
+    fall back to the CPU reference bit-exact, fire
+    `notary.degraded_mode`, and auto-recover once the injector drains.
+    `heal_at` bounds the logged fault window (disarming any leftover
+    failures) so the checker can reconcile the alert story against it.
+    Batching flavour only."""
+
+    return ChaosEvent(
+        f"device-fault[x{flushes}]", "device_fault", at,
+        lambda sim: sim.inject_device_fault(flushes),
+        heal_at,
+        (lambda sim: sim.device_injector.disarm())
+        if heal_at is not None else None,
+        member=0,
+    )
+
+
+def kill_notary_mid_flush(at: float, restart_at: float) -> ChaosEvent:
+    """SIGKILL the (single-node batching) notary with a non-empty
+    pending queue at `at`; boot a replacement over the same persistent
+    state at `restart_at`. In-flight requests die with the process —
+    the intent WAL (FleetSim(intent_wal=True)) replays them through
+    the replacement's normal flush path, and the re-attached futures
+    resolve every still-waiting client: zero admitted-then-lost."""
+
+    return ChaosEvent(
+        f"kill-notary", "kill_notary", at,
+        lambda sim: sim.kill_notary(),
+        restart_at,
+        lambda sim: sim.restart_notary(),
+        member=0,
+    )
+
+
 class ChaosPlane:
     """Applies scheduled faults as the stream crosses their fractions
     and records each one's simulated-time window — the injected-reality
@@ -519,6 +577,17 @@ class FleetReport:
     bulk_shed_brownout: int = 0
     bulk_served: int = 0
     distinct_clients: int = 0
+    # round-9 fault plane: intent-WAL + verifier-pool reconciliation
+    intent_wal: bool = False
+    intent_unresolved: int = 0
+    intent_replayed: int = 0
+    verify_offered: int = 0
+    verify_resolved: int = 0
+    verify_failed: int = 0
+    verify_redispatched: int = 0
+    verify_workers_lost: int = 0
+    device_faults: int = 0
+    degraded_flushes: int = 0
 
     @property
     def sim_seconds(self) -> float:
@@ -547,9 +616,25 @@ class FleetSim:
         qos_policy: Optional[qoslib.QosPolicy] = None,
         heartbeat_deadline_rounds: int = 3,
         lag_alert_threshold: int = 8,
+        verifier_pool: int = 0,
+        intent_wal: bool = False,
     ):
+        """`verifier_pool` (batching only): attach N out-of-process
+        VerifierWorkers on the fabric and an
+        OutOfProcessTransactionVerifierService on the notary — one
+        spend per round additionally round-trips the pool, so
+        kill_verifier() chaos drives the lease/redispatch machinery at
+        fleet shape. `intent_wal` (batching only): a NotaryIntentJournal
+        under the notary's intake, which is what lets
+        kill_notary_mid_flush() complete with ZERO lost admitted
+        requests and tightens the checker's loss bound to an equality
+        (check_exact_accounting)."""
         if flavour not in FLAVOURS:
             raise ValueError(f"unknown fleet flavour {flavour!r}")
+        if (verifier_pool or intent_wal) and flavour != "batching":
+            raise ValueError(
+                "verifier_pool / intent_wal are batching-flavour seams"
+            )
         self.scenario = scenario
         self.flavour = flavour
         self.chaos = ChaosPlane(chaos)
@@ -678,6 +763,80 @@ class FleetSim:
             cache_ttl_micros=0,      # every sample is a fresh pull
         )
 
+        # -- round-9 fault plane (batching seams) ---------------------------
+        self._fault_arc = bool(verifier_pool or intent_wal) or any(
+            e.kind in ("kill_verifier", "device_fault", "kill_notary")
+            for e in self.chaos.events
+        )
+        self.device_injector = None
+        self.intent_journal = None
+        self.verify_pool = None
+        self._verify_workers: list = []
+        self._verify_worker_alive: list[bool] = []
+        self.verify_futures: list = []
+        self._notary_down = False
+        self._degraded_flushes_base = 0   # carried across notary restarts
+        if flavour == "batching" and self._fault_arc:
+            notary = self.members[0]
+            svc = notary.services.notary_service
+            # device-fault seam: the injector IS the installed hub
+            # verifier — disarmed it is a passthrough, armed it raises
+            # exactly where a real XLA failure would
+            from ..crypto.batch_verifier import DispatchFaultInjector
+
+            self.device_injector = DispatchFaultInjector(
+                notary.services.batch_verifier
+            )
+            notary.services._batch_verifier = self.device_injector
+            if intent_wal:
+                from ..node.persistence import (
+                    NodeDatabase,
+                    NotaryIntentJournal,
+                )
+
+                self.intent_journal = NotaryIntentJournal(
+                    NodeDatabase(":memory:")
+                )
+                svc.attach_intent_journal(self.intent_journal)
+            # flush heartbeat + the degraded-mode alert land on the
+            # member's monitor, so kill/device faults show in the same
+            # healthz/alert story the checker reconciles
+            svc.attach_health(self.monitors[notary.name])
+            if verifier_pool:
+                from ..crypto.batch_verifier import CpuBatchVerifier
+                from ..node.verifier import (
+                    OutOfProcessTransactionVerifierService,
+                    RedispatchPolicy,
+                    VerifierWorker,
+                )
+
+                R = scenario.round_micros
+                self.verify_pool = OutOfProcessTransactionVerifierService(
+                    notary.messaging,
+                    clock=self.net.clock,
+                    policy=RedispatchPolicy(
+                        lease_micros=3 * R,
+                        request_timeout_micros=60 * R,
+                        backoff_base_micros=max(R // 2, 1),
+                        backoff_cap_micros=4 * R,
+                        max_attempts=6,
+                    ),
+                )
+                self.verify_pool.watch_health(self.monitors[notary.name])
+                for k in range(verifier_pool):
+                    ep = self.net.fabric.endpoint(f"fleet-verifier-w{k}")
+                    self._verify_workers.append(
+                        VerifierWorker(
+                            ep,
+                            notary.name,
+                            batch_verifier=CpuBatchVerifier(),
+                            clock=self.net.clock,
+                            heartbeat_micros=R,
+                        )
+                    )
+                    self._verify_worker_alive.append(True)
+                self.net.run()   # deliver the WorkerReady attaches
+
         # -- bookkeeping ----------------------------------------------------
         self.records: list[RequestRecord] = []
         self.timeline: list[dict] = []
@@ -787,6 +946,114 @@ class FleetSim:
         # a restarted process reports live from its first pump
         self._beats[node.name].beat()
 
+    # -- round-9 fault-plane actions ------------------------------------------
+
+    def _worker_name(self, idx: int) -> str:
+        return f"fleet-verifier-w{idx}"
+
+    def kill_verifier_worker(self, idx: int) -> None:
+        """SIGKILL one pool worker mid-batch: its endpoint stops
+        pumping and the fault plane blackholes it — the node-side lease
+        expires, the worker detaches, and its in-flight nonces
+        re-dispatch to a survivor."""
+        if self.verify_pool is None:
+            raise ValueError(
+                "kill_verifier needs FleetSim(verifier_pool=N>=2)"
+            )
+        name = self._worker_name(idx)
+        self.faults.kill(name)
+        self.net.fabric.endpoint(name).running = False
+        self._verify_worker_alive[idx] = False
+
+    def revive_verifier_worker(self, idx: int) -> None:
+        """Bring a killed worker back under the SAME name: revive the
+        endpoint and re-announce WorkerReady. Answers it computed
+        before the kill were re-dispatched away in the meantime; the
+        attempt binding rejects them as a stale incarnation."""
+        name = self._worker_name(idx)
+        self.faults.revive(name)
+        self.net.fabric.endpoint(name).running = True
+        self._verify_worker_alive[idx] = True
+        self._verify_workers[idx]._send_ready()
+
+    def inject_device_fault(self, flushes: int = 2) -> None:
+        """Arm the dispatch-seam injector: the next `flushes` verify
+        dispatches raise a DeviceFaultError; after that the device
+        path serves again (which is what the notary's recovery probe
+        re-arms on)."""
+        if self.device_injector is None:
+            raise ValueError(
+                "device_fault needs the batching flavour (the injector "
+                "wraps the notary hub's batch verifier)"
+            )
+        self.device_injector.arm(flushes)
+
+    def kill_notary(self) -> None:
+        """Process death for the single-node batching notary, mid
+        serving: every queued-but-unflushed request vanishes with the
+        heap, the journal's unflushed resolution buffer is lost (those
+        intents will REPLAY and dedupe), and the pump freezes — the
+        watchdog flips healthz exactly as a real crash would."""
+        if self.flavour != "batching":
+            raise ValueError("kill_notary is the batching-flavour crash")
+        node = self.members[0]
+        svc = node.services.notary_service
+        if getattr(svc, "_shards", None) is not None:
+            for shard in svc._shards:
+                with shard.cond:
+                    shard.pending.clear()
+        else:
+            svc._pending.clear()
+        if self.intent_journal is not None:
+            self.intent_journal.lose_unflushed_resolutions()
+        self.frozen.add(node.name)
+        self._notary_down = True
+
+    def restart_notary(self) -> None:
+        """Boot a replacement notary over the same durable state (the
+        uniqueness provider and intent WAL survive the process), replay
+        unresolved intents through its normal flush path, and re-attach
+        every still-waiting client future to its replayed twin by
+        transaction id — the restarted service answers requests the
+        dead one admitted."""
+        from ..node.notary import BatchingNotaryService
+
+        node = self.members[0]
+        old = node.services.notary_service
+        self._degraded_flushes_base += old.metrics.counter(
+            "Notary.DegradedFlushes"
+        ).count
+        had_workers = bool(old._workers)
+        old.stop()   # dead worker threads must not keep flushing
+        svc = BatchingNotaryService(
+            node.services,
+            old.uniqueness,
+            max_batch=old.max_batch,
+            max_wait_micros=old.max_wait_micros,
+            qos=self.qos,
+            # the replacement boots with the SAME plane shape the dead
+            # process ran — a sharded scenario must stay sharded or the
+            # post-restart half of the soak tests a different notary
+            shards=old.n_shards,
+            shard_workers=had_workers,
+            degraded_fallback=old.degraded_fallback,
+            intent_journal=self.intent_journal,
+        )
+        node.services.notary_service = svc
+        self._drive_tick = svc.tick
+        svc.attach_health(self.monitors[node.name])
+        replayed = svc.replay_intents()
+        by_tx = {tx_id: fut for _seq, tx_id, fut in replayed}
+        for entry in self._live:
+            gen, _wait, rec = entry
+            if gen is None and rec.outcome is None:
+                fut = by_tx.get(rec.tx_id)
+                if fut is not None:
+                    entry[1] = fut
+        self.frozen.discard(node.name)
+        self._notary_down = False
+        self._beats[node.name].beat()
+
     # -- submission ----------------------------------------------------------
 
     def _gateway(self, k: int):
@@ -835,7 +1102,7 @@ class FleetSim:
         n_bulk = int(phase.offered_per_round * mix.bulk_fraction)
         n_interactive = phase.offered_per_round - n_bulk
         now = self.now()
-        for _ in range(n_interactive):
+        for i in range(n_interactive):
             client = self.clients[self._client_cursor % len(self.clients)]
             self._client_cursor += 1
             jitter = (
@@ -844,6 +1111,18 @@ class FleetSim:
             )
             deadline = now + mix.deadline_micros + jitter
             payload = self.source.spend(client)
+            if self.verify_pool is not None and i == 0:
+                # one spend per round additionally round-trips the
+                # out-of-process pool (the verification sidecar the
+                # kill_verifier chaos acts on): EVERY one of these
+                # futures must resolve, worker churn or not
+                stx = payload[0]
+                ltx = self.source.owner.services.resolve_transaction(
+                    stx.wtx
+                )
+                self.verify_futures.append(
+                    self.verify_pool.verify(ltx, stx)
+                )
             rec = self._submit(client, "interactive", phase.name, deadline, payload)
             # deterministic injection: every floor(1/fraction)-th spend
             # gets a rival, so the double-spend count never flakes
@@ -990,6 +1269,16 @@ class FleetSim:
             # deadline passes while wedged sheds at the thaw
             self._drive_tick()
         self.net.run()
+        if self.verify_pool is not None:
+            # worker pump round: drain (which heartbeats), deliver the
+            # answers, then walk the pool's lease/redispatch state
+            for alive, w in zip(
+                self._verify_worker_alive, self._verify_workers
+            ):
+                if alive:
+                    w.drain()
+            self.net.run()
+            self.verify_pool.tick()
         self._step_generators()
         if self.qos is not None:
             # the lane consumer: drain what a real ring consumer would
@@ -1030,6 +1319,21 @@ class FleetSim:
             shed_brownout = self.qos.snapshot()["shed"].get(
                 qoslib.SHED_BROWNOUT_BULK, 0
             )
+        verify_resolved = verify_failed = 0
+        for fut in self.verify_futures:
+            if fut.done:
+                try:
+                    fut.result()
+                    verify_resolved += 1
+                except Exception:   # noqa: BLE001 - reconciled below
+                    verify_failed += 1
+        intent_unresolved = intent_replayed = 0
+        if self.intent_journal is not None:
+            self.intent_journal.flush_resolved()
+            intent_unresolved = self.intent_journal.unresolved_count
+            intent_replayed = self.intent_journal.replayed
+        pool = self.verify_pool
+        svc = self.members[0].services.notary_service
         return FleetReport(
             flavour=self.flavour,
             scenario=s,
@@ -1047,6 +1351,29 @@ class FleetSim:
             bulk_served=self.bulk_served,
             distinct_clients=len(
                 {r.client for r in self.records}
+            ),
+            intent_wal=self.intent_journal is not None,
+            intent_unresolved=intent_unresolved,
+            intent_replayed=intent_replayed,
+            verify_offered=len(self.verify_futures),
+            verify_resolved=verify_resolved,
+            verify_failed=verify_failed,
+            verify_redispatched=(
+                pool.metrics.meter("Verifier.Redispatched").count
+                if pool is not None else 0
+            ),
+            verify_workers_lost=(
+                pool.metrics.meter("Verifier.WorkersLost").count
+                if pool is not None else 0
+            ),
+            device_faults=(
+                self.device_injector.faults_raised
+                if self.device_injector is not None else 0
+            ),
+            degraded_flushes=(
+                self._degraded_flushes_base
+                + svc.metrics.counter("Notary.DegradedFlushes").count
+                if self.flavour == "batching" else 0
             ),
         )
 
@@ -1247,6 +1574,12 @@ class InvariantChecker:
     def _window(self, entry: dict) -> tuple[int, Optional[int]]:
         return entry["applied_at_micros"], entry["reverted_at_micros"]
 
+    def _alert_of(self, member: str, name: str) -> Optional[dict]:
+        mon = self.report.monitors.get(member)
+        if mon is None:
+            return None
+        return mon.snapshot().get("alerts", {}).get(name)
+
     def _samples_between(self, start, end):
         return [
             t for t in self.report.timeline
@@ -1327,6 +1660,45 @@ class InvariantChecker:
                     f"{entry['name']}: the lag alert never fired for "
                     f"{victim}"
                 )
+            elif entry["kind"] == "kill_notary":
+                # a dead pump is a stalled flush heartbeat: the
+                # watchdog must flip healthz while the notary is down
+                assert any(
+                    not t["healthz"].get(victim, True) for t in during
+                ), (
+                    f"{entry['name']}: healthz never flipped while the "
+                    f"notary was dead"
+                )
+            elif entry["kind"] == "device_fault":
+                # the monitor's fire_count is authoritative unless a
+                # notary restart re-registered the rule (wiping its
+                # state); the timeline's per-round alert samples carry
+                # the firing either way
+                alert = self._alert_of(victim, "notary.degraded_mode")
+                fired = (
+                    alert is not None and alert["fire_count"] >= 1
+                ) or any(
+                    (t["alerts_firing"].get(victim) or 0) > 0
+                    for t in during
+                )
+                assert fired, (
+                    f"{entry['name']}: notary.degraded_mode never fired"
+                )
+                assert alert is None or alert["state"] != "firing", (
+                    f"{entry['name']}: degraded mode never auto-"
+                    f"resolved (the recovery probe is not re-arming "
+                    f"the device path)"
+                )
+            elif entry["kind"] == "kill_verifier":
+                alert = self._alert_of(victim, "verifier.pool_degraded")
+                assert alert is not None and alert["fire_count"] >= 1, (
+                    f"{entry['name']}: verifier.pool_degraded never "
+                    f"fired on the worker loss"
+                )
+                assert alert["state"] != "firing", (
+                    f"{entry['name']}: the pool never recovered "
+                    f"(pool_degraded still firing at the end)"
+                )
             # recovery: the LAST sample shows a clean fleet
             if victim is not None:
                 assert final["healthz"].get(victim, False), (
@@ -1339,15 +1711,74 @@ class InvariantChecker:
                 )
 
     def check_lost_bounded(self, max_fraction: float = 0.05) -> None:
-        """Requests in flight at a kill may lose their reply; the
-        fraction must stay small and the ledger invariants above
-        already bound their effect."""
+        """WITHOUT the intent WAL, requests in flight at a kill may
+        lose their reply; the fraction must stay small and the ledger
+        invariants above already bound their effect. (With the WAL,
+        check_exact_accounting replaces this allowance with an
+        equality — check_all picks automatically.)"""
         lost = sum(1 for r in self.report.records if r.outcome == OUT_LOST)
         frac = lost / max(1, len(self.report.records))
         assert frac <= max_fraction, (
             f"{lost}/{len(self.report.records)} requests lost "
             f"({frac:.1%} > {max_fraction:.1%})"
         )
+
+    def check_exact_accounting(self) -> None:
+        """The intent-WAL-era loss bound, tightened to an EQUALITY:
+        every admitted request is committed, rejected or shed — never
+        silently dropped, kill-restarts included — and the WAL itself
+        drained (no intent is still pending recovery). The in-flight-
+        at-kill allowance check_lost_bounded tolerated is gone."""
+        assert self.report.intent_wal, (
+            "exact accounting needs the intent WAL "
+            "(FleetSim(intent_wal=True)); without it use "
+            "check_lost_bounded"
+        )
+        lost = [
+            r for r in self.report.records
+            if r.outcome in (None, OUT_LOST)
+        ]
+        assert not lost, (
+            f"{len(lost)} admitted request(s) silently dropped despite "
+            f"the intent WAL (first: rid={lost[0].rid} "
+            f"tx={lost[0].tx_id} phase={lost[0].phase})"
+        )
+        assert self.report.intent_unresolved == 0, (
+            f"{self.report.intent_unresolved} intent(s) still "
+            f"unresolved in the WAL after the drain"
+        )
+
+    def check_verifier_pool(self) -> None:
+        """Every verify shipped to the out-of-process pool resolved —
+        worker kills included: the lease/redispatch machinery moved
+        in-flight nonces to a survivor instead of stranding them."""
+        rep = self.report
+        assert rep.verify_offered > 0, (
+            "verifier-pool check needs FleetSim(verifier_pool=N) traffic"
+        )
+        unresolved = rep.verify_offered - rep.verify_resolved - (
+            rep.verify_failed
+        )
+        assert unresolved == 0, (
+            f"{unresolved}/{rep.verify_offered} pool verifications "
+            f"never resolved (stranded in flight)"
+        )
+        assert rep.verify_failed == 0, (
+            f"{rep.verify_failed} pool verifications failed (all fleet "
+            f"spends are valid — a failure means a lost/duplicated "
+            f"answer path)"
+        )
+        killed = [
+            e for e in rep.chaos_log if e["kind"] == "kill_verifier"
+        ]
+        if killed:
+            assert rep.verify_workers_lost >= len(killed), (
+                "a worker was killed but the pool never detached it "
+                "(lease expiry broken)"
+            )
+            assert rep.verify_redispatched > 0, (
+                "a worker was killed mid-batch yet nothing re-dispatched"
+            )
 
     # -- the bundle ----------------------------------------------------------
 
@@ -1364,7 +1795,13 @@ class InvariantChecker:
         if expect_conflicts:
             self.check_exactly_one_winner()
         self.check_no_admitted_then_expired()
-        self.check_lost_bounded()
+        if self.report.intent_wal:
+            # the WAL turns the loss allowance into an equality
+            self.check_exact_accounting()
+        else:
+            self.check_lost_bounded()
+        if self.report.verify_offered:
+            self.check_verifier_pool()
         if slo_p99_micros is not None:
             self.check_slo(slo_p99_micros)
         if expect_brownout:
@@ -1385,4 +1822,15 @@ class InvariantChecker:
                 3,
             ),
             "faults": [e["name"] for e in self.report.chaos_log],
+            "fault_plane": {
+                "intent_wal": self.report.intent_wal,
+                "intent_replayed": self.report.intent_replayed,
+                "intent_unresolved": self.report.intent_unresolved,
+                "verify_offered": self.report.verify_offered,
+                "verify_resolved": self.report.verify_resolved,
+                "verify_redispatched": self.report.verify_redispatched,
+                "verify_workers_lost": self.report.verify_workers_lost,
+                "device_faults": self.report.device_faults,
+                "degraded_flushes": self.report.degraded_flushes,
+            },
         }

@@ -1178,6 +1178,12 @@ def notary_canary_fn(services, requester_party, tracer=None):
                 "health.canary", canary=True, seq=state["seq"]
             )
         p = _PendingNotarisation(stx, requester_party, fut, span=span)
+        # synthetic probe, NOT an admitted client request: it must not
+        # journal into the intent WAL (a crash would replay it into a
+        # boot where the canary contract isn't codec-registered yet,
+        # and replaying a probe is meaningless anyway) — the sentinel
+        # skips the journal append while staying "already stamped"
+        p.intent_seq = -1
         enqueue = getattr(svc, "enqueue_pending", None)
         if enqueue is not None:
             # routes to the owning SHARD on a sharded plane — a bare

@@ -272,15 +272,23 @@ def test_batching_notary_rejects_wrong_notary_immediately():
 
 def test_dispatch_failure_answers_every_requester():
     """A failed SPI dispatch (device down, unsupported scheme) must
-    resolve every queued future with an error, not strand the flows or
-    crash the pump tick."""
+    resolve every queued future, not strand the flows or crash the
+    pump tick. With the round-9 degraded fallback OFF, every future
+    answers `verification-unavailable`; with it ON (the default), the
+    flush falls back to the CPU reference and answers for REAL —
+    either way, nothing strands."""
     from corda_tpu.flows.api import FlowFuture
     from corda_tpu.node.notary import NotaryError, _PendingNotarisation
 
     net, spy, notary, bank, clients = make_net(1)
     svc = notary.services.notary_service
     alice = clients[0]
-    bank.run_flow(CashIssueFlow(100, "USD", alice.party, notary.party))
+    issue = bank.run_flow(
+        CashIssueFlow(100, "USD", alice.party, notary.party)
+    )
+    # the degraded flush below validates for real: the (validating)
+    # notary needs the spend's backchain in its tx storage
+    notary.services.record_transactions([issue])
     st = alice.vault.unconsumed_states(CashState)[0]
     b = TransactionBuilder(notary.party)
     b.add_input_state(st)
@@ -296,6 +304,7 @@ def test_dispatch_failure_answers_every_requester():
         def verify_batch(self, requests):
             raise RuntimeError("device unavailable")
 
+    svc.degraded_fallback = False   # the fallback path has its own test
     futs = [FlowFuture(), FlowFuture()]
     svc._pending = [
         _PendingNotarisation(stx, alice.party, f) for f in futs
@@ -306,6 +315,17 @@ def test_dispatch_failure_answers_every_requester():
         err = f.result()
         assert isinstance(err, NotaryError)
         assert err.kind == "verification-unavailable"
+
+    # fallback ON (default): the same dead device degrades the flush
+    # instead of failing it — the CPU reference answers for real and
+    # the degraded flag arms the recovery probe
+    svc.degraded_fallback = True
+    fut = FlowFuture()
+    svc._pending = [_PendingNotarisation(stx, alice.party, fut)]
+    svc.flush()
+    assert hasattr(fut.result(), "by"), "degraded flush must sign"
+    assert svc.degraded
+    assert svc.metrics.counter("Notary.DegradedFlushes").count == 1
 
 
 def test_max_batch_triggers_inline_flush():

@@ -457,6 +457,39 @@ def test_invariant_checker_catches_forged_ledger_and_phantoms():
     conflicted.outcome = fl.OUT_CONFLICT
 
 
+def test_exact_accounting_replaces_loss_allowance_with_wal():
+    """ISSUE 9: with the intent WAL attached, check_all swaps the
+    bounded in-flight-at-kill loss allowance for EXACT accounting —
+    every admitted request committed, shed or replayed, never silently
+    dropped — and a single doctored LOST record fails it."""
+    scenario = fl.FleetScenario(
+        clients=16,
+        phases=(fl.Phase("steady", 6, 4, fl.TrafficMix(
+            deadline_micros=20 * R, conflict_fraction=0.2)),),
+        round_micros=R, seed=13,
+    )
+    rep = fl.FleetSim(
+        scenario, "batching", qos_policy=_batching_policy(8),
+        intent_wal=True,
+    ).run()
+    assert rep.intent_wal and rep.intent_unresolved == 0
+    checker = fl.InvariantChecker(rep)
+    checker.check_all(expect_conflicts=True)
+    checker.check_exact_accounting()
+
+    # exact means EXACT: one silently-dropped record fails the soak
+    victim = rep.records[0]
+    saved, victim.outcome = victim.outcome, fl.OUT_LOST
+    with pytest.raises(AssertionError, match="silently dropped"):
+        fl.InvariantChecker(rep).check_exact_accounting()
+    victim.outcome = saved
+
+    # and without the WAL the tightened check refuses to vouch
+    no_wal = fl.FleetReport(**{**rep.__dict__, "intent_wal": False})
+    with pytest.raises(AssertionError, match="intent WAL"):
+        fl.InvariantChecker(no_wal).check_exact_accounting()
+
+
 # ---------------------------------------------------------------------------
 # bench plumbing
 

@@ -368,12 +368,16 @@ def test_same_tx_recommit_recovers_after_stream_tail_failure():
     all-or-nothing flush — a mid-stream failure leaves
     committed-but-unsigned transactions whose ONLY recovery is the
     client re-submitting the identical transaction and the uniqueness
-    provider accepting the same-tx re-commit. Pin exactly that."""
+    provider accepting the same-tx re-commit. Pin exactly that for the
+    round-9 fallback turned OFF; with the degraded fallback ON (the
+    default, pinned at the end) the same mid-stream failure now
+    completes IN PLACE on the CPU reference — no client retry needed."""
     from corda_tpu.flows.api import FlowFuture
 
     net, notary, requester, spends = _cash_spends(4, seed=33)
     svc = notary.services.notary_service
     svc.uniqueness = InMemoryUniquenessProvider()
+    svc.degraded_fallback = False   # the old contract first
     # first attempt: streamed verify dies after chunk 1 (2 of 4 txs)
     notary.services._batch_verifier = MidStreamFailVerifier(chunk=2)
     futs = []
@@ -411,6 +415,25 @@ def test_same_tx_recommit_recovers_after_stream_tail_failure():
     for stx, fut in zip(spends, retry_futs):
         sig = fut.result()
         assert hasattr(sig, "by"), f"retry not recovered: {sig}"
+
+    # round 9: with the degraded fallback ON (the shipped default) the
+    # SAME mid-stream failure no longer needs the client retry — the
+    # CPU reference fills the unresolved rows bit-exact and the flush
+    # completes in place, signing everything (already-committed chunk-1
+    # rows keep their first commit; the pointer never revisits them)
+    svc2 = type(svc)(notary.services, InMemoryUniquenessProvider())
+    notary.services._batch_verifier = MidStreamFailVerifier(chunk=2)
+    futs2 = []
+    for stx in spends:
+        fut = FlowFuture()
+        futs2.append(fut)
+        svc2._pending.append(_PendingNotarisation(stx, requester, fut))
+    svc2.flush()
+    for stx, fut in zip(spends, futs2):
+        sig = fut.result()
+        assert hasattr(sig, "by"), f"degraded flush did not sign: {sig}"
+    assert svc2.degraded
+    assert svc2.metrics.counter("Notary.DegradedFlushes").count == 1
 
 
 # ---------------------------------------------------------------------------

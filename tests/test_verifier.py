@@ -12,8 +12,10 @@ from corda_tpu.core import serialization as ser
 from corda_tpu.core.transactions import SignedTransaction
 from corda_tpu.crypto.tx_signature import sign_tx_id
 from corda_tpu.finance import CashIssueFlow, CashPaymentFlow
+from corda_tpu.node.messaging import FabricFaults
 from corda_tpu.node.verifier import (
     OutOfProcessTransactionVerifierService,
+    RedispatchPolicy,
     TxVerificationRequest,
     TxVerificationResponse,
     VerificationFailedError,
@@ -22,9 +24,9 @@ from corda_tpu.node.verifier import (
 from corda_tpu.testing import MockNetwork
 
 
-def issue_and_resolve(quantity=1000):
+def issue_and_resolve(quantity=1000, faults=None):
     """MockNetwork with one issued-cash tx; returns (net, node, stx, ltx)."""
-    net = MockNetwork(seed=11)
+    net = MockNetwork(seed=11, faults=faults)
     notary = net.create_notary()
     alice = net.create_node("Alice")
     stx = alice.run_flow(
@@ -196,6 +198,228 @@ def test_malformed_tx_in_batch_answers_every_request():
     good_fut.result()                       # the good tx verified fine
     with pytest.raises(VerificationFailedError):
         bad_fut.result()                    # the bad one failed alone
+
+
+# ---------------------------------------------------------------------------
+# round 9: worker churn — lease expiry, redispatch, stale answers, buffers
+
+
+def _churn_rig(faults, lease_rounds=100_000):
+    """Fault-aware fixture: service on the node clock with tight
+    self-healing knobs, plus the spend to verify."""
+    net, alice, stx, ltx = issue_and_resolve(faults=faults)
+    svc = OutOfProcessTransactionVerifierService(
+        alice.messaging,
+        clock=net.clock,
+        policy=RedispatchPolicy(
+            lease_micros=lease_rounds,
+            request_timeout_micros=100_000_000,
+            backoff_base_micros=50_000,
+            backoff_cap_micros=200_000,
+            max_attempts=4,
+        ),
+    )
+    return net, alice, stx, ltx, svc
+
+
+def test_worker_kill_mid_batch_redispatches_to_survivor():
+    """Kill one of two workers with its requests in flight: the lease
+    expires, the dead worker detaches, its nonces re-dispatch to the
+    survivor after the backoff, and EVERY future resolves — the
+    silent 30s strand is gone."""
+    faults = FabricFaults()
+    net, alice, stx, ltx, svc = _churn_rig(faults)
+    w1 = attach_worker(
+        net, "Alice", "worker-1", clock=net.clock, heartbeat_micros=50_000
+    )
+    w2 = attach_worker(
+        net, "Alice", "worker-2", clock=net.clock, heartbeat_micros=50_000
+    )
+    net.fabric.run()
+    assert svc.worker_count == 2
+
+    futs = [svc.verify(ltx, stx) for _ in range(4)]   # RR: 2 per worker
+    faults.kill("worker-1")
+    net.fabric.endpoint("worker-1").running = False
+    net.fabric.run()   # w2 receives + answers its two; w1's frames queue
+    w2.drain()
+    net.fabric.run()
+    assert sum(1 for f in futs if f.done) == 2
+
+    # the survivor keeps renewing its lease; the dead worker goes silent
+    net.clock.advance(150_000)
+    w2.drain()             # heartbeat rides the pump loop
+    net.fabric.run()
+    svc.tick()             # lease expiry: worker-1 detaches
+    assert svc.worker_count == 1
+    assert svc.metrics.meter("Verifier.WorkersLost").count == 1
+
+    # past the (jittered) backoff but inside the survivor's lease
+    net.clock.advance(80_000)
+    svc.tick()                   # redispatch to the survivor
+    assert svc.metrics.meter("Verifier.Redispatched").count == 2
+    net.fabric.run()
+    w2.drain()
+    net.fabric.run()
+    assert all(f.done for f in futs)
+    for f in futs:
+        f.result()   # every answer is a real success, none stranded
+    assert svc.in_flight == 0
+
+
+def test_worker_restart_same_name_rejects_stale_incarnation():
+    """A worker that dies with a computed answer in flight and later
+    re-attaches under the SAME name must not have that stale answer
+    accepted: the nonce was re-dispatched (attempt bumped), so only
+    the new incarnation's answer resolves the future."""
+    faults = FabricFaults()
+    net, alice, stx, ltx, svc = _churn_rig(faults)
+    # a manual batch window on worker-1 so ITS answer is sent (and
+    # killed in flight) under test control, not inside the pump
+    w1 = attach_worker(
+        net, "Alice", "worker-1", clock=net.clock,
+        heartbeat_micros=50_000, batch_window=100,
+    )
+    w2 = attach_worker(
+        net, "Alice", "worker-2", clock=net.clock, heartbeat_micros=50_000
+    )
+    net.fabric.run()
+    assert svc.incarnation_of("worker-1") == 1
+
+    fut = svc.verify(ltx, stx)     # RR -> worker-1
+    net.fabric.run()               # w1 receives the request
+    w1.drain()                     # w1 computes + SENDS the answer...
+    faults.kill("worker-1")        # ...but dies before it delivers
+    net.fabric.endpoint("worker-1").running = False
+    assert not fut.done
+
+    net.clock.advance(150_000)     # w1's lease expires
+    w2.drain()
+    net.fabric.run()
+    svc.tick()
+    assert svc.worker_count == 1
+    # past the (jittered) backoff but inside the survivor's lease
+    net.clock.advance(80_000)
+    svc.tick()                     # re-dispatch to worker-2, attempt 1
+    assert svc.metrics.meter("Verifier.Redispatched").count == 1
+
+    # worker-1 comes back under the same name; its queued stale answer
+    # (attempt 0) now delivers — and is rejected
+    faults.revive("worker-1")
+    net.fabric.endpoint("worker-1").running = True
+    w1._send_ready()
+    net.fabric.run()
+    assert svc.incarnation_of("worker-1") == 2
+    w2.drain()
+    net.fabric.run()
+    assert fut.done
+    fut.result()
+    # exactly ONE answer was accepted (the survivor's); the stale
+    # incarnation's answer did not double-count
+    assert (
+        svc.metrics.meter(
+            "TransactionVerifierService.Verification.Success"
+        ).count
+        == 1
+    )
+
+
+def test_lost_answer_redispatches_before_the_overall_deadline():
+    """A dropped response frame (worker alive and heartbeating) must
+    NOT strand the nonce until the overall timeout: the per-attempt
+    deadline re-dispatches it — to the other worker — and the future
+    resolves, with the late original rejected by the attempt bump."""
+    faults = FabricFaults()
+    net, alice, stx, ltx = issue_and_resolve(faults=faults)
+    svc = OutOfProcessTransactionVerifierService(
+        alice.messaging,
+        clock=net.clock,
+        policy=RedispatchPolicy(
+            lease_micros=10_000_000,        # leases never expire here
+            attempt_timeout_micros=200_000,  # the seam under test
+            request_timeout_micros=100_000_000,
+        ),
+    )
+    w1 = attach_worker(
+        net, "Alice", "worker-1", clock=net.clock, heartbeat_micros=50_000
+    )
+    w2 = attach_worker(
+        net, "Alice", "worker-2", clock=net.clock, heartbeat_micros=50_000
+    )
+    net.fabric.run()
+
+    # worker-1's answers vanish on the wire; its heartbeats still flow
+    faults.drop_link("worker-1", "Alice", 1.0, symmetric=False)
+    fut = svc.verify(ltx, stx)     # RR -> worker-1
+    net.fabric.run()               # w1 answers; the frame is dropped
+    assert not fut.done
+    net.clock.advance(250_000)     # past the ATTEMPT deadline only
+    w1.drain()                     # worker-1 is alive (lease renewed)
+    faults.drop_link("worker-1", "Alice", 0.0)
+    svc.tick()                     # re-dispatch, excluding worker-1
+    assert svc.metrics.meter("Verifier.Redispatched").count == 1
+    assert svc.metrics.meter("Verifier.WorkersLost").count == 0
+    net.fabric.run()
+    w2.drain()
+    net.fabric.run()
+    assert fut.done
+    fut.result()
+    assert svc.worker_count == 2   # nobody was detached for a lost frame
+
+
+def test_two_worker_pool_drains_buffer_exactly_once():
+    """Requests buffered before any worker attaches flush exactly once
+    when the pool comes up — two workers attaching must not double-
+    process the store-and-forward buffer."""
+    net, alice, stx, ltx = issue_and_resolve()
+    svc = OutOfProcessTransactionVerifierService(
+        alice.messaging, clock=net.clock
+    )
+    futs = [svc.verify(ltx, stx) for _ in range(4)]
+    net.fabric.run()
+    assert not any(f.done for f in futs)
+    assert svc.buffered == 4
+
+    w1 = attach_worker(net, "Alice", "worker-1", clock=net.clock)
+    w2 = attach_worker(net, "Alice", "worker-2", clock=net.clock)
+    net.fabric.run()
+    assert svc.buffered == 0
+    assert all(f.done for f in futs)
+    for f in futs:
+        f.result()
+    # exactly once: the pool verified 4 requests total, no duplicates
+    total = (
+        w1.metrics.meter("Verifier.Verified").count
+        + w2.metrics.meter("Verifier.Verified").count
+    )
+    assert total == 4
+    assert (
+        svc.metrics.meter(
+            "TransactionVerifierService.Verification.Success"
+        ).count
+        == 4
+    )
+
+
+def test_pool_state_gauges_on_metrics_surface():
+    """Verifier.InFlight / Buffered / Workers are live gauges next to
+    the duration histogram, visible in the Prometheus exposition."""
+    net, alice, stx, ltx = issue_and_resolve()
+    svc = OutOfProcessTransactionVerifierService(
+        alice.messaging, clock=net.clock
+    )
+    svc.verify(ltx, stx)
+    net.fabric.run()
+    text = svc.metrics.to_prometheus()
+    assert "Verifier_Buffered 1" in text       # no worker yet
+    assert "Verifier_Workers 0" in text
+    assert "Verifier_InFlight 1" in text
+    attach_worker(net, "Alice", "worker-1", clock=net.clock)
+    net.fabric.run()
+    text = svc.metrics.to_prometheus()
+    assert "Verifier_Buffered 0" in text
+    assert "Verifier_Workers 1" in text
+    assert "Verifier_InFlight 0" in text
 
 
 def test_invalid_signature_gates_contract_execution():
