@@ -104,9 +104,8 @@ def register_ring_gauges(metrics, topic: str, ring, parked_count=None) -> None:
     high-water mark, and (when the fabric exposes a counter) frames
     parked waiting for retry_parked. ONE naming scheme for every
     fabric, so dashboards don't fork per transport."""
-    base = f"Ingest.{topic}.Ring"
-    metrics.gauge(base + "Depth", lambda: len(ring))
-    metrics.gauge(base + "HighWater", lambda: ring.high_water)
+    metrics.gauge(f"Ingest.{topic}.RingDepth", lambda: len(ring))
+    metrics.gauge(f"Ingest.{topic}.RingHighWater", lambda: ring.high_water)
     if parked_count is not None:
         metrics.gauge(f"Ingest.{topic}.Parked", parked_count)
 

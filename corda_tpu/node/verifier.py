@@ -25,6 +25,7 @@ are buffered and flushed on the first `verifier.ready`.
 from __future__ import annotations
 
 import random
+import threading
 import time
 from dataclasses import dataclass, replace
 from typing import Optional
@@ -210,6 +211,19 @@ class OutOfProcessTransactionVerifierService(TransactionVerifierService):
     stragglers can be hedged onto a second worker, and a nonce that
     exhausts its deadline fails with a typed
     VerificationTimeoutError/WorkerLostError instead of stranding.
+
+    Threading model: on the in-process pump fabrics everything here
+    runs on the pump thread, but NOT always — on pump-less fabrics the
+    response/ready handlers fire on the fabric's receive thread, and
+    `wait()` drives `tick()` from whichever thread owns the future. A
+    single service lock therefore guards ALL pool state (`_pending`,
+    `_workers`, `_leases`, `_buffer`, `_rr`, `_nonce`); the lock spans
+    pure bookkeeping only — fabric sends, `register_peer` callbacks
+    and future resolutions (whose done-callbacks run arbitrary code)
+    are collected under the lock and performed AFTER it is released,
+    so the pump-hot redispatch path never does I/O under the service
+    lock and no callback can re-enter it (tools/lint blocking pass
+    holds this line).
     """
 
     def __init__(
@@ -233,6 +247,9 @@ class OutOfProcessTransactionVerifierService(TransactionVerifierService):
         self._clock = clock
         self.policy = policy or RedispatchPolicy()
         self._rng = random.Random(0xFA17)   # jitter: seeded, deterministic
+        # guards the pool state below; never held across a fabric
+        # send, a register_peer callback or a future resolution
+        self._lock = threading.Lock()
         self._pending: dict[int, _PendingVerify] = {}
         self._workers: list[str] = []              # attach order (RR)
         self._leases: dict[str, int] = {}          # worker -> last-ready us
@@ -280,18 +297,20 @@ class OutOfProcessTransactionVerifierService(TransactionVerifierService):
         """Ship `ltx` (and optionally the signature batch) to a worker.
         The returned future completes when the response message is
         pumped; callers in flows should re-check it per pump cycle."""
-        self._nonce += 1
-        nonce = self._nonce
         fut = _Future()
-        fut.nonce = nonce   # wait() names the nonce in its typed timeout
-        req = TxVerificationRequest(
-            nonce, ltx, self._messaging.my_address, stx
-        )
-        entry = _PendingVerify(
-            req, fut, time.perf_counter(), self._now_micros()
-        )
-        self._pending[nonce] = entry
-        self._dispatch(entry)
+        with self._lock:
+            self._nonce += 1
+            nonce = self._nonce
+            fut.nonce = nonce   # wait() names it in its typed timeout
+            req = TxVerificationRequest(
+                nonce, ltx, self._messaging.my_address, stx
+            )
+            entry = _PendingVerify(
+                req, fut, time.perf_counter(), self._now_micros()
+            )
+            self._pending[nonce] = entry
+            send = self._dispatch_locked(entry)
+        self._send_all((send,) if send else ())
         return fut
 
     def wait(self, fut: _Future, timeout: float = 30.0) -> None:
@@ -356,67 +375,95 @@ class OutOfProcessTransactionVerifierService(TransactionVerifierService):
         """One self-healing pass, driven by the node pump (or a test
         clock): expire worker leases (detaching the dead and
         re-dispatching their in-flight nonces), time out / retry
-        pending nonces, and hedge stragglers."""
+        pending nonces, and hedge stragglers. Bookkeeping happens
+        under the service lock; the collected sends and failure
+        resolutions run after it releases."""
         if now is None:
             now = self._now_micros()
         pol = self.policy
-        # 1 — lease expiry: a worker silent past its lease is dead
-        for worker in [
-            w for w in self._workers
-            if now - self._leases.get(w, now) > pol.lease_micros
-        ]:
-            self._detach_worker(worker, now)
-        # 2 — per-nonce deadlines, retries, hedging
+        sends: list[tuple] = []
+        failures: list[tuple] = []   # (future, typed exception)
+        # the hedge threshold reads the duration histogram's own lock —
+        # taken before the service lock, never under it
         hedge_after = self._hedge_after_micros()
-        for nonce, entry in list(self._pending.items()):
-            elapsed = now - entry.enqueued_micros
-            if elapsed > pol.request_timeout_micros:
-                self._fail_entry(nonce, entry, elapsed)
-                continue
-            if entry.worker is None:
-                # unbound (its worker died, or it never had one): retry
-                # once the backoff window passes and a worker exists
+        with self._lock:
+            # 1 — lease expiry: a worker silent past its lease is dead
+            for worker in [
+                w for w in self._workers
+                if now - self._leases.get(w, now) > pol.lease_micros
+            ]:
+                self._detach_worker_locked(worker, now)
+            # 2 — per-nonce deadlines, retries, hedging
+            for nonce, entry in list(self._pending.items()):
+                elapsed = now - entry.enqueued_micros
+                if elapsed > pol.request_timeout_micros:
+                    failures.append(self._fail_locked(nonce, entry, elapsed))
+                    continue
+                if entry.worker is None:
+                    # unbound (its worker died, or it never had one):
+                    # retry once the backoff passes and a worker exists
+                    if (
+                        self._workers
+                        and (
+                            entry.retry_at_micros is None
+                            or now >= entry.retry_at_micros
+                        )
+                    ):
+                        self._retry_or_fail_locked(
+                            nonce, entry, elapsed,
+                            entry.lost_workers, sends, failures,
+                        )
+                    continue
                 if (
-                    self._workers
-                    and (
-                        entry.retry_at_micros is None
-                        or now >= entry.retry_at_micros
-                    )
+                    pol.attempt_timeout_micros
+                    and entry.dispatched_micros is not None
+                    and now - entry.dispatched_micros
+                    > pol.attempt_timeout_micros
                 ):
-                    if entry.dispatches >= pol.max_attempts:
-                        self._fail_entry(nonce, entry, elapsed)
-                    else:
-                        self._redispatched.mark()
-                        self._dispatch(entry, exclude=entry.lost_workers)
-                continue
-            if (
-                pol.attempt_timeout_micros
-                and entry.dispatched_micros is not None
-                and now - entry.dispatched_micros
-                > pol.attempt_timeout_micros
-            ):
-                # the bound worker is (or looks) alive but this
-                # attempt's answer never came — lost frame, or a
-                # same-name restart inside the lease. Re-dispatch NOW
-                # (prefer a different worker); the attempt bump
-                # rejects the original answer if it limps in later.
-                if entry.dispatches >= pol.max_attempts:
-                    self._fail_entry(nonce, entry, elapsed)
-                else:
-                    self._redispatched.mark()
-                    self._dispatch(
-                        entry,
-                        exclude=entry.lost_workers + [entry.worker],
+                    # the bound worker is (or looks) alive but this
+                    # attempt's answer never came — lost frame, or a
+                    # same-name restart inside the lease. Re-dispatch
+                    # NOW (prefer a different worker); the attempt bump
+                    # rejects the original answer if it limps in later.
+                    self._retry_or_fail_locked(
+                        nonce, entry, elapsed,
+                        entry.lost_workers + [entry.worker],
+                        sends, failures,
                     )
-                continue
-            if (
-                hedge_after is not None
-                and entry.hedged_to is None
-                and len(self._workers) > 1
-                and entry.dispatched_micros is not None
-                and now - entry.dispatched_micros >= hedge_after
-            ):
-                self._hedge(entry)
+                    continue
+                if (
+                    hedge_after is not None
+                    and entry.hedged_to is None
+                    and len(self._workers) > 1
+                    and entry.dispatched_micros is not None
+                    and now - entry.dispatched_micros >= hedge_after
+                ):
+                    send = self._hedge_locked(entry)
+                    if send:
+                        sends.append(send)
+        # failures FIRST: _fail_locked already removed these nonces
+        # from _pending, so if a fabric send raised before resolution
+        # the futures could never complete (late responses drop at the
+        # `entry is None` guard) — typed-error delivery must not
+        # depend on the sends succeeding
+        for fut, exc in failures:
+            fut.set_exception(exc)
+        self._send_all(sends)
+
+    def _retry_or_fail_locked(
+        self, nonce, entry, elapsed, exclude, sends, failures
+    ) -> None:
+        """Re-dispatch one unbound / attempt-timed-out nonce — or fail
+        it once its attempts are spent — collecting the send or the
+        typed failure for the caller to perform after the lock
+        releases."""
+        if entry.dispatches >= self.policy.max_attempts:
+            failures.append(self._fail_locked(nonce, entry, elapsed))
+            return
+        self._redispatched.mark()
+        send = self._dispatch_locked(entry, exclude=exclude)
+        if send:
+            sends.append(send)
 
     def _hedge_after_micros(self) -> Optional[int]:
         pol = self.policy
@@ -428,22 +475,21 @@ class OutOfProcessTransactionVerifierService(TransactionVerifierService):
             q = float(hist.quantile(pol.hedge_quantile)) * 1e6
         return max(int(q), pol.hedge_min_micros)
 
-    def _hedge(self, entry: _PendingVerify) -> None:
+    def _hedge_locked(self, entry: _PendingVerify) -> Optional[tuple]:
         """Duplicate a straggler onto a different worker, SAME attempt:
         either copy's answer is valid, the first one wins, the other is
-        deduped by the nonce having left the pending map."""
+        deduped by the nonce having left the pending map. Returns the
+        send for the caller to perform outside the lock."""
         others = [w for w in self._workers if w != entry.worker]
         if not others:
-            return
+            return None
         worker = others[self._rr % len(others)]
         self._rr += 1
         entry.hedged_to = worker
         self._hedged_meter.mark()
-        self._messaging.send(
-            msglib.TOPIC_VERIFIER_REQ, ser.encode(entry.req), worker
-        )
+        return (msglib.TOPIC_VERIFIER_REQ, entry.req, worker)
 
-    def _detach_worker(self, worker: str, now: int) -> None:
+    def _detach_worker_locked(self, worker: str, now: int) -> None:
         self._workers.remove(worker)
         self._leases.pop(worker, None)
         self._workers_lost.mark()
@@ -465,19 +511,24 @@ class OutOfProcessTransactionVerifierService(TransactionVerifierService):
             jitter = 1.0 + pol.backoff_jitter * (2 * self._rng.random() - 1)
             entry.retry_at_micros = now + int(backoff * jitter)
 
-    def _fail_entry(self, nonce: int, entry: _PendingVerify, elapsed: int) -> None:
+    def _fail_locked(
+        self, nonce: int, entry: _PendingVerify, elapsed: int
+    ) -> tuple:
+        """Remove a dead nonce under the lock; the caller resolves the
+        returned (future, exception) AFTER releasing it — set_exception
+        runs done-callbacks, which must never fire under the service
+        lock."""
         del self._pending[nonce]
         if entry in self._buffer:
             self._buffer.remove(entry)
         self._failure.mark()
         if entry.lost_workers and entry.worker is None:
-            entry.fut.set_exception(
-                WorkerLostError(nonce, entry.lost_workers, entry.dispatches)
+            exc: Exception = WorkerLostError(
+                nonce, entry.lost_workers, entry.dispatches
             )
         else:
-            entry.fut.set_exception(
-                VerificationTimeoutError(nonce, entry.worker, elapsed)
-            )
+            exc = VerificationTimeoutError(nonce, entry.worker, elapsed)
+        return entry.fut, exc
 
     def watch_health(self, monitor) -> None:
         """Register the `verifier.pool_degraded` rule on a
@@ -513,13 +564,16 @@ class OutOfProcessTransactionVerifierService(TransactionVerifierService):
 
     # -- internals ---------------------------------------------------------
 
-    def _dispatch(
+    def _dispatch_locked(
         self, entry: _PendingVerify, exclude: Optional[list] = None
-    ) -> None:
+    ) -> Optional[tuple]:
+        """Bind (or buffer) one entry under the service lock; returns
+        the (topic, request, target) send for the caller to encode and
+        perform after release, or None when the entry was buffered."""
         if not self._workers:
             if entry not in self._buffer:
                 self._buffer.append(entry)   # store-and-forward
-            return
+            return None
         candidates = (
             [w for w in self._workers if w not in exclude] if exclude else []
         ) or self._workers
@@ -535,9 +589,15 @@ class OutOfProcessTransactionVerifierService(TransactionVerifierService):
         entry.dispatches += 1
         entry.dispatched_micros = self._now_micros()
         entry.retry_at_micros = None
-        self._messaging.send(
-            msglib.TOPIC_VERIFIER_REQ, ser.encode(entry.req), worker
-        )
+        # capture the request REFERENCE under the lock (the frozen
+        # dataclass is only ever replaced, never mutated, so encoding
+        # can safely happen after release — full-tx serialization must
+        # not serialize every other thread behind the service lock)
+        return (msglib.TOPIC_VERIFIER_REQ, entry.req, worker)
+
+    def _send_all(self, sends) -> None:
+        for topic, req, target in sends:
+            self._messaging.send(topic, ser.encode(req), target)
 
     def _on_ready(self, msg: msglib.Message) -> None:
         ready = ser.decode(msg.payload)
@@ -552,42 +612,58 @@ class OutOfProcessTransactionVerifierService(TransactionVerifierService):
             and ready.worker not in self._allowed_workers
         ):
             return
-        now = self._now_micros()
-        self._leases[ready.worker] = now   # heartbeat = lease renewal
         if ready.host and self._register_peer is not None:
             # EVERY announcement refreshes the dial-back address, not
             # just the first: a worker that restarts on a new port
             # within its lease would otherwise keep renewing the lease
-            # while dispatches bridge to its dead old address
+            # while dispatches bridge to its dead old address. The
+            # callback reaches into the fabric's peer table — outside
+            # the service lock, and BEFORE the worker is published
+            # into _workers so a concurrent verify()/tick() can never
+            # bind a nonce to a peer the fabric cannot resolve yet.
             self._register_peer(ready.worker, ready.host, ready.port)
-        if ready.worker in self._workers:
-            return
-        self._workers.append(ready.worker)
-        self._incarnations[ready.worker] = (
-            self._incarnations.get(ready.worker, 0) + 1
-        )
-        # fresh capacity: flush the store-and-forward buffer, then give
-        # any orphaned in-flight nonce (its worker died while the pool
-        # was empty) a home without waiting for the next tick
-        buffered, self._buffer = self._buffer, []
-        for entry in buffered:
-            self._dispatch(entry)
-        for entry in self._pending.values():
-            if entry.worker is None and entry not in self._buffer:
-                if entry.dispatches:
-                    self._redispatched.mark()
-                self._dispatch(entry, exclude=entry.lost_workers)
+        now = self._now_micros()
+        sends: list[tuple] = []
+        with self._lock:
+            self._leases[ready.worker] = now   # heartbeat = lease renewal
+            if ready.worker not in self._workers:
+                self._workers.append(ready.worker)
+                self._incarnations[ready.worker] = (
+                    self._incarnations.get(ready.worker, 0) + 1
+                )
+                # fresh capacity: flush the store-and-forward buffer,
+                # then give any orphaned in-flight nonce (its worker
+                # died while the pool was empty) a home without
+                # waiting for the next tick
+                buffered, self._buffer = self._buffer, []
+                for entry in buffered:
+                    send = self._dispatch_locked(entry)
+                    if send:
+                        sends.append(send)
+                for entry in self._pending.values():
+                    if entry.worker is None and entry not in self._buffer:
+                        if entry.dispatches:
+                            self._redispatched.mark()
+                        send = self._dispatch_locked(
+                            entry, exclude=entry.lost_workers
+                        )
+                        if send:
+                            sends.append(send)
+        self._send_all(sends)
 
     def _on_response(self, msg: msglib.Message) -> None:
         res: TxVerificationResponse = ser.decode(msg.payload)
-        entry = self._pending.get(res.nonce)
-        if entry is None:
-            return   # duplicate / already answered (at-least-once upstream)
-        if getattr(res, "attempt", 0) != entry.attempt:
-            return   # stale incarnation: the nonce was re-dispatched since
-        if msg.sender not in (entry.worker, entry.hedged_to):
-            return   # only the bound (or hedge) worker may answer
-        del self._pending[res.nonce]
+        with self._lock:
+            entry = self._pending.get(res.nonce)
+            if entry is None:
+                return   # duplicate / already answered (at-least-once)
+            if getattr(res, "attempt", 0) != entry.attempt:
+                return   # stale incarnation: re-dispatched since
+            if msg.sender not in (entry.worker, entry.hedged_to):
+                return   # only the bound (or hedge) worker may answer
+            del self._pending[res.nonce]
+        # resolution outside the lock: set_result/set_exception run
+        # done-callbacks (qos latency observers, span ends)
         self._duration.update(time.perf_counter() - entry.t0)
         if res.error is None:
             self._success.mark()

@@ -66,6 +66,16 @@ from ..node.notary import NotaryError
 from ..utils.health import AlertRule, ClusterHealth, HealthMonitor, HealthPolicy
 from .mock_network import MockNetwork
 
+
+def _metric_count(registry, name: str) -> int:
+    """Read a counter/meter total WITHOUT registering it: the fleet
+    reconciles against series OWNED by the services it drives, and a
+    `registry.counter(name)` read would create the series when the
+    owner has not — a second registration site for every dashboard
+    name the checker touches (tools/lint metrics pass)."""
+    m = registry.get(name)
+    return m.count if m is not None else 0
+
 # outcome vocabulary — one set for records, reports and assertions
 OUT_SIGNED = "signed"
 OUT_CONFLICT = "conflict"
@@ -1020,9 +1030,9 @@ class FleetSim:
 
         node = self.members[0]
         old = node.services.notary_service
-        self._degraded_flushes_base += old.metrics.counter(
-            "Notary.DegradedFlushes"
-        ).count
+        self._degraded_flushes_base += _metric_count(
+            old.metrics, "Notary.DegradedFlushes"
+        )
         had_workers = bool(old._workers)
         old.stop()   # dead worker threads must not keep flushing
         svc = BatchingNotaryService(
@@ -1359,11 +1369,11 @@ class FleetSim:
             verify_resolved=verify_resolved,
             verify_failed=verify_failed,
             verify_redispatched=(
-                pool.metrics.meter("Verifier.Redispatched").count
+                _metric_count(pool.metrics, "Verifier.Redispatched")
                 if pool is not None else 0
             ),
             verify_workers_lost=(
-                pool.metrics.meter("Verifier.WorkersLost").count
+                _metric_count(pool.metrics, "Verifier.WorkersLost")
                 if pool is not None else 0
             ),
             device_faults=(
@@ -1372,7 +1382,7 @@ class FleetSim:
             ),
             degraded_flushes=(
                 self._degraded_flushes_base
-                + svc.metrics.counter("Notary.DegradedFlushes").count
+                + _metric_count(svc.metrics, "Notary.DegradedFlushes")
                 if self.flavour == "batching" else 0
             ),
         )

@@ -133,9 +133,14 @@ class WebDemoBench:
         return 200, {"status": "stopped", "name": name}
 
     def status(self) -> tuple[int, dict]:
+        # snapshot the table under the lock; the pane-log scan in
+        # _web_port (file I/O, unbounded pane growth) runs OUTSIDE it,
+        # same discipline as pane() — status polls must not serialize
+        # behind each other on disk reads
         with self._lock:
             map_host = self.bench._map_host()
             nodes = []
+            live: list = []
             seen = set()
             for name in self.bench._order:
                 seen.add(name)
@@ -150,23 +155,25 @@ class WebDemoBench:
                         state = "stopped"
                     nodes.append({"name": name, "state": state})
                     continue
-                nodes.append(
-                    {
-                        "name": name,
-                        "state": "up" if node.alive else "DEAD",
-                        "port": node.port,
-                        "pane": node.log_path,
-                        "web_port": self._web_port(node),
-                        "map_host": node is map_host,
-                        "notary": node.config.notary or None,
-                    }
-                )
+                row = {
+                    "name": name,
+                    "state": "up" if node.alive else "DEAD",
+                    "port": node.port,
+                    "pane": node.log_path,
+                    "web_port": None,
+                    "map_host": node is map_host,
+                    "notary": node.config.notary or None,
+                }
+                nodes.append(row)
+                live.append((row, node))
             for name, err in self._starting.items():
                 if name not in seen and name not in self.bench.nodes:
                     nodes.append(
                         {"name": name,
                          "state": f"failed: {err}" if err else "starting"}
                     )
+        for row, node in live:
+            row["web_port"] = self._web_port(node)
         return 200, {"bench_dir": self.bench.bench_dir, "nodes": nodes}
 
     def pane(self, name: str, tail: int) -> tuple[int, dict]:
@@ -184,8 +191,13 @@ class WebDemoBench:
     def _web_port(self, node) -> Optional[int]:
         """A gateway node announces WEB_PORT= into its pane log;
         cached on first sight (the announcement never changes and the
-        pane grows unboundedly — status must not rescan it forever)."""
-        cached = self._web_ports.get(node.name)
+        pane grows unboundedly — status must not rescan it forever).
+        Cache reads/writes happen under the lock (stop() invalidates
+        under it) but the pane scan does not; the write re-checks that
+        `node` is still the bench's current instance so a stop()/
+        re-add racing the scan can never resurrect a stale port."""
+        with self._lock:
+            cached = self._web_ports.get(node.name)
         if cached is not None:
             return cached
         if node.config.web_port < 0:
@@ -197,8 +209,11 @@ class WebDemoBench:
             return None
         if m is None:
             return None
-        self._web_ports[node.name] = int(m.group(1))
-        return self._web_ports[node.name]
+        port = int(m.group(1))
+        with self._lock:
+            if self.bench.nodes.get(node.name) is node:
+                self._web_ports[node.name] = port
+        return port
 
     def shutdown(self) -> None:
         with self._lock:

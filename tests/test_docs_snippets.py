@@ -45,6 +45,9 @@ RUNNABLE = (
     "serving-notary.md",
     # PR 4: QoS overload+shed scenario (simulated time, CI-runnable)
     "loadtest.md",
+    # PR 10: the concurrency & JAX-hazard lint plane (gate, baseline,
+    # dot export — fixture-driven, CI-runnable)
+    "static-analysis.md",
 )
 
 

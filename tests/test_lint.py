@@ -1,0 +1,818 @@
+"""tools/lint: the concurrency & JAX-hazard static analyzer + CI gate.
+
+Each detector is pinned by one true-positive fixture AND one near-miss
+that must NOT flag (the ubiquitous `with self._lock: return x` guarded
+read, shape-only branching under jit, the condition-variable's own
+wait). The committed tree itself is part of the suite: the full-repo
+gate must be clean (every finding baselined with a written
+justification) and a fixture that introduces a new lock-order
+inversion must turn the gate red — that pair is the CI wiring, the
+same way tests/test_bench_history.py runs `bench_history --gate` over
+the committed trajectory.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.lint import cli, lockcheck  # noqa: E402
+from tools.lint.cli import gate, load_baseline, run_passes  # noqa: E402
+
+
+def _scan(tmp_path, files, only=None):
+    """Write fixture sources under <tmp>/pkg and run the analyzer."""
+    for rel, src in files.items():
+        dest = tmp_path / "pkg" / rel
+        dest.parent.mkdir(parents=True, exist_ok=True)
+        dest.write_text(textwrap.dedent(src))
+    return run_passes(str(tmp_path), only=only, subdirs=("pkg",))
+
+
+def _rules(findings):
+    return [f.rule for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# lockcheck
+
+INVERSION = """
+    import threading
+
+    class Pair:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+
+        def forward(self):
+            with self._a:
+                with self._b:
+                    return 1
+
+        def backward(self):
+            with self._b:
+                with self._a:
+                    return 2
+"""
+
+
+def test_lockcheck_flags_order_inversion(tmp_path):
+    _, findings = _scan(tmp_path, {"pair.py": INVERSION}, only=("lockcheck",))
+    cycles = [f for f in findings if f.rule == "lock-cycle"]
+    assert len(cycles) == 1
+    assert cycles[0].severity == "P0"
+    assert "Pair._a" in cycles[0].detail and "Pair._b" in cycles[0].detail
+    assert cycles[0].evidence   # names at least one acquisition site
+
+
+def test_lockcheck_guarded_read_not_flagged(tmp_path):
+    """The ubiquitous `with self._lock: return self._x` — every pass
+    must stay silent on it."""
+    _, findings = _scan(
+        tmp_path,
+        {
+            "counter.py": """
+            import threading
+
+            class Counter:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0
+
+                def incr(self):
+                    with self._lock:
+                        self._n += 1
+
+                def snapshot(self):
+                    with self._lock:
+                        return self._n
+            """
+        },
+    )
+    assert findings == []
+
+
+def test_lockcheck_self_deadlock_lock_vs_rlock(tmp_path):
+    """Re-acquiring a held non-reentrant Lock through a call chain is a
+    P0 self-deadlock; the identical shape on an RLock is its contract
+    and must not flag."""
+    src = """
+        import threading
+
+        class Recur:
+            def __init__(self):
+                self._lock = threading.{kind}()
+
+            def outer(self):
+                with self._lock:
+                    self._inner()
+
+            def _inner(self):
+                with self._lock:
+                    return 1
+    """
+    _, findings = _scan(
+        tmp_path, {"recur.py": src.format(kind="Lock")}, only=("lockcheck",)
+    )
+    assert "lock-self-cycle" in _rules(findings)
+    _, findings = _scan(
+        tmp_path, {"recur.py": src.format(kind="RLock")}, only=("lockcheck",)
+    )
+    assert "lock-self-cycle" not in _rules(findings)
+
+
+def test_lockcheck_instance_order(tmp_path):
+    """The textbook transfer(): nesting the SAME lock attribute through
+    two receivers is safe only under a global acquisition order."""
+    _, findings = _scan(
+        tmp_path,
+        {
+            "account.py": """
+            import threading
+
+            class Account:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.balance = 0
+
+                def transfer(self, other, amount):
+                    with self._lock:
+                        with other._lock:
+                            self.balance -= amount
+                            other.balance += amount
+            """
+        },
+        only=("lockcheck",),
+    )
+    hits = [f for f in findings if f.rule == "lock-instance-order"]
+    assert len(hits) == 1 and hits[0].severity == "P0"
+    assert hits[0].detail == "Account._lock"
+
+
+def test_lockcheck_sharing_map(tmp_path):
+    """A lock reachable from a discovered Thread target AND a fabric
+    handler callback is cross-thread shared (P2 sharing map); entry
+    points are discovered from the source, not hard-coded."""
+    _, findings = _scan(
+        tmp_path,
+        {
+            "svc.py": """
+            import threading
+
+            class Svc:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._stopped = False
+
+                def start(self, fabric):
+                    t = threading.Thread(target=self._loop)
+                    t.start()
+                    fabric.add_handler("svc.msg", self._on_msg)
+
+                def _loop(self):
+                    with self._lock:
+                        self._step()
+
+                def _on_msg(self, msg):
+                    with self._lock:
+                        self._stopped = True
+
+                def _step(self):
+                    pass
+            """
+        },
+        only=("lockcheck",),
+    )
+    shared = [f for f in findings if f.rule == "lock-shared"]
+    assert len(shared) == 1
+    assert shared[0].detail == "Svc._lock"
+    assert "thread:" in shared[0].message and "pump" in shared[0].message
+
+
+def test_same_named_classes_in_different_modules_do_not_merge(tmp_path):
+    """Two classes sharing a name in different modules are DIFFERENT
+    classes: methods and lock attributes must not cross-resolve (the
+    repo really has two `Handler`s and two `Obligation`s), while a
+    repo-unique name still resolves across modules for base-class
+    walks."""
+    repo, findings = _scan(
+        tmp_path,
+        {
+            "a.py": """
+            import time
+            import threading
+
+            class Svc:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def tick(self):
+                    with self._lock:
+                        self._work()
+
+                def _work(self):
+                    time.sleep(0.001)
+            """,
+            "b.py": """
+            class Svc:
+                def _work(self):
+                    return 2
+            """,
+        },
+        only=("blocking",),
+    )
+    a = repo.class_for("Svc", "pkg/a.py")
+    b = repo.class_for("Svc", "pkg/b.py")
+    assert a is not b
+    assert "tick" in a.methods and "tick" not in b.methods
+    assert a.lock_attrs and not b.lock_attrs
+    # `self._work()` from a.py's tick binds to a.py's sleeper — the
+    # chain finding exists and names it, not b.py's harmless _work
+    assert len(findings) == 1
+    assert any("a.py" in ev for ev in findings[0].evidence)
+
+
+# ---------------------------------------------------------------------------
+# blocking
+
+PUMP = """
+    import time
+    import threading
+
+    class Pump:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cond = threading.Condition()
+
+        def tick(self):
+            with self._lock:
+                time.sleep(0.001)
+
+        def idle(self):
+            time.sleep(0.001)
+
+        def wait_turn(self):
+            with self._cond:
+                self._cond.wait()
+"""
+
+
+def test_blocking_sleep_under_pump_hot_lock_is_p1(tmp_path):
+    """sleep under a lock acquired by a serving-loop function ranks
+    P1; the same sleep outside any lock, and the condition variable's
+    own wait (which RELEASES the lock), never flag."""
+    _, findings = _scan(tmp_path, {"pump.py": PUMP}, only=("blocking",))
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule == "blocking-sleep"
+    assert f.severity == "P1"          # Pump.tick makes Pump._lock hot
+    assert f.scope == "Pump.tick"
+    assert "pump-hot" in f.message
+
+
+def test_blocking_wait_with_extra_lock_held(tmp_path):
+    """A condition wait is only exempt for the condition's OWN lock —
+    any other lock held across the wait is the hazard, and the finding
+    must name that lock, not the condition."""
+    _, findings = _scan(
+        tmp_path,
+        {
+            # NB: matches PUMP's four-space base indent so the shared
+            # textwrap.dedent in _scan strips both blocks uniformly
+            "pump.py": PUMP
+            + """
+    class Bad(Pump):
+        def bad_wait(self):
+            with self._lock:
+                with self._cond:
+                    self._cond.wait()
+"""
+        },
+        only=("blocking",),
+    )
+    waits = [f for f in findings if f.rule == "blocking-cond-wait"]
+    assert len(waits) == 1
+    assert waits[0].scope == "Bad.bad_wait"
+    assert "Pump._lock" in waits[0].detail
+    assert "Pump._cond" not in waits[0].detail
+
+
+def test_blocking_new_call_under_baselined_lock_is_new_finding(tmp_path):
+    """Fingerprints carry the call identity: a justified baseline row
+    for sleep-under-lock must not grandfather a DIFFERENT blocking
+    call added under the same lock in the same function later."""
+    src_v2 = PUMP.replace(
+        "time.sleep(0.001)\n",
+        "time.sleep(0.001)\n                sock.recv(1)\n",
+        1,
+    )
+    _, v1 = _scan(tmp_path, {"pump.py": PUMP}, only=("blocking",))
+    _, v2 = _scan(tmp_path, {"pump.py": src_v2}, only=("blocking",))
+    assert len(v1) == 1 and len(v2) == 2
+    fps_v2 = {f.fingerprint for f in v2}
+    assert v1[0].fingerprint in fps_v2          # the old row still matches
+    assert len(fps_v2) == 2                     # the recv is NEW
+
+
+def test_blocking_follows_one_extract_method_hop(tmp_path):
+    """An extract-method refactor must not defeat the pass: sleep in a
+    helper called under the pump-hot lock still flags (attributed to
+    the call site, with the helper's site as evidence)."""
+    _, findings = _scan(
+        tmp_path,
+        {
+            "pump.py": """
+            import time
+            import threading
+
+            class Pump:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def tick(self):
+                    with self._lock:
+                        self._helper()
+
+                def _helper(self):
+                    time.sleep(0.001)
+            """
+        },
+        only=("blocking",),
+    )
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule == "blocking-sleep" and f.severity == "P1"
+    assert f.scope == "Pump.tick" and f.detail.startswith("chain:")
+    assert any("Pump._helper" in ev for ev in f.evidence)
+
+
+def test_lockcheck_module_lock_chain_reentry_is_self_cycle(tmp_path):
+    """A module-level lock is a singleton: re-entering it through a
+    call chain is a guaranteed self-deadlock, never an instance-order
+    question."""
+    _, findings = _scan(
+        tmp_path,
+        {
+            "reg.py": """
+            import threading
+
+            _REG_LOCK = threading.Lock()
+
+            def register(item):
+                with _REG_LOCK:
+                    _validate(item)
+
+            def _validate(item):
+                with _REG_LOCK:
+                    return item is not None
+            """
+        },
+        only=("lockcheck",),
+    )
+    rules = _rules(findings)
+    assert "lock-self-cycle" in rules
+    assert "lock-instance-order" not in rules
+
+
+# ---------------------------------------------------------------------------
+# jaxhazard
+
+JAXMOD = """
+    import time
+
+    import jax
+
+
+    def trace_time():
+        return time.time()
+
+
+    def build_bad():
+        def kern(x, flag):
+            if flag:
+                return x * 2
+            return x + trace_time()
+        return jax.jit(kern)
+
+
+    def build_ok():
+        def kern(x, n):
+            if n > 2:
+                return x
+            if x.shape[0] > 4:
+                return x * 2
+            return x
+        return jax.jit(kern, static_argnames=("n",))
+"""
+
+
+def test_jaxhazard_value_branch_and_host_clock(tmp_path):
+    """`if` on a traced argument's value and a host clock read in a
+    helper reachable under the trace both flag P1."""
+    _, findings = _scan(tmp_path, {"jm.py": JAXMOD}, only=("jaxhazard",))
+    rules = _rules(findings)
+    assert "jax-value-branch" in rules
+    assert "jax-host-clock" in rules
+    branch = next(f for f in findings if f.rule == "jax-value-branch")
+    assert branch.severity == "P1" and "flag" in branch.detail
+    assert all(f.scope != "build_ok.kern" for f in findings)
+
+
+def test_jaxhazard_shape_and_static_args_exempt(tmp_path):
+    """Branching on .shape and on a static_argnames-pinned parameter is
+    compile-time static — zero findings for the clean builder alone."""
+    _, findings = _scan(
+        tmp_path,
+        {
+            "jm.py": """
+            import jax
+
+            def build_ok():
+                def kern(x, n):
+                    if n > 2:
+                        return x
+                    if x.shape[0] > 4:
+                        return x * 2
+                    return x
+                return jax.jit(kern, static_argnames=("n",))
+            """
+        },
+        only=("jaxhazard",),
+    )
+    assert findings == []
+
+
+def test_jaxhazard_concretize_and_unrolled_loop(tmp_path):
+    _, findings = _scan(
+        tmp_path,
+        {
+            "jm.py": """
+            import jax
+
+            def build():
+                def kern(xs, y):
+                    total = float(y)
+                    for x in xs:
+                        total = total + x
+                    return total
+                return jax.jit(kern)
+            """
+        },
+        only=("jaxhazard",),
+    )
+    rules = _rules(findings)
+    assert "jax-concretize" in rules      # float(y) on a traced arg
+    assert "jax-python-loop" in rules     # python for over a traced arg
+
+
+def test_jaxhazard_self_rebinding_concretize_flags(tmp_path):
+    """`n = int(n)` concretizes BEFORE the rebinding lands: the value
+    expression audits while `n` is still traced (regression: targets
+    used to join `rebound` first, hiding the hazard)."""
+    _, findings = _scan(
+        tmp_path,
+        {
+            "jm.py": """
+            import jax
+
+            def build():
+                def kern(x, n):
+                    n = int(n)
+                    return x * n
+                return jax.jit(kern)
+            """
+        },
+        only=("jaxhazard",),
+    )
+    assert "jax-concretize" in _rules(findings)
+
+
+def test_module_level_statements_are_walked(tmp_path):
+    """`f = jax.jit(kernel)` at module scope — the most common JAX
+    idiom — plus module-scope metric registrations and
+    `Thread(target=...)` starts all collect under the synthetic
+    `<module>` scope (regression: top-level statements were skipped,
+    so these facts were invisible to every pass)."""
+    repo, findings = _scan(
+        tmp_path,
+        {
+            "mm.py": """
+            import threading
+            import jax
+
+            def kern(x):
+                if x > 0:
+                    return x
+                return -x
+
+            fast = jax.jit(kern)
+
+            def pumper():
+                pass
+
+            t = threading.Thread(target=pumper)
+            t.start()
+            """
+        },
+        only=("jaxhazard",),
+    )
+    assert len(repo.jit_roots) == 1
+    assert any(e.kind == "thread" for e in repo.entries)
+    assert "jax-value-branch" in _rules(findings)
+
+
+# ---------------------------------------------------------------------------
+# metrics
+
+def test_metrics_convention_and_duplicates(tmp_path):
+    """Bad names and second registration sites flag; the Domain.Name
+    convention with a rendered f-string placeholder does not."""
+    _, findings = _scan(
+        tmp_path,
+        {
+            "m.py": """
+            def wire(metrics, shard):
+                metrics.counter("requests_total")
+                metrics.counter("Notary.Commits")
+                metrics.gauge(f"Notary.Shard{shard}.Depth", lambda: 0)
+
+            def wire_again(metrics):
+                metrics.counter("Notary.Commits")
+            """
+        },
+        only=("metrics",),
+    )
+    by_rule = {f.rule: f for f in findings}
+    assert by_rule["metric-name-convention"].detail == "requests_total"
+    dup = by_rule["metric-duplicate-registration"]
+    assert dup.detail == "Notary.Commits" and len(dup.evidence) == 2
+    assert len(findings) == 2   # the f-string shard gauge is clean
+
+
+# ---------------------------------------------------------------------------
+# contracts
+
+def test_contracts_pass_sweeps_installed_classes(tmp_path):
+    """The determinism audit runs over every contract class under
+    finance/ — a time.time() in verify() flags, a clean contract does
+    not. (Before this pass only attachment-carried source was audited.)"""
+    det = os.path.join(REPO, "corda_tpu", "experimental", "determinism.py")
+    dest = tmp_path / "corda_tpu" / "experimental" / "determinism.py"
+    dest.parent.mkdir(parents=True)
+    shutil.copy(det, dest)
+    (tmp_path / "corda_tpu" / "finance").mkdir()
+    (tmp_path / "corda_tpu" / "finance" / "bad.py").write_text(
+        textwrap.dedent(
+            """
+            import time
+
+            class WallClockContract:
+                def verify(self, tx):
+                    if time.time() > 0:
+                        raise ValueError("expired")
+
+            class CleanContract:
+                def verify(self, tx):
+                    for cmd in tx.commands:
+                        if cmd is None:
+                            raise ValueError("bad command")
+            """
+        )
+    )
+    _, findings = run_passes(
+        str(tmp_path), only=("contracts",), subdirs=("corda_tpu",)
+    )
+    assert findings and all(
+        f.rule == "contract-determinism" and f.severity == "P1"
+        for f in findings
+    )
+    assert all(f.scope == "WallClockContract" for f in findings)
+
+
+def test_contracts_pass_real_tree_runs():
+    """The sweep executes over the real finance/ package (and is clean
+    — installed contracts pass the same audit attachments do)."""
+    _, findings = run_passes(REPO, only=("contracts",))
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# the gate (CI wiring)
+
+def _write_justified_baseline(path, findings):
+    cli.write_baseline(str(path), findings)
+    doc = json.loads(path.read_text())
+    for row in doc["baselined"]:
+        row["justification"] = "fixture: accepted for the gate test"
+    path.write_text(json.dumps(doc))
+
+
+def test_gate_fails_on_new_inversion_passes_when_baselined(tmp_path):
+    """The acceptance arc: a fresh inversion fails the gate, a
+    justified baseline admits it, a SECOND new inversion fails again."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "pair.py").write_text(textwrap.dedent(INVERSION))
+    base = tmp_path / "LINT_BASELINE.json"
+    argv = [
+        "--root", str(tmp_path), "--paths", "pkg",
+        "--baseline", str(base), "--gate",
+    ]
+    assert cli.main(argv) == 1          # no baseline: the P0 is new
+
+    _, findings = run_passes(str(tmp_path), subdirs=("pkg",))
+    _write_justified_baseline(base, findings)
+    assert cli.main(argv) == 0          # baselined with justification
+
+    (pkg / "more.py").write_text(
+        textwrap.dedent(INVERSION).replace("Pair", "Pair2")
+    )
+    assert cli.main(argv) == 1          # a NEW inversion fails again
+
+
+def test_gate_empty_justification_does_not_suppress(tmp_path, capsys):
+    """write_baseline leaves justifications empty on purpose: a row
+    nobody wrote a reason for must not admit its finding."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "pair.py").write_text(textwrap.dedent(INVERSION))
+    base = tmp_path / "LINT_BASELINE.json"
+    _, findings = run_passes(str(tmp_path), subdirs=("pkg",))
+    cli.write_baseline(str(base), findings)   # justifications stay ""
+    rc = cli.main(
+        [
+            "--root", str(tmp_path), "--paths", "pkg",
+            "--baseline", str(base), "--gate",
+        ]
+    )
+    assert rc == 1
+    assert "no justification" in capsys.readouterr().err
+
+
+def test_gate_stale_rows_reported_not_fatal(tmp_path, capsys):
+    """A baseline row whose finding was fixed goes STALE: reported on
+    stderr so it gets pruned, but never a failure."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "clean.py").write_text("X = 1\n")
+    base = tmp_path / "LINT_BASELINE.json"
+    base.write_text(
+        json.dumps(
+            {
+                "version": 1,
+                "baselined": [
+                    {
+                        "fingerprint": "feedfeedfeedfeed",
+                        "rule": "lock-cycle",
+                        "justification": "was fixed two PRs ago",
+                    }
+                ],
+            }
+        )
+    )
+    rc = cli.main(
+        [
+            "--root", str(tmp_path), "--paths", "pkg",
+            "--baseline", str(base), "--gate",
+        ]
+    )
+    assert rc == 0
+    assert "STALE" in capsys.readouterr().err
+
+
+MIXED = INVERSION + """
+
+    def wire(metrics):
+        metrics.counter("bad_name")
+"""
+
+
+def test_only_gate_scopes_staleness_to_selected_passes(tmp_path, capsys):
+    """`--only lockcheck --gate` cannot re-find the metrics pass's
+    findings — their live baseline rows must not be called STALE (the
+    printed 'prune it' advice would break the next full gate)."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mix.py").write_text(textwrap.dedent(MIXED))
+    base = tmp_path / "LINT_BASELINE.json"
+    _, findings = run_passes(str(tmp_path), subdirs=("pkg",))
+    assert {f.pass_name for f in findings} == {"lockcheck", "metrics"}
+    _write_justified_baseline(base, findings)
+    rc = cli.main(
+        [
+            "--root", str(tmp_path), "--paths", "pkg",
+            "--baseline", str(base), "--gate", "--only", "lockcheck",
+        ]
+    )
+    assert rc == 0
+    assert "STALE" not in capsys.readouterr().err
+
+
+def test_write_baseline_merges_and_preserves_justifications(tmp_path):
+    """Re-seeding must never erase accepted history: kept findings
+    keep their hand-written justifications, a fixed finding's row is
+    dropped, and an --only run leaves other passes' rows verbatim."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mix.py").write_text(textwrap.dedent(MIXED))
+    base = tmp_path / "LINT_BASELINE.json"
+    _, findings = run_passes(str(tmp_path), subdirs=("pkg",))
+    _write_justified_baseline(base, findings)
+
+    # full re-seed: every surviving row keeps its justification
+    cli.write_baseline(str(base), findings)
+    rows = json.loads(base.read_text())["baselined"]
+    assert rows and all(
+        r["justification"] == "fixture: accepted for the gate test"
+        for r in rows
+    )
+
+    # --only lockcheck re-seed with the metrics finding "fixed" in
+    # that subset's eyes: the metric row survives untouched
+    lock_only = [f for f in findings if f.pass_name == "lockcheck"]
+    cli.write_baseline(str(base), lock_only, selected=("lockcheck",))
+    rows = json.loads(base.read_text())["baselined"]
+    assert any(r["rule"].startswith("metric-") for r in rows)
+    assert all(
+        r["justification"] == "fixture: accepted for the gate test"
+        for r in rows
+    )
+
+    # a FULL re-seed after the lock finding is fixed drops its row
+    metrics_only = [f for f in findings if f.pass_name == "metrics"]
+    cli.write_baseline(str(base), metrics_only)
+    rows = json.loads(base.read_text())["baselined"]
+    assert all(not r["rule"].startswith("lock-") for r in rows)
+
+
+def test_committed_tree_gate_is_clean_and_fast():
+    """Tier-1 CI wiring (the bench_history --gate pattern): the
+    analyzer over the committed tree finds nothing outside the
+    justified baseline — and the whole-repo run fits the < 10 s CPU
+    budget. Every baseline row must still match a live finding (no
+    stale rows ride along) and carry a written justification."""
+    t0 = time.perf_counter()
+    _, findings = run_passes(REPO)
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 10.0, f"full-repo lint took {elapsed:.1f}s (budget 10s)"
+    rows = load_baseline(os.path.join(REPO, "LINT_BASELINE.json"))
+    assert rows, "committed LINT_BASELINE.json is missing or empty"
+    new, stale, unjustified = gate(findings, rows)
+    assert unjustified == [], [r["fingerprint"] for r in unjustified]
+    assert stale == [], [r["fingerprint"] for r in stale]
+    assert new == [], "new findings:\n" + "\n".join(
+        f.render() for f in new
+    )
+
+
+def test_cli_gate_subprocess():
+    """`python -m tools.lint --gate` — the literal CI command — exits 0
+    on the committed tree."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.lint", "--gate"],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "gate clean" in proc.stdout
+
+
+def test_unknown_pass_rejected(capsys):
+    assert cli.main(["--only", "nosuchpass"]) == 2
+    assert "unknown pass" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# dot export
+
+def test_dot_export_marks_cycles(tmp_path):
+    """--format dot renders the lock graph; cycle members are red so
+    graphviz output shows the deadlock at a glance."""
+    repo, _ = _scan(tmp_path, {"pair.py": INVERSION}, only=("lockcheck",))
+    dot = lockcheck.to_dot(repo)
+    assert dot.startswith("digraph locks {")
+    assert '"Pair._a" -> "Pair._b"' in dot
+    assert '"Pair._b" -> "Pair._a"' in dot
+    assert "color=red" in dot
+
+
+def test_dot_export_cli(tmp_path, capsys):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "pair.py").write_text(textwrap.dedent(INVERSION))
+    rc = cli.main(
+        ["--root", str(tmp_path), "--paths", "pkg", "--format", "dot"]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "digraph locks" in out and "Pair._a" in out

@@ -463,3 +463,54 @@ def test_invalid_signature_gates_contract_execution():
     with pytest.raises(VerificationFailedError, match="[Ii]nvalid signature"):
         fut.result()
     assert ran == []      # the contract never executed
+
+
+def test_tick_failures_delivered_before_sends():
+    """tick() resolves typed timeout failures BEFORE performing the
+    collected redispatch sends: a fabric send that raises (journal
+    full, dead socket) must not strand a timed-out future whose nonce
+    already left the pending map — its late answer would be dropped at
+    the `entry is None` guard, so the typed error is its only exit."""
+    from corda_tpu.node.services import TestClock
+    from corda_tpu.node.verifier import (
+        RedispatchPolicy,
+        VerificationTimeoutError,
+    )
+
+    net, alice, stx, ltx = issue_and_resolve()
+    clock = TestClock()
+    svc = OutOfProcessTransactionVerifierService(
+        alice.messaging,
+        clock=clock,
+        policy=RedispatchPolicy(
+            request_timeout_micros=1_000_000,
+            attempt_timeout_micros=500_000,
+            lease_micros=60_000_000,
+        ),
+    )
+    attach_worker(net, "Alice", "worker-1")
+    net.fabric.run()
+    fut_a = svc.verify(ltx, stx)      # ages past the request timeout
+    clock.advance(600_000)
+    fut_b = svc.verify(ltx, stx)      # ages past the attempt timeout
+    clock.advance(600_000)            # a: 1.2s > 1s; b: 0.6s > 0.5s
+    # neither frame was pumped to the worker, so neither answered
+
+    class _BrokenFabric:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def send(self, *a, **kw):
+            raise RuntimeError("journal full")
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+    svc._messaging = _BrokenFabric(svc._messaging)
+    with pytest.raises(RuntimeError, match="journal full"):
+        svc.tick()                    # b's redispatch send blows up
+    # a's typed failure was already delivered
+    assert fut_a.done
+    with pytest.raises(VerificationTimeoutError):
+        fut_a.result()
+    assert not fut_b.done             # still pending, retryable

@@ -1,0 +1,33 @@
+"""Concurrency & JAX-hazard lint plane.
+
+A whole-repo AST static analyzer with five passes sharing one
+per-function fact-extraction core (tools/lint/facts.py):
+
+  lockcheck  — lock-acquisition graph across corda_tpu/: lock-order
+               inversions (potential deadlock cycles) and locks
+               reachable from more than one thread entry point.
+  blocking   — blocking work (sleep, socket/sqlite I/O, condition
+               waits, future results, verifier dispatch) performed
+               while a lock is held, severity-ranked by whether the
+               lock is pump-hot.
+  jaxhazard  — the static complement to the perf plane's runtime
+               retrace counter: host callbacks, clocks/randomness and
+               Python-level value-dependent branching inside jitted /
+               Pallas kernel bodies.
+  metrics    — every Counter/Gauge/Histogram/Meter/Timer name matches
+               the `Domain.Name` convention and each literal name has
+               exactly one registration site.
+  contracts  — the experimental/determinism.py contract audit swept
+               over every contract class under finance/ (previously
+               only attachment-carried source was audited).
+
+Findings are severity-tiered (P0 deadlock-cycle / P1 blocking-hot /
+P2 style) and diffed against the committed LINT_BASELINE.json by
+`python -m tools.lint --gate` (the bench_history --gate pattern):
+pre-existing accepted findings carry a written justification, any NEW
+finding fails CI.
+"""
+
+from .facts import RepoFacts, extract_repo  # noqa: F401
+from .findings import Finding, fingerprint  # noqa: F401
+from .cli import main, run_passes  # noqa: F401

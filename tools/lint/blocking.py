@@ -1,0 +1,245 @@
+"""blocking — blocking work performed while a lock is held.
+
+A lock span should cover state mutation, not waiting: a sleep, socket
+or sqlite round trip, condition wait, future join or verifier dispatch
+made under a lock extends every other thread's worst-case wait by the
+full blocking interval — on the pump path that is the serving p99.
+
+Matched call shapes (attribute-name based — the receiver's type is
+rarely knowable statically, so these names are chosen to be specific
+in this codebase):
+
+  sleep                                    -> "sleep"
+  .wait / .wait_for                        -> "cond-wait"  (waiting on
+      the innermost held condition itself is the condition-variable
+      contract — it RELEASES that lock — and is only flagged when
+      OTHER locks stay held across the wait)
+  .result                                  -> "future-result"
+  .join on a known Thread                  -> "thread-join"
+  .recv/.accept/.connect/.sendall/...     -> "socket-io"
+  .execute/.executescript/.commit/...     -> "sqlite-io"
+  .send                                    -> "fabric-send" (journal
+      write + bridge wake on the TCP fabric)
+  .pump                                    -> "pump"
+  .verify_batch/.verify_batch_async/
+      .device_put/.block_until_ready       -> "verifier-dispatch"
+  open(...)                                -> "file-io"
+
+Severity: P1 when any held lock is pump-hot (acquired somewhere in the
+closure of the serving loops/handlers — facts.RepoFacts.hot_locks),
+P2 otherwise. One finding per (function, callee, lock) triple.
+"""
+
+from __future__ import annotations
+
+from .facts import RepoFacts
+from .findings import P1, P2, Finding
+
+_SOCKET_ATTRS = frozenset(
+    {"recv", "recv_into", "accept", "connect", "sendall", "getaddrinfo"}
+)
+_SQLITE_ATTRS = frozenset(
+    {
+        "execute",
+        "executemany",
+        "executescript",
+        "commit",
+        "fetchall",
+        "fetchone",
+    }
+)
+_DISPATCH_ATTRS = frozenset(
+    {"verify_batch", "verify_batch_async", "device_put", "block_until_ready"}
+)
+
+
+def _classify_blocking(call, repo: RepoFacts, fn) -> str | None:
+    attr = call.attr
+    if attr == "sleep":
+        return "sleep"
+    if attr in ("wait", "wait_for"):
+        return "cond-wait"
+    if attr == "result" and call.receiver:
+        return "future-result"
+    if attr == "join":
+        # strings also .join(): only receivers known to be Threads
+        walker_threads = fn.thread_locals
+        recv = call.receiver
+        if recv in walker_threads:
+            return "thread-join"
+        if recv.startswith("self."):
+            cls = repo.class_for(fn.cls or "", fn.file)
+            if cls and recv[5:] in cls.thread_attrs:
+                return "thread-join"
+        if recv in ("t", "thread", "worker", "collector"):
+            return "thread-join"
+        return None
+    if attr in _SOCKET_ATTRS:
+        return "socket-io"
+    if attr in _SQLITE_ATTRS:
+        return "sqlite-io"
+    if attr == "send" and call.receiver:
+        return "fabric-send"
+    if attr == "pump":
+        return "pump"
+    if attr in _DISPATCH_ATTRS:
+        return "verifier-dispatch"
+    if attr == "open" and not call.receiver:
+        return "file-io"
+    return None
+
+
+def _direct_blocking_sites(repo: RepoFacts) -> dict:
+    """funckey -> [(kind, site description)] for blocking calls in the
+    function body, lock-context-free: the chain check attributes these
+    to CALLERS that hold locks. cond-wait is excluded — whether a wait
+    releases the caller's lock depends on instance identity the chain
+    cannot judge, and the direct check already covers the common
+    same-function shape."""
+    out: dict = {}
+    for key, fn in repo.functions.items():
+        rows = []
+        for call in fn.calls:
+            kind = _classify_blocking(call, repo, fn)
+            if kind is not None and kind != "cond-wait":
+                rows.append(
+                    (
+                        kind,
+                        f"{fn.file}:{call.line} {fn.qualname}: "
+                        f"{call.text}(...)",
+                    )
+                )
+        out[key] = rows
+    return out
+
+
+def _reachable_blocking(
+    repo: RepoFacts, roots: tuple, direct: dict, depth: int = 2
+) -> list:
+    """Blocking sites within `depth` call hops of `roots` (roots'
+    own bodies count as hop 1)."""
+    out = []
+    seen = set(roots)
+    frontier = list(roots)
+    for _ in range(depth):
+        nxt = []
+        for k in frontier:
+            out.extend(direct.get(k, ()))
+            for e in repo.callgraph.get(k, ()):
+                if e not in seen:
+                    seen.add(e)
+                    nxt.append(e)
+        frontier = nxt
+    return out
+
+
+def run(repo: RepoFacts) -> list[Finding]:
+    findings: list[Finding] = []
+    seen: set[tuple] = set()
+    direct = _direct_blocking_sites(repo)
+    for fn in repo.functions.values():
+        mod = repo.modules[fn.file]
+        for call in fn.calls:
+            if not call.held:
+                continue
+            kind = _classify_blocking(call, repo, fn)
+            if kind is None:
+                # an extract-method refactor must not defeat the pass:
+                # follow the call one resolution step into the repo and
+                # flag blocking work performed there (or one hop below)
+                # while this site's locks stay held
+                roots = repo.resolve_ref(call.ref, mod, fn.cls)
+                if not roots:
+                    continue
+                for bkind, site in _reachable_blocking(
+                    repo, roots, direct
+                ):
+                    lock_ids = tuple(
+                        sorted({h.lock_id for h in call.held})
+                    )
+                    key = (fn.key, "chain", bkind, lock_ids, call.text)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    hot = [
+                        h.lock_id
+                        for h in call.held
+                        if h.lock_id in repo.hot_locks
+                    ]
+                    findings.append(
+                        Finding(
+                            "blocking",
+                            f"blocking-{bkind}",
+                            P1 if hot else P2,
+                            fn.file,
+                            call.line,
+                            fn.qualname,
+                            f"chain:{bkind}|{call.text}|"
+                            f"{'+'.join(lock_ids)}",
+                            f"call `{call.text}(...)` while holding "
+                            + ", ".join(
+                                f"{h.receiver} ({h.lock_id})"
+                                for h in call.held
+                            )
+                            + f" reaches {bkind} work"
+                            + (
+                                f" — pump-hot: "
+                                f"{', '.join(sorted(set(hot)))}"
+                                if hot
+                                else ""
+                            ),
+                            [site],
+                        )
+                    )
+                continue
+            held = list(call.held)
+            if kind == "cond-wait":
+                # waiting on the held condition itself releases it —
+                # that is the pattern, not a hazard. Only locks HELD
+                # ACROSS the wait count. Match exactly (the held
+                # receiver, or receiver + the lock's attribute name):
+                # a bare prefix match would strip `self._lock` from a
+                # `self._cond.wait()` and pin the hazard on the wrong
+                # lock when both are held.
+                recv_lock = None
+                for h in held:
+                    lock_attr = h.lock_id.rsplit(".", 1)[-1]
+                    if call.receiver in (
+                        h.receiver,
+                        f"{h.receiver}.{lock_attr}",
+                    ):
+                        recv_lock = h
+                        break
+                if recv_lock is not None:
+                    held = [h for h in held if h != recv_lock]
+                if not held:
+                    continue
+            lock_ids = tuple(sorted({h.lock_id for h in held}))
+            key = (fn.key, kind, lock_ids, call.text)
+            if key in seen:
+                continue
+            seen.add(key)
+            hot = [h.lock_id for h in held if h.lock_id in repo.hot_locks]
+            severity = P1 if hot else P2
+            lock_desc = ", ".join(
+                f"{h.receiver} ({h.lock_id})" for h in held
+            )
+            findings.append(
+                Finding(
+                    "blocking",
+                    f"blocking-{kind}",
+                    severity,
+                    fn.file,
+                    call.line,
+                    fn.qualname,
+                    f"{kind}|{call.text}|{'+'.join(lock_ids)}",
+                    f"{kind} call `{call.text}(...)` while holding "
+                    f"{lock_desc}"
+                    + (
+                        f" — pump-hot: {', '.join(sorted(set(hot)))}"
+                        if hot
+                        else ""
+                    ),
+                )
+            )
+    return findings

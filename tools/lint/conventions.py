@@ -1,0 +1,183 @@
+"""metrics + contracts passes.
+
+metrics — every Counter/Gauge/Histogram/Meter/Timer registration name
+matches the `Domain.Name` convention (dotted, CamelCase domain root,
+at least two segments; `<>` marks a dynamic piece rendered from an
+f-string or concatenation) and every fully-literal name has exactly
+one registration site (MetricRegistry.get_or_create makes a duplicate
+benign at runtime, which is exactly why a second owner site goes
+unnoticed until two subsystems fight over one series).
+
+contracts — the experimental/determinism.py static audit swept over
+every contract class under finance/ (any class defining `verify`, plus
+anything passed to `register_contract`). Until this pass, only
+attachment-carried source was audited (core/sandbox.py); installed
+contracts were never statically checked.
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib.util
+import os
+import re
+import sys
+
+from .facts import RepoFacts
+from .findings import P1, P2, Finding
+
+# Domain.Name: CamelCase root segment, then dotted segments that may
+# carry digits, underscores or a rendered-dynamic `<>` placeholder
+_NAME_RE = re.compile(
+    r"^[A-Z][A-Za-z0-9]*(\.(<>|[A-Za-z0-9_]+(<>[A-Za-z0-9_]*)*))+$"
+)
+
+
+def run_metrics(repo: RepoFacts) -> list[Finding]:
+    findings: list[Finding] = []
+    sites: dict[str, list] = {}
+    for reg in repo.metric_regs:
+        if reg.name is None:
+            findings.append(
+                Finding(
+                    "metrics",
+                    "metric-dynamic-name",
+                    P2,
+                    reg.file,
+                    reg.line,
+                    reg.scope,
+                    f"{reg.method}@{reg.scope}",
+                    f"{reg.method}() name is not statically renderable "
+                    "— convention cannot be checked",
+                )
+            )
+            continue
+        if not _NAME_RE.match(reg.name):
+            findings.append(
+                Finding(
+                    "metrics",
+                    "metric-name-convention",
+                    P2,
+                    reg.file,
+                    reg.line,
+                    reg.scope,
+                    reg.name,
+                    f"metric name {reg.name!r} does not match the "
+                    "`Domain.Name` convention (dotted, CamelCase root)",
+                )
+            )
+        if reg.literal:
+            sites.setdefault(reg.name, []).append(reg)
+    for name, regs in sorted(sites.items()):
+        locations = {(r.file, r.line) for r in regs}
+        if len(locations) <= 1:
+            continue
+        first = regs[0]
+        findings.append(
+            Finding(
+                "metrics",
+                "metric-duplicate-registration",
+                P2,
+                first.file,
+                first.line,
+                "",
+                name,
+                f"metric {name!r} is registered from "
+                f"{len(locations)} sites — one series, several owners",
+                [f"{f}:{line}" for f, line in sorted(locations)],
+            )
+        )
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# contracts
+
+
+def _load_determinism(root: str):
+    """Import experimental/determinism.py by file path so the audit
+    runs without importing the corda_tpu package (whose __init__ chain
+    can pull jax — the lint gate must stay dependency-free). Returns
+    None when the scan root does not carry the module (fixture trees):
+    the contracts pass has nothing to audit with, so it yields no
+    findings rather than crashing every other pass's run."""
+    path = os.path.join(
+        root, "corda_tpu", "experimental", "determinism.py"
+    )
+    if not os.path.exists(path):
+        return None
+    spec = importlib.util.spec_from_file_location(
+        "_lint_determinism", path
+    )
+    module = importlib.util.module_from_spec(spec)
+    # dataclasses resolves string annotations through sys.modules —
+    # the module must be registered before exec
+    sys.modules[spec.name] = module
+    spec.loader.exec_module(module)
+    return module
+
+
+def _registered_names(tree: ast.AST) -> set:
+    """Class names passed to register_contract(...) anywhere in the
+    module (either `register_contract(n, Cls())` or `Cls` itself)."""
+    out = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        name = fn.attr if isinstance(fn, ast.Attribute) else getattr(
+            fn, "id", ""
+        )
+        if name != "register_contract":
+            continue
+        for arg in node.args:
+            if isinstance(arg, ast.Call) and isinstance(
+                arg.func, ast.Name
+            ):
+                out.add(arg.func.id)
+            elif isinstance(arg, ast.Name):
+                out.add(arg.id)
+    return out
+
+
+def run_contracts(
+    repo: RepoFacts, subdir: str = "corda_tpu/finance"
+) -> list[Finding]:
+    det = _load_determinism(repo.root)
+    if det is None:
+        return []
+    findings: list[Finding] = []
+    for relpath, mod in sorted(repo.modules.items()):
+        if not relpath.startswith(subdir):
+            continue
+        registered = _registered_names(mod.tree)
+        for node in mod.tree.body:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            has_verify = any(
+                isinstance(sub, ast.FunctionDef) and sub.name == "verify"
+                for sub in node.body
+            )
+            if not has_verify and node.name not in registered:
+                continue
+            segment = ast.get_source_segment(mod.source, node)
+            if segment is None:
+                continue
+            try:
+                violations = det.audit_source(segment)
+            except SyntaxError:
+                continue
+            for v in violations:
+                findings.append(
+                    Finding(
+                        "contracts",
+                        "contract-determinism",
+                        P1,
+                        relpath,
+                        node.lineno + v.line - 1,
+                        node.name,
+                        f"{node.name}:{v.message}",
+                        f"contract class {node.name}: {v.message}",
+                    )
+                )
+    return findings

@@ -1,0 +1,1062 @@
+"""Per-function fact extraction — the core every lint pass shares.
+
+One AST walk over every ``.py`` file under the scan root collects, per
+function: which locks it acquires (``with self._lock:`` blocks and
+bare ``.acquire()`` spans, with the already-held stack at each
+acquisition), every call it makes (with the held-lock stack at the
+call site), the thread entry points it creates
+(``threading.Thread(target=...)``), the message-handler callbacks it
+registers (``add_handler``), the metric names it registers, and the
+``jax.jit``/``pallas_call`` roots it builds. The passes
+(lockcheck/blocking/jaxhazard/metrics) are pure consumers of this
+table — none of them re-walk the tree.
+
+Identity model: a lock is named ``Class.attr`` when
+``self.attr = threading.Lock()`` appears in exactly one scanned class
+(``module.NAME`` for module-level locks, ``?.attr`` when several
+classes define the same lock attribute and the receiver's class cannot
+be inferred statically). Different INSTANCES of the same class share a
+static lock id — nested acquisition of the same id through two
+different receivers is reported as an instance-order hazard, not a
+self-deadlock (see lockcheck.py).
+
+Everything here is best-effort static resolution: ``self.m()`` binds
+through the enclosing class (then its repo base classes), bare names
+bind to module/local functions and ``from``-imports, ``alias.m()``
+binds through the module import table, and ``obj.m()`` binds only when
+exactly one scanned class defines ``m``. Unresolvable calls are kept
+(the blocking pass matches on attribute names) but grow no call-graph
+edges.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Optional
+
+LOCK_FACTORIES = {
+    "Lock": "Lock",
+    "RLock": "RLock",
+    "Condition": "Condition",
+    "Semaphore": "Semaphore",
+    "BoundedSemaphore": "Semaphore",
+}
+
+# receiver-attr shapes worth treating as a lock even when no scanned
+# class defines them (third-party objects)
+_LOCKISH_ATTR = re.compile(r"(^|_)(lock|cond|condition|mutex|sem)s?$", re.I)
+
+METRIC_METHODS = frozenset(
+    {"counter", "meter", "timer", "histogram", "gauge"}
+)
+
+# functions whose enclosing loop IS the serving hot path: locks
+# reachable from these (or from fabric-handler callbacks) rank P1 when
+# blocked under, everything else P2
+_PUMPISH = re.compile(
+    r"^(tick|pump|flush|drain|run|_tick\w*|_pump\w*|\w*_worker|_run\w*)$"
+)
+
+# attribute names that collide with stdlib container/IO/concurrency
+# methods: a repo class defining one of these must not capture every
+# `obj.<name>()` call in the tree through the unique-method fallback
+# (e.g. `self._conn.execute(...)` is sqlite, not NodeDatabase.execute)
+_STDLIB_METHOD_NOISE = frozenset(
+    {
+        "execute", "executemany", "executescript", "commit", "rollback",
+        "fetchall", "fetchone", "fetchmany", "close", "open", "read",
+        "write", "seek", "flush", "recv", "accept", "connect", "sendall",
+        "send", "bind", "listen", "join", "start", "stop", "run", "wait",
+        "acquire", "release", "notify", "notify_all", "set", "clear",
+        "get", "put", "pop", "popleft", "append", "appendleft", "remove",
+        "insert", "extend", "add", "discard", "update", "copy", "items",
+        "keys", "values", "sort", "index", "count", "result", "done",
+        "cancel", "encode", "decode", "strip", "split", "format",
+        "replace",
+    }
+)
+
+
+@dataclass(frozen=True)
+class Held:
+    lock_id: str
+    receiver: str            # source text, e.g. "self._lock", "shard.cond"
+
+
+@dataclass
+class Acquire:
+    lock_id: str
+    kind: str                # Lock | RLock | Condition | Semaphore
+    line: int
+    receiver: str
+    held: tuple[Held, ...]   # outer -> inner at this acquisition
+    via: str                 # "with" | "acquire"
+
+
+@dataclass
+class CallSite:
+    text: str                # dotted source text, best effort
+    attr: str                # last segment ("sleep", "execute", ...)
+    receiver: str            # text left of the last segment ("" = bare)
+    line: int
+    held: tuple[Held, ...]
+    args: int = 0
+    ref: Optional[tuple] = None   # classified callee for resolution
+
+
+@dataclass
+class MetricReg:
+    method: str              # counter | meter | timer | histogram | gauge
+    name: Optional[str]      # rendered name ("<>" marks dynamic parts)
+    literal: bool            # True when the name is one literal string
+    file: str
+    line: int
+    scope: str
+
+
+@dataclass
+class JitRoot:
+    kind: str                # "jit" | "pallas"
+    target: Optional[ast.expr]
+    static_names: tuple[str, ...]
+    static_nums: tuple[int, ...]
+    line: int
+    scope: str
+    module: str              # relpath of the defining module
+
+
+@dataclass
+class FunctionFacts:
+    key: str                 # "relpath::Qual.name"
+    qualname: str
+    file: str
+    line: int
+    cls: Optional[str]
+    params: tuple[str, ...]
+    node: ast.AST
+    acquires: list[Acquire] = field(default_factory=list)
+    calls: list[CallSite] = field(default_factory=list)
+    thread_locals: set = field(default_factory=set)
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    file: str
+    bases: tuple[str, ...]
+    methods: dict[str, str] = field(default_factory=dict)  # name -> funckey
+    lock_attrs: dict[str, str] = field(default_factory=dict)  # attr -> kind
+    thread_attrs: set[str] = field(default_factory=set)
+
+
+@dataclass
+class ModuleFacts:
+    relpath: str
+    source: str
+    tree: ast.AST
+    # alias -> relpath of a scanned module ("from . import x as y")
+    mod_imports: dict[str, str] = field(default_factory=dict)
+    # alias -> (relpath, symbol) ("from .x import f as g")
+    sym_imports: dict[str, tuple[str, str]] = field(default_factory=dict)
+    # alias -> external dotted name ("import jax.numpy as jnp")
+    ext_imports: dict[str, str] = field(default_factory=dict)
+    functions: dict[str, str] = field(default_factory=dict)  # name -> key
+    module_locks: dict[str, tuple[str, str]] = field(
+        default_factory=dict
+    )  # name -> (lock_id, kind)
+    str_constants: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class Entry:
+    key: str                 # "thread:<funckey>" etc.
+    kind: str                # thread | handler | web | main
+    func: str                # funckey
+    group: str               # thread-identity bucket for sharing checks
+    file: str
+    line: int
+
+
+@dataclass
+class RepoFacts:
+    root: str
+    modules_paths: set = field(default_factory=set)
+    modules: dict[str, ModuleFacts] = field(default_factory=dict)
+    functions: dict[str, FunctionFacts] = field(default_factory=dict)
+    # keyed "relpath::Name" — two same-named classes in different
+    # modules are DIFFERENT classes and must never merge methods/locks
+    classes: dict[str, ClassInfo] = field(default_factory=dict)
+    class_index: dict[str, list] = field(default_factory=dict)  # name -> keys
+    # lock_id -> (kind, file, line)
+    locks: dict[str, tuple[str, str, int]] = field(default_factory=dict)
+    entries: list[Entry] = field(default_factory=list)
+    metric_regs: list[MetricReg] = field(default_factory=list)
+    jit_roots: list[JitRoot] = field(default_factory=list)
+    # attr -> {(class, kind)} across every scanned class
+    lock_attr_index: dict[str, set] = field(default_factory=dict)
+    # method name -> {funckey} across every scanned class
+    method_index: dict[str, set] = field(default_factory=dict)
+    # ---- derived (computed by finalize) --------------------------------
+    callgraph: dict[str, set] = field(default_factory=dict)
+    acq_trans: dict[str, set] = field(default_factory=dict)
+    reachable_groups: dict[str, set] = field(default_factory=dict)
+    hot_funcs: set = field(default_factory=set)
+    hot_locks: set = field(default_factory=set)
+    # lock ids defined at module level: singletons by construction, so
+    # re-entry through ANY receiver text is a self-deadlock, never an
+    # instance-order question
+    module_level_locks: set = field(default_factory=set)
+
+    # -- resolution helpers --------------------------------------------------
+
+    def resolve_ref(
+        self, ref: Optional[tuple], mod: ModuleFacts, cls: Optional[str]
+    ) -> tuple[str, ...]:
+        """Candidate function keys for a classified callee reference."""
+        if ref is None:
+            return ()
+        kind = ref[0]
+        if kind == "key":
+            return (ref[1],)
+        if kind == "name":
+            name = ref[1]
+            if name in mod.functions:
+                return (mod.functions[name],)
+            if name in mod.sym_imports:
+                relpath, sym = mod.sym_imports[name]
+                target = self.modules.get(relpath)
+                if target and sym in target.functions:
+                    return (target.functions[sym],)
+                # imported class: constructing it runs __init__
+                ckey = f"{sym}.__init__"
+                hit = self.functions.get(f"{relpath}::{ckey}")
+                if hit:
+                    return (hit.key,)
+            return ()
+        if kind == "self":
+            if cls:
+                hit = self._method_on(cls, ref[1], mod.relpath)
+                if hit:
+                    return (hit,)
+            return self._unique_method(ref[1])
+        if kind == "attr":
+            receiver, name = ref[1], ref[2]
+            if receiver in mod.mod_imports:
+                target = self.modules.get(mod.mod_imports[receiver])
+                if target and name in target.functions:
+                    return (target.functions[name],)
+                return ()
+            if receiver in mod.ext_imports:
+                return ()          # external module: no repo edge
+            return self._unique_method(name)
+        return ()
+
+    def class_for(self, name: str, relpath: str) -> Optional[ClassInfo]:
+        """The class `name` as seen from `relpath`: the module's own
+        definition wins; otherwise a repo-wide unique name resolves
+        (cross-module base classes); a colliding name with no local
+        definition is ambiguous and resolves to nothing — guessing
+        would merge unrelated classes' methods and locks."""
+        info = self.classes.get(f"{relpath}::{name}")
+        if info is not None:
+            return info
+        keys = self.class_index.get(name, ())
+        return self.classes[keys[0]] if len(keys) == 1 else None
+
+    def _method_on(
+        self, cls: str, name: str, relpath: str
+    ) -> Optional[str]:
+        seen = set()
+        stack = [(cls, relpath)]
+        while stack:
+            cname, where = stack.pop()
+            info = self.class_for(cname, where)
+            if info is None or id(info) in seen:
+                continue
+            seen.add(id(info))
+            if name in info.methods:
+                return info.methods[name]
+            stack.extend((b, info.file) for b in info.bases)
+        return None
+
+    def _unique_method(self, name: str) -> tuple[str, ...]:
+        if name in _STDLIB_METHOD_NOISE or name.startswith("__"):
+            return ()
+        keys = self.method_index.get(name, ())
+        return tuple(keys) if len(keys) == 1 else ()
+
+    # -- finalization --------------------------------------------------------
+
+    def finalize(self) -> None:
+        """Build the call graph, transitive lock-acquisition sets,
+        entry-point reachability and the pump-hot partition."""
+        self.module_level_locks = {
+            lock_id
+            for m in self.modules.values()
+            for lock_id, _kind in m.module_locks.values()
+        }
+        for fn in self.functions.values():
+            mod = self.modules[fn.file]
+            edges = set()
+            for call in fn.calls:
+                for key in self.resolve_ref(call.ref, mod, fn.cls):
+                    if key != fn.key:
+                        edges.add(key)
+            self.callgraph[fn.key] = edges
+        # transitive acquires: fixpoint over the (cyclic) call graph
+        acq = {
+            k: {a.lock_id for a in f.acquires}
+            for k, f in self.functions.items()
+        }
+        changed = True
+        while changed:
+            changed = False
+            for k, edges in self.callgraph.items():
+                cur = acq[k]
+                before = len(cur)
+                for e in edges:
+                    cur |= acq.get(e, set())
+                if len(cur) != before:
+                    changed = True
+        self.acq_trans = acq
+        # entry reachability: which thread-identity groups reach which
+        # functions
+        groups: dict[str, set] = {k: set() for k in self.functions}
+        for entry in self.entries:
+            for key in self._closure({entry.func}):
+                groups.setdefault(key, set()).add(entry.group)
+        self.reachable_groups = groups
+        # pump-hot: serving-loop functions + fabric handlers, closed
+        # over the call graph
+        roots = {
+            f.key
+            for f in self.functions.values()
+            if _PUMPISH.match(f.qualname.rsplit(".", 1)[-1])
+        }
+        roots |= {
+            e.func for e in self.entries if e.kind in ("handler", "thread")
+        }
+        self.hot_funcs = self._closure(roots)
+        self.hot_locks = set()
+        for k in self.hot_funcs:
+            fn = self.functions.get(k)
+            if fn is not None:
+                self.hot_locks |= {a.lock_id for a in fn.acquires}
+
+    def _closure(self, roots: set) -> set:
+        seen = set(roots)
+        stack = list(roots)
+        while stack:
+            k = stack.pop()
+            for e in self.callgraph.get(k, ()):
+                if e not in seen:
+                    seen.add(e)
+                    stack.append(e)
+        return seen
+
+
+# ---------------------------------------------------------------------------
+# extraction
+
+
+def _unparse(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # noqa: BLE001 - display-only text
+        return "<expr>"
+
+
+def _call_factory_kind(node: ast.expr) -> Optional[str]:
+    """'Lock' for threading.Lock() / Lock(), None otherwise."""
+    if not isinstance(node, ast.Call):
+        return None
+    fn = node.func
+    if isinstance(fn, ast.Attribute) and fn.attr in LOCK_FACTORIES:
+        if isinstance(fn.value, ast.Name) and fn.value.id in (
+            "threading",
+            "multiprocessing",
+        ):
+            return LOCK_FACTORIES[fn.attr]
+    if isinstance(fn, ast.Name) and fn.id in LOCK_FACTORIES:
+        return LOCK_FACTORIES[fn.id]
+    return None
+
+
+def _is_thread_ctor(node: ast.expr) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    fn = node.func
+    if isinstance(fn, ast.Attribute) and fn.attr == "Thread":
+        return True
+    return isinstance(fn, ast.Name) and fn.id == "Thread"
+
+
+class _ModuleScanner(ast.NodeVisitor):
+    """First pass: imports, class skeletons, lock/thread attribute
+    definitions, module-level locks and function tables."""
+
+    def __init__(self, repo: RepoFacts, mod: ModuleFacts):
+        self.repo = repo
+        self.mod = mod
+        self._cls_stack: list[ClassInfo] = []
+        self._fn_depth = 0
+
+    # imports ---------------------------------------------------------------
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            name = alias.asname or alias.name.split(".")[0]
+            relpath = self._module_to_relpath(alias.name, level=0)
+            if relpath:
+                self.mod.mod_imports[alias.asname or alias.name] = relpath
+            else:
+                self.mod.ext_imports[name] = alias.name
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        base = self._module_to_relpath(node.module or "", node.level)
+        for alias in node.names:
+            name = alias.asname or alias.name
+            if base and base.endswith("/"):
+                # "from . import x" / "from ..pkg import mod"
+                child = base + alias.name.replace(".", "/")
+                if child + ".py" in self.repo.modules_paths:
+                    self.mod.mod_imports[name] = child + ".py"
+                    continue
+                if child + "/__init__.py" in self.repo.modules_paths:
+                    self.mod.mod_imports[name] = child + "/__init__.py"
+                    continue
+                self.mod.ext_imports[name] = alias.name
+            elif base:
+                self.mod.sym_imports[name] = (base, alias.name)
+            else:
+                dotted = ("." * node.level) + (node.module or "")
+                self.mod.ext_imports[name] = f"{dotted}.{alias.name}"
+
+    def _module_to_relpath(self, dotted: str, level: int) -> Optional[str]:
+        """Resolve an import to a scanned file ('x/y.py'), a scanned
+        package dir ('x/y/'), or None (external)."""
+        if level:
+            parts = self.mod.relpath.split("/")[:-1]
+            for _ in range(level - 1):
+                if not parts:
+                    return None
+                parts = parts[:-1]
+            parts += [p for p in dotted.split(".") if p]
+        else:
+            parts = dotted.split(".")
+        path = "/".join(parts)
+        if path + ".py" in self.repo.modules_paths:
+            return path + ".py"
+        if path + "/__init__.py" in self.repo.modules_paths:
+            return path + "/"
+        # package dir with no scanned __init__ still resolves children
+        if any(
+            p.startswith(path + "/") for p in self.repo.modules_paths
+        ):
+            return path + "/"
+        return None
+
+    # classes / functions ---------------------------------------------------
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        bases = tuple(
+            b.id if isinstance(b, ast.Name) else getattr(b, "attr", "")
+            for b in node.bases
+        )
+        key = f"{self.mod.relpath}::{node.name}"
+        info = self.repo.classes.get(key)
+        if info is None:
+            info = ClassInfo(node.name, self.mod.relpath, bases)
+            self.repo.classes[key] = info
+            self.repo.class_index.setdefault(node.name, []).append(key)
+        self._cls_stack.append(info)
+        self.generic_visit(node)
+        self._cls_stack.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._scan_fn(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._scan_fn(node)
+
+    def _scan_fn(self, node) -> None:
+        cls = self._cls_stack[-1] if self._cls_stack else None
+        if cls is not None and self._fn_depth == 0:
+            key = f"{self.mod.relpath}::{cls.name}.{node.name}"
+            cls.methods.setdefault(node.name, key)
+            self.repo.method_index.setdefault(node.name, set()).add(key)
+        elif cls is None and self._fn_depth == 0:
+            key = f"{self.mod.relpath}::{node.name}"
+            self.mod.functions.setdefault(node.name, key)
+        # lock/thread attribute definitions live inside method bodies
+        self._fn_depth += 1
+        self.generic_visit(node)
+        self._fn_depth -= 1
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        kind = _call_factory_kind(node.value)
+        cls = self._cls_stack[-1] if self._cls_stack else None
+        for tgt in node.targets:
+            if (
+                kind
+                and isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self"
+                and cls is not None
+            ):
+                cls.lock_attrs[tgt.attr] = kind
+                self.repo.lock_attr_index.setdefault(tgt.attr, set()).add(
+                    (cls.name, kind)
+                )
+                self.repo.locks.setdefault(
+                    f"{cls.name}.{tgt.attr}",
+                    (kind, self.mod.relpath, node.lineno),
+                )
+            elif (
+                kind
+                and isinstance(tgt, ast.Name)
+                and not self._cls_stack
+                and self._fn_depth == 0
+            ):
+                stem = os.path.splitext(
+                    os.path.basename(self.mod.relpath)
+                )[0]
+                lock_id = f"{stem}.{tgt.id}"
+                self.mod.module_locks[tgt.id] = (lock_id, kind)
+                self.repo.locks.setdefault(
+                    lock_id, (kind, self.mod.relpath, node.lineno)
+                )
+            elif (
+                _is_thread_ctor(node.value)
+                and isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self"
+                and cls is not None
+            ):
+                cls.thread_attrs.add(tgt.attr)
+        self.generic_visit(node)
+
+
+class _FunctionWalker:
+    """Second pass, per function: held-lock tracking + call/entry/
+    metric/jit-root events."""
+
+    def __init__(
+        self, repo: RepoFacts, mod: ModuleFacts, facts: FunctionFacts
+    ):
+        self.repo = repo
+        self.mod = mod
+        self.facts = facts
+        self.held: list[Held] = []
+        self.local_locks: dict[str, str] = {}   # local name -> kind
+        self.local_threads: set[str] = set()
+        self.local_funcs: dict[str, str] = {}   # nested defs: name -> key
+
+    # -- lock identity -------------------------------------------------------
+
+    def _lock_of(self, expr: ast.expr) -> Optional[tuple[str, str, str]]:
+        """(lock_id, kind, receiver_text) when `expr` looks like a
+        lock, else None."""
+        if isinstance(expr, ast.Name):
+            if expr.id in self.local_locks:
+                return (
+                    f"{self.facts.qualname}.<{expr.id}>",
+                    self.local_locks[expr.id],
+                    expr.id,
+                )
+            if expr.id in self.mod.module_locks:
+                lock_id, kind = self.mod.module_locks[expr.id]
+                return (lock_id, kind, expr.id)
+            return None
+        if not isinstance(expr, ast.Attribute):
+            return None
+        attr = expr.attr
+        owners = self.repo.lock_attr_index.get(attr, set())
+        receiver = _unparse(expr.value)
+        if (
+            isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+            and self.facts.cls
+        ):
+            # the attribute may be defined by a repo base class; walk
+            # module-scoped so a same-named class elsewhere never leaks
+            # its locks in
+            seen, stack = set(), [(self.facts.cls, self.facts.file)]
+            while stack:
+                cname, where = stack.pop()
+                ci = self.repo.class_for(cname, where)
+                if ci is None or id(ci) in seen:
+                    continue
+                seen.add(id(ci))
+                if attr in ci.lock_attrs:
+                    return (
+                        f"{ci.name}.{attr}",
+                        ci.lock_attrs[attr],
+                        receiver,
+                    )
+                stack.extend((b, ci.file) for b in ci.bases)
+        if len(owners) == 1:
+            cls_name, kind = next(iter(owners))
+            return (f"{cls_name}.{attr}", kind, receiver)
+        if len(owners) > 1:
+            kinds = {k for _, k in owners}
+            kind = kinds.pop() if len(kinds) == 1 else "Lock"
+            return (f"?.{attr}", kind, receiver)
+        if _LOCKISH_ATTR.search(attr):
+            kind = "Condition" if "cond" in attr.lower() else "Lock"
+            return (f"?.{attr}", kind, receiver)
+        return None
+
+    def _record_acquire(self, lock, line: int, via: str) -> None:
+        lock_id, kind, receiver = lock
+        self.repo.locks.setdefault(lock_id, (kind, self.facts.file, line))
+        self.facts.acquires.append(
+            Acquire(lock_id, kind, line, receiver, tuple(self.held), via)
+        )
+
+    # -- statements ----------------------------------------------------------
+
+    def walk_body(self, stmts: list) -> None:
+        acquired_here: list[Held] = []
+        for st in stmts:
+            self._stmt(st, acquired_here)
+        for h in acquired_here:
+            if h in self.held:
+                self.held.remove(h)
+
+    def _stmt(self, st: ast.stmt, acquired_here: list) -> None:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            key = f"{self.facts.key}.{st.name}"
+            self.local_funcs[st.name] = key
+            walk_function(
+                self.repo,
+                self.mod,
+                st,
+                key,
+                f"{self.facts.qualname}.{st.name}",
+                self.facts.cls,
+            )
+            return
+        if isinstance(st, ast.ClassDef):
+            # nested handler classes (webserver): scan their methods
+            for sub in st.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    key = f"{self.facts.key}.{st.name}.{sub.name}"
+                    walk_function(
+                        self.repo,
+                        self.mod,
+                        sub,
+                        key,
+                        f"{self.facts.qualname}.{st.name}.{sub.name}",
+                        st.name,
+                    )
+                    self.repo.method_index.setdefault(sub.name, set()).add(
+                        key
+                    )
+                    ckey = f"{self.mod.relpath}::{st.name}"
+                    info = self.repo.classes.get(ckey)
+                    if info is None:
+                        info = ClassInfo(
+                            st.name,
+                            self.mod.relpath,
+                            tuple(
+                                b.id
+                                if isinstance(b, ast.Name)
+                                else getattr(b, "attr", "")
+                                for b in st.bases
+                            ),
+                        )
+                        self.repo.classes[ckey] = info
+                        self.repo.class_index.setdefault(
+                            st.name, []
+                        ).append(ckey)
+                    info.methods.setdefault(sub.name, key)
+                    _maybe_web_entry(self.repo, info, sub, key)
+            return
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            pushed: list[Held] = []
+            for item in st.items:
+                self._expr(item.context_expr)
+                lock = self._lock_of(item.context_expr)
+                if lock is not None:
+                    self._record_acquire(lock, st.lineno, "with")
+                    h = Held(lock[0], lock[2])
+                    self.held.append(h)
+                    pushed.append(h)
+            self.walk_body(st.body)
+            for h in pushed:
+                self.held.remove(h)
+            return
+        if isinstance(st, ast.Assign):
+            self._expr(st.value)
+            kind = _call_factory_kind(st.value)
+            for tgt in st.targets:
+                if kind and isinstance(tgt, ast.Name):
+                    self.local_locks[tgt.id] = kind
+                elif _is_thread_ctor(st.value) and isinstance(tgt, ast.Name):
+                    self.local_threads.add(tgt.id)
+                    self.facts.thread_locals.add(tgt.id)
+            return
+        if isinstance(st, ast.Expr):
+            call = st.value
+            if isinstance(call, ast.Call) and isinstance(
+                call.func, ast.Attribute
+            ):
+                lock = self._lock_of(call.func.value)
+                if lock is not None and call.func.attr == "acquire":
+                    self._record_acquire(lock, st.lineno, "acquire")
+                    h = Held(lock[0], lock[2])
+                    self.held.append(h)
+                    acquired_here.append(h)
+                    return
+                if lock is not None and call.func.attr == "release":
+                    h = Held(lock[0], lock[2])
+                    if h in acquired_here:
+                        acquired_here.remove(h)
+                    if h in self.held:
+                        self.held.remove(h)
+                    return
+            self._expr(st.value)
+            return
+        # generic statement: visit contained expressions, recurse into
+        # bodies with branch-scoped acquire tracking
+        for fname, value in ast.iter_fields(st):
+            if fname in ("body", "orelse", "finalbody"):
+                self.walk_body(value)
+            elif fname == "handlers":
+                for handler in value:
+                    self.walk_body(handler.body)
+            elif isinstance(value, ast.expr):
+                self._expr(value)
+            elif isinstance(value, list):
+                for v in value:
+                    if isinstance(v, ast.expr):
+                        self._expr(v)
+
+    # -- expressions ---------------------------------------------------------
+
+    def _expr(self, node: Optional[ast.expr]) -> None:
+        if node is None:
+            return
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                self._call(sub)
+            elif isinstance(sub, ast.Lambda):
+                pass   # body visited by ast.walk; held context kept —
+                #        deferred-execution misattribution is accepted
+                #        (over-approximation, never under)
+
+    def _call(self, node: ast.Call) -> None:
+        fn = node.func
+        text = _unparse(fn)
+        if isinstance(fn, ast.Attribute):
+            attr = fn.attr
+            receiver = _unparse(fn.value)
+        elif isinstance(fn, ast.Name):
+            attr = fn.id
+            receiver = ""
+        else:
+            attr = text.rsplit(".", 1)[-1]
+            receiver = ""
+        ref = self._classify(fn)
+        self.facts.calls.append(
+            CallSite(
+                text,
+                attr,
+                receiver,
+                node.lineno,
+                tuple(self.held),
+                len(node.args),
+                ref,
+            )
+        )
+        # thread entry points
+        if _is_thread_ctor(node):
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    for key in self._resolve_fn_expr(kw.value):
+                        self.repo.entries.append(
+                            Entry(
+                                f"thread:{key}",
+                                "thread",
+                                key,
+                                f"thread:{key}",
+                                self.facts.file,
+                                node.lineno,
+                            )
+                        )
+        # fabric handler registrations (pump-thread callbacks)
+        if attr in ("add_handler",) and len(node.args) >= 2:
+            for key in self._resolve_fn_expr(node.args[1]):
+                self.repo.entries.append(
+                    Entry(
+                        f"handler:{key}",
+                        "handler",
+                        key,
+                        "pump",
+                        self.facts.file,
+                        node.lineno,
+                    )
+                )
+        # metric registrations
+        if attr in METRIC_METHODS and node.args:
+            name, literal = _metric_name(node.args[0], self.mod)
+            self.repo.metric_regs.append(
+                MetricReg(
+                    attr,
+                    name,
+                    literal,
+                    self.facts.file,
+                    node.lineno,
+                    self.facts.qualname,
+                )
+            )
+        # jit / pallas roots
+        is_jit = text in ("jax.jit",) or (
+            isinstance(fn, ast.Name)
+            and fn.id == "jit"
+            and self.mod.ext_imports.get("jit", "").startswith("jax")
+        )
+        is_pallas = attr == "pallas_call"
+        if (is_jit or is_pallas) and (node.args or node.keywords):
+            target = node.args[0] if node.args else None
+            if target is None:
+                for kw in node.keywords:
+                    if kw.arg in ("fun", "f", "kernel"):
+                        target = kw.value
+            static_names: tuple[str, ...] = ()
+            static_nums: tuple[int, ...] = ()
+            for kw in node.keywords:
+                if kw.arg == "static_argnames":
+                    static_names = _const_strs(kw.value)
+                elif kw.arg == "static_argnums":
+                    static_nums = _const_ints(kw.value)
+            self.repo.jit_roots.append(
+                JitRoot(
+                    "pallas" if is_pallas else "jit",
+                    target,
+                    static_names,
+                    static_nums,
+                    node.lineno,
+                    self.facts.qualname,
+                    self.mod.relpath,
+                )
+            )
+
+    def _classify(self, fn: ast.expr) -> Optional[tuple]:
+        if isinstance(fn, ast.Name):
+            if fn.id in self.local_funcs:
+                return ("key", self.local_funcs[fn.id])
+            return ("name", fn.id)
+        if isinstance(fn, ast.Attribute):
+            if isinstance(fn.value, ast.Name):
+                if fn.value.id == "self":
+                    return ("self", fn.attr)
+                return ("attr", fn.value.id, fn.attr)
+            return ("attr", _unparse(fn.value), fn.attr)
+        return None
+
+    def _resolve_fn_expr(self, expr: ast.expr) -> tuple[str, ...]:
+        ref = self._classify(expr)
+        if ref and ref[0] == "key":
+            return (ref[1],)
+        return self.repo.resolve_ref(ref, self.mod, self.facts.cls)
+
+
+def _const_strs(node: ast.expr) -> tuple[str, ...]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(
+            e.value
+            for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, str)
+        )
+    return ()
+
+
+def _const_ints(node: ast.expr) -> tuple[int, ...]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(
+            e.value
+            for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, int)
+        )
+    return ()
+
+
+def _metric_name(
+    node: ast.expr, mod: ModuleFacts
+) -> tuple[Optional[str], bool]:
+    """Render a metric-name argument: literal strings verbatim,
+    f-strings/concats with `<>` placeholders, module constants through
+    one level of Name lookup, anything else None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value, True
+    if isinstance(node, ast.JoinedStr):
+        out = []
+        for part in node.values:
+            if isinstance(part, ast.Constant):
+                out.append(str(part.value))
+            else:
+                out.append("<>")
+        return "".join(out), False
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        left, _ = _metric_name(node.left, mod)
+        right, _ = _metric_name(node.right, mod)
+        return (left or "<>") + (right or "<>"), False
+    if isinstance(node, ast.Name):
+        const = mod.str_constants.get(node.id)
+        if const is not None:
+            return const, True
+    return None, False
+
+
+def _maybe_web_entry(repo: RepoFacts, info: ClassInfo, fn, key: str) -> None:
+    if fn.name.startswith("do_") and any(
+        "Handler" in b for b in info.bases
+    ):
+        repo.entries.append(
+            Entry(
+                f"web:{key}", "web", key, "web", info.file, fn.lineno
+            )
+        )
+
+
+def walk_function(
+    repo: RepoFacts,
+    mod: ModuleFacts,
+    node,
+    key: str,
+    qualname: str,
+    cls: Optional[str],
+) -> FunctionFacts:
+    params = tuple(
+        a.arg
+        for a in (
+            node.args.posonlyargs + node.args.args + node.args.kwonlyargs
+        )
+    )
+    facts = FunctionFacts(
+        key, qualname, mod.relpath, node.lineno, cls, params, node
+    )
+    repo.functions[key] = facts
+    walker = _FunctionWalker(repo, mod, facts)
+    walker.walk_body(node.body)
+    return facts
+
+
+def _walk_module(repo: RepoFacts, mod: ModuleFacts) -> None:
+    for node in mod.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            key = f"{mod.relpath}::{node.name}"
+            walk_function(repo, mod, node, key, node.name, None)
+            if node.name == "main":
+                repo.entries.append(
+                    Entry(
+                        f"main:{key}",
+                        "main",
+                        key,
+                        "pump",
+                        mod.relpath,
+                        node.lineno,
+                    )
+                )
+        elif isinstance(node, ast.ClassDef):
+            info = repo.classes.get(f"{mod.relpath}::{node.name}")
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    key = f"{mod.relpath}::{node.name}.{sub.name}"
+                    walk_function(
+                        repo,
+                        mod,
+                        sub,
+                        key,
+                        f"{node.name}.{sub.name}",
+                        node.name,
+                    )
+                    if info is not None:
+                        _maybe_web_entry(repo, info, sub, key)
+    # module-level statements run at import time but carry the same
+    # facts a function body does — `f = jax.jit(kernel)` roots,
+    # module-scope metric registrations, `Thread(target=...)` starts —
+    # so they walk under a synthetic `<module>` scope (defs/classes
+    # excluded: the loop above already walked them)
+    top = [
+        st
+        for st in mod.tree.body
+        if not isinstance(
+            st, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        )
+    ]
+    if top:
+        key = f"{mod.relpath}::<module>"
+        facts = FunctionFacts(
+            key, "<module>", mod.relpath, 1, None, (), mod.tree
+        )
+        repo.functions[key] = facts
+        _FunctionWalker(repo, mod, facts).walk_body(top)
+
+
+def _collect_str_constants(mod: ModuleFacts) -> None:
+    consts: dict[str, str] = {}
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign) and isinstance(
+            node.value, ast.Constant
+        ):
+            if isinstance(node.value.value, str):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        consts[tgt.id] = node.value.value
+    mod.str_constants = consts
+
+
+def iter_py_files(root: str, subdirs: tuple[str, ...]) -> list[str]:
+    out = []
+    for sub in subdirs:
+        base = os.path.join(root, sub)
+        if os.path.isfile(base) and base.endswith(".py"):
+            out.append(os.path.relpath(base, root).replace(os.sep, "/"))
+            continue
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fname in sorted(filenames):
+                if fname.endswith(".py"):
+                    full = os.path.join(dirpath, fname)
+                    out.append(
+                        os.path.relpath(full, root).replace(os.sep, "/")
+                    )
+    return sorted(set(out))
+
+
+def extract_repo(
+    root: str, subdirs: tuple[str, ...] = ("corda_tpu",)
+) -> RepoFacts:
+    """Parse every .py file under `root`/`subdirs` and build the full
+    fact table, finalized (call graph + reachability computed)."""
+    repo = RepoFacts(root)
+    paths = iter_py_files(root, subdirs)
+    repo.modules_paths = set(paths)
+    for relpath in paths:
+        full = os.path.join(root, relpath)
+        try:
+            with open(full, encoding="utf-8") as f:
+                source = f.read()
+            tree = ast.parse(source)
+        except (OSError, SyntaxError):
+            continue
+        repo.modules[relpath] = ModuleFacts(relpath, source, tree)
+    # pass 1: imports, classes, lock/thread attrs (order-independent)
+    for mod in repo.modules.values():
+        _collect_str_constants(mod)
+        _ModuleScanner(repo, mod).visit(mod.tree)
+    # pass 2: per-function facts
+    for mod in repo.modules.values():
+        _walk_module(repo, mod)
+    repo.finalize()
+    return repo

@@ -1,0 +1,74 @@
+"""Finding model + stable fingerprints for the lint baseline.
+
+A fingerprint must survive unrelated edits (line shifts, renames
+elsewhere in the file), so it hashes the rule, the file, the enclosing
+scope and a rule-chosen detail key — never line numbers. Two findings
+with the same fingerprint are the same accepted fact about the code;
+a fingerprint that stops matching anything in the tree is a STALE
+baseline row (reported, never fatal), and a finding with no baseline
+row is NEW (fails the gate).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+
+
+# severity tiers (ISSUE 10): P0 deadlock-cycle, P1 blocking-hot /
+# contract-determinism, P2 style/informational
+P0 = "P0"
+P1 = "P1"
+P2 = "P2"
+
+_SEV_ORDER = {P0: 0, P1: 1, P2: 2}
+
+
+def fingerprint(rule: str, file: str, scope: str, detail: str) -> str:
+    h = hashlib.sha256(
+        f"{rule}|{file}|{scope}|{detail}".encode()
+    ).hexdigest()
+    return h[:16]
+
+
+@dataclass
+class Finding:
+    """One analyzer result.
+
+    `detail` is the stable identity key (lock names in a cycle, the
+    blocked callee + held lock, a metric name) — what the fingerprint
+    hashes. `message` is the human rendering and may carry line
+    numbers and evidence freely."""
+
+    pass_name: str
+    rule: str
+    severity: str
+    file: str
+    line: int
+    scope: str          # enclosing function/class qualname ("" = module)
+    detail: str
+    message: str
+    evidence: list[str] = field(default_factory=list)
+
+    @property
+    def fingerprint(self) -> str:
+        return fingerprint(self.rule, self.file, self.scope, self.detail)
+
+    def render(self) -> str:
+        loc = f"{self.file}:{self.line}"
+        head = (
+            f"[{self.severity}] {self.rule} {loc}"
+            + (f" ({self.scope})" if self.scope else "")
+            + f" [{self.fingerprint}]"
+        )
+        lines = [head, f"    {self.message}"]
+        for ev in self.evidence:
+            lines.append(f"      - {ev}")
+        return "\n".join(lines)
+
+
+def sort_findings(findings: list[Finding]) -> list[Finding]:
+    return sorted(
+        findings,
+        key=lambda f: (_SEV_ORDER.get(f.severity, 9), f.file, f.line, f.rule),
+    )

@@ -1,0 +1,405 @@
+"""jaxhazard — static complement to the perf plane's retrace counter.
+
+The runtime counter (utils/perf.py KernelAccounting) pages when a jit
+cache misses inside the serving window; this pass flags the code
+shapes that CAUSE those misses — or silently move work back to the
+host — before they ship:
+
+  P1 `jax-host-clock`     — time/datetime clock reads inside a jitted
+                            or Pallas kernel body: traced once at
+                            compile time, frozen forever after (the
+                            classic "why is my timestamp constant").
+  P1 `jax-host-rng`       — python/numpy randomness inside a kernel
+                            body (same freeze; jax.random is exempt).
+  P1 `jax-host-callback`  — print/open/input in a kernel body: runs at
+                            trace time only (or crashes under jit).
+  P1 `jax-value-branch`   — python `if`/`while` on a traced argument's
+                            VALUE: retraces per value at best,
+                            ConcretizationError at worst. Branching on
+                            `.shape`/`.ndim`/`.dtype`/`len(...)` is
+                            static and exempt; arguments pinned by
+                            `functools.partial` or declared in
+                            static_argnames/static_argnums are static
+                            and exempt.
+  P1 `jax-concretize`     — int()/float()/bool() of a traced argument
+                            (forces a host sync + concretization).
+  P2 `jax-python-loop`    — python `for` over a traced argument:
+                            unrolls at trace time (compile-time blowup
+                            that grows with batch shape).
+
+Roots are discovered, not hard-coded: every `jax.jit(f)` / `jit(f)` /
+`*.pallas_call(kernel)` call site in any module that imports jax. `f`
+resolves through names, `functools.partial` wrappers and repo imports;
+value-level checks run on the ROOT function (whose static/traced
+parameter split is known from the jit call); call-level checks (clock,
+rng, host callbacks) additionally follow the root's repo-internal
+callees, since a helper running under trace inherits the hazard.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from .facts import FunctionFacts, ModuleFacts, RepoFacts
+from .findings import P1, P2, Finding
+
+_CLOCK_ATTRS = frozenset(
+    {
+        "time",
+        "time_ns",
+        "monotonic",
+        "monotonic_ns",
+        "perf_counter",
+        "perf_counter_ns",
+        "now",
+        "today",
+        "utcnow",
+    }
+)
+_RNG_ATTRS = frozenset(
+    {"random", "randint", "randrange", "choice", "shuffle", "getrandbits",
+     "normal", "uniform"}
+)
+_HOST_NAMES = frozenset({"print", "open", "input"})
+_SHAPE_ATTRS = frozenset({"shape", "ndim", "dtype", "size", "itemsize"})
+
+
+def _resolve_targets(
+    repo: RepoFacts,
+    mod: ModuleFacts,
+    scope: str,
+    expr,
+    pinned_kw: tuple[str, ...] = (),
+    pinned_pos: int = 0,
+    depth: int = 0,
+) -> list[tuple[FunctionFacts, tuple[str, ...], int]]:
+    """Candidate (function facts, partial-pinned kwarg names,
+    partial-pinned positional count) for a jit/pallas target
+    expression. Follows `functools.partial` wrappers and one level of
+    local-variable aliasing (`inner = some_fn` in the enclosing
+    function — the batch_verifier shape), so a root can resolve to
+    SEVERAL candidates (one per alias assignment)."""
+    if depth > 4 or expr is None:
+        return []
+    # unwrap functools.partial(f, ...)
+    while isinstance(expr, ast.Call):
+        fn = expr.func
+        name = fn.attr if isinstance(fn, ast.Attribute) else getattr(
+            fn, "id", ""
+        )
+        if name != "partial" or not expr.args:
+            return []
+        pinned_kw = pinned_kw + tuple(
+            kw.arg for kw in expr.keywords if kw.arg
+        )
+        pinned_pos += len(expr.args) - 1
+        expr = expr.args[0]
+    if isinstance(expr, ast.Name):
+        # nested def in the enclosing scope chain, innermost first
+        parts = scope.split(".")
+        for i in range(len(parts), -1, -1):
+            prefix = ".".join(parts[:i] + [expr.id])
+            hit = repo.functions.get(f"{mod.relpath}::{prefix}")
+            if hit is not None:
+                return [(hit, pinned_kw, pinned_pos)]
+        if expr.id in mod.functions:
+            hit = repo.functions.get(mod.functions[expr.id])
+            return [(hit, pinned_kw, pinned_pos)] if hit else []
+        if expr.id in mod.sym_imports:
+            relpath, sym = mod.sym_imports[expr.id]
+            target = repo.modules.get(relpath)
+            if target and sym in target.functions:
+                hit = repo.functions.get(target.functions[sym])
+                return [(hit, pinned_kw, pinned_pos)] if hit else []
+            return []
+        # local alias: `inner = <fn expr>` in the enclosing function —
+        # resolve every assignment (if/else arms give several)
+        enclosing = repo.functions.get(f"{mod.relpath}::{scope}")
+        out: list = []
+        if enclosing is not None:
+            for node in ast.walk(enclosing.node):
+                if not isinstance(node, ast.Assign):
+                    continue
+                if any(
+                    isinstance(t, ast.Name) and t.id == expr.id
+                    for t in node.targets
+                ):
+                    out.extend(
+                        _resolve_targets(
+                            repo,
+                            mod,
+                            scope,
+                            node.value,
+                            pinned_kw,
+                            pinned_pos,
+                            depth + 1,
+                        )
+                    )
+        return out
+    if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name):
+        scope_cls = scope.split(".", 1)[0] if "." in scope else None
+        if expr.value.id == "self" and scope_cls:
+            hit = repo._method_on(scope_cls, expr.attr, mod.relpath)
+            if hit and hit in repo.functions:
+                return [(repo.functions[hit], pinned_kw, pinned_pos)]
+        if expr.value.id in mod.mod_imports:
+            target = repo.modules.get(mod.mod_imports[expr.value.id])
+            if target and expr.attr in target.functions:
+                hit = repo.functions.get(target.functions[expr.attr])
+                return [(hit, pinned_kw, pinned_pos)] if hit else []
+    return []
+
+
+def _is_jax_receiver(text: str, mod: ModuleFacts) -> bool:
+    root = text.split(".", 1)[0]
+    return mod.ext_imports.get(root, root).split(".", 1)[0] == "jax"
+
+
+def _call_hazard(call, mod: ModuleFacts) -> Optional[tuple[str, str, str]]:
+    """(rule, severity, description) for a hazardous call, else None."""
+    attr, recv = call.attr, call.receiver
+    if recv and _is_jax_receiver(recv, mod):
+        return None                      # jax.random / jax.debug are fine
+    root = recv.split(".", 1)[0] if recv else ""
+    root_mod = mod.ext_imports.get(root, root)
+    if attr in _CLOCK_ATTRS and root_mod.split(".")[0] in (
+        "time",
+        "datetime",
+    ):
+        return ("jax-host-clock", P1, "host clock read")
+    if attr in _CLOCK_ATTRS and root in ("datetime", "time", "date"):
+        return ("jax-host-clock", P1, "host clock read")
+    if attr in _RNG_ATTRS and (
+        root_mod.split(".")[0] in ("random", "numpy")
+        or root in ("random", "np", "numpy")
+        or "rng" in root.lower()
+    ):
+        return ("jax-host-rng", P1, "host randomness")
+    if not recv and attr in _HOST_NAMES:
+        return ("jax-host-callback", P1, f"host `{attr}` call")
+    return None
+
+
+class _BodyAuditor(ast.NodeVisitor):
+    """Value-level checks over ONE root kernel body, with the known
+    traced-parameter set."""
+
+    def __init__(self, traced: set, facts: FunctionFacts):
+        self.traced = traced
+        self.facts = facts
+        self.hits: list[tuple[str, str, int, str]] = []
+        # names rebound inside the body stop being "the traced arg"
+        self.rebound: set = set()
+
+    def _traced_value_names(self, expr: ast.expr) -> list[str]:
+        """Traced params whose VALUE (not shape/dtype) feeds `expr`."""
+        out = []
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Attribute) and node.attr in _SHAPE_ATTRS:
+                # prune: anything under .shape/.dtype is static
+                continue
+            if (
+                isinstance(node, ast.Name)
+                and node.id in self.traced
+                and node.id not in self.rebound
+            ):
+                out.append(node.id)
+        # second pass removes names that ONLY appear under shape-like
+        # attributes or len() — cheap approximation: collect names
+        # reachable without crossing a shape attribute
+        allowed = set(_shape_only_names(expr))
+        return [n for n in out if n not in allowed]
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        # audit the VALUE while its names are still traced — a
+        # self-rebinding concretization (`n = int(n)`) must flag
+        # before `n` joins the rebound set
+        self.visit(node.value)
+        for tgt in node.targets:
+            for sub in ast.walk(tgt):
+                if isinstance(sub, ast.Name):
+                    self.rebound.add(sub.id)
+        for tgt in node.targets:
+            self.visit(tgt)
+
+    def visit_If(self, node: ast.If) -> None:
+        names = self._traced_value_names(node.test)
+        if names:
+            self.hits.append(
+                (
+                    "jax-value-branch",
+                    P1,
+                    node.lineno,
+                    f"`if` on traced value(s) {', '.join(sorted(set(names)))}",
+                )
+            )
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        names = self._traced_value_names(node.test)
+        if names:
+            self.hits.append(
+                (
+                    "jax-value-branch",
+                    P1,
+                    node.lineno,
+                    f"`while` on traced value(s) "
+                    f"{', '.join(sorted(set(names)))}",
+                )
+            )
+        self.generic_visit(node)
+
+    def visit_For(self, node: ast.For) -> None:
+        if (
+            isinstance(node.iter, ast.Name)
+            and node.iter.id in self.traced
+            and node.iter.id not in self.rebound
+        ):
+            self.hits.append(
+                (
+                    "jax-python-loop",
+                    P2,
+                    node.lineno,
+                    f"python `for` over traced argument {node.iter.id} "
+                    "unrolls at trace time",
+                )
+            )
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        if (
+            isinstance(fn, ast.Name)
+            and fn.id in ("int", "float", "bool")
+            and len(node.args) == 1
+        ):
+            names = self._traced_value_names(node.args[0])
+            if names:
+                self.hits.append(
+                    (
+                        "jax-concretize",
+                        P1,
+                        node.lineno,
+                        f"{fn.id}() concretizes traced value(s) "
+                        f"{', '.join(sorted(set(names)))}",
+                    )
+                )
+        self.generic_visit(node)
+
+
+def _shape_only_names(expr: ast.expr) -> list[str]:
+    """Names that appear ONLY under .shape/.ndim/.dtype/len() in
+    `expr` — static uses that must not trigger value-branch findings."""
+    shape_uses: list[str] = []
+    value_uses: list[str] = []
+
+    def walk(node: ast.AST, static_ctx: bool) -> None:
+        if isinstance(node, ast.Attribute) and node.attr in _SHAPE_ATTRS:
+            walk(node.value, True)
+            return
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id in ("len", "isinstance", "type")
+        ):
+            for arg in node.args:
+                walk(arg, True)
+            return
+        if isinstance(node, ast.Name):
+            (shape_uses if static_ctx else value_uses).append(node.id)
+            return
+        for child in ast.iter_child_nodes(node):
+            walk(child, static_ctx)
+
+    walk(expr, False)
+    return [n for n in shape_uses if n not in value_uses]
+
+
+def run(repo: RepoFacts) -> list[Finding]:
+    findings: list[Finding] = []
+    seen: set[tuple] = set()
+    audited_roots: set[tuple] = set()
+    for root in repo.jit_roots:
+      mod = repo.modules[root.module]
+      for target, pinned_kw, pinned_pos in _resolve_targets(
+          repo, mod, root.scope, root.target
+      ):
+        # the traced/static parameter split, from the jit call site
+        params = [p for p in target.params if p != "self"]
+        static = set(root.static_names) | set(pinned_kw)
+        for i in sorted(root.static_nums):
+            if 0 <= i < len(params):
+                static.add(params[i])
+        static |= set(params[:pinned_pos])
+        traced = {p for p in params if p not in static}
+        audit_key = (target.key, tuple(sorted(traced)))
+        if audit_key not in audited_roots:
+            audited_roots.add(audit_key)
+            auditor = _BodyAuditor(traced, target)
+            for st in target.node.body:
+                auditor.visit(st)
+            for rule, sev, line, desc in auditor.hits:
+                key = (rule, target.key, desc)
+                if key in seen:
+                    continue
+                seen.add(key)
+                findings.append(
+                    Finding(
+                        "jaxhazard",
+                        rule,
+                        sev,
+                        target.file,
+                        line,
+                        target.qualname,
+                        desc,
+                        f"{desc} inside {root.kind} body "
+                        f"`{target.qualname}` (root built at "
+                        f"{root.module}:{root.line})",
+                    )
+                )
+        # call-level hazards: the root body plus repo callees under it
+        reach = {target.key}
+        stack = [target.key]
+        while stack:
+            k = stack.pop()
+            for nxt in repo.callgraph.get(k, ()):
+                fnext = repo.functions.get(nxt)
+                if fnext is None or nxt in reach:
+                    continue
+                nmod = repo.modules.get(fnext.file)
+                # only helpers in jax-importing modules run under trace
+                if nmod is None or not any(
+                    v.split(".", 1)[0] == "jax"
+                    for v in nmod.ext_imports.values()
+                ):
+                    continue
+                reach.add(nxt)
+                stack.append(nxt)
+        for key in reach:
+            fn = repo.functions[key]
+            fmod = repo.modules[fn.file]
+            for call in fn.calls:
+                hazard = _call_hazard(call, fmod)
+                if hazard is None:
+                    continue
+                rule, sev, desc = hazard
+                dkey = (rule, fn.key, call.text)
+                if dkey in seen:
+                    continue
+                seen.add(dkey)
+                findings.append(
+                    Finding(
+                        "jaxhazard",
+                        rule,
+                        sev,
+                        fn.file,
+                        call.line,
+                        fn.qualname,
+                        f"{call.text}",
+                        f"{desc} `{call.text}(...)` reachable under a "
+                        f"{root.kind} trace (root at "
+                        f"{root.module}:{root.line})",
+                    )
+                )
+    return findings

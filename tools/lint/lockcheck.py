@@ -1,0 +1,292 @@
+"""lockcheck — lock-acquisition graph, order inversions, sharing map.
+
+Builds a directed graph over static lock identities (facts.py): an
+edge A -> B means "B was acquired while A was held", either by direct
+nesting inside one function or through a call made under A into a
+function that (transitively) acquires B. Findings:
+
+  P0 `lock-cycle`       — a strongly connected component of two or
+                          more locks: two threads taking them in
+                          opposite orders deadlock.
+  P0 `lock-self-cycle`  — a non-reentrant Lock re-acquired while held
+                          (same static id, same receiver text, or via
+                          a call chain back into itself): guaranteed
+                          self-deadlock the first time the path runs.
+  P0 `lock-instance-order` — nested acquisition of the same lock
+                          attribute through two DIFFERENT receivers
+                          (two instances of one class): correct only
+                          under a deterministic global acquisition
+                          order the analyzer cannot see — baseline
+                          with the ordering argument written down, or
+                          fix.
+  P2 `lock-shared`      — a lock reachable from more than one thread
+                          entry-point group (the sharing map: which
+                          locks actually mediate cross-thread state).
+
+Reentrant locks (RLock) and condition self-waits never produce
+self-cycle findings — re-entry is their contract.
+"""
+
+from __future__ import annotations
+
+from .facts import RepoFacts
+from .findings import P0, P2, Finding
+
+
+class LockGraph:
+    """edges: (a, b) -> list of evidence strings; receivers seen per
+    direct self-edge kept to split self-deadlock from instance-order."""
+
+    def __init__(self) -> None:
+        self.edges: dict[tuple[str, str], list[str]] = {}
+        self.self_same_recv: dict[str, list[str]] = {}
+        self.self_diff_recv: dict[str, list[str]] = {}
+
+    def add(self, a: str, b: str, evidence: str) -> None:
+        self.edges.setdefault((a, b), []).append(evidence)
+
+    def nodes(self) -> set:
+        out = set()
+        for a, b in self.edges:
+            out.add(a)
+            out.add(b)
+        return out
+
+
+def build_lock_graph(repo: RepoFacts) -> LockGraph:
+    g = LockGraph()
+    for fn in repo.functions.values():
+        mod = repo.modules[fn.file]
+        # direct nesting
+        for acq in fn.acquires:
+            for held in acq.held:
+                ev = (
+                    f"{fn.file}:{acq.line} {fn.qualname}: "
+                    f"{acq.receiver} acquired holding {held.receiver}"
+                )
+                if held.lock_id == acq.lock_id:
+                    if held.receiver == acq.receiver:
+                        g.self_same_recv.setdefault(
+                            acq.lock_id, []
+                        ).append(ev)
+                    else:
+                        g.self_diff_recv.setdefault(
+                            acq.lock_id, []
+                        ).append(ev)
+                else:
+                    g.add(held.lock_id, acq.lock_id, ev)
+        # calls under a lock into functions that (transitively) acquire
+        for call in fn.calls:
+            if not call.held:
+                continue
+            # a `self.m()` call re-entering a `self.X` lock is the SAME
+            # instance (RLock re-entry is its contract); an obj.m() call
+            # chain may hit a different instance — instance-order hazard
+            self_call = call.ref is not None and call.ref[0] == "self"
+            for key in repo.resolve_ref(call.ref, mod, fn.cls):
+                for inner in repo.acq_trans.get(key, ()):
+                    for held in call.held:
+                        ev = (
+                            f"{fn.file}:{call.line} {fn.qualname}: "
+                            f"call {call.text}() under {held.receiver} "
+                            f"reaches a {inner} acquisition"
+                        )
+                        if held.lock_id == inner:
+                            # a `self.m()` chain re-enters the SAME
+                            # instance; a module-level lock is a
+                            # singleton, so any chain back into it is
+                            # a self-deadlock too — only obj.m() into
+                            # a CLASS lock is an instance question
+                            same_instance = (
+                                self_call and held.receiver == "self"
+                            ) or inner in repo.module_level_locks
+                            bucket = (
+                                g.self_same_recv
+                                if same_instance
+                                else g.self_diff_recv
+                            )
+                            bucket.setdefault(inner, []).append(ev)
+                        else:
+                            g.add(held.lock_id, inner, ev)
+    return g
+
+
+def _sccs(nodes: set, edges: dict) -> list[list[str]]:
+    """Tarjan SCCs (iterative), components of size >= 2 only."""
+    adj: dict[str, list[str]] = {n: [] for n in nodes}
+    for a, b in edges:
+        if a in adj and b in nodes:
+            adj[a].append(b)
+    index: dict[str, int] = {}
+    low: dict[str, int] = {}
+    on_stack: set = set()
+    stack: list[str] = []
+    out: list[list[str]] = []
+    counter = [0]
+
+    for root in sorted(nodes):
+        if root in index:
+            continue
+        work = [(root, iter(adj[root]))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for nxt in it:
+                if nxt not in index:
+                    index[nxt] = low[nxt] = counter[0]
+                    counter[0] += 1
+                    stack.append(nxt)
+                    on_stack.add(nxt)
+                    work.append((nxt, iter(adj[nxt])))
+                    advanced = True
+                    break
+                if nxt in on_stack:
+                    low[node] = min(low[node], index[nxt])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                if len(comp) > 1:
+                    out.append(sorted(comp))
+    return out
+
+
+def run(repo: RepoFacts) -> list[Finding]:
+    g = build_lock_graph(repo)
+    findings: list[Finding] = []
+
+    def lock_loc(lock_id: str) -> tuple[str, int]:
+        kind_file_line = repo.locks.get(lock_id)
+        if kind_file_line is None:
+            return "<unknown>", 0
+        return kind_file_line[1], kind_file_line[2]
+
+    # P0: multi-lock order-inversion cycles
+    for comp in _sccs(g.nodes(), g.edges):
+        evidence = []
+        for a, b in sorted(g.edges):
+            if a in comp and b in comp:
+                evidence.extend(g.edges[(a, b)][:2])
+        file, line = lock_loc(comp[0])
+        findings.append(
+            Finding(
+                "lockcheck",
+                "lock-cycle",
+                P0,
+                file,
+                line,
+                "",
+                "<->".join(comp),
+                "lock-order inversion cycle: "
+                + " -> ".join(comp + [comp[0]])
+                + " — two threads taking these in different orders "
+                "deadlock",
+                evidence[:6],
+            )
+        )
+    # P0: self-deadlock on a non-reentrant lock, same receiver
+    for lock_id, evidence in sorted(g.self_same_recv.items()):
+        kind = repo.locks.get(lock_id, ("Lock",))[0]
+        if kind in ("RLock",):
+            continue   # re-entry is the type's contract
+        file, line = lock_loc(lock_id)
+        findings.append(
+            Finding(
+                "lockcheck",
+                "lock-self-cycle",
+                P0,
+                file,
+                line,
+                "",
+                lock_id,
+                f"non-reentrant {kind} {lock_id} re-acquired while "
+                "already held — self-deadlock on first execution",
+                evidence[:4],
+            )
+        )
+    # P0: same attribute, different receivers (instance ordering) —
+    # RLocks included: two *different* RLock instances still
+    # order-invert, only same-receiver re-entry is their contract
+    for lock_id, evidence in sorted(g.self_diff_recv.items()):
+        file, line = lock_loc(lock_id)
+        findings.append(
+            Finding(
+                "lockcheck",
+                "lock-instance-order",
+                P0,
+                file,
+                line,
+                "",
+                lock_id,
+                f"{lock_id} acquired while another instance of the "
+                "same lock is held — safe only under a deterministic "
+                "global acquisition order",
+                evidence[:4],
+            )
+        )
+    # P2: the sharing map — locks reachable from >1 thread group
+    lock_groups: dict[str, set] = {}
+    for key, fn in repo.functions.items():
+        groups = repo.reachable_groups.get(key, set())
+        if not groups:
+            continue
+        for acq in fn.acquires:
+            lock_groups.setdefault(acq.lock_id, set()).update(groups)
+    for lock_id, groups in sorted(lock_groups.items()):
+        if len(groups) < 2:
+            continue
+        file, line = lock_loc(lock_id)
+        findings.append(
+            Finding(
+                "lockcheck",
+                "lock-shared",
+                P2,
+                file,
+                line,
+                "",
+                lock_id,
+                f"{lock_id} is reachable from {len(groups)} thread "
+                "entry groups: " + ", ".join(sorted(groups)[:6]),
+            )
+        )
+    return findings
+
+
+def to_dot(repo: RepoFacts) -> str:
+    """The lock graph in graphviz dot format (docs/static-analysis.md
+    export): cycle members red, pump-hot locks bold."""
+    g = build_lock_graph(repo)
+    cyclic = {n for comp in _sccs(g.nodes(), g.edges) for n in comp}
+    lines = ["digraph locks {", "  rankdir=LR;"]
+    for node in sorted(g.nodes()):
+        kind = repo.locks.get(node, ("Lock",))[0]
+        attrs = [f'label="{node}\\n({kind})"']
+        if node in cyclic:
+            attrs.append("color=red")
+        if node in repo.hot_locks:
+            attrs.append("style=bold")
+        lines.append(f'  "{node}" [{", ".join(attrs)}];')
+    for (a, b), evidence in sorted(g.edges.items()):
+        color = ' [color=red]' if a in cyclic and b in cyclic else ""
+        lines.append(f'  "{a}" -> "{b}"{color};  // {len(evidence)} site(s)')
+    for lock_id in sorted(g.self_diff_recv):
+        lines.append(
+            f'  "{lock_id}" -> "{lock_id}" [color=orange, '
+            'label="instance order"];'
+        )
+    lines.append("}")
+    return "\n".join(lines)
