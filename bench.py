@@ -880,6 +880,193 @@ def _trace_metric(batch: int, iters: int, cpu: bool = False) -> dict:
     }
 
 
+def _consensus_metric(batch: int, iters: int) -> dict:
+    """Consensus-phase attribution (the cluster-tracing tentpole's
+    bench leg): drive `batch` distributed commits through a REAL
+    3-member Raft cluster on the in-memory fabric, alternating
+    UNTRACED / TRACED reps, and fold every member's `raft.<phase>`
+    span summary into a per-commit phase breakdown (propose / append /
+    quorum / commit / apply seconds). `value` is untraced distributed
+    commits/sec on this rig; `tracing_overhead` is
+    min(traced)/min(untraced)-1 on the SAME cluster in the SAME
+    process — the cost of consensus tracing stays a measured ratio
+    inside one record, gated <= 5% like the PR 2 hot-path trace
+    metric."""
+    import gc
+
+    from corda_tpu.crypto import schemes as _schemes
+    from corda_tpu.flows.api import _WaitFuture
+    from corda_tpu.testing.fleet import FleetClient, TearOffSource
+    from corda_tpu.testing.mock_network import MockNetwork
+    from corda_tpu.utils import tracing
+    from corda_tpu.utils.metrics import MetricRegistry
+    from corda_tpu.core.identity import Party
+
+    batch = max(8, batch)
+    reps = max(2, iters)
+    tracers: dict = {}
+    registries: dict = {}
+
+    def tracer_for(name):
+        t = tracers.get(name)
+        if t is None:
+            t = tracers[name] = tracing.Tracer(
+                enabled=False,
+                recorder=tracing.FlightRecorder(
+                    # every phase span completes as its own recorder
+                    # entry: size to the traced reps so the summary
+                    # covers the whole run, not the tail
+                    keep_recent=12 * batch * reps + 64,
+                    keep_slowest=16,
+                ),
+            )
+        return t
+
+    net = MockNetwork(seed=11)
+    service_party, members = net.create_raft_notary_cluster(
+        3,
+        scheme_id=_schemes.ECDSA_SECP256R1_SHA256,
+        tracer_factory=tracer_for,
+        metrics_factory=lambda name: registries.setdefault(
+            name, MetricRegistry()
+        ),
+    )
+    net.elect(members)
+    # the REAL serving path, fleet-style: tear-off notarisations via
+    # SimpleNotaryService.process (ftx verify + replicated commit +
+    # sign), so the A/B measures tracing against production per-commit
+    # work — not against a bare dict update
+    kp = _schemes.generate_keypair(_schemes.ECDSA_SECP256R1_SHA256, seed=7)
+    client = FleetClient("bench-consensus", Party("bench-consensus", kp.public))
+    source = TearOffSource(service_party, seed=13)
+
+    def fresh_payloads(n):
+        out = []
+        for _ in range(n):
+            client.submitted += 1   # fresh coin per spend (no conflicts)
+            out.append(source.spend(client))
+        return out
+
+    def run_once(traced: bool) -> float:
+        for t in tracers.values():
+            t.enabled = traced
+        payloads = fresh_payloads(batch)   # fixture build OUTSIDE timing
+        live = []
+        t0 = time.perf_counter()
+        for i, (ftx, _inputs, tx_id) in enumerate(payloads):
+            member = members[i % len(members)]   # every member gateways
+            root = (
+                tracer_for(member.name).start_trace(
+                    "notarise.bench", tx_id=str(tx_id)
+                )
+                if traced else None
+            )
+            gen = member.services.notary_service.process(
+                ftx, client.party,
+                trace=root.context if root is not None else None,
+            )
+            live.append([gen, None, root])
+            net.run()
+        # heartbeat rounds: commit-index propagation resolves forwarded
+        # futures and lands follower commit/apply phases
+        for _ in range(200):
+            still = []
+            for entry in live:
+                gen, wait, root = entry
+                try:
+                    if wait is None:
+                        step = gen.send(None)
+                    elif wait.future.done:
+                        step = gen.send(wait.future.result())
+                    else:
+                        still.append(entry)
+                        continue
+                    if isinstance(step, _WaitFuture):
+                        entry[1] = step
+                        still.append(entry)
+                    else:
+                        raise SystemExit(
+                            f"unexpected notary yield {step!r}"
+                        )
+                except StopIteration as stop:
+                    if not hasattr(stop.value, "by"):
+                        raise SystemExit(
+                            f"consensus notarisation failed: {stop.value}"
+                        )
+                    if root is not None:
+                        root.end()
+            live = still
+            if not live:
+                break
+            net.clock.advance(60_000)
+            net.run()
+        if live:
+            raise SystemExit(
+                f"{len(live)} consensus notarisations never resolved"
+            )
+        wall = time.perf_counter() - t0
+        # two extra heartbeats so every member's apply span completes
+        # before the stage summary reads the recorders
+        for _ in range(2):
+            net.clock.advance(60_000)
+            net.run()
+        return wall
+
+    run_once(False)   # warm both paths (jit-free, but first-run
+    run_once(True)    # bytecode + fabric caches)
+    for t in tracers.values():
+        t.recorder.clear()
+    walls_off, walls_on = [], []
+    traced_commits = 0
+    for _ in range(reps):             # interleaved A/B: drift cancels
+        gc.collect()
+        walls_off.append(run_once(False))
+        gc.collect()
+        walls_on.append(run_once(True))
+        traced_commits += batch
+    overhead = min(walls_on) / min(walls_off) - 1.0
+
+    phases = {
+        p: 0.0 for p in ("propose", "append", "quorum", "commit", "apply")
+    }
+    span_counts = dict.fromkeys(phases, 0)
+    members_represented = set()
+    for name, t in tracers.items():
+        for span_name, row in t.stage_summary().items():
+            if not span_name.startswith("raft."):
+                continue
+            phase = span_name[len("raft."):]
+            if phase in phases:
+                phases[phase] += row["total_s"]
+                span_counts[phase] += row["count"]
+                members_represented.add(name)
+    per_commit = {
+        k: round(v / max(traced_commits, 1), 9) for k, v in phases.items()
+    }
+    value = batch / min(walls_off)
+    return {
+        "metric": "consensus",
+        "value": round(value, 3),
+        "unit": "distributed raft notarisations/sec (3 members, untraced)",
+        "vs_baseline": 1.0,
+        # per-commit phase seconds, summed across members: the gate
+        # catches a single phase regressing under a steady headline
+        "phases_seconds": per_commit,
+        "gate_lower_is_better": ["phases_seconds"],
+        "phase_span_counts": span_counts,
+        "members_with_spans": sorted(members_represented),
+        "tracing_overhead": round(overhead, 4),
+        "overhead_ok": overhead <= float(
+            os.environ.get("BENCH_CONSENSUS_OVERHEAD_MAX", "0.05")
+        ),
+        "gate_required_true": ["overhead_ok"],
+        "wall_seconds": round(_median(walls_on), 6),
+        "untraced_wall_seconds": round(_median(walls_off), 6),
+        "batch": batch,
+        "reps": reps,
+    }
+
+
 def _qos_metric(batch: int, iters: int) -> dict:
     """QoS overload serving (the admission-control tentpole's bench
     leg): drive ~2x the measured no-overload capacity of a CPU-fixture
@@ -1804,6 +1991,11 @@ def _run_metric(metric: str, batch: int, iters: int) -> dict:
         if batch > 4096:
             out["batch_requested"] = batch   # cap visible in the record
         return out
+    if metric == "consensus":
+        out = _consensus_metric(min(batch, 512), iters)
+        if batch > 512:
+            out["batch_requested"] = batch   # cap visible in the record
+        return out
     if metric == "qos":
         out = _qos_metric(min(batch, 256), iters)
         if batch > 256:
@@ -2064,6 +2256,35 @@ def _quick(metric: str) -> None:
                 "acceptance line on a quiet machine)"
             )
         return
+    if metric == "consensus":
+        batch = int(os.environ.get("BENCH_BATCH", "48"))
+        reps = int(os.environ.get("BENCH_ITERS", "3"))
+        out = _consensus_metric(batch, reps)
+        out["quick"] = True
+        print(json.dumps(out), flush=True)
+        missing = [
+            p for p, n in out["phase_span_counts"].items() if n <= 0
+        ]
+        if missing:
+            raise SystemExit(
+                f"consensus phases {missing} stamped no spans — the "
+                "distributed commit trace is incomplete"
+            )
+        if len(out["members_with_spans"]) < 2:
+            raise SystemExit(
+                "consensus phase spans came from "
+                f"{out['members_with_spans']} — a distributed-commit "
+                "trace must carry spans from >= 2 members"
+            )
+        if not out["overhead_ok"]:
+            raise SystemExit(
+                f"consensus tracing overhead {out['tracing_overhead']:.3f}"
+                " exceeds BENCH_CONSENSUS_OVERHEAD_MAX (default 5%) vs "
+                "the untraced run"
+            )
+        if out["value"] <= 0:
+            raise SystemExit("zero distributed-commit throughput")
+        return
     if metric == "trace":
         batch = int(os.environ.get("BENCH_BATCH", "192"))
         reps = int(os.environ.get("BENCH_TRACE_REPS", "3"))
@@ -2088,8 +2309,9 @@ def _quick(metric: str) -> None:
         return
     if metric != "ingest":
         raise SystemExit(
-            f"--quick supports 'ingest', 'trace', 'qos', 'health', "
-            f"'perf', 'fleet', 'faults' or 'shards', not {metric!r}"
+            f"--quick supports 'ingest', 'trace', 'consensus', 'qos', "
+            f"'health', 'perf', 'fleet', 'faults' or 'shards', not "
+            f"{metric!r}"
         )
     batch = int(os.environ.get("BENCH_BATCH", "256"))
     iters = int(os.environ.get("BENCH_ITERS", "1"))
@@ -2109,7 +2331,8 @@ def main() -> None:
     if argv:
         raise SystemExit(
             f"unknown arguments {argv!r} "
-            "(try --quick ingest|trace|qos|health|perf|fleet|faults|shards)"
+            "(try --quick ingest|trace|consensus|qos|health|perf|"
+            "fleet|faults|shards)"
         )
     t_start = time.perf_counter()
     # On a remote-attached TPU the host<->device link latency (~50-100
@@ -2121,8 +2344,8 @@ def main() -> None:
     metric = os.environ.get("BENCH_METRIC", "all")
     known = (
         "all", "p256", "mixed", "merkle", "notary", "notary_commit_plane",
-        "ingest", "ingest_pipelined", "trace", "qos", "health", "perf",
-        "fleet", "faults", "montmul", "parity",
+        "ingest", "ingest_pipelined", "trace", "consensus", "qos", "health",
+        "perf", "fleet", "faults", "montmul", "parity",
     )
     if metric not in known:
         # a typo must not record a p256-only rate under another name
@@ -2161,8 +2384,8 @@ def main() -> None:
     # parity runs LAST of the optional work (cheapest to drop), but
     # before the headline so the headline stays the final stdout line
     for m in ("mixed", "merkle", "notary", "ingest", "ingest_pipelined",
-              "trace", "qos", "health", "perf", "fleet", "faults",
-              "parity"):
+              "trace", "consensus", "qos", "health", "perf", "fleet",
+              "faults", "parity"):
         avail = left() - reserve
         if avail < 60:
             print(
@@ -2174,7 +2397,8 @@ def main() -> None:
         env = dict(os.environ, BENCH_METRIC=m)
         if avail < 300 and m in (
             "mixed", "merkle", "notary", "ingest", "ingest_pipelined",
-            "trace", "qos", "health", "perf", "fleet", "faults",
+            "trace", "consensus", "qos", "health", "perf", "fleet",
+            "faults",
         ):
             # trim before dropping: one timed rep at a shallower batch
             # still yields a usable point for the table

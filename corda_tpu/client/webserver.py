@@ -117,6 +117,8 @@ class NodeWebServer:
         health=None,
         cluster=None,
         perf=None,
+        cluster_traces=None,
+        incidents=None,
     ):
         """`metrics`: an optional MetricRegistry served at GET /metrics
         in prometheus exposition format (the reference exports
@@ -152,6 +154,16 @@ class NodeWebServer:
         capture when the continuous sampler is off; `?reset=1` clears
         the table after serving).
 
+        `cluster_traces`: an optional utils/tracing.ClusterTraces —
+        GET /cluster/trace/<trace_id> serves the cross-node assembly
+        of one trace (matching span sets pulled from every peer's
+        flight recorder, clock-offset-adjusted, merged into one tree
+        with a per-member consensus-phase summary).
+
+        `incidents`: an optional utils/health.IncidentRecorder — GET
+        /incidents lists the captured forensics bundles,
+        GET /incidents/<id> serves one bundle in full.
+
         Every operational endpoint honours `?ts=1`: the payload gains
         a shared process-monotonic `ts_micros` stamp (a trailing
         `# ts_micros` comment on /metrics text), so cross-endpoint
@@ -166,6 +178,8 @@ class NodeWebServer:
         self.health = health
         self.cluster = cluster
         self.perf = perf
+        self.cluster_traces = cluster_traces
+        self.incidents = incidents
         # serializes /profile on-demand captures and resets: without
         # it a second ?seconds=N request returns a partial table and
         # a concurrent ?reset=1 wipes an in-flight capture
@@ -182,7 +196,13 @@ class NodeWebServer:
             ),
             "/traces": (
                 "flight recorder (chrome://tracing JSON + stage "
-                "summary)", self._serve_traces,
+                "summary; ?trace_id= ?name= ?limit= filter "
+                "server-side)", self._serve_traces,
+            ),
+            "/incidents": (
+                "incident forensics bundles (alerts + assembled "
+                "traces + metrics + event tail); /incidents/<id> for "
+                "one bundle", self._serve_incidents,
             ),
             "/qos": ("QoS control-plane state", self._serve_qos),
             "/healthz": (
@@ -313,18 +333,31 @@ class NodeWebServer:
             "/qos": self.qos, "/healthz": self.health,
             "/health": self.health, "/cluster": self.cluster,
             "/perf": self.perf, "/profile": self.perf,
+            "/incidents": self.incidents,
         }
+        rows = [
+            {
+                "path": path,
+                "description": desc,
+                "enabled": (
+                    wired[path] is not None if path in wired else True
+                ),
+            }
+            for path, (desc, _) in self._ops.items()
+        ]
+        # path-parameterized route (dispatched by prefix, not the _ops
+        # table — an exact-match entry for it could never be hit)
+        rows.append({
+            "path": "/cluster/trace/<trace_id>",
+            "description": (
+                "cross-node assembly of one trace: span sets pulled "
+                "from every peer's flight recorder, clock-offset "
+                "adjusted, merged with a per-member phase summary"
+            ),
+            "enabled": self.cluster_traces is not None,
+        })
         return self._json(200, {
-            "endpoints": [
-                {
-                    "path": path,
-                    "description": desc,
-                    "enabled": (
-                        wired[path] is not None if path in wired else True
-                    ),
-                }
-                for path, (desc, _) in sorted(self._ops.items())
-            ],
+            "endpoints": sorted(rows, key=lambda r: r["path"]),
             "api": [
                 "/api/status", "/api/network", "/api/notaries",
                 "/api/vault", "/api/flows", "/api/plugins",
@@ -349,18 +382,99 @@ class NodeWebServer:
         # hot-path traces: the flight recorder's retained traces
         # (N slowest + N most recent) as chrome://tracing-loadable
         # JSON plus the per-stage latency summary — /metrics tells
-        # you THAT serving slowed, this tells you WHICH stage
+        # you THAT serving slowed, this tells you WHICH stage.
+        # ?trace_id= / ?name= / ?limit= filter SERVER-side (the
+        # ClusterTraces pull path, and the cure for serializing the
+        # whole recorder per request).
+        from ..utils import tracing as tracelib
+
         try:
-            if self.tracer is not None:
-                # serialize INSIDE the guard: a non-JSON span
-                # attribute must yield the 500, not a half-written
-                # response (span attributes are caller-typed Any)
-                return self._json(200, self.tracer.export())
+            if self.tracer is None:
+                return self._json(
+                    404, {"error": "tracing not wired on this gateway"}
+                )
+            tid_text = query.get("trace_id", [None])[0]
+            trace_id = None
+            if tid_text is not None:
+                trace_id = tracelib.parse_trace_id(tid_text)
+                if trace_id is None:
+                    return self._json(
+                        400, {"error": f"bad trace_id {tid_text!r}"}
+                    )
+            name = query.get("name", [None])[0] or None
+            limit_text = query.get("limit", [None])[0]
+            limit = None
+            if limit_text:
+                try:
+                    limit = max(0, int(limit_text))
+                except ValueError:
+                    return self._json(
+                        400, {"error": f"bad limit {limit_text!r}"}
+                    )
+            # serialize INSIDE the guard: a non-JSON span attribute
+            # must yield the 500, not a half-written response (span
+            # attributes are caller-typed Any)
             return self._json(
-                404, {"error": "tracing not wired on this gateway"}
+                200,
+                self.tracer.export(
+                    trace_id=trace_id, name=name, limit=limit
+                ),
             )
         except Exception as e:   # noqa: BLE001 - defensive render
             return self._json(500, {"error": f"trace export failed: {e}"})
+
+    def _serve_cluster_trace(self, tid_text: str) -> tuple[int, str, bytes]:
+        from ..utils import tracing as tracelib
+
+        try:
+            if self.cluster_traces is None:
+                return self._json(
+                    404,
+                    {"error": "cluster traces not wired on this gateway"},
+                )
+            trace_id = tracelib.parse_trace_id(tid_text)
+            if trace_id is None:
+                return self._json(
+                    400, {"error": f"bad trace_id {tid_text!r}"}
+                )
+            out = self.cluster_traces.assemble(trace_id)
+            return self._json(200 if out["found"] else 404, out)
+        except Exception as e:   # noqa: BLE001 - defensive render
+            return self._json(
+                500, {"error": f"cluster trace assembly failed: {e}"}
+            )
+
+    def _serve_incidents(self, query) -> tuple[int, str, bytes]:
+        try:
+            if self.incidents is None:
+                return self._json(
+                    404,
+                    {"error": "incident recorder not wired on this "
+                              "gateway"},
+                )
+            return self._json(200, {
+                "incidents": self.incidents.list(),
+                "recorded": self.incidents.recorded,
+            })
+        except Exception as e:   # noqa: BLE001 - defensive render
+            return self._json(500, {"error": f"incident list failed: {e}"})
+
+    def _serve_incident(self, incident_id: str) -> tuple[int, str, bytes]:
+        try:
+            if self.incidents is None:
+                return self._json(
+                    404,
+                    {"error": "incident recorder not wired on this "
+                              "gateway"},
+                )
+            bundle = self.incidents.load(incident_id)
+            if bundle is None:
+                return self._json(
+                    404, {"error": f"no incident {incident_id!r}"}
+                )
+            return self._json(200, bundle)
+        except Exception as e:   # noqa: BLE001 - defensive render
+            return self._json(500, {"error": f"incident load failed: {e}"})
 
     def _serve_qos(self, query) -> tuple[int, str, bytes]:
         # the QoS control plane's live state: shed counters,
@@ -478,6 +592,20 @@ class NodeWebServer:
                 )
             else:
                 status, ctype, payload = 200, hit[0], hit[1]
+            self._send(req, status, ctype, payload)
+            return
+        if method == "GET" and path.startswith("/cluster/trace/"):
+            # path-parameterized: the trace id rides in the URL (the
+            # form every evidence row and export prints verbatim)
+            status, ctype, payload = self._serve_cluster_trace(
+                path[len("/cluster/trace/"):]
+            )
+            self._send(req, status, ctype, payload)
+            return
+        if method == "GET" and path.startswith("/incidents/"):
+            status, ctype, payload = self._serve_incident(
+                path[len("/incidents/"):]
+            )
             self._send(req, status, ctype, payload)
             return
         if method == "GET" and path in self._ops:

@@ -353,9 +353,38 @@ class NotaryFlow(FlowLogic):
         self.deadline_micros = deadline_micros
 
     def call(self):
+        from ..utils import tracing
+
         notary = self.stx.wtx.notary
         if notary is None:
             raise FlowException("transaction has no notary")
+        # the trace is BORN here when tracing is on: a client-side root
+        # span whose context rides every session message (and, notary-
+        # side, the consensus protocol messages), so one notarisation
+        # assembles as one cross-node tree via GET /cluster/trace/<id>.
+        # Replayed (checkpoint-restored) flows stay untraced — a second
+        # root span joined to a finished trace would orphan it.
+        tracer = tracing.get_tracer()
+        machine = getattr(self, "_machine", None)
+        span = None
+        if (
+            tracer.enabled
+            and machine is not None
+            and machine.trace is None
+            and not machine.replaying
+        ):
+            span = tracer.start_trace(
+                "notarise.client", tx_id=str(self.stx.id)
+            )
+            machine.trace = tuple(span.context)
+        try:
+            result = yield from self._notarise(notary)
+            return result
+        finally:
+            if span is not None:
+                span.end()
+
+    def _notarise(self, notary):
         self.stx.verify_required_signatures(
             except_keys={notary.owning_key}
         )
@@ -482,7 +511,8 @@ class NotaryServiceFlow(FlowLogic):
         elif not isinstance(payload, FilteredTransaction):
             raise FlowException("non-validating notary takes a tear-off")
         result = yield from service.process(
-            payload, self.other_party, deadline=deadline
+            payload, self.other_party, deadline=deadline,
+            trace=getattr(self._machine, "trace", None),
         )
         if isinstance(result, NotaryError):
             resp = NotarisationResponse((), result)

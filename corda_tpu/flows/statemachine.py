@@ -132,6 +132,14 @@ class FlowStateMachine:
         self.logic = logic
         self.snapshot = snapshot            # constructor-state for restore
         self.root_tag = root_tag            # default session protocol tag
+        # optional trace context (utils/tracing wire header): adopted
+        # from the initiating session message, or set by the flow
+        # itself (NotaryFlow opens a client root span). Every emission
+        # carries it, so a flow conversation — and the consensus round
+        # it triggers — assembles as ONE cross-node trace.
+        # Observability only: never checkpointed, never consensus input
+        # (a restored flow simply continues untraced).
+        self.trace: Optional[tuple] = None
         self.gen = as_generator(logic.call())
         self.journal: list = []
         self.replay_pos = 0
@@ -665,12 +673,16 @@ class StateMachineManager:
             return
         decoded = ser.decode(msg.payload)
         if isinstance(decoded, SessionInit):
-            self._on_init(decoded)
+            self._on_init(decoded, msg.trace)
             return
         entry = self.sessions_by_id.get(decoded.session_id)
         if entry is None:
             return  # flow finished or duplicate — drop
         fsm, sess = entry
+        if msg.trace is not None and fsm.trace is None:
+            # late adoption: a counter-flow that started untraced joins
+            # the peer's trace on its first traced frame
+            fsm.trace = tuple(msg.trace)
         if isinstance(decoded, SessionData):
             sess.buffer.append(decoded.payload)
         elif isinstance(decoded, SessionEnd):
@@ -685,7 +697,7 @@ class StateMachineManager:
             if self._try_receive_on(fsm, sess):
                 self._run(fsm)
 
-    def _on_init(self, init: SessionInit) -> None:
+    def _on_init(self, init: SessionInit, trace=None) -> None:
         if init.session_id in self.sessions_by_id:
             return  # duplicate Init (redelivery) — drop
         factory = self._responder_factory(init.flow_tag)
@@ -703,6 +715,8 @@ class StateMachineManager:
         fsm = FlowStateMachine(
             flow_id, logic, _state_snapshot(logic), init.flow_tag
         )
+        if trace is not None:
+            fsm.trace = tuple(trace)   # responder joins the initiator's trace
         sess = SessionState(
             id=init.session_id,
             party=init.initiator,
@@ -732,11 +746,22 @@ class StateMachineManager:
     # -- plumbing -----------------------------------------------------------
 
     def _emit(self, fsm: FlowStateMachine, message, party: Party) -> None:
+        if fsm.trace is None:
+            self.messaging.send(
+                msglib.TOPIC_SESSION,
+                ser.encode(message),
+                self._address_of(party),
+                unique_id=fsm.next_msg_id(),
+            )
+            return
+        from ..utils import tracing as tracelib
+
         self.messaging.send(
             msglib.TOPIC_SESSION,
             ser.encode(message),
             self._address_of(party),
             unique_id=fsm.next_msg_id(),
+            trace=tracelib.wire_trace(fsm.trace),
         )
 
     def _address_of(self, party: Party) -> str:

@@ -49,14 +49,26 @@ replicas; replies only count with f+1 agreement.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 from typing import Any, Callable, Optional
 
 from ..core import serialization as ser
+from ..utils import tracing
 from ..flows.api import FlowFuture
 from .messaging import Message, MessagingService
 
 TOPIC_BFT = "bft"
+
+# consensus-phase vocabulary (per-member `bft.<phase>` spans + always-
+# on Bft.Phase.* timers): pre_prepare = ordering/accept processing;
+# prepare = accept -> prepared (the 2f+1 PREPARE quorum wait); commit =
+# prepared -> committed (the COMMIT quorum wait); reply = in-sequence
+# execution + answer; view_change / catch_up are repair-arc root spans.
+BFT_PHASES = (
+    "pre_prepare", "prepare", "commit", "reply", "view_change", "catch_up",
+)
+_TRACE_TABLE_CAP = 4096
 
 
 class BftUnavailable(Exception):
@@ -261,7 +273,15 @@ class BftReplica:
         cluster: str = "bft-notary",
         rng=None,
         config: BftConfig = BftConfig(),
+        metrics=None,
+        tracer=None,
     ):
+        """`metrics` / `tracer`: the consensus observability seam (see
+        raft.RaftNode — same contract): Bft.Phase.* timers + lag/view
+        gauges on the registry, per-member `bft.<phase>` spans joined
+        to a submitted command's trace context, ClockSync feeding from
+        traced frames. Both None by default — the bare protocol pays
+        nothing."""
         import random as _random
 
         assert name in peers
@@ -355,6 +375,33 @@ class BftReplica:
         self._catchup_served: dict[str, int] = {}   # per-requester limit
         self.stopped = False
 
+        # -- observability (PR 11): phase timers, gauges, spans --------
+        self.metrics = metrics
+        self.tracer = tracer
+        self._phase_timers: dict[str, Any] = {}
+        if metrics is not None:
+            for phase in BFT_PHASES:
+                self._phase_timers[phase] = metrics.timer(
+                    "Bft.Phase."
+                    + "".join(p.title() for p in phase.split("_"))
+                )
+            metrics.gauge("Bft.View", lambda: self.view)
+            metrics.gauge(
+                "Bft.ExecLagEntries",
+                lambda: max(0, self.credible_seq - (self.exec_seq - 1)),
+            )
+        # (origin, cmd_id) -> wire trace header; seq -> header once
+        # ordered; seq -> perf_counter marks at accept/prepared time
+        self._req_trace: dict[tuple, tuple] = {}
+        self._seq_trace: dict[int, tuple] = {}
+        self._seq_accept_t: dict[int, float] = {}
+        self._seq_prepared_t: dict[int, float] = {}
+        self._vc_span = None
+        self._vc_t0 = 0.0
+        self._vc_view = 0
+        self._catchup_span = None
+        self._catchup_t0 = 0.0
+
         self.topic = f"{TOPIC_BFT}.{cluster}"
         messaging.add_handler(self.topic, self._on_message)
 
@@ -368,11 +415,75 @@ class BftReplica:
     def is_primary(self) -> bool:
         return self.primary == self.name
 
+    # -- consensus-phase observability ---------------------------------------
+
+    def _tracing(self) -> bool:
+        return self.tracer is not None and self.tracer.enabled
+
+    def _observing(self) -> bool:
+        return self.metrics is not None or self._tracing()
+
+    def _stamp(self, phase: str, hdr, t0: float,
+               t1: Optional[float] = None, **attrs) -> None:
+        """One phase interval: into the Bft.Phase.* timer always (when
+        metrics are wired) and — for a traced command — as a completed
+        `bft.<phase>` span joined to the client's trace with member=
+        and at= (node-clock micros) attributes."""
+        t1 = time.perf_counter() if t1 is None else t1
+        timer = self._phase_timers.get(phase)
+        if timer is not None:
+            timer.update(t1 - t0)
+        if hdr is not None and self._tracing():
+            self.tracer.span_at(
+                "bft." + phase, hdr, t0, t1,
+                member=self.name, at=self.clock.now_micros(), **attrs,
+            )
+
+    def _bind(self, table: dict, key, value) -> None:
+        if value is None:
+            return
+        if len(table) >= _TRACE_TABLE_CAP:
+            table.pop(next(iter(table)))
+        table[key] = value
+
+    def _seq_hdr(self, seq: int) -> Optional[tuple]:
+        hdr = self._seq_trace.get(seq)
+        return tracing.wire_trace(hdr) if hdr is not None else None
+
+    def _open_repair_span(self, name: str):
+        if not self._tracing():
+            return None
+        return self.tracer.start_trace(
+            name, member=self.name, at=self.clock.now_micros()
+        )
+
+    def _close_repair_span(self, kind: str, outcome: str) -> None:
+        span_attr, t0_attr = f"_{kind}_span", f"_{kind}_t0"
+        span = getattr(self, span_attr)
+        if span is not None:
+            span.set_attribute("outcome", outcome)
+            span.end()
+            setattr(self, span_attr, None)
+        t0 = getattr(self, t0_attr)
+        if t0:
+            timer = self._phase_timers.get(
+                "view_change" if kind == "vc" else "catch_up"
+            )
+            if timer is not None:
+                timer.update(time.perf_counter() - t0)
+            setattr(self, t0_attr, 0.0)
+
     # -- client gateway ------------------------------------------------------
 
-    def submit(self, command: Any) -> FlowFuture:
+    def submit(self, command: Any, trace=None) -> FlowFuture:
         """Broadcast a request; future resolves once f+1 replicas reply
-        with the same outcome — value is (outcome, [signatures])."""
+        with the same outcome — value is (outcome, [signatures]).
+
+        `trace`: optional trace context — protocol messages for this
+        command carry it across the fabric and every replica stamps
+        its `bft.<phase>` spans into the SAME trace (see
+        raft.RaftNode.submit)."""
+        hdr = tracing.wire_trace(trace)
         self._next_cmd += 1
         cmd_id = self._next_cmd
         fut = FlowFuture()
@@ -382,9 +493,9 @@ class BftReplica:
         payload = ser.encode(req)
         for peer in self.peers:
             if peer == self.name:
-                self._on_request(req)
+                self._on_request(req, hdr)
             else:
-                self.messaging.send(self.topic, payload, peer)
+                self._send(peer, payload, trace=tracing.wire_trace(hdr))
         return fut
 
     def _on_reply(self, m: BftReply) -> None:
@@ -406,8 +517,9 @@ class BftReplica:
 
     # -- replica: request handling -------------------------------------------
 
-    def _on_request(self, m: BftRequest) -> None:
+    def _on_request(self, m: BftRequest, hdr=None) -> None:
         key = (m.origin, m.cmd_id)
+        self._bind(self._req_trace, key, hdr)
         seq = self.seen_requests.get(key)
         if seq is not None:
             # duplicate (client retry): re-reply if already executed
@@ -427,13 +539,16 @@ class BftReplica:
             self.clock.now_micros(),
         )
         self._accept_preprepare(pp)
-        self._broadcast(pp)
+        self._broadcast(pp, trace=self._seq_hdr(seq))
 
     def _accept_preprepare(
-        self, pp: PrePrepare, skew_exempt: bool = False
+        self, pp: PrePrepare, skew_exempt: bool = False, hdr=None
     ) -> None:
         if pp.seq in self.accepted and self.accepted[pp.seq][0] >= pp.view:
             return   # first pre-prepare per (seq, view) wins; stale views drop
+        t0 = time.perf_counter() if self._observing() else 0.0
+        if hdr is None:
+            hdr = self._req_trace.get((pp.origin, pp.cmd_id))
         skew = abs(pp.timestamp - self.clock.now_micros())
         if skew > self.config.timestamp_skew_micros and not skew_exempt:
             # primary's clock is lying/broken: refuse to prepare.
@@ -449,6 +564,9 @@ class BftReplica:
             pp.view, pp.cmd_id, pp.origin, pp.command, pp.timestamp,
         )
         self.seen_requests[(pp.origin, pp.cmd_id)] = pp.seq
+        self._bind(self._seq_trace, pp.seq, hdr)
+        if self._observing():
+            self._bind(self._seq_accept_t, pp.seq, t0)
         d = _digest(_canon(pp.command))
         sig = (
             self.sign_prepare_fn(pp.view, pp.seq, d)
@@ -457,9 +575,10 @@ class BftReplica:
         )
         prep = BftPrepare(pp.view, pp.seq, d, self.name, sig)
         self._record_prepare(prep)
-        self._broadcast(prep)
+        self._stamp("pre_prepare", hdr, t0, seq=pp.seq)
+        self._broadcast(prep, trace=self._seq_hdr(pp.seq))
 
-    def _on_preprepare(self, pp: PrePrepare, sender: str) -> None:
+    def _on_preprepare(self, pp: PrePrepare, sender: str, hdr=None) -> None:
         if sender != self.primary or pp.view != self.view:
             return   # only the current primary may order
         if self._awaiting_new_view:
@@ -470,7 +589,7 @@ class BftReplica:
             # next_seq starts above its top), so this is either a
             # stale redelivery or a byzantine reorder attempt
             return
-        self._accept_preprepare(pp)
+        self._accept_preprepare(pp, hdr=hdr)
 
     def _record_prepare(self, p: BftPrepare) -> None:
         if (
@@ -518,9 +637,19 @@ class BftReplica:
                 bytes(p.digest),
                 tuple(sorted(group.items(), key=lambda kv: kv[0])),
             )
+            if self._observing():
+                # prepare phase: accept -> 2f+1 PREPARE quorum
+                t_prep = time.perf_counter()
+                t_accept = self._seq_accept_t.get(p.seq)
+                if t_accept is not None:
+                    self._stamp(
+                        "prepare", self._seq_trace.get(p.seq),
+                        t_accept, t_prep, seq=p.seq,
+                    )
+                self._bind(self._seq_prepared_t, p.seq, t_prep)
             c = BftCommitMsg(p.view, p.seq, bytes(p.digest), self.name)
             self._record_commit(c)
-            self._broadcast(c)
+            self._broadcast(c, trace=self._seq_hdr(p.seq))
 
     def _record_commit(self, c: BftCommitMsg) -> None:
         key = (c.view, c.seq, bytes(c.digest))
@@ -532,6 +661,14 @@ class BftReplica:
             and c.seq not in self.committed
         ):
             self.committed.add(c.seq)
+            if self._observing():
+                # commit phase: prepared -> 2f+1 COMMIT quorum
+                t_prep = self._seq_prepared_t.pop(c.seq, None)
+                if t_prep is not None:
+                    self._stamp(
+                        "commit", self._seq_trace.get(c.seq),
+                        t_prep, seq=c.seq,
+                    )
             self._execute_ready()
 
     def _execute_ready(self) -> None:
@@ -547,13 +684,22 @@ class BftReplica:
                 self.executed[seq] = (cmd_id, origin, None, None)
                 self._maybe_checkpoint(seq)
                 continue
+            observing = self._observing()
+            t0 = time.perf_counter() if observing else 0.0
             outcome, signature = self.execute_fn(
                 _canon(command), timestamp,
             )
             self.executed[seq] = (cmd_id, origin, outcome, signature)
             self._watch.pop((origin, cmd_id), None)
             self.pending_requests.pop((origin, cmd_id), None)
+            self._req_trace.pop((origin, cmd_id), None)
             self._reply(seq)
+            if observing:
+                # reply phase: in-sequence execution + the answer send
+                self._stamp(
+                    "reply", self._seq_trace.get(seq), t0, seq=seq,
+                )
+            self._seq_accept_t.pop(seq, None)
             self._maybe_checkpoint(seq)
 
     # -- checkpoints ---------------------------------------------------------
@@ -591,6 +737,7 @@ class BftReplica:
         self.stable_state = state
         for d in (
             self.accepted, self.prepared, self.prepared_cert, self.executed,
+            self._seq_trace, self._seq_accept_t, self._seq_prepared_t,
         ):
             for s in [s for s in d if s <= seq]:
                 del d[s]
@@ -642,6 +789,12 @@ class BftReplica:
             return 0
         self._last_catchup_ask = now
         self._catchup_replies.clear()
+        if self._catchup_span is None:
+            # the state-transfer arc: ask -> f+1-agreed install
+            self._catchup_span = self._open_repair_span("bft.catch_up")
+            self._catchup_t0 = (
+                time.perf_counter() if self._observing() else 0.0
+            )
         self._broadcast(CatchUpRequest(self.exec_seq - 1, self.name))
         return self.n - 1
 
@@ -741,6 +894,7 @@ class BftReplica:
             progressed = True
         if progressed:
             self._catchup_replies.clear()
+            self._close_repair_span("catchup", "installed")
 
     def _reply(self, seq: int) -> None:
         cmd_id, origin, outcome, signature = self.executed[seq]
@@ -748,7 +902,7 @@ class BftReplica:
         if origin == self.name:
             self._on_reply(reply)
         else:
-            self.messaging.send(self.topic, ser.encode(reply), origin)
+            self._send(origin, ser.encode(reply), trace=self._seq_hdr(seq))
 
     # -- view change (simplified) --------------------------------------------
 
@@ -799,7 +953,18 @@ class BftReplica:
         sent += self._maybe_request_catchup(now)
         return sent
 
+    def _open_vc_span(self, new_view: int) -> None:
+        if new_view <= self._vc_view:
+            return   # re-vote for a view already being tracked
+        self._vc_view = new_view
+        if self._vc_span is None:
+            self._vc_span = self._open_repair_span("bft.view_change")
+            self._vc_t0 = time.perf_counter() if self._observing() else 0.0
+        if self._vc_span is not None:
+            self._vc_span.set_attribute("new_view", new_view)
+
     def _vote_view_change(self, new_view: int) -> int:
+        self._open_vc_span(new_view)
         # EVERY certified entry above the stable checkpoint rides in
         # the vote — including executed ones. Excluding executed seqs
         # would break the NEW-VIEW no-op filler's invariant ("no vote
@@ -984,6 +1149,7 @@ class BftReplica:
             if (origin, cmd_id) in self.seen_requests:
                 continue   # already ordered (possibly re-proposed above)
             self._order(cmd_id, origin, command)
+        self._close_repair_span("vc", "primary")
 
     def _on_new_view(self, m: NewView, sender: str) -> None:
         """Adopt the new view on the primary's NEW-VIEW: late replicas
@@ -1057,6 +1223,7 @@ class BftReplica:
                 v: vm for v, vm in self._view_votes.items() if v >= self.view
             }
         self._awaiting_new_view = False
+        self._close_repair_span("vc", "adopted")
         if listed:
             # ordinary ordering in this view must start above the
             # adopted re-proposal top — see _on_preprepare
@@ -1078,12 +1245,15 @@ class BftReplica:
         except ser.SerializationError:
             return
         sender = msg.sender
+        if msg.trace is not None and self._tracing():
+            # clock-offset evidence for cross-node span ordering
+            self.tracer.clock_sync.observe_header(sender, msg.trace)
         if isinstance(m, BftRequest):
             if sender == m.origin or sender == self.name:
-                self._on_request(m)
+                self._on_request(m, msg.trace)
         elif isinstance(m, PrePrepare):
             self._note_seq(m.seq, sender)
-            self._on_preprepare(m, sender)
+            self._on_preprepare(m, sender, msg.trace)
         elif isinstance(m, BftPrepare):
             if sender == m.replica and sender in self.peers:
                 self._note_seq(m.seq, sender)
@@ -1118,11 +1288,22 @@ class BftReplica:
             if sender == m.replica and sender in self.peers:
                 self._on_catchup_reply(m)
 
-    def _broadcast(self, message) -> None:
+    def _send(self, peer: str, payload: bytes, trace=None) -> None:
+        if trace is None:
+            # the common untraced path keeps the bare send signature
+            # (narrow test doubles stub send(topic, payload, target))
+            self.messaging.send(self.topic, payload, peer)
+        else:
+            self.messaging.send(self.topic, payload, peer, trace=trace)
+
+    def _broadcast(self, message, trace=None) -> None:
         payload = ser.encode(message)
         for peer in self.peers:
             if peer != self.name:
-                self.messaging.send(self.topic, payload, peer)
+                self._send(
+                    peer, payload,
+                    trace=tracing.wire_trace(trace) if trace else None,
+                )
 
     def stop(self) -> None:
         self.stopped = True
@@ -1340,7 +1521,7 @@ class BFTNotaryService:
 
     # -- the NotaryService surface (generator, like the others) --------------
 
-    def process(self, ftx, requester, deadline=None):
+    def process(self, ftx, requester, deadline=None, trace=None):
         del deadline   # accepted for flow-call parity; BFT replicas
         #                order every admitted request (notary.py
         #                SimpleNotaryService.process note)
@@ -1350,7 +1531,7 @@ class BFTNotaryService:
 
         if not isinstance(ftx, FilteredTransaction):
             return NotaryError("invalid-proof", "BFT notary takes a tear-off")
-        fut = self.replica.submit(["notarise", ser.encode(ftx)])
+        fut = self.replica.submit(["notarise", ser.encode(ftx)], trace=trace)
         try:
             outcome, sigs = yield from wait_future(fut)
         except BftUnavailable as e:

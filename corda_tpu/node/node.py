@@ -272,6 +272,29 @@ class Node:
             self._health_peer_urls,
             clock_fn=self.services.clock.now_micros,
         )
+        # cross-node trace assembly (utils/tracing.ClusterTraces):
+        # GET /cluster/trace/<id> pulls matching span sets from every
+        # peer's flight recorder over the same advertised web_port the
+        # health rollup rides, merges them clock-offset-adjusted
+        self.cluster_traces = tracing.ClusterTraces(
+            config.name,
+            self.tracer,
+            self._peer_web_urls,
+        )
+        # incident forensics (utils/health.IncidentRecorder): every
+        # firing alert snapshots a durable bundle — alert + slowest
+        # matching traces WITH their remote halves + metrics snapshot
+        # + event tail — to base_dir/incidents, served at /incidents
+        from ..utils.health import IncidentRecorder
+
+        self.incidents = IncidentRecorder(
+            os.path.join(config.base_dir, "incidents"),
+            clock_fn=self.services.clock.now_micros,
+            assemble=self.cluster_traces.assemble,
+        )
+        self.health.attach_incidents(
+            self.incidents, node=config.name, background=True
+        )
 
         # -- flows, notary, scheduler ----------------------------------
         # @corda_service instances from the imported cordapps, before
@@ -457,20 +480,27 @@ class Node:
 
     # -- health plane ---------------------------------------------------------
 
-    def _health_peer_urls(self) -> dict:
-        """The cluster rollup's peer list: every network-map node that
-        advertises a web gateway (NodeInfo.web_port) answers
-        GET /health?summary=1 there."""
+    def _peer_web_urls(self) -> dict:
+        """Base gateway URL per network-map peer that advertises a web
+        port — the one peer list both the health rollup and the
+        cross-node trace assembler ride."""
         out: dict[str, str] = {}
         for info in self.services.network_map_cache.all_nodes():
             name = info.legal_identity.name
             if name == self.config.name:
                 continue
             if info.host and info.web_port:
-                out[name] = (
-                    f"http://{info.host}:{info.web_port}/health?summary=1"
-                )
+                out[name] = f"http://{info.host}:{info.web_port}"
         return out
+
+    def _health_peer_urls(self) -> dict:
+        """The cluster rollup's peer list: every network-map node that
+        advertises a web gateway (NodeInfo.web_port) answers
+        GET /health?summary=1 there."""
+        return {
+            name: f"{base}/health?summary=1"
+            for name, base in self._peer_web_urls().items()
+        }
 
     def _launch_canary(self, complete) -> None:
         """One canary notarisation through the REAL flush path
@@ -645,6 +675,11 @@ class Node:
                     cluster=self.config.cluster_name,
                     db=self.db,
                     rng=random.Random(self._dev_seed("raft")),
+                    # consensus observability: Raft.Phase.* timers +
+                    # lag gauges on this node's scrape surface, phase
+                    # spans joined to propagated client traces
+                    metrics=self.metrics,
+                    tracer=self.tracer,
                     **raft_kw,
                 )
 
@@ -675,6 +710,8 @@ class Node:
                 self.services.clock,
                 cluster=self.config.cluster_name,
                 rng=random.Random(self._dev_seed("bft")),
+                metrics=self.metrics,
+                tracer=self.tracer,
             )
             self.bft = replica
             self.services.notary_service = BFTNotaryService(
@@ -907,6 +944,8 @@ class Node:
             health=self.health,
             cluster=self.cluster_health,
             perf=self.perf,
+            cluster_traces=self.cluster_traces,
+            incidents=self.incidents,
         )
 
 

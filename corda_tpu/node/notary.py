@@ -114,11 +114,21 @@ class UniquenessProvider:
         raise NotImplementedError
 
     def commit_async(
-        self, states: list[StateRef], tx_id: SecureHash, requester: Party
+        self,
+        states: list[StateRef],
+        tx_id: SecureHash,
+        requester: Party,
+        trace=None,
     ):
         """Future-shaped commit (what notary flows actually await):
         local providers resolve immediately; distributed ones (Raft,
-        BFT) resolve when the cluster reaches consensus."""
+        BFT) resolve when the cluster reaches consensus. `trace` is an
+        optional trace context: distributed providers thread it
+        through their protocol messages so every cluster member stamps
+        consensus-phase spans into the requester's trace; local
+        providers (commit resolves inline, nothing to attribute)
+        ignore it."""
+        del trace
         from ..flows.api import FlowFuture
 
         fut = FlowFuture()
@@ -597,13 +607,16 @@ class NotaryService:
         inputs: list[StateRef],
         time_window: Optional[TimeWindow],
         requester: Party,
+        trace=None,
     ):
         """validate time window -> commit inputs -> sign tx id
         (NotaryFlow.Service.call, NotaryFlow.kt:110-130). A generator
         (`yield from` it inside a flow): the commit awaits the
         uniqueness provider's future, which suspends the service flow
         while a distributed provider reaches consensus. Returns a
-        TransactionSignature or a NotaryError."""
+        TransactionSignature or a NotaryError. `trace`: optional trace
+        context handed to the provider so a distributed commit's
+        consensus-phase spans join the requester's trace."""
         from ..flows.api import wait_future
 
         if not self.time_window_checker.is_valid(time_window):
@@ -613,7 +626,9 @@ class NotaryService:
             )
         try:
             yield from wait_future(
-                self.uniqueness.commit_async(inputs, tx_id, requester)
+                self.uniqueness.commit_async(
+                    inputs, tx_id, requester, trace=trace
+                )
             )
         except UniquenessConflict as e:
             return NotaryError(
@@ -639,11 +654,15 @@ class SimpleNotaryService(NotaryService):
         ftx: FilteredTransaction,
         requester: Party,
         deadline: Optional[int] = None,
+        trace=None,
     ):
         # `deadline` (node/qos.py) is accepted on every notary flavour
         # so the service flow passes it uniformly; only the batching
         # notary currently sheds on it (this flavour serves per-request
-        # — by the time it runs, answering costs less than shedding)
+        # — by the time it runs, answering costs less than shedding).
+        # `trace` likewise: an optional trace context threaded to the
+        # uniqueness provider, where a distributed (Raft) commit stamps
+        # per-member consensus-phase spans into it.
         del deadline
         try:
             ftx.verify()
@@ -670,7 +689,8 @@ class SimpleNotaryService(NotaryService):
             )
         return (
             yield from self.commit_and_sign(
-                ftx.id, list(ftx.inputs), ftx.time_window, requester
+                ftx.id, list(ftx.inputs), ftx.time_window, requester,
+                trace=trace,
             )
         )
 
@@ -1012,6 +1032,7 @@ class BatchingNotaryService(NotaryService):
         stx: SignedTransaction,
         requester: Party,
         deadline: Optional[int] = None,
+        trace=None,
     ):
         from ..flows.api import FlowFuture, wait_future
 
@@ -1057,12 +1078,16 @@ class BatchingNotaryService(NotaryService):
         fut = FlowFuture()
         # flow-driven requests trace too: a root span per notarisation
         # (the wire-ingest path arrives with its span already attached
-        # via attach_ingest; this is the fabric-less service entry)
+        # via attach_ingest; this is the fabric-less service entry).
+        # With a propagated `trace` context the span JOINS the
+        # requester's trace instead of opening a fresh id, so a
+        # cross-node pull assembles the client and notary halves.
         tracer = tracing.get_tracer()
         span = None
         if tracer.enabled:
             span = tracer.start_trace(
-                "notarise.request", tx_id=str(stx.id), requester=requester.name
+                "notarise.request", parent=trace,
+                tx_id=str(stx.id), requester=requester.name,
             )
         p = _PendingNotarisation(
             stx, requester, fut, span=span,
@@ -2472,6 +2497,7 @@ class ValidatingNotaryService(NotaryService):
         stx: SignedTransaction,
         requester: Party,
         deadline: Optional[int] = None,
+        trace=None,
     ):
         del deadline   # see SimpleNotaryService.process
         if stx.wtx.notary != self.identity:
@@ -2489,6 +2515,7 @@ class ValidatingNotaryService(NotaryService):
             return NotaryError("invalid-transaction", str(e))
         return (
             yield from self.commit_and_sign(
-                stx.id, list(stx.wtx.inputs), stx.wtx.time_window, requester
+                stx.id, list(stx.wtx.inputs), stx.wtx.time_window, requester,
+                trace=trace,
             )
         )

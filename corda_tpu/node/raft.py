@@ -19,14 +19,32 @@ deterministic under the Ring-3 manual pump, wall-clock on a real node.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 from typing import Any, Callable, Optional
 
 from ..core import serialization as ser
+from ..utils import tracing
 from ..flows.api import FlowFuture
 from .messaging import Message, MessagingService
 
 TOPIC_RAFT = "raft"
+
+# consensus-phase vocabulary: per-member spans (`raft.<phase>`, each
+# carrying member= and at= attributes) and always-on Raft.Phase.*
+# timers. propose = submission handling on the origin member; append =
+# AppendEntries processing on any member; quorum = leader-side wait
+# from local append to commit-index advance; commit = commit-known to
+# entry-resolved on each member (apply nested inside it); apply =
+# apply_fn alone; view_change / catch_up are root spans over the
+# protocol's repair arcs.
+RAFT_PHASES = (
+    "propose", "append", "quorum", "commit", "apply",
+    "view_change", "catch_up",
+)
+# bound on the per-entry trace/timing tables: a trace context whose
+# entry never commits (deposed leader, lost quorum) must not leak
+_TRACE_TABLE_CAP = 4096
 
 
 class RaftUnavailable(Exception):
@@ -66,7 +84,13 @@ class AppendEntries:
     leader: str
     prev_log_index: int
     prev_log_term: int
-    entries: tuple          # of (term, command) pairs
+    # (term, command) pairs; a TRACED entry ships as a
+    # (term, command, wire_trace_header) triple so a 64-entry batch
+    # attributes each entry to ITS OWN client trace (one message-level
+    # header could not say which entry it belongs to). The header is
+    # observability metadata: receivers strip it before the log append,
+    # so replication state is identical traced or not.
+    entries: tuple
     leader_commit: int
 
 
@@ -200,7 +224,17 @@ class RaftNode:
         config: RaftConfig = RaftConfig(),
         snapshot_fn: Optional[Callable[[], Any]] = None,
         restore_fn: Optional[Callable[[Any], None]] = None,
+        metrics=None,
+        tracer=None,
     ):
+        """`metrics`: an optional MetricRegistry — Raft.Phase.* timers
+        over every consensus phase plus quorum-lag gauges land on it
+        (always-on, the Notary.FlushPhase.* discipline). `tracer`: an
+        optional utils/tracing.Tracer — commands submitted with a
+        trace context get per-member `raft.<phase>` spans stamped into
+        it, and traced protocol frames feed the tracer's ClockSync so
+        cross-node assembly can order spans honestly. Both default to
+        None: the bare protocol stays dependency- and overhead-free."""
         import random as _random
 
         assert name in peers, "peers must include this member"
@@ -268,6 +302,43 @@ class RaftNode:
         self._last_heartbeat_sent = 0
         self._election_deadline = self._fresh_election_deadline()
         self.applied_count = 0
+
+        # -- observability (PR 11): phase timers, lag gauges, spans ----
+        self.metrics = metrics
+        self.tracer = tracer
+        self._phase_timers: dict[str, Any] = {}
+        if metrics is not None:
+            for phase in RAFT_PHASES:
+                self._phase_timers[phase] = metrics.timer(
+                    "Raft.Phase." + phase.title().replace("_", "")
+                )
+            metrics.gauge(
+                "Raft.QuorumLagEntries",
+                lambda: self.last_log_index - self.commit_index,
+            )
+            metrics.gauge(
+                "Raft.ApplyLagEntries",
+                lambda: self.commit_index - self.last_applied,
+            )
+            for peer in self.others:
+                metrics.gauge(
+                    f"Raft.PeerLag.{peer}",
+                    lambda p=peer: (
+                        self.last_log_index - self.match_index.get(p, 0)
+                        if self.role == LEADER else 0
+                    ),
+                )
+        # log idx -> propagated wire trace header (the client's trace);
+        # log idx -> perf_counter seconds at local append (phase t0)
+        self._entry_trace: dict[int, tuple] = {}
+        self._entry_t0: dict[int, float] = {}
+        # cmd_id -> wire trace header for commands parked/forwarded
+        self._cmd_trace: dict[int, tuple] = {}
+        # open repair-arc spans (root traces, not client-joined)
+        self._vc_span = None
+        self._vc_t0 = 0.0
+        self._catchup_span = None
+        self._catchup_t0 = 0.0
 
         self.topic = f"{TOPIC_RAFT}.{cluster}"
         messaging.add_handler(self.topic, self._on_message)
@@ -378,6 +449,78 @@ class RaftNode:
             return self._entry(idx)[0]
         return 0
 
+    # -- consensus-phase observability ---------------------------------------
+
+    def _tracing(self) -> bool:
+        return self.tracer is not None and self.tracer.enabled
+
+    def _observing(self) -> bool:
+        """True when per-entry phase timing is worth collecting at all
+        (a timer or a tracer will consume it)."""
+        return self.metrics is not None or self._tracing()
+
+    def _stamp(self, phase: str, hdr, t0: float, t1: Optional[float] = None,
+               **attrs) -> None:
+        """One consensus phase interval: always into the Raft.Phase.*
+        timer (when metrics are wired), and — when the entry carries a
+        trace context and tracing is on — as a completed
+        `raft.<phase>` span joined to the client's trace, carrying
+        member= (which replica) and at= (node-clock micros at phase
+        end, the simulated-time-honest ordering key `phase_summary`
+        ranks members by)."""
+        t1 = time.perf_counter() if t1 is None else t1
+        timer = self._phase_timers.get(phase)
+        if timer is not None:
+            timer.update(t1 - t0)
+        if hdr is not None and self._tracing():
+            self.tracer.span_at(
+                "raft." + phase, hdr, t0, t1,
+                member=self.name, at=self.clock.now_micros(), **attrs,
+            )
+
+    def _bind_trace(self, idx: int, hdr) -> None:
+        if hdr is None:
+            return
+        if len(self._entry_trace) >= _TRACE_TABLE_CAP:
+            self._entry_trace.pop(next(iter(self._entry_trace)))
+        self._entry_trace[idx] = tuple(hdr)
+
+    def _bind_t0(self, idx: int) -> None:
+        if not self._observing():
+            return
+        if len(self._entry_t0) >= _TRACE_TABLE_CAP:
+            self._entry_t0.pop(next(iter(self._entry_t0)))
+        self._entry_t0[idx] = time.perf_counter()
+
+    def _open_repair_span(self, name: str):
+        if not self._tracing():
+            return None
+        return self.tracer.start_trace(
+            name, member=self.name, at=self.clock.now_micros()
+        )
+
+    def _close_vc_span(self, outcome: str) -> None:
+        if self._vc_span is not None:
+            self._vc_span.set_attribute("outcome", outcome)
+            self._vc_span.end()
+            self._vc_span = None
+        if self._vc_t0:
+            timer = self._phase_timers.get("view_change")
+            if timer is not None:
+                timer.update(time.perf_counter() - self._vc_t0)
+            self._vc_t0 = 0.0
+
+    def _close_catchup_span(self, outcome: str) -> None:
+        if self._catchup_span is not None:
+            self._catchup_span.set_attribute("outcome", outcome)
+            self._catchup_span.end()
+            self._catchup_span = None
+        if self._catchup_t0:
+            timer = self._phase_timers.get("catch_up")
+            if timer is not None:
+                timer.update(time.perf_counter() - self._catchup_t0)
+            self._catchup_t0 = 0.0
+
     # -- timers --------------------------------------------------------------
 
     def _fresh_election_deadline(self) -> int:
@@ -431,6 +574,12 @@ class RaftNode:
         self.voted_for = self.name
         self.leader = None
         self.votes = {self.name}
+        if self._vc_span is None:
+            # a repair arc, not client work: its own root trace, so
+            # the flight recorder answers "was there an election while
+            # that commit was slow" — ends on leadership or yield
+            self._vc_span = self._open_repair_span("raft.view_change")
+            self._vc_t0 = time.perf_counter() if self._observing() else 0.0
         self._persist_meta()
         self._election_deadline = self._fresh_election_deadline()
         msg = RequestVote(
@@ -448,6 +597,7 @@ class RaftNode:
     def _become_leader(self) -> None:
         self.role = LEADER
         self.leader = self.name
+        self._close_vc_span("leader")
         self.next_index = {p: self.last_log_index + 1 for p in self.others}
         self.match_index = {p: 0 for p in self.others}
         # commit a no-op entry so prior-term entries can commit under
@@ -458,6 +608,8 @@ class RaftNode:
         for cmd_id, command in list(self._pending_client.items()):
             self.log.append((self.term, command))
             idx = self.last_log_index
+            self._bind_t0(idx)
+            self._bind_trace(idx, self._cmd_trace.get(cmd_id))
             self._persist_append(idx)
             self._forwarded[idx] = (self.name, cmd_id, self.term)
         self._flushed_to = self.name
@@ -466,6 +618,8 @@ class RaftNode:
 
     def _maybe_step_down(self, term: int) -> None:
         if term > self.term:
+            if self.role == CANDIDATE:
+                self._close_vc_span("superseded")
             self.term = term
             self.voted_for = None
             self.role = FOLLOWER
@@ -506,22 +660,47 @@ class RaftNode:
             self._send_snapshot_chunk(peer, 0)
             return
         off = prev - self.snap_index
-        entries = tuple(
-            (t, c) for t, c in self.log[off : off + 64]
-        )
+        window = self.log[off : off + 64]
+        msg_hdr = None
+        if self._entry_trace:
+            entries = []
+            for k, (t, c) in enumerate(window):
+                hdr = self._entry_trace.get(prev + 1 + k)
+                if hdr is not None:
+                    hdr = tracing.wire_trace(hdr)
+                    if msg_hdr is None:
+                        # message-level header: the first traced
+                        # entry's context — what feeds the receiver's
+                        # clock-offset evidence
+                        msg_hdr = hdr
+                    entries.append((t, c, hdr))
+                else:
+                    entries.append((t, c))
+            entries = tuple(entries)
+        else:
+            entries = tuple((t, c) for t, c in window)
         self._send(
             peer,
             AppendEntries(
                 self.term, self.name, prev, self._term_at(prev),
                 entries, self.commit_index,
             ),
+            trace=msg_hdr,
         )
 
-    def submit(self, command: Any) -> FlowFuture:
+    def submit(self, command: Any, trace=None) -> FlowFuture:
         """Replicate one command; future resolves with apply_fn's return
         once committed (leader) or via ClientResult (member/forwarded).
         Submissions while leaderless wait in the client table and are
-        flushed to the leader when one emerges (deadline-bounded)."""
+        flushed to the leader when one emerges (deadline-bounded).
+
+        `trace`: optional trace context (Span / SpanContext / wire
+        header) — the command's protocol messages carry it across the
+        fabric and every member stamps its `raft.<phase>` spans into
+        the SAME trace, so a distributed commit reads as one
+        cross-node tree."""
+        hdr = tracing.wire_trace(trace)
+        t0 = time.perf_counter() if self._observing() else 0.0
         fut = FlowFuture()
         deadline = (
             self.clock.now_micros() + self.config.command_deadline_micros
@@ -531,21 +710,30 @@ class RaftNode:
             # append commits (and applies) inline
             idx = self.last_log_index + 1
             self._index_futures[idx] = (self.term, fut, deadline)
+            self._bind_trace(idx, hdr)
             self._leader_append(command)
+            self._stamp("propose", hdr, t0)
             return fut
         self._next_cmd += 1
         cmd_id = self._next_cmd
         self._client_futures[cmd_id] = (fut, deadline)
         self._pending_client[cmd_id] = command
+        if hdr is not None:
+            if len(self._cmd_trace) >= _TRACE_TABLE_CAP:
+                self._cmd_trace.pop(next(iter(self._cmd_trace)))
+            self._cmd_trace[cmd_id] = hdr
         if self.leader is not None:
             self._send(
-                self.leader, ClientCommand(cmd_id, self.name, command)
+                self.leader, ClientCommand(cmd_id, self.name, command),
+                trace=tracing.wire_trace(hdr),
             )
+        self._stamp("propose", hdr, t0)
         return fut
 
     def _leader_append(self, command: Any) -> int:
         self.log.append((self.term, command))
         idx = self.last_log_index
+        self._bind_t0(idx)
         self._persist_append(idx)
         self._broadcast_append()
         self._maybe_advance_commit()   # single-member clusters commit now
@@ -560,12 +748,17 @@ class RaftNode:
             m = ser.decode(msg.payload)
         except ser.SerializationError:
             return
+        if msg.trace is not None and self._tracing():
+            # traced frames carry the sender's monotonic send stamp:
+            # the receive pairing is the clock-offset evidence cross-
+            # node assembly orders spans by (tracing.ClockSync)
+            self.tracer.clock_sync.observe_header(msg.sender, msg.trace)
         if isinstance(m, RequestVote):
             self._on_request_vote(m, msg.sender)
         elif isinstance(m, VoteReply):
             self._on_vote_reply(m)
         elif isinstance(m, AppendEntries):
-            self._on_append(m, msg.sender)
+            self._on_append(m, msg.sender, msg.trace)
         elif isinstance(m, InstallSnapshot):
             self._on_install_snapshot(m, msg.sender)
         elif isinstance(m, SnapshotAck):
@@ -574,7 +767,7 @@ class RaftNode:
         elif isinstance(m, AppendReply):
             self._on_append_reply(m)
         elif isinstance(m, ClientCommand):
-            self._on_client_command(m)
+            self._on_client_command(m, msg.trace)
         elif isinstance(m, ClientResult):
             self._on_client_result(m)
 
@@ -606,9 +799,10 @@ class RaftNode:
         if self._quorum(len(self.votes)):
             self._become_leader()
 
-    def _on_append(self, m: AppendEntries, sender: str) -> None:
+    def _on_append(self, m: AppendEntries, sender: str, hdr=None) -> None:
         if sender != m.leader or m.leader not in self.peers:
             return
+        t0 = time.perf_counter() if self._observing() else 0.0
         self._maybe_step_down(m.term)
         if m.term < self.term:
             self._send(
@@ -616,6 +810,8 @@ class RaftNode:
             )
             return
         # valid leader for this term
+        if self.role == CANDIDATE:
+            self._close_vc_span("yielded")
         self.role = FOLLOWER
         self.leader = m.leader
         self.votes = set()
@@ -635,19 +831,39 @@ class RaftNode:
         # append, truncating any conflicting suffix
         insert_at = m.prev_log_index
         changed_from = None
-        for i, (term, command) in enumerate(m.entries):
+        for i, entry in enumerate(m.entries):
+            term, command = entry[0], entry[1]
             idx = insert_at + i + 1
             if idx <= self.snap_index:
                 continue   # compacted == committed: matches by definition
+            # per-entry header, named apart from the MESSAGE-level
+            # `hdr` parameter (the first traced entry's context, which
+            # the batch append span below is stamped into)
+            e_hdr = tuple(entry[2]) if len(entry) > 2 and entry[2] else None
             if idx <= self.last_log_index:
                 if self._term_at(idx) == term:
+                    # term-matched redelivery: bind the header if the
+                    # first copy predated the trace
+                    if e_hdr is not None and idx not in self._entry_trace:
+                        self._bind_trace(idx, e_hdr)
                     continue
                 del self.log[idx - self.snap_index - 1 :]
+                # the truncated entries' trace/timing bindings die with
+                # them: a REPLACEMENT entry at the same index must not
+                # stamp its commit/apply spans into the overwritten
+                # entry's trace
+                for table in (self._entry_trace, self._entry_t0):
+                    for k in [k for k in table if k >= idx]:
+                        del table[k]
             self.log.append((term, list(command) if isinstance(command, tuple) else command))
+            if e_hdr is not None:
+                self._bind_trace(idx, e_hdr)
+            self._bind_t0(idx)
             if changed_from is None:
                 changed_from = idx
         if changed_from is not None:
             self._persist_append(changed_from)
+            self._stamp("append", hdr, t0, batch=len(m.entries))
         if m.leader_commit > self.commit_index:
             self.commit_index = min(m.leader_commit, self.last_log_index)
             self._apply_committed()
@@ -665,7 +881,8 @@ class RaftNode:
         self._flushed_to = self.leader
         for cmd_id, command in list(self._pending_client.items()):
             self._send(
-                self.leader, ClientCommand(cmd_id, self.name, command)
+                self.leader, ClientCommand(cmd_id, self.name, command),
+                trace=tracing.wire_trace(self._cmd_trace.get(cmd_id)),
             )
 
     def _on_append_reply(self, m: AppendReply) -> None:
@@ -749,10 +966,30 @@ class RaftNode:
     def _apply_committed(self) -> None:
         while self.last_applied < self.commit_index:
             self.last_applied += 1
+            idx = self.last_applied
+            if self.role == LEADER:
+                # the leader RETAINS the binding past apply: a follower
+                # that missed the original frames (drop/partition — the
+                # lagging replica this plane exists to identify) gets
+                # the header on the re-send; the snapshot prune and the
+                # table cap bound the retention
+                hdr = self._entry_trace.get(idx)
+            else:
+                hdr = self._entry_trace.pop(idx, None)
+            append_t0 = self._entry_t0.pop(idx, None)
+            observing = self._observing()
+            t_commit = time.perf_counter() if observing else 0.0
+            if self.role == LEADER and append_t0 is not None:
+                # quorum: leader-side wait from local append to the
+                # commit-index advance that covered this entry
+                self._stamp("quorum", hdr, append_t0, t_commit)
             term, command = self._entry(self.last_applied)
+            t_apply = time.perf_counter() if observing else 0.0
             result = (
                 None if command == ["noop"] else self.apply_fn(command)
             )
+            if observing:
+                self._stamp("apply", hdr, t_apply)
             self.applied_count += 1
             entry = self._index_futures.pop(self.last_applied, None)
             if entry is not None:
@@ -785,7 +1022,14 @@ class RaftNode:
                         self._pending_client.pop(cmd_id, None)
                         entry[0].set_result(result)
                 else:
-                    self._send(origin, ClientResult(cmd_id, True, result))
+                    self._send(
+                        origin, ClientResult(cmd_id, True, result),
+                        trace=tracing.wire_trace(hdr),
+                    )
+            if observing:
+                # commit: commit-known to entry-resolved on THIS member
+                # (apply_fn nested inside as raft.apply)
+                self._stamp("commit", hdr, t_commit)
         # a deposed leader's outstanding futures must not hang forever:
         # indexes at/below commit that resolved above are gone; the rest
         # expire via the client-deadline path or on overwrite
@@ -807,6 +1051,11 @@ class RaftNode:
         del self.log[: self.last_applied - self.snap_index]
         self.snap_index = self.last_applied
         self.snap_term = new_term
+        # compacted entries can never be re-sent (InstallSnapshot
+        # covers them): drop their retained trace bindings
+        for table in (self._entry_trace, self._entry_t0):
+            for k in [k for k in table if k <= self.snap_index]:
+                del table[k]
         self._persist_snapshot()
 
     def _on_install_snapshot(self, m: InstallSnapshot, sender: str) -> None:
@@ -847,6 +1096,15 @@ class RaftNode:
                     return
                 buf = bytearray()
                 self._snap_incoming = (*key, buf)
+                if self._catchup_span is None:
+                    # the state-transfer arc: one root span from first
+                    # chunk to installed (or abandoned)
+                    self._catchup_span = self._open_repair_span(
+                        "raft.catch_up"
+                    )
+                    self._catchup_t0 = (
+                        time.perf_counter() if self._observing() else 0.0
+                    )
             elif buf is None or m.offset != len(buf):
                 # out-of-order / superseded chunk: report where we
                 # really are (0 if we hold nothing for this snapshot)
@@ -877,6 +1135,7 @@ class RaftNode:
                 # network speed (an unthrottled loop when the failure
                 # is deterministic); silence lets the leader's stall
                 # re-kick retry at heartbeat pace instead
+                self._close_catchup_span("corrupt")
                 return
         else:
             try:
@@ -916,6 +1175,7 @@ class RaftNode:
         # entries up to the snapshot point are committed on the leader,
         # so they "match" regardless of whether we installed or were
         # already past it
+        self._close_catchup_span("installed")
         self._send(
             m.leader,
             AppendReply(
@@ -923,13 +1183,14 @@ class RaftNode:
             ),
         )
 
-    def _on_client_command(self, m: ClientCommand) -> None:
+    def _on_client_command(self, m: ClientCommand, hdr=None) -> None:
         if m.origin not in self.peers:
             return
         if self.role != LEADER:
             return   # origin re-flushes on leader discovery
         idx = self.last_log_index + 1
         self._forwarded[idx] = (m.origin, m.cmd_id, self.term)
+        self._bind_trace(idx, hdr)
         self._leader_append(m.command)
 
     def _on_client_result(self, m: ClientResult) -> None:
@@ -937,6 +1198,7 @@ class RaftNode:
         if entry is None:
             return
         self._pending_client.pop(m.cmd_id, None)
+        self._cmd_trace.pop(m.cmd_id, None)
         fut, _deadline = entry
         if m.ok:
             fut.set_result(m.value)
@@ -945,8 +1207,15 @@ class RaftNode:
 
     # -- plumbing ------------------------------------------------------------
 
-    def _send(self, peer: str, message) -> None:
-        self.messaging.send(self.topic, ser.encode(message), peer)
+    def _send(self, peer: str, message, trace=None) -> None:
+        if trace is None:
+            # the common untraced path keeps the bare send signature
+            # (narrow test doubles stub send(topic, payload, target))
+            self.messaging.send(self.topic, ser.encode(message), peer)
+        else:
+            self.messaging.send(
+                self.topic, ser.encode(message), peer, trace=trace
+            )
 
     def stop(self) -> None:
         self.stopped = True
@@ -1024,11 +1293,12 @@ class RaftUniquenessProvider:
 
     # the UniquenessProvider surface ----------------------------------------
 
-    def commit_async(self, states, tx_id, requester) -> FlowFuture:
+    def commit_async(self, states, tx_id, requester, trace=None) -> FlowFuture:
         from .notary import UniquenessConflict
 
         raft_fut = self.raft.submit(
-            ["commit", tx_id.bytes_, [ser.encode(r) for r in states]]
+            ["commit", tx_id.bytes_, [ser.encode(r) for r in states]],
+            trace=trace,
         )
         out = FlowFuture()
 

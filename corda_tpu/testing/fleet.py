@@ -63,7 +63,14 @@ from ..crypto.hashes import SecureHash
 from ..node import qos as qoslib
 from ..node.messaging import FabricFaults, Message
 from ..node.notary import NotaryError
-from ..utils.health import AlertRule, ClusterHealth, HealthMonitor, HealthPolicy
+from ..utils import tracing as tracelib
+from ..utils.health import (
+    AlertRule,
+    ClusterHealth,
+    HealthMonitor,
+    HealthPolicy,
+    IncidentRecorder,
+)
 from .mock_network import MockNetwork
 
 
@@ -183,6 +190,7 @@ class RequestRecord:
     outcome: Optional[str] = None
     shed_reason: Optional[str] = None
     rival_of: Optional[int] = None   # rid of the spend this one contests
+    trace_id: Optional[int] = None   # tracing-enabled runs: the root trace
 
 
 # ---------------------------------------------------------------------------
@@ -598,6 +606,11 @@ class FleetReport:
     verify_workers_lost: int = 0
     device_faults: int = 0
     degraded_flushes: int = 0
+    # round-11 tracing plane: per-member tracers, the cross-node
+    # assembler and the incident recorder (None when not enabled)
+    tracers: dict = field(default_factory=dict)
+    cluster_traces: Any = None
+    incidents: Any = None
 
     @property
     def sim_seconds(self) -> float:
@@ -628,6 +641,8 @@ class FleetSim:
         lag_alert_threshold: int = 8,
         verifier_pool: int = 0,
         intent_wal: bool = False,
+        tracing: bool = False,
+        incident_dir: Optional[str] = None,
     ):
         """`verifier_pool` (batching only): attach N out-of-process
         VerifierWorkers on the fabric and an
@@ -638,7 +653,16 @@ class FleetSim:
         under the notary's intake, which is what lets
         kill_notary_mid_flush() complete with ZERO lost admitted
         requests and tightens the checker's loss bound to an equality
-        (check_exact_accounting)."""
+        (check_exact_accounting).
+
+        `tracing` (cluster flavours): every member gets its OWN
+        enabled Tracer, each submitted request opens a root span whose
+        context rides the consensus protocol, and `cluster_traces`
+        assembles any request's cross-node tree over a simulated
+        /traces pull. `incident_dir`: an IncidentRecorder under it —
+        firing member alerts snapshot forensics bundles (assembled
+        cross-node traces included when tracing is on) and failed
+        reconciliations cite a bundle id."""
         if flavour not in FLAVOURS:
             raise ValueError(f"unknown fleet flavour {flavour!r}")
         if (verifier_pool or intent_wal) and flavour != "batching":
@@ -654,6 +678,32 @@ class FleetSim:
         self._partitioned: Optional[str] = None
         self._rng = random.Random(scenario.seed ^ 0x5EED)
         scheme = schemes.ECDSA_SECP256R1_SHA256
+
+        # -- per-member tracing (cluster-wide trace assembly) ---------------
+        self._tracing = bool(tracing)
+        self.tracers: dict[str, tracelib.Tracer] = {}
+        self._spans: dict[int, Any] = {}   # rid -> open root span
+
+        def tracer_for(name: str) -> tracelib.Tracer:
+            # memoized: a kill/restart rebuild re-attaches the SAME
+            # member tracer (the sim's stand-in for a node's recorder
+            # surviving in the assembly story). Recorders are sized to
+            # the soak: each consensus phase span completes as its own
+            # recorder entry, and a 64-deep recent ring would evict a
+            # follower's µs-scale spans long before the incident
+            # bundle pulls them.
+            t = self.tracers.get(name)
+            if t is None:
+                t = tracelib.Tracer(
+                    enabled=True,
+                    recorder=tracelib.FlightRecorder(
+                        keep_recent=4096, keep_slowest=64
+                    ),
+                )
+                self.tracers[name] = t
+            return t
+
+        self._tracer_for = tracer_for
 
         # -- the cluster ----------------------------------------------------
         if flavour == "batching":
@@ -683,7 +733,8 @@ class FleetSim:
         elif flavour == "raft":
             self.service_party, self.members = (
                 self.net.create_raft_notary_cluster(
-                    cluster_size or 3, scheme_id=scheme
+                    cluster_size or 3, scheme_id=scheme,
+                    tracer_factory=self._tracer_for if tracing else None,
                 )
             )
             self.qos = None
@@ -692,7 +743,8 @@ class FleetSim:
         else:
             self.service_party, self.members = (
                 self.net.create_bft_notary_cluster(
-                    cluster_size or 4, scheme_id=scheme
+                    cluster_size or 4, scheme_id=scheme,
+                    tracer_factory=self._tracer_for if tracing else None,
                 )
             )
             self.qos = None
@@ -742,7 +794,13 @@ class FleetSim:
         self.monitors: dict[str, HealthMonitor] = {}
         self._beats = {}
         for m in self.members:
-            mon = HealthMonitor(clock=self.net.clock, policy=policy)
+            mon = HealthMonitor(
+                clock=self.net.clock, policy=policy,
+                # with tracing on, alert evidence cites the member's
+                # OWN slowest traces — what the incident bundle's
+                # cross-node assembly starts from
+                tracer=self.tracers.get(m.name),
+            )
             self.monitors[m.name] = mon
             self._beats[m.name] = mon.heartbeat(f"{m.name}.pump")
             if self.flavour in ("raft", "bft"):
@@ -756,6 +814,9 @@ class FleetSim:
                         ),
                         for_micros=scenario.round_micros,
                         clear_for_micros=scenario.round_micros,
+                        # evidence: traces that actually carry this
+                        # flavour's consensus phase spans
+                        trace_filter=self.flavour,
                     )
                 )
         rollup_home = self.members[0].name
@@ -772,6 +833,34 @@ class FleetSim:
             clock_fn=self.net.clock.now_micros,
             cache_ttl_micros=0,      # every sample is a fresh pull
         )
+
+        # -- cross-node trace assembly + incident forensics -----------------
+        self.cluster_traces = None
+        if self._tracing:
+            home = self.members[0].name
+            self.cluster_traces = tracelib.ClusterTraces(
+                home,
+                self._tracer_for(home),
+                peers_fn=lambda: {
+                    m.name: f"fleet://{m.name}" for m in self.members
+                },
+                fetch=self._fetch_peer_traces,
+            )
+        self.incidents = None
+        if incident_dir is not None:
+            self.incidents = IncidentRecorder(
+                incident_dir,
+                clock_fn=self.net.clock.now_micros,
+                assemble=(
+                    self.cluster_traces.assemble
+                    if self.cluster_traces is not None else None
+                ),
+                chaos_log=lambda: self.chaos.log,
+            )
+            for m in self.members:
+                self.monitors[m.name].attach_incidents(
+                    self.incidents, node=m.name
+                )
 
         # -- round-9 fault plane (batching seams) ---------------------------
         self._fault_arc = bool(verifier_pool or intent_wal) or any(
@@ -890,6 +979,24 @@ class FleetSim:
         if self.faults.blocked(home, name) or self.faults.blocked(name, home):
             raise ConnectionError(f"{name} unreachable from {home}")
         return self.monitors[name].snapshot(summary=True)
+
+    def _fetch_peer_traces(self, url: str) -> dict:
+        """The /cluster/trace transport, simulated: the peer's filtered
+        GET /traces payload, with the same reachability rules as the
+        health pull."""
+        from urllib.parse import parse_qs, urlparse
+
+        parsed = urlparse(url)
+        name = parsed.netloc
+        home = self.cluster_traces.self_name
+        if not self.alive.get(name, False):
+            raise ConnectionError(f"{name} is down")
+        if self.faults.blocked(home, name) or self.faults.blocked(name, home):
+            raise ConnectionError(f"{name} unreachable from {home}")
+        tid = tracelib.parse_trace_id(
+            parse_qs(parsed.query).get("trace_id", [None])[0]
+        )
+        return self._tracer_for(name).export(trace_id=tid)
 
     def _lag_check(self, name: str, threshold: int):
         lag = self.consensus_lag(name)
@@ -1099,8 +1206,20 @@ class FleetSim:
             )
             self._live.append([None, fut, rec])
         else:
+            trace = None
+            if self._tracing:
+                # the trace is born at the gateway member (the fleet's
+                # stand-in for the client node): a root span whose
+                # context the consensus layer threads to every member
+                span = self._tracer_for(member.name).start_trace(
+                    "notarise.fleet",
+                    tx_id=str(tx_id), requester=client.name,
+                )
+                self._spans[rec.rid] = span
+                rec.trace_id = span.trace_id
+                trace = tuple(span.context)
             gen = member.services.notary_service.process(
-                ftx, client.party, deadline=deadline
+                ftx, client.party, deadline=deadline, trace=trace
             )
             self._live.append([gen, None, rec])
         client.submitted += 1
@@ -1218,6 +1337,9 @@ class FleetSim:
 
     def _record_answer(self, rec: RequestRecord, value) -> None:
         rec.answered_at = self.now()
+        span = self._spans.pop(rec.rid, None)
+        if span is not None:
+            span.end()
         if isinstance(value, NotaryError):
             if value.kind == qoslib.SHED_KIND:
                 rec.outcome = OUT_SHED
@@ -1321,6 +1443,9 @@ class FleetSim:
                 break
         for gen, wait, rec in self._live:
             rec.outcome = OUT_LOST
+            span = self._spans.pop(rec.rid, None)
+            if span is not None:
+                span.end()
         self._live = []
         for _ in range(s.settle_rounds):
             self._round("settle")
@@ -1385,6 +1510,9 @@ class FleetSim:
                 + _metric_count(svc.metrics, "Notary.DegradedFlushes")
                 if self.flavour == "batching" else 0
             ),
+            tracers=dict(self.tracers),
+            cluster_traces=self.cluster_traces,
+            incidents=self.incidents,
         )
 
     # -- reconciliation inputs ----------------------------------------------
@@ -1799,7 +1927,31 @@ class InvariantChecker:
         expect_brownout: bool = False,
     ) -> dict:
         """The full reconciliation; returns a JSON-safe verdict dict
-        (bench.py's fleet metric embeds it)."""
+        (bench.py's fleet metric embeds it). With an IncidentRecorder
+        on the report, a FAILED check snapshots a reconciliation
+        bundle (the failure text, the chaos log, the monitors' event
+        story) and the re-raised AssertionError CITES its id — the
+        forensics artifact is minted at the moment the invariant
+        broke, not reconstructed from memory later."""
+        try:
+            self._check_all_inner(
+                slo_p99_micros, expect_conflicts, expect_brownout
+            )
+        except AssertionError as e:
+            incident_id = self._record_reconciliation_failure(e)
+            if incident_id is not None:
+                raise AssertionError(
+                    f"{e} [incident {incident_id}]"
+                ) from e
+            raise
+        return self._verdict()
+
+    def _check_all_inner(
+        self,
+        slo_p99_micros: Optional[int],
+        expect_conflicts: bool,
+        expect_brownout: bool,
+    ) -> None:
         self.check_replica_agreement()
         self.check_ledger_vs_answers()
         if expect_conflicts:
@@ -1819,6 +1971,42 @@ class InvariantChecker:
             self.check_brownout_engaged_during_spike()
         if self.report.chaos_log:
             self.check_health_story()
+
+    def _record_reconciliation_failure(self, exc) -> Optional[str]:
+        recorder = self.report.incidents
+        if recorder is None:
+            return None
+        # the slowest signed requests' trace ids: the bundle pulls
+        # their cross-node assemblies when the sim traced them
+        traced = sorted(
+            (
+                r for r in self.report.records
+                if r.trace_id is not None and r.answered_at is not None
+            ),
+            key=lambda r: r.answered_at - r.submitted_at,
+            reverse=True,
+        )
+        evidence = {
+            "traces": [
+                {"trace_id": f"{r.trace_id:#x}"} for r in traced[:3]
+            ],
+        }
+        monitors = self.report.monitors
+        home = self.report.members[0] if self.report.members else None
+        try:
+            return recorder.record(
+                "reconciliation",
+                "fleet.invariant_failed",
+                detail={"failure": str(exc)},
+                severity="critical",
+                evidence=evidence,
+                monitor=monitors.get(home) if home else None,
+                node=home,
+            )
+        except Exception:
+            return None   # forensics must not mask the real failure
+
+    def _verdict(self) -> dict:
         out = self.report.outcomes()
         return {
             "reconciled": True,

@@ -222,6 +222,8 @@ class MockNetwork:
         name: str = "RaftNotary",
         validating: bool = False,
         scheme_id: int = schemes.DEFAULT_SCHEME,
+        tracer_factory=None,
+        metrics_factory=None,
     ):
         """n MockNodes forming one Raft notary cluster behind a shared
         service identity (reference: notary-demo Raft cluster,
@@ -230,7 +232,11 @@ class MockNetwork:
         (see tests/test_raft_notary.py drive helper). `scheme_id` picks
         the member/service signature scheme — fleet soaks use secp256r1
         (cheap pure-python keygen/sign) so thousand-request runs fit in
-        CI seconds."""
+        CI seconds. `tracer_factory(member_name)` / `metrics_factory(
+        member_name)` optionally hand each member its OWN tracer /
+        metric registry — consensus-phase spans and Raft.Phase.* timers
+        land per member, the shape cross-node trace assembly tests
+        against (None keeps the bare protocol)."""
         import random as _random
 
         from ..core.identity import Party
@@ -263,6 +269,12 @@ class MockNetwork:
                     self.clock,
                     db=getattr(_node.services, "db", None),
                     rng=_random.Random(self.rng.getrandbits(32)),
+                    tracer=(
+                        tracer_factory(_mname) if tracer_factory else None
+                    ),
+                    metrics=(
+                        metrics_factory(_mname) if metrics_factory else None
+                    ),
                     **raft_kw,
                 )
                 _node.raft = raft
@@ -299,6 +311,8 @@ class MockNetwork:
         n: int = 4,
         name: str = "BFTNotary",
         scheme_id: int = schemes.DEFAULT_SCHEME,
+        tracer_factory=None,
+        metrics_factory=None,
     ):
         """3f+1 MockNodes forming a BFT notary cluster. The service
         identity is a CompositeKey(threshold=f+1) over the member keys
@@ -345,6 +359,14 @@ class MockNetwork:
                     self.clock,
                     cluster=name,
                     rng=_random.Random(self.rng.getrandbits(32)),
+                    tracer=(
+                        tracer_factory(_node.name)
+                        if tracer_factory else None
+                    ),
+                    metrics=(
+                        metrics_factory(_node.name)
+                        if metrics_factory else None
+                    ),
                 )
                 _node.bft = replica
                 _node.ticks.append(replica.tick)

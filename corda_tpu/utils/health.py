@@ -220,13 +220,34 @@ class Watchdog:
 class HealthEventLog:
     """Structured event log: bounded in-memory tail (what GET /health
     serves) plus optional append-only JSON-lines file — the durable
-    record an operator greps after the incident."""
+    record an operator greps after the incident.
 
-    def __init__(self, capacity: int = 512, path: Optional[str] = None):
+    The on-disk file is BOUNDED too: once it grows past `max_bytes`
+    it rotates to `<path>.1` (replacing the previous rotation), so a
+    long-lived node holds at most ~2x max_bytes of event history —
+    the in-memory tail was always bounded, but the file used to grow
+    forever."""
+
+    def __init__(
+        self,
+        capacity: int = 512,
+        path: Optional[str] = None,
+        max_bytes: int = 4 << 20,
+    ):
         self._lock = threading.Lock()
         self._tail: deque = deque(maxlen=max(8, capacity))
         self.path = path
+        self.max_bytes = max(4096, int(max_bytes))
         self.appended = 0
+        self.rotations = 0
+        self._file_bytes = 0
+        if path:
+            try:
+                import os as _os
+
+                self._file_bytes = _os.path.getsize(path)
+            except OSError:
+                self._file_bytes = 0
 
     def append(self, record: dict) -> None:
         line = json.dumps(record, default=str, sort_keys=True)
@@ -235,6 +256,14 @@ class HealthEventLog:
             self.appended += 1
         if self.path:
             try:
+                with self._lock:
+                    if self._file_bytes >= self.max_bytes:
+                        import os as _os
+
+                        _os.replace(self.path, self.path + ".1")
+                        self._file_bytes = 0
+                        self.rotations += 1
+                    self._file_bytes += len(line) + 1
                 with open(self.path, "a") as f:
                     f.write(line + "\n")
             except OSError:
@@ -585,6 +614,11 @@ class HealthMonitor:
         self._rules_lock = threading.Lock()
         self._alerts: dict[str, _Alert] = {}
         self.canary: Optional[CanaryProbe] = None
+        # incident forensics (attach_incidents): every firing
+        # transition snapshots a durable evidence bundle
+        self.incidents: Optional["IncidentRecorder"] = None
+        self._incident_node: Optional[str] = None
+        self._incident_background = False
         # last liveness verdict seen by tick(): healthz FLIPS land in
         # the event log as first-class records, so post-hoc forensics
         # (and chaos-rig invariant checkers) can reconcile "when did
@@ -671,6 +705,24 @@ class HealthMonitor:
             RingRule(f"ring.{name}", depth_fn, capacity, self.policy,
                      parked_fn=parked_fn)
         )
+
+    def attach_incidents(
+        self,
+        recorder: "IncidentRecorder",
+        node: Optional[str] = None,
+        background: bool = False,
+    ) -> "IncidentRecorder":
+        """Wire incident forensics: every alert FIRING transition from
+        now on snapshots a durable bundle (see IncidentRecorder) whose
+        id lands in the alert's evidence and event-log line.
+        `background=True` (production nodes) moves the capture — the
+        cross-node pulls and the disk write — off the pump tick onto a
+        daemon thread; simulated-time rigs keep the synchronous
+        default."""
+        self.incidents = recorder
+        self._incident_node = node
+        self._incident_background = background
+        return recorder
 
     def attach_canary(
         self,
@@ -792,6 +844,24 @@ class HealthMonitor:
                 alert.fired_at_micros = now
                 alert.fire_count += 1
                 alert.evidence = self._capture_evidence(rule, detail)
+                if self.incidents is not None:
+                    # the forensics bundle: captured AT the firing
+                    # transition (rare — hysteresis gates it), never
+                    # fatal to the tick
+                    try:
+                        alert.evidence["incident_id"] = (
+                            self.incidents.record(
+                                "alert", rule.name,
+                                detail=detail,
+                                severity=rule.severity,
+                                evidence=alert.evidence,
+                                monitor=self,
+                                node=self._incident_node,
+                                background=self._incident_background,
+                            )
+                        )
+                    except Exception:
+                        pass
                 self.events.append({
                     "at_micros": now,
                     "event": "firing",
@@ -1079,6 +1149,269 @@ class ClusterHealth:
             "stale_peers": sorted(stale),
             "at_micros": now,
         }
+
+
+# ---------------------------------------------------------------------------
+# incident forensics bundles
+
+
+class IncidentRecorder:
+    """Durable evidence bundles for firing alerts and failed fleet
+    invariants, written to `base_dir/incidents/<id>.json` with bounded
+    retention and served at GET /incidents.
+
+    A bundle is everything a post-hoc debugger reaches for, captured
+    AT the moment the alert fired instead of reconstructed later: the
+    firing alert (name, severity, detail), the slowest matching traces
+    — INCLUDING their remote halves when a cross-node assembler
+    (`tracing.ClusterTraces.assemble`) is wired — a metrics snapshot,
+    the health-event tail, and the chaos plane's injected-reality log
+    when one exists (fleet rigs: what was DONE to the system next to
+    what the system SAID). Capture is best-effort end to end: an
+    unreachable peer or full disk degrades the bundle, never the
+    health tick that triggered it."""
+
+    def __init__(
+        self,
+        dir_path: str,
+        clock_fn: Optional[Callable[[], int]] = None,
+        keep: int = 32,
+        assemble: Optional[Callable[[int], dict]] = None,
+        chaos_log: Optional[Callable[[], list]] = None,
+        max_traces: int = 3,
+    ):
+        import os
+
+        self.dir_path = dir_path
+        self._clock_fn = clock_fn or (
+            lambda: __import__("time").time_ns() // 1_000
+        )
+        self.keep = max(1, int(keep))
+        self.assemble = assemble
+        self.chaos_log = chaos_log
+        self.max_traces = max(0, int(max_traces))
+        self._lock = threading.Lock()
+        self._seq = 0
+        self.recorded = 0
+        # GET /incidents headline cache: bundles embed whole assembled
+        # traces, so the index must not re-read and re-parse every
+        # bundle file per request — rows cache by (name, mtime)
+        self._headlines: dict[str, tuple[float, dict]] = {}
+        os.makedirs(dir_path, exist_ok=True)
+
+    # -- capture -------------------------------------------------------------
+
+    def record(
+        self,
+        kind: str,
+        name: str,
+        detail: Optional[dict] = None,
+        severity: str = SEV_WARNING,
+        evidence: Optional[dict] = None,
+        monitor: Optional["HealthMonitor"] = None,
+        node: Optional[str] = None,
+        background: bool = False,
+    ) -> str:
+        """Snapshot one incident; returns its id. `kind` is "alert" or
+        "reconciliation"; `evidence` is the alert's captured evidence
+        (trace ids + metrics snapshot) whose trace ids get their
+        cross-node assembly pulled via `assemble`.
+
+        `background=True` mints and returns the id immediately and
+        runs the CAPTURE (the cross-node pulls + the disk write) on a
+        daemon thread: an alert fires exactly when peers tend to be
+        unreachable, and N peers x the fetch timeout of synchronous
+        assembly would stall the very pump tick that fired it —
+        flipping healthz and escalating the incident being recorded.
+        Simulated-time rigs keep the synchronous default (deterministic
+        bundles, no clock to stall)."""
+        now = self._clock_fn()
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+        slug = "".join(
+            ch if ch.isalnum() or ch in "._-" else "-" for ch in name
+        )[:48]
+        incident_id = f"inc-{now}-{seq:03d}-{slug}"
+        # snapshot the caller's dicts NOW: the firing path mutates the
+        # live alert.evidence right after this call returns (it stores
+        # the incident id into it), and a background capture iterating
+        # the same dict mid-mutation would die on 'dictionary changed
+        # size'. The JSON round-trip doubles as the JSON-safety check
+        # _write would otherwise hit at dump time.
+        detail = json.loads(json.dumps(detail or {}, default=str))
+        evidence = json.loads(json.dumps(evidence or {}, default=str))
+        if background:
+            def run():
+                try:
+                    self._capture(
+                        incident_id, now, kind, name, detail, severity,
+                        evidence, monitor, node,
+                    )
+                except Exception:   # a dead capture must not be silent
+                    import logging
+
+                    logging.getLogger("corda_tpu.health").exception(
+                        "incident capture %s failed", incident_id
+                    )
+
+            threading.Thread(
+                target=run, daemon=True, name=f"incident-{seq}",
+            ).start()
+        else:
+            self._capture(
+                incident_id, now, kind, name, detail, severity,
+                evidence, monitor, node,
+            )
+        return incident_id
+
+    def _capture(
+        self, incident_id, now, kind, name, detail, severity,
+        evidence, monitor, node,
+    ) -> None:
+        bundle: dict = {
+            "id": incident_id,
+            "at_micros": now,
+            "kind": kind,
+            "node": node,
+            "alert": {
+                "name": name,
+                "severity": severity,
+                "detail": detail or {},
+            },
+            "evidence": evidence or {},
+        }
+        traces = []
+        for row in (evidence or {}).get("traces", ())[: self.max_traces]:
+            tid_text = row.get("trace_id") if isinstance(row, dict) else row
+            assembled = self._assemble_one(tid_text)
+            if assembled is not None:
+                traces.append(assembled)
+        bundle["traces"] = traces
+        if monitor is not None:
+            try:
+                bundle["events"] = monitor.events.tail(64)
+            except Exception:
+                bundle["events"] = []
+            if "metrics" not in bundle["evidence"]:
+                try:
+                    bundle["evidence"]["metrics"] = (
+                        monitor._metrics_snapshot()
+                    )
+                except Exception:
+                    pass
+        if self.chaos_log is not None:
+            try:
+                bundle["chaos"] = list(self.chaos_log())
+            except Exception:
+                bundle["chaos"] = []
+        self._write(incident_id, bundle)
+        self.recorded += 1
+
+    def _assemble_one(self, tid_text) -> Optional[dict]:
+        from . import tracing as tracelib
+
+        tid = tracelib.parse_trace_id(tid_text)
+        if tid is None:
+            return None
+        if self.assemble is None:
+            return {"trace_id": f"{tid:#x}", "assembled": False}
+        try:
+            out = dict(self.assemble(tid))
+            out["assembled"] = True
+            return out
+        except Exception as e:   # partial evidence beats no bundle
+            return {
+                "trace_id": f"{tid:#x}",
+                "assembled": False,
+                "error": f"{type(e).__name__}: {e}",
+            }
+
+    def _write(self, incident_id: str, bundle: dict) -> None:
+        import os
+
+        path = os.path.join(self.dir_path, incident_id + ".json")
+        try:
+            with open(path, "w") as f:
+                json.dump(bundle, f, default=str, indent=1)
+            self._prune()
+        except OSError:
+            pass   # full disk: the alert still fired, the node serves on
+
+    def _prune(self) -> None:
+        import os
+
+        names = sorted(
+            n for n in os.listdir(self.dir_path) if n.endswith(".json")
+        )
+        # ids sort chronologically (micros-stamped), oldest first
+        for n in names[: max(0, len(names) - self.keep)]:
+            try:
+                os.remove(os.path.join(self.dir_path, n))
+            except OSError:
+                pass
+
+    # -- serving (GET /incidents) --------------------------------------------
+
+    def list(self) -> list[dict]:
+        """Newest-first index: id plus the alert headline per bundle.
+        Each bundle file is parsed once per (name, mtime) — the cache
+        keeps repeated GET /incidents hits from re-reading every
+        multi-trace bundle in full for seven scalar fields."""
+        import os
+
+        out = []
+        try:
+            names = sorted(os.listdir(self.dir_path), reverse=True)
+        except OSError:
+            return []
+        seen = set()
+        for n in names:
+            if not n.endswith(".json"):
+                continue
+            seen.add(n)
+            try:
+                mtime = os.path.getmtime(
+                    os.path.join(self.dir_path, n)
+                )
+            except OSError:
+                continue
+            with self._lock:
+                cached = self._headlines.get(n)
+            if cached is not None and cached[0] == mtime:
+                out.append(cached[1])
+                continue
+            bundle = self.load(n[:-5])
+            if bundle is None:
+                continue
+            row = {
+                "id": bundle.get("id", n[:-5]),
+                "at_micros": bundle.get("at_micros"),
+                "kind": bundle.get("kind"),
+                "node": bundle.get("node"),
+                "alert": (bundle.get("alert") or {}).get("name"),
+                "severity": (bundle.get("alert") or {}).get("severity"),
+                "traces": len(bundle.get("traces") or ()),
+            }
+            with self._lock:
+                self._headlines[n] = (mtime, row)
+            out.append(row)
+        with self._lock:
+            for n in [k for k in self._headlines if k not in seen]:
+                del self._headlines[n]   # pruned bundles leave the cache
+        return out
+
+    def load(self, incident_id: str) -> Optional[dict]:
+        import os
+
+        if "/" in incident_id or ".." in incident_id:
+            return None   # path traversal via the URL id
+        path = os.path.join(self.dir_path, incident_id + ".json")
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
 
 
 # ---------------------------------------------------------------------------

@@ -46,7 +46,7 @@ import os
 import random
 import threading
 import time
-from typing import Any, Iterable, Optional
+from typing import Any, Callable, Iterable, Optional
 
 
 class SpanContext(tuple):
@@ -71,15 +71,90 @@ class SpanContext(tuple):
 
     @classmethod
     def from_header(cls, header) -> Optional["SpanContext"]:
-        """None-tolerant decode of a propagated header (a 2-sequence of
-        ints, a SpanContext, or None/malformed -> None)."""
+        """None-tolerant decode of a propagated header (a sequence of
+        >= 2 ints, a SpanContext, or None/malformed -> None). Extra
+        elements — the wire form appends a send timestamp for clock-
+        offset estimation (`wire_trace`) — are ignored here."""
         if header is None:
             return None
         try:
-            trace_id, span_id = header
-            return cls(int(trace_id), int(span_id))
+            return cls(int(header[0]), int(header[1]))
         except Exception:
             return None
+
+
+def wire_trace(parent) -> Optional[tuple]:
+    """The fabric-header form of a trace context: `(trace_id, span_id,
+    sent_at_us)` where `sent_at_us` is the SENDER's monotonic clock
+    (time.perf_counter microseconds) at send time. The receiver pairs
+    it with its own arrival clock (`ClockSync.observe`), which is what
+    lets `ClusterTraces` put two processes' span timestamps on one
+    honest axis. Accepts a live Span, a SpanContext, or a prior wire
+    header (re-stamping the timestamp for the new hop); None in,
+    None out."""
+    if parent is None:
+        return None
+    ctx = parent.context if isinstance(parent, (Span, _NoopSpan)) \
+        else SpanContext.from_header(parent)
+    if ctx is None:
+        return None
+    return (ctx.trace_id, ctx.span_id, int(time.perf_counter() * 1e6))
+
+
+class ClockSync:
+    """Per-peer clock-offset evidence from fabric send/recv pairs.
+
+    Span timestamps are process-local `time.perf_counter` readings —
+    two nodes' spans live on unrelated axes. Every traced frame's wire
+    header carries the sender's send time; the receiver records
+    `skew = recv_local - sent_peer = offset + network_delay`, so the
+    MINIMUM skew over many frames is the tightest available upper
+    bound on `offset` (local minus peer). With the PEER's minimum for
+    the reverse direction (pulled from its /traces export),
+    `ClusterTraces` takes the NTP-style midpoint
+    `(fwd_min - bwd_min) / 2`, accurate to half the minimum RTT."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # peer -> [min skew micros, observation count]
+        self._obs: dict[str, list] = {}
+
+    def observe(self, peer: str, sent_us, recv_us: Optional[int] = None) -> None:
+        if recv_us is None:
+            recv_us = int(time.perf_counter() * 1e6)
+        skew = int(recv_us) - int(sent_us)
+        with self._lock:
+            row = self._obs.get(peer)
+            if row is None:
+                self._obs[peer] = [skew, 1]
+            else:
+                if skew < row[0]:
+                    row[0] = skew
+                row[1] += 1
+
+    def observe_header(self, peer: str, header) -> None:
+        """Record a wire-header observation if the header carries a
+        send timestamp (3rd element); no-op otherwise."""
+        if header is not None and len(header) >= 3:
+            try:
+                self.observe(peer, int(header[2]))
+            except (TypeError, ValueError):
+                pass
+
+    def min_skew(self, peer: str) -> Optional[int]:
+        with self._lock:
+            row = self._obs.get(peer)
+            return row[0] if row else None
+
+    def export(self) -> dict:
+        """JSON-safe per-peer evidence — served inside GET /traces so a
+        remote assembler can read this node's view of the reverse
+        direction."""
+        with self._lock:
+            return {
+                peer: {"min_skew_us": row[0], "count": row[1]}
+                for peer, row in sorted(self._obs.items())
+            }
 
 
 class _NoopSpan:
@@ -317,11 +392,17 @@ class Tracer:
     ):
         self.enabled = enabled
         self.recorder = recorder if recorder is not None else FlightRecorder()
+        # per-peer clock-offset evidence (see ClockSync): consensus
+        # layers feed it from traced fabric frames; /traces exports it
+        self.clock_sync = ClockSync()
         self._lock = threading.Lock()
-        # trace ids are salted per-tracer so two processes' traces can
-        # merge into one recorder/export without colliding; span ids
-        # only need uniqueness within the tracer
+        # trace AND span ids are salted per-tracer: two processes'
+        # spans merge into one cross-node assembly (ClusterTraces), so
+        # a bare per-tracer counter would collide span ids across
+        # nodes — every node's first span would be id 1, and the
+        # merged tree's parent links would be ambiguous
         self._trace_salt = random.getrandbits(32) << 20
+        self._span_salt = random.getrandbits(32) << 20
         self._next_trace = 0
         self._next_span = 0
         self._open: dict[int, list] = {}   # trace_id -> [spans, n_open]
@@ -376,8 +457,9 @@ class Tracer:
         with self._lock:
             self._next_span += 1
             span = Span(
-                self, name, trace_id, self._next_span, parent_id,
-                time.perf_counter(), dict(attributes) if attributes else None,
+                self, name, trace_id, self._span_salt + self._next_span,
+                parent_id, time.perf_counter(),
+                dict(attributes) if attributes else None,
             )
             state = self._open.get(trace_id)
             if state is None:
@@ -405,14 +487,40 @@ class Tracer:
 
     # -- export -------------------------------------------------------------
 
-    def export(self) -> dict:
+    def export(
+        self,
+        trace_id: Optional[int] = None,
+        name: Optional[str] = None,
+        limit: Optional[int] = None,
+    ) -> dict:
         """The GET /traces payload: chrome://tracing-loadable (object
-        form with `traceEvents`) plus the per-stage latency summary."""
+        form with `traceEvents`) plus the per-stage latency summary.
+
+        Server-side filtering (the ClusterTraces pull path, and the
+        cure for the unbounded serialize-everything payload):
+        `trace_id` keeps only traces with that id (a cross-node trace
+        may retain SEVERAL Trace objects per id — remote phase spans
+        complete independently — all are kept), `name` keeps traces
+        with any span name containing the substring, `limit` caps the
+        trace count AFTER filtering (slowest-first order, so the cap
+        keeps what an operator is hunting)."""
         traces = self.recorder.traces() if self.recorder else []
+        total_retained = len(traces)
+        if trace_id is not None:
+            traces = [t for t in traces if t.trace_id == trace_id]
+        if name:
+            traces = [
+                t for t in traces
+                if any(name in s.name for s in t.spans)
+            ]
+        if limit is not None and limit >= 0:
+            traces = traces[:limit]
         out = chrome_trace(traces)
         out["stageSummary"] = stage_summary(traces)
         out["tracesRecorded"] = self.recorder.recorded if self.recorder else 0
-        out["tracesRetained"] = len(traces)
+        out["tracesRetained"] = total_retained
+        out["tracesReturned"] = len(traces)
+        out["clockSync"] = self.clock_sync.export()
         out["enabled"] = self.enabled
         return out
 
@@ -486,6 +594,178 @@ def stage_summary(traces: Iterable[Trace]) -> dict:
         row["max_s"] = round(row["max_s"], 9)
         row["mean_s"] = round(row["total_s"] / row["count"], 9)
     return agg
+
+
+# -- cross-node trace assembly ------------------------------------------------
+
+
+def parse_trace_id(text) -> Optional[int]:
+    """Trace-id query decode: hex (`0x...` — the form every export and
+    evidence row prints) or decimal; None on garbage."""
+    if text is None:
+        return None
+    try:
+        s = str(text).strip()
+        return int(s, 16) if s.lower().startswith("0x") else int(s)
+    except ValueError:
+        return None
+
+
+class ClusterTraces:
+    """Cross-node trace assembly: serve `GET /cluster/trace/<id>` from
+    ANY node (the ClusterHealth shape, riding the same network-map
+    `web_port` advertisement).
+
+    `assemble(trace_id)` pulls the matching span set from every peer's
+    flight recorder (`GET /traces?trace_id=...` — the filtered form),
+    estimates each peer's clock offset from fabric send/recv timestamp
+    pairs (this node's ClockSync forward minimum paired with the
+    peer's exported reverse minimum — NTP-style midpoint, one-way
+    upper bound when only one direction has evidence), shifts remote
+    span timestamps onto the LOCAL monotonic axis, and merges
+    everything into one causally-linked tree plus a per-member
+    consensus-phase summary — the artifact that answers "where did
+    this distributed commit spend its time, per replica".
+
+    `peers_fn() -> {name: base_url}`; unreachable peers degrade to an
+    `errors` entry, never a failed assembly (same stance as the
+    health rollup)."""
+
+    def __init__(
+        self,
+        self_name: str,
+        tracer: Tracer,
+        peers_fn: Callable[[], dict],
+        fetch: Optional[Callable[[str], dict]] = None,
+        timeout: float = 1.5,
+    ):
+        self.self_name = self_name
+        self.tracer = tracer
+        self._peers_fn = peers_fn
+        self._fetch = fetch or self._http_fetch
+        self.timeout = timeout
+
+    def _http_fetch(self, url: str) -> dict:
+        import json
+        import urllib.request
+
+        with urllib.request.urlopen(url, timeout=self.timeout) as resp:
+            return json.loads(resp.read())
+
+    # -- span collection -----------------------------------------------------
+
+    def _local_payload(self, trace_id: int) -> dict:
+        return self.tracer.export(trace_id=trace_id)
+
+    @staticmethod
+    def _span_events(payload: dict) -> list[dict]:
+        """The complete ('X') span events of one /traces payload."""
+        return [
+            e for e in payload.get("traceEvents", ())
+            if e.get("ph") == "X"
+        ]
+
+    def _offset_for(self, peer: str, payload: dict) -> tuple[int, str]:
+        """(offset_us, quality): add `offset_us` to the PEER's span
+        timestamps to land them on the local monotonic axis."""
+        fwd = self.tracer.clock_sync.min_skew(peer)
+        bwd_row = (payload.get("clockSync") or {}).get(self.self_name)
+        bwd = bwd_row.get("min_skew_us") if bwd_row else None
+        if fwd is not None and bwd is not None:
+            # fwd = off + d1, bwd = -off + d2: the midpoint cancels the
+            # offset's sign, residual error <= min-RTT / 2
+            return (int(fwd) - int(bwd)) // 2, "paired"
+        if fwd is not None:
+            return int(fwd), "one_way"
+        if bwd is not None:
+            return -int(bwd), "one_way"
+        return 0, "none"
+
+    # -- the rollup ----------------------------------------------------------
+
+    def assemble(self, trace_id: int) -> dict:
+        spans: list[dict] = []
+        offsets: dict[str, dict] = {}
+        errors: dict[str, str] = {}
+
+        def add(node: str, payload: dict, offset_us: int) -> None:
+            for e in self._span_events(payload):
+                args = e.get("args") or {}
+                spans.append({
+                    "name": e["name"],
+                    "node": node,
+                    "ts_us": round(e["ts"] + offset_us, 3),
+                    "dur_us": e["dur"],
+                    "span_id": args.get("span_id"),
+                    "parent_span_id": args.get("parent_span_id"),
+                    "attributes": {
+                        k: v for k, v in args.items()
+                        if k not in ("span_id", "parent_span_id", "trace_id")
+                    },
+                })
+
+        add(self.self_name, self._local_payload(trace_id), 0)
+        for name, base in sorted(self._peers_fn().items()):
+            if name == self.self_name:
+                continue
+            url = f"{base}/traces?trace_id={trace_id:#x}"
+            try:
+                payload = self._fetch(url)
+            except Exception as e:   # unreachable peer: partial, not fatal
+                errors[name] = f"{type(e).__name__}: {e}"
+                continue
+            offset_us, quality = self._offset_for(name, payload)
+            offsets[name] = {"offset_us": offset_us, "quality": quality}
+            add(name, payload, offset_us)
+
+        spans.sort(key=lambda s: s["ts_us"])
+        have = {s["span_id"] for s in spans}
+        roots = [
+            s["span_id"] for s in spans
+            if s.get("parent_span_id") not in have
+        ]
+        return {
+            "trace_id": f"{trace_id:#x}",
+            "self": self.self_name,
+            "found": bool(spans),
+            "spans": spans,
+            "span_count": len(spans),
+            "roots": roots,
+            "members": sorted({s["node"] for s in spans}),
+            "offsets_micros": offsets,
+            "errors": errors,
+            "phase_summary": phase_summary(spans),
+        }
+
+
+def phase_summary(spans: list[dict]) -> dict:
+    """Per-(member, phase) aggregate over assembled spans that carry a
+    `member` attribute (the consensus phase spans): busy micros, span
+    count, and the LAST node-clock completion stamp (`at` attribute,
+    absolute node-clock micros) per member. The slow replica of a
+    distributed commit is the row with the largest `last_at_micros` /
+    busy time — identifiable from the bundle alone."""
+    out: dict[str, dict] = {}
+    for s in spans:
+        member = (s.get("attributes") or {}).get("member")
+        if member is None:
+            continue
+        row = out.setdefault(
+            member,
+            {"phases": {}, "busy_us": 0.0, "last_at_micros": None},
+        )
+        ph = row["phases"].setdefault(
+            s["name"], {"count": 0, "total_us": 0.0}
+        )
+        ph["count"] += 1
+        ph["total_us"] = round(ph["total_us"] + s["dur_us"], 3)
+        row["busy_us"] = round(row["busy_us"] + s["dur_us"], 3)
+        at = (s.get("attributes") or {}).get("at")
+        if at is not None and (
+            row["last_at_micros"] is None or at > row["last_at_micros"]
+        ):
+            row["last_at_micros"] = at
+    return out
 
 
 # -- XLA profiler alignment ---------------------------------------------------

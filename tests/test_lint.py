@@ -558,6 +558,55 @@ def test_metrics_convention_and_duplicates(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# spans
+
+def test_spans_convention_and_duplicate_spelling(tmp_path):
+    """Span names off the dotted-lowercase `component.phase` form flag,
+    a literal stamped from TWO sites flags (filters and summaries key
+    on the literal), and the rendered-dynamic `raft.<phase>` idiom
+    plus single-site literals stay clean."""
+    _, findings = _scan(
+        tmp_path,
+        {
+            "s.py": """
+            def stamp(tracer, parent, phase, t0, t1):
+                tracer.start_trace("NotariseFrame")
+                tracer.start_trace("notarise.frame")
+                tracer.span_at("raft." + phase, parent, t0, t1)
+                tracer.span_at(f"bft.{phase}", parent, t0, t1)
+
+            def stamp_again(tracer):
+                tracer.start_trace("notarise.frame")
+
+            def stamp_unrenderable(tracer, name):
+                tracer.start_span(name, None)
+            """
+        },
+        only=("spans",),
+    )
+    by_rule = {f.rule: f for f in findings}
+    assert by_rule["span-name-convention"].detail == "NotariseFrame"
+    dup = by_rule["span-duplicate-spelling"]
+    assert dup.detail == "notarise.frame" and len(dup.evidence) == 2
+    assert by_rule["span-dynamic-name"].detail.startswith("start_span@")
+    assert len(findings) == 3   # both rendered-dynamic stamps are clean
+
+
+def test_spans_pass_gates_committed_tree_clean(tmp_path):
+    """The committed tree's span names all pass (modulo the justified
+    baseline rows): same gate-clean discipline as the metrics pass."""
+    import os
+
+    from tools.lint.cli import DEFAULT_BASELINE, gate, load_baseline, run_passes
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    _, findings = run_passes(root, only=("spans",))
+    rows = load_baseline(os.path.join(root, DEFAULT_BASELINE))
+    new, _stale, _unjust = gate(findings, rows, selected=("spans",))
+    assert not new, [f.render() for f in new]
+
+
+# ---------------------------------------------------------------------------
 # contracts
 
 def test_contracts_pass_sweeps_installed_classes(tmp_path):

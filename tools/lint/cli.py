@@ -33,7 +33,7 @@ from . import blocking, conventions, jaxhazard, lockcheck
 from .facts import RepoFacts, extract_repo
 from .findings import Finding, sort_findings
 
-PASSES = ("lockcheck", "blocking", "jaxhazard", "metrics", "contracts")
+PASSES = ("lockcheck", "blocking", "jaxhazard", "metrics", "spans", "contracts")
 
 # rule-name prefix per pass: lets a --only run judge staleness (and
 # baseline merging) ONLY for rows its selected passes could have
@@ -43,6 +43,7 @@ _RULE_PREFIX = {
     "blocking": "blocking-",
     "jaxhazard": "jax-",
     "metrics": "metric-",
+    "spans": "span-",
     "contracts": "contract-",
 }
 
@@ -70,6 +71,8 @@ def run_passes(
         findings += jaxhazard.run(repo)
     if "metrics" in selected:
         findings += conventions.run_metrics(repo)
+    if "spans" in selected:
+        findings += conventions.run_spans(repo)
     if "contracts" in selected:
         findings += conventions.run_contracts(repo)
     return repo, sort_findings(findings)

@@ -1,4 +1,4 @@
-"""metrics + contracts passes.
+"""metrics + spans + contracts passes.
 
 metrics — every Counter/Gauge/Histogram/Meter/Timer registration name
 matches the `Domain.Name` convention (dotted, CamelCase domain root,
@@ -7,6 +7,14 @@ f-string or concatenation) and every fully-literal name has exactly
 one registration site (MetricRegistry.get_or_create makes a duplicate
 benign at runtime, which is exactly why a second owner site goes
 unnoticed until two subsystems fight over one series).
+
+spans — the same discipline for trace span names: every
+start_trace/start_span/span_at first argument renders to a dotted
+lowercase `component.phase` name (`<>` for dynamic pieces), and every
+fully-literal span name is stamped from exactly one site — the
+stage-summary, trace_filter matching and cross-node phase_summary all
+key on these strings, so a second spelling site forks every dashboard
+and filter that reads them.
 
 contracts — the experimental/determinism.py static audit swept over
 every contract class under finance/ (any class defining `verify`, plus
@@ -84,6 +92,79 @@ def run_metrics(repo: RepoFacts) -> list[Finding]:
                 name,
                 f"metric {name!r} is registered from "
                 f"{len(locations)} sites — one series, several owners",
+                [f"{f}:{line}" for f, line in sorted(locations)],
+            )
+        )
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# spans
+
+# component.phase: lowercase dotted segments (digits/underscores fine,
+# `<>` marks a rendered-dynamic piece), at least two segments
+_SPAN_RE = re.compile(
+    r"^[a-z][a-z0-9_]*(\.(<>|[a-z0-9_]+(<>[a-z0-9_]*)*))+$"
+)
+
+
+def run_spans(repo: RepoFacts) -> list[Finding]:
+    findings: list[Finding] = []
+    sites: dict[str, list] = {}
+    for reg in repo.span_regs:
+        if reg.file.endswith("utils/tracing.py"):
+            # the Tracer's own forwarding plumbing (span_at delegating
+            # to start_span) is not a stamp site — only callers name
+            # spans
+            continue
+        if reg.name is None:
+            findings.append(
+                Finding(
+                    "spans",
+                    "span-dynamic-name",
+                    P2,
+                    reg.file,
+                    reg.line,
+                    reg.scope,
+                    f"{reg.method}@{reg.scope}",
+                    f"{reg.method}() span name is not statically "
+                    "renderable — convention cannot be checked",
+                )
+            )
+            continue
+        if not _SPAN_RE.match(reg.name):
+            findings.append(
+                Finding(
+                    "spans",
+                    "span-name-convention",
+                    P2,
+                    reg.file,
+                    reg.line,
+                    reg.scope,
+                    reg.name,
+                    f"span name {reg.name!r} does not match the dotted "
+                    "lowercase `component.phase` convention",
+                )
+            )
+        if reg.literal:
+            sites.setdefault(reg.name, []).append(reg)
+    for name, regs in sorted(sites.items()):
+        locations = {(r.file, r.line) for r in regs}
+        if len(locations) <= 1:
+            continue
+        first = regs[0]
+        findings.append(
+            Finding(
+                "spans",
+                "span-duplicate-spelling",
+                P2,
+                first.file,
+                first.line,
+                "",
+                name,
+                f"span name {name!r} is stamped from {len(locations)} "
+                "sites — one stage, several owners (filters and "
+                "summaries key on the literal)",
                 [f"{f}:{line}" for f, line in sorted(locations)],
             )
         )

@@ -53,6 +53,10 @@ METRIC_METHODS = frozenset(
     {"counter", "meter", "timer", "histogram", "gauge"}
 )
 
+# span-stamping sites (utils/tracing.Tracer): the spans pass checks
+# their first-arg names the way the metrics pass checks registrations
+SPAN_METHODS = frozenset({"start_trace", "start_span", "span_at"})
+
 # functions whose enclosing loop IS the serving hot path: locks
 # reachable from these (or from fabric-handler callbacks) rank P1 when
 # blocked under, everything else P2
@@ -194,6 +198,9 @@ class RepoFacts:
     locks: dict[str, tuple[str, str, int]] = field(default_factory=dict)
     entries: list[Entry] = field(default_factory=list)
     metric_regs: list[MetricReg] = field(default_factory=list)
+    # span-name stamp sites (same record shape as metric_regs; the
+    # `method` field carries start_trace/start_span/span_at)
+    span_regs: list[MetricReg] = field(default_factory=list)
     jit_roots: list[JitRoot] = field(default_factory=list)
     # attr -> {(class, kind)} across every scanned class
     lock_attr_index: dict[str, set] = field(default_factory=dict)
@@ -805,6 +812,21 @@ class _FunctionWalker:
         if attr in METRIC_METHODS and node.args:
             name, literal = _metric_name(node.args[0], self.mod)
             self.repo.metric_regs.append(
+                MetricReg(
+                    attr,
+                    name,
+                    literal,
+                    self.facts.file,
+                    node.lineno,
+                    self.facts.qualname,
+                )
+            )
+        # span-name stamps (tracing.Tracer.start_trace/start_span/
+        # span_at): same rendering as metric names, consumed by the
+        # spans conventions pass
+        if attr in SPAN_METHODS and node.args:
+            name, literal = _metric_name(node.args[0], self.mod)
+            self.repo.span_regs.append(
                 MetricReg(
                     attr,
                     name,
