@@ -1708,6 +1708,115 @@ def _fleet_metric(batch: int, iters: int) -> dict:
     }
 
 
+def _distributed_metric(batch: int, iters: int) -> dict:
+    """Distributed sharded uniqueness (round 12): the fleet simulator
+    drives a 3-member notary cluster whose state-ref space is
+    partitioned ACROSS the members (corda_tpu/node/
+    distributed_uniqueness.py) — half the spends cross members and
+    take the fabric two-phase reserve→commit — through a kill/restart
+    of the coordinator-heavy home member mid-stream, with injected
+    cross-shard double-spends. `value` is the cluster's simulated-time
+    goodput; `vs_single_owner` compares the SAME offered load against
+    a single-member cluster (every commit local — what the distributed
+    plane's message round trips cost); `recovery_micros_after_kill` is
+    how much simulated time the restarted member needed to finish
+    everything still open after its WAL recovery. The record's
+    `xshard_zero_orphans` and `xshard_exactly_once` verdicts are
+    REQUIRED-TRUE gate keys for tools/bench_history.py — throughput
+    with a leaked reservation or a double-signed double-spend fails
+    the gate no matter what the headline says."""
+    from corda_tpu.testing import fleet as fl
+
+    R = 20_000
+    cap = max(4, min(batch, 8))
+    clients = int(os.environ.get("BENCH_DIST_CLIENTS", "192"))
+    steady = max(10, 5 * iters)
+    mix = fl.TrafficMix(
+        deadline_micros=200 * R, conflict_fraction=0.08,
+        cross_shard_fraction=0.5,
+    )
+    scenario = fl.FleetScenario(
+        clients=clients,
+        phases=(fl.Phase("steady", steady, cap, mix),),
+        round_micros=R, drain_rounds=100, seed=23,
+    )
+
+    def run(cluster_size: int, chaos=()):
+        sim = fl.FleetSim(
+            scenario, "distributed", cluster_size=cluster_size,
+            chaos=chaos, intent_wal=True,
+        )
+        report = sim.run()
+        out = report.outcomes()
+        goodput = out.get(fl.OUT_SIGNED, 0) / max(report.sim_seconds, 1e-9)
+        lat = [
+            r.answered_at - r.submitted_at
+            for r in report.records
+            if r.outcome == fl.OUT_SIGNED and r.answered_at is not None
+        ]
+        mean_lat = sum(lat) / max(len(lat), 1)
+        return report, out, goodput, mean_lat
+
+    chaos = (fl.kill_restart(0, at=0.45, restart_at=0.6),)
+    report, outcomes, goodput, mean_lat = run(3, chaos)
+    _base_report, _base_out, base_goodput, base_lat = run(1)
+    checker = fl.InvariantChecker(report)
+    exactly_once = True
+    reconcile_error = None
+    try:
+        checker.check_all()
+    except AssertionError as e:
+        exactly_once, reconcile_error = False, str(e)
+    zero_orphans = (
+        all(v == 0 for v in report.reservations_live.values())
+        and all(v == 0 for v in report.xshard_orphans.values())
+        and report.intent_unresolved == 0
+    )
+    kill = next(
+        (e for e in report.chaos_log if e["kind"] == "kill"), None
+    )
+    recovery_micros = None
+    if kill is not None and kill.get("reverted_at_micros"):
+        restart_at = kill["reverted_at_micros"]
+        tail = [
+            r.answered_at for r in report.records
+            if r.answered_at is not None and r.answered_at >= restart_at
+        ]
+        recovery_micros = (max(tail) - restart_at) if tail else 0
+    return {
+        "metric": "distributed_commit",
+        "value": round(goodput, 3),
+        "unit": "signed notarisations per SIMULATED second, 3-member "
+                "cluster under kill/restart, 50% cross-shard",
+        "vs_baseline": None,
+        "vs_single_owner": round(goodput / max(base_goodput, 1e-9), 3),
+        "single_owner_goodput": round(base_goodput, 3),
+        # where the cross-member protocol's cost actually shows in
+        # simulated time: answer latency vs the all-local baseline
+        # (goodput is offered-load-bound in both configurations)
+        "answer_latency_micros_mean": round(mean_lat, 1),
+        "single_owner_latency_micros_mean": round(base_lat, 1),
+        "latency_vs_single_owner": round(
+            mean_lat / max(base_lat, 1e-9), 3
+        ),
+        "recovery_micros_after_kill": recovery_micros,
+        # bench_history --gate: REQUIRED TRUE in the newest record
+        "gate_required_true": ["xshard_zero_orphans", "xshard_exactly_once"],
+        "xshard_zero_orphans": zero_orphans,
+        "xshard_exactly_once": exactly_once,
+        "reconcile_error": reconcile_error,
+        "cluster_shards": report.cluster_shards,
+        "members": len(report.members),
+        "clients": clients,
+        "requests": len(report.records),
+        "outcomes": outcomes,
+        "decisions": len(report.xshard_decisions),
+        "intent_replayed": report.intent_replayed,
+        "faults": [e["name"] for e in report.chaos_log],
+        "sim_seconds": round(report.sim_seconds, 6),
+    }
+
+
 def _faults_metric(batch: int, iters: int) -> dict:
     """Fault-tolerance plane (round 9): what the self-healing costs
     when nothing is broken, and whether it actually recovers when
@@ -2021,6 +2130,11 @@ def _run_metric(metric: str, batch: int, iters: int) -> dict:
         if batch > 128:
             out["batch_requested"] = batch   # cap visible in the record
         return out
+    if metric == "distributed_commit":
+        out = _distributed_metric(min(batch, 8), iters)
+        if batch > 8:
+            out["batch_requested"] = batch   # cap visible in the record
+        return out
     if metric == "parity":
         return _parity_metric(batch, iters)
     return _spi_metric(metric, batch, iters)
@@ -2210,6 +2324,28 @@ def _quick(metric: str) -> None:
         if out["value"] <= 0:
             raise SystemExit("zero goodput through the soak")
         return
+    if metric == "distributed":
+        batch = int(os.environ.get("BENCH_BATCH", "6"))
+        iters = int(os.environ.get("BENCH_ITERS", "1"))
+        os.environ.setdefault("BENCH_DIST_CLIENTS", "64")
+        out = _distributed_metric(batch, iters)
+        out["quick"] = True
+        print(json.dumps(out), flush=True)
+        if not out["xshard_exactly_once"]:
+            raise SystemExit(
+                f"distributed cluster failed reconciliation: "
+                f"{out['reconcile_error']}"
+            )
+        if not out["xshard_zero_orphans"]:
+            raise SystemExit(
+                "orphaned reservations (or unresolved WAL intents) "
+                "survived the drain — presumed-abort recovery leaked"
+            )
+        if out["value"] <= 0:
+            raise SystemExit("zero cross-shard goodput")
+        if not out["faults"]:
+            raise SystemExit("the kill/restart chaos never fired")
+        return
     if metric == "faults":
         batch = int(os.environ.get("BENCH_BATCH", "32"))
         iters = int(os.environ.get("BENCH_ITERS", "1"))
@@ -2310,8 +2446,8 @@ def _quick(metric: str) -> None:
     if metric != "ingest":
         raise SystemExit(
             f"--quick supports 'ingest', 'trace', 'consensus', 'qos', "
-            f"'health', 'perf', 'fleet', 'faults' or 'shards', not "
-            f"{metric!r}"
+            f"'health', 'perf', 'fleet', 'faults', 'distributed' or "
+            f"'shards', not {metric!r}"
         )
     batch = int(os.environ.get("BENCH_BATCH", "256"))
     iters = int(os.environ.get("BENCH_ITERS", "1"))
@@ -2345,7 +2481,7 @@ def main() -> None:
     known = (
         "all", "p256", "mixed", "merkle", "notary", "notary_commit_plane",
         "ingest", "ingest_pipelined", "trace", "consensus", "qos", "health",
-        "perf", "fleet", "faults", "montmul", "parity",
+        "perf", "fleet", "faults", "distributed_commit", "montmul", "parity",
     )
     if metric not in known:
         # a typo must not record a p256-only rate under another name
@@ -2385,7 +2521,7 @@ def main() -> None:
     # before the headline so the headline stays the final stdout line
     for m in ("mixed", "merkle", "notary", "ingest", "ingest_pipelined",
               "trace", "consensus", "qos", "health", "perf", "fleet",
-              "faults", "parity"):
+              "faults", "distributed_commit", "parity"):
         avail = left() - reserve
         if avail < 60:
             print(
@@ -2398,7 +2534,7 @@ def main() -> None:
         if avail < 300 and m in (
             "mixed", "merkle", "notary", "ingest", "ingest_pipelined",
             "trace", "consensus", "qos", "health", "perf", "fleet",
-            "faults",
+            "faults", "distributed_commit",
         ):
             # trim before dropping: one timed rep at a shallower batch
             # still yields a usable point for the table

@@ -119,6 +119,7 @@ class NodeWebServer:
         perf=None,
         cluster_traces=None,
         incidents=None,
+        shards=None,
     ):
         """`metrics`: an optional MetricRegistry served at GET /metrics
         in prometheus exposition format (the reference exports
@@ -164,6 +165,13 @@ class NodeWebServer:
         /incidents lists the captured forensics bundles,
         GET /incidents/<id> serves one bundle in full.
 
+        `shards`: an optional node/distributed_uniqueness.
+        DistributedUniquenessProvider — GET /shards serves the
+        cross-member ownership map (partition -> owner, this member's
+        committed/reservation depths, orphan count, unreachable
+        owners), the operator's routing-truth view of the distributed
+        uniqueness plane.
+
         Every operational endpoint honours `?ts=1`: the payload gains
         a shared process-monotonic `ts_micros` stamp (a trailing
         `# ts_micros` comment on /metrics text), so cross-endpoint
@@ -180,6 +188,7 @@ class NodeWebServer:
         self.perf = perf
         self.cluster_traces = cluster_traces
         self.incidents = incidents
+        self.shards = shards
         # serializes /profile on-demand captures and resets: without
         # it a second ?seconds=N request returns a partial table and
         # a concurrent ?reset=1 wipes an in-flight capture
@@ -205,6 +214,11 @@ class NodeWebServer:
                 "one bundle", self._serve_incidents,
             ),
             "/qos": ("QoS control-plane state", self._serve_qos),
+            "/shards": (
+                "distributed uniqueness ownership map: partition -> "
+                "owner, reservation/orphan depths, unreachable owners",
+                self._serve_shards,
+            ),
             "/healthz": (
                 "liveness probe: 200/503 from watchdog state",
                 self._serve_healthz,
@@ -333,7 +347,7 @@ class NodeWebServer:
             "/qos": self.qos, "/healthz": self.health,
             "/health": self.health, "/cluster": self.cluster,
             "/perf": self.perf, "/profile": self.perf,
-            "/incidents": self.incidents,
+            "/incidents": self.incidents, "/shards": self.shards,
         }
         rows = [
             {
@@ -490,6 +504,22 @@ class NodeWebServer:
             )
         except Exception as e:   # noqa: BLE001 - defensive render
             return self._json(500, {"error": f"qos snapshot failed: {e}"})
+
+    def _serve_shards(self, query) -> tuple[int, str, bytes]:
+        # the distributed uniqueness plane's routing truth: which
+        # member owns which partition, how many reservations this
+        # member holds (and how many are orphaned), which owners the
+        # cross-shard protocol currently cannot reach
+        try:
+            if self.shards is not None:
+                return self._json(200, self.shards.shards_snapshot())
+            return self._json(
+                404,
+                {"error": "distributed uniqueness not wired on this "
+                          "gateway"},
+            )
+        except Exception as e:   # noqa: BLE001 - defensive render
+            return self._json(500, {"error": f"shards snapshot failed: {e}"})
 
     def _serve_healthz(self, query) -> tuple[int, str, bytes]:
         # orchestrator liveness: judged LIVE against the watchdog (the

@@ -84,6 +84,24 @@ class NodeConfig:
     # replay through the normal flush path on boot — in-flight-at-kill
     # loss goes to zero (persistence.py NotaryIntentJournal)
     notary_intent_wal: bool = False
+    # distributed sharded uniqueness (round 12, node/
+    # distributed_uniqueness.py): partition the state-ref space into
+    # this many partitions ACROSS the notary cluster members named in
+    # cluster_peers — each member owns partition k where
+    # k % len(cluster_peers) picks it, cross-member transactions take
+    # the fabric two-phase reserve→commit, and the ownership map is
+    # served at GET /shards. 0 = off (single-node planes above).
+    # Requires notary = "batching" and this node in cluster_peers;
+    # mutually exclusive with notary_shards > 1 (the in-process and
+    # cross-member planes partition the same namespace differently).
+    notary_cluster_shards: int = 0
+    # cross-shard per-phase silence timeout, microseconds: a partition
+    # owner that never acks within this window yields a typed
+    # `shard-unavailable` answer instead of a hang
+    notary_xshard_timeout_micros: int = 2_000_000
+    # base of the capped exponential cross-shard retry/resend backoff,
+    # microseconds (seeded jitter rides on top)
+    notary_xshard_backoff: int = 50_000
     # degraded-mode verify (batching notary): a device/kernel failure
     # at the dispatch seam retries once, then serves the flush through
     # the CPU reference verifier (bit-exact) with the
@@ -211,6 +229,33 @@ class NodeConfig:
                 "notary_intent_wal requires notary = 'batching' (only "
                 "the batching notary has a durable intake queue)"
             )
+        if self.notary_cluster_shards < 0:
+            raise ConfigError("notary_cluster_shards must be >= 0")
+        if self.notary_cluster_shards > 0:
+            if self.notary != "batching":
+                raise ConfigError(
+                    "notary_cluster_shards requires notary = 'batching' "
+                    "(the distributed uniqueness plane serves the "
+                    "batching notary's commit path)"
+                )
+            if self.name not in self.cluster_peers:
+                raise ConfigError(
+                    "notary_cluster_shards needs cluster_peers "
+                    "including this node (the ownership map is computed "
+                    "from the member list)"
+                )
+            if self.notary_shards > 1:
+                raise ConfigError(
+                    "notary_cluster_shards and notary_shards > 1 are "
+                    "mutually exclusive (one namespace, one "
+                    "partitioning)"
+                )
+        if self.notary_xshard_timeout_micros <= 0:
+            raise ConfigError(
+                "notary_xshard_timeout_micros must be positive"
+            )
+        if self.notary_xshard_backoff <= 0:
+            raise ConfigError("notary_xshard_backoff must be positive")
         if self.verifier_lease_micros <= 0:
             raise ConfigError("verifier_lease_micros must be positive")
         if self.verifier_redispatch_backoff < 0:
@@ -379,6 +424,12 @@ def write_config(cfg: NodeConfig, path: str) -> None:
             emit("notary_shard_workers", cfg.notary_shard_workers)
     if cfg.notary_intent_wal:
         emit("notary_intent_wal", cfg.notary_intent_wal)
+    if cfg.notary_cluster_shards:
+        emit("notary_cluster_shards", cfg.notary_cluster_shards)
+    if cfg.notary_xshard_timeout_micros != 2_000_000:
+        emit("notary_xshard_timeout_micros", cfg.notary_xshard_timeout_micros)
+    if cfg.notary_xshard_backoff != 50_000:
+        emit("notary_xshard_backoff", cfg.notary_xshard_backoff)
     if not cfg.notary_degraded_fallback:
         emit("notary_degraded_fallback", cfg.notary_degraded_fallback)
     if cfg.verifier_lease_micros != 10_000_000:

@@ -29,6 +29,11 @@ TOPIC_NETWORK_MAP = "platform.network_map"
 TOPIC_RPC = "rpc.requests"
 TOPIC_VERIFIER_REQ = "verifier.requests"
 TOPIC_VERIFIER_RES = "verifier.responses"
+# distributed sharded uniqueness (node/distributed_uniqueness.py): the
+# cross-member two-phase reserve→commit protocol — ShardReserve /
+# ShardReserveAck / ShardCommit / ShardCommitAck / ShardAbort plus the
+# presumed-abort status queries — all ride this one topic
+TOPIC_XSHARD = "notary.xshard"
 
 
 @dataclass(frozen=True)

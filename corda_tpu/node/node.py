@@ -126,7 +126,12 @@ class Node:
                     f"{config.notary} notary needs cluster_peers including "
                     f"this node"
                 )
-        if config.notary in ("raft", "raft-validating"):
+        if config.notary in ("raft", "raft-validating") or (
+            config.notary == "batching" and config.notary_cluster_shards > 0
+        ):
+            # the distributed-uniqueness batching cluster shares one
+            # service identity exactly like the raft cluster: every
+            # member answers (and signs) for the cluster party
             from ..core.identity import Party as _Party
 
             self._cluster_keypair = self._derive_keypair(
@@ -516,11 +521,116 @@ class Node:
 
     # -- notary ---------------------------------------------------------------
 
+    def _build_qos(self) -> None:
+        """SLO plane for the serving path: deadline shedding, priority
+        lanes, admission gating and the adaptive batching controller,
+        on the node's registry so /metrics carries Qos.* and the web
+        gateway serves the JSON mirror at GET /qos. An operator-
+        configured batching window is the controller's CEILING (it
+        tunes inside the fence, never past the configured bound);
+        unset (0) falls back to the policy default ceiling."""
+        from .qos import NotaryQos, QosPolicy
+
+        self.qos = NotaryQos(
+            QosPolicy(
+                target_p99_micros=self.config.qos_target_p99_micros,
+                max_wait_micros=(
+                    self.config.notary_batch_wait_micros
+                    or QosPolicy.max_wait_micros
+                ),
+                admission_rate_per_sec=(
+                    self.config.qos_admission_rate_per_sec
+                ),
+                admission_burst=self.config.qos_admission_burst,
+            ),
+            clock=self.services.clock,
+            metrics=self.metrics,
+        )
+
+    def _install_distributed_uniqueness(self) -> None:
+        """Round-12 horizontal scale-out: the batching notary over a
+        DistributedUniquenessProvider — the state-ref space
+        partitioned across the cluster members named in cluster_peers
+        (ShardMap; GET /shards serves the ownership map), cross-member
+        transactions taking the fabric two-phase reserve→commit with
+        the presumed-abort WAL on this node's database. The member
+        signs with the cluster service identity, exactly like a raft
+        member."""
+        from .distributed_uniqueness import (
+            DistributedUniquenessProvider,
+            XShardPolicy,
+        )
+        from .persistence import (
+            NotaryIntentJournal,
+            ShardedPersistentUniquenessProvider,
+            XShardCoordinatorJournal,
+            XShardReservationJournal,
+        )
+
+        cfg = self.config
+        self.services.key_management.register_keypair(self._cluster_keypair)
+        if cfg.qos_enabled:
+            self._build_qos()
+        store = ShardedPersistentUniquenessProvider(
+            self.db, cfg.notary_cluster_shards
+        )
+        provider = DistributedUniquenessProvider(
+            cfg.name,
+            list(cfg.cluster_peers),
+            self.messaging,
+            self.services.clock,
+            n_partitions=cfg.notary_cluster_shards,
+            store=store,
+            journal=XShardCoordinatorJournal(self.db),
+            reservations=XShardReservationJournal(self.db),
+            metrics=self.metrics,
+            tracer=self.tracer,
+            qos=self.qos,
+            policy=XShardPolicy(
+                timeout_micros=cfg.notary_xshard_timeout_micros,
+                backoff_base_micros=cfg.notary_xshard_backoff,
+                backoff_cap_micros=20 * cfg.notary_xshard_backoff,
+            ),
+            seed=self._dev_seed("xshard") or 0,
+        )
+        # boot recovery BEFORE serving: commit-marked WAL intents
+        # re-drive, unmarked ones presumed-abort, journaled
+        # reservations reload as immediate orphans
+        provider.recover()
+        self.xshard = provider
+        intent_journal = None
+        if cfg.notary_intent_wal:
+            intent_journal = NotaryIntentJournal(self.db)
+        self.services.notary_service = BatchingNotaryService(
+            self.services,
+            provider,
+            service_identity=self._cluster_identity,
+            max_wait_micros=cfg.notary_batch_wait_micros,
+            metrics=self.metrics,
+            qos=self.qos,
+            degraded_fallback=cfg.notary_degraded_fallback,
+            intent_journal=intent_journal,
+        )
+        if intent_journal is not None:
+            self.services.notary_service.replay_intents()
+        self.services.notary_service.attach_health(self.health)
+        provider.attach_health(self.health)
+        if self.qos is not None:
+            self.health.watch_qos(self.qos)
+        self.health.attach_canary(self._launch_canary)
+        if self.perf is not None:
+            self.services.notary_service.attach_perf(self.perf)
+            self.health.watch_perf(self.perf)
+
     def _install_notary(self) -> None:
         kind = self.config.notary
         self.raft = None
         self.bft = None
+        self.xshard = None
         if kind == "":
+            return
+        if kind == "batching" and self.config.notary_cluster_shards > 0:
+            self._install_distributed_uniqueness()
             return
         if kind in ("simple", "validating", "batching"):
             # sharded commit plane (round 6): >1 shard — or a node
@@ -584,34 +694,7 @@ class Node:
                     except Exception:
                         shard_verifiers = None   # shared SPI verifier
                 if self.config.qos_enabled:
-                    # SLO plane for the serving path: deadline shedding,
-                    # priority lanes, admission gating and the adaptive
-                    # batching controller, on the node's registry so
-                    # /metrics carries Qos.* and the web gateway serves
-                    # the JSON mirror at GET /qos
-                    from .qos import NotaryQos, QosPolicy
-
-                    # an operator-configured batching window is the
-                    # controller's CEILING (it tunes inside the fence,
-                    # never past the configured bound); unset (0) falls
-                    # back to the policy default ceiling
-                    self.qos = NotaryQos(
-                        QosPolicy(
-                            target_p99_micros=(
-                                self.config.qos_target_p99_micros
-                            ),
-                            max_wait_micros=(
-                                self.config.notary_batch_wait_micros
-                                or QosPolicy.max_wait_micros
-                            ),
-                            admission_rate_per_sec=(
-                                self.config.qos_admission_rate_per_sec
-                            ),
-                            admission_burst=self.config.qos_admission_burst,
-                        ),
-                        clock=self.services.clock,
-                        metrics=self.metrics,
-                    )
+                    self._build_qos()
                 intent_journal = None
                 if self.config.notary_intent_wal:
                     # durable intake (round 9): intents share the node
@@ -821,6 +904,11 @@ class Node:
             # pool self-healing: lease expiry, redispatch backoff and
             # hedging all walk on the pump cadence
             self.verifier_service.tick()
+        if self.xshard is not None:
+            # distributed uniqueness: resend schedules, reserve-phase
+            # timeouts, commit re-drives and orphan queries all walk
+            # on the pump cadence too
+            self.xshard.tick()
         if self.raft is not None:
             if self._hb_raft is None:
                 self._hb_raft = self.health.heartbeat("raft.driver")
@@ -893,6 +981,8 @@ class Node:
         notary = getattr(self.services, "notary_service", None)
         if isinstance(notary, BatchingNotaryService):
             notary.stop()   # shard worker threads, when running
+        if getattr(self, "xshard", None) is not None:
+            self.xshard.stop()
         if self.raft is not None:
             self.raft.stop()
         if self.bft is not None:
@@ -946,6 +1036,7 @@ class Node:
             perf=self.perf,
             cluster_traces=self.cluster_traces,
             incidents=self.incidents,
+            shards=getattr(self, "xshard", None),
         )
 
 

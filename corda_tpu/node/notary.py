@@ -77,6 +77,23 @@ ser.register_custom(
 )
 
 
+class ShardUnavailableError(Exception):
+    """A distributed cross-shard commit could not reach a partition
+    owner (partitioned away, dead past its phase timeout). Typed so the
+    serving paths answer a `shard-unavailable` NotaryError — a degraded
+    answer, never a hang and never a silent double-spend window: the
+    request neither reserved nor committed anything that outlives it."""
+
+    def __init__(self, owner: str, partitions, elapsed_micros: int = 0):
+        self.owner = owner
+        self.partitions = tuple(partitions)
+        self.elapsed_micros = elapsed_micros
+        super().__init__(
+            f"shard owner {owner} unreachable for partitions "
+            f"{sorted(self.partitions)} after {elapsed_micros} us"
+        )
+
+
 # -- uniqueness providers ----------------------------------------------------
 
 
@@ -340,6 +357,29 @@ class ShardedUniquenessProvider(UniquenessProvider):
         committed = self._parts[shard].committed
         for ref, tx_id, _requester in rows:
             committed[ref] = tx_id
+
+    # -- partition primitives (the distributed provider's store seam) ------
+
+    def prior_consumer(self, partition: int, ref: StateRef):
+        """Committed consumer of `ref` on `partition` (None = free),
+        under the partition condition — the check half of the
+        distributed provider's participant role (node/
+        distributed_uniqueness.py), which keeps its own reservation
+        table and only needs the committed registry from here."""
+        part = self._parts[partition]
+        with part.cond:
+            return self._prior_consumer(partition, ref)
+
+    def write_partition(self, partition: int, refs, tx_id, requester) -> None:
+        """Durably commit `refs` -> tx_id on one partition, under its
+        condition — the write half of the distributed store seam.
+        Idempotent (the backing writes are INSERT OR IGNORE / dict
+        assignment), so a re-driven cross-member commit replays
+        safely."""
+        part = self._parts[partition]
+        with part.cond:
+            self._write_shard(partition, refs, tx_id, requester)
+            part.cond.notify_all()
 
     # -- the two-phase core ------------------------------------------------
 
@@ -636,6 +676,12 @@ class NotaryService:
                 str(e),
                 conflict={str(r): h for r, h in e.conflict.items()},
             )
+        except ShardUnavailableError as e:
+            # a partition owner is unreachable: a typed degraded answer
+            # the client can retry against a healed cluster — distinct
+            # from commit-unavailable so operators (and the fleet
+            # checker) can tell a partitioned shard from a broken store
+            return NotaryError("shard-unavailable", str(e))
         except Exception as e:
             return NotaryError("commit-unavailable", str(e))
         sig = self.services.key_management.sign(
@@ -2270,6 +2316,11 @@ class BatchingNotaryService(NotaryService):
                 f.result()
             except UniquenessConflict as e:
                 p.future.set_result(conflict_error(e))
+            except ShardUnavailableError as e:
+                # distributed commit plane: the owning partition's
+                # member is unreachable — typed degraded answer, the
+                # request holds no reservations anywhere
+                p.future.set_result(NotaryError("shard-unavailable", str(e)))
             except Exception as e:
                 p.future.set_result(NotaryError("commit-unavailable", str(e)))
             else:
@@ -2280,7 +2331,14 @@ class BatchingNotaryService(NotaryService):
 
         for i, p in enumerate(eligible):
             fut = self.uniqueness.commit_async(
-                list(p.stx.wtx.inputs), p.stx.id, p.requester
+                list(p.stx.wtx.inputs), p.stx.id, p.requester,
+                # the frame's live root span rides into the provider:
+                # a distributed commit stamps its xshard.* phase spans
+                # into the requester's trace, cross-member hops included
+                trace=(
+                    tuple(p.span.context)
+                    if p.span and not p.span.ended else None
+                ),
             )
             fut.add_done_callback(lambda f, i=i, p=p: on_commit(f, i, p))
         self._mark("sign_scatter", t, marks)

@@ -372,6 +372,10 @@ class NotaryQos:
         # global controller stays as the unsharded/back-compat lane.
         self.shard_controllers: list[AdaptiveBatchController] = []
         self._shard_latency: list[Histogram] = []
+        # distributed cross-shard commit latency lane (round 12):
+        # created lazily on the first record_xshard so nodes without
+        # the distributed plane register no extra series
+        self._xshard_latency: Optional[Histogram] = None
         self.metrics.gauge(
             "Qos.Controller.WaitMicros", lambda: self.controller.wait_micros
         )
@@ -461,6 +465,35 @@ class NotaryQos:
         if shard is not None and shard < len(self._shard_latency):
             self._shard_latency[shard].update(max(0, latency_micros))
 
+    # -- cross-shard lane (round 12) -----------------------------------------
+
+    def record_xshard(self, latency_micros: int) -> None:
+        """Resolution latency of one DISTRIBUTED cross-shard commit
+        (reserve sent -> decided/aborted, node-clock micros). Its own
+        lane, not mixed into the admitted histogram: a cross-member
+        round trip is structurally slower than a local flush commit,
+        and folding it in would stretch the adaptive controller's p99
+        signal — the operator reads the two latencies side by side at
+        GET /qos instead."""
+        hist = self._xshard_latency
+        if hist is None:
+            with self._lock:
+                hist = self._xshard_latency
+                if hist is None:
+                    hist = self.metrics.histogram("Qos.XShardLatencyMicros")
+                    self._xshard_latency = hist
+        hist.update(max(0, latency_micros))
+
+    def xshard_snapshot(self) -> dict:
+        hist = self._xshard_latency
+        if hist is None:
+            return {"count": 0}
+        return {
+            "count": hist.count,
+            "p50_micros": hist.quantile(0.5),
+            "p99_micros": hist.quantile(0.99),
+        }
+
     def observe_flush(self, batch_size: int, backlog: int) -> None:
         """One call per notary flush: feeds the controller and walks
         the brownout state machine on the backlog trend."""
@@ -544,6 +577,10 @@ class NotaryQos:
                 for reason, counter in sorted(shed.items())
             },
             "shed_total": self.shed_total,
+            # distributed cross-shard commit latency (round 12): its
+            # own lane next to the admitted p99 — count 0 when the
+            # node runs no distributed plane
+            "xshard": self.xshard_snapshot(),
             "admitted": self.admitted.count,
             "answered": self.answered.count,
             "admission": self.admission.snapshot(),

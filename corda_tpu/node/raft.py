@@ -1320,3 +1320,48 @@ class RaftUniquenessProvider:
         raise NotImplementedError(
             "Raft commits are asynchronous; use commit_async"
         )
+
+
+def partition_raft_groups(
+    name: str,
+    peers: list,
+    messaging: MessagingService,
+    clock,
+    apply_for: Callable[[int], Callable],
+    partitions,
+    cluster: str = "xshard",
+    db=None,
+    rng=None,
+    config: Optional[RaftConfig] = None,
+    metrics=None,
+    tracer=None,
+) -> dict:
+    """One Raft group PER uniqueness partition (round 12, the
+    distributed sharded uniqueness plane): group k rides the
+    `raft.<cluster>.p<k>` topic namespace — the groups' protocol
+    frames stay disjoint on ONE fabric endpoint per member, and the
+    persistence tables are already cluster-keyed, so every group can
+    share the node database.
+
+    `apply_for(k)` supplies partition k's replicated state machine
+    (DistributedUniquenessProvider.partition_apply: idempotent
+    committed-row writes into the member's local store copy, so a
+    partition owner's rows gain a replica on every member and a
+    failover owner boots warm). Returns {partition: RaftNode} — the
+    caller ticks each group alongside the provider."""
+    groups: dict[int, RaftNode] = {}
+    for k in partitions:
+        groups[k] = RaftNode(
+            name,
+            list(peers),
+            messaging,
+            apply_for(k),
+            clock,
+            cluster=f"{cluster}.p{k}",
+            db=db,
+            rng=rng,
+            config=config or RaftConfig(),
+            metrics=metrics,
+            tracer=tracer,
+        )
+    return groups

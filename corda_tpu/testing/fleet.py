@@ -90,7 +90,7 @@ OUT_SHED = "shed"
 OUT_UNAVAILABLE = "unavailable"
 OUT_LOST = "lost"          # future never resolved (in flight at a kill)
 
-FLAVOURS = ("batching", "raft", "bft")
+FLAVOURS = ("batching", "raft", "bft", "distributed")
 
 
 # ---------------------------------------------------------------------------
@@ -469,6 +469,8 @@ class CashSpendSource:
         count: int,
         cross_shard_fraction: float = 0.0,
         seed: int = 0,
+        extra_record_nodes=(),
+        notary_party: Optional[Party] = None,
     ):
         from ..core.contracts import Amount, Issued
         from ..core.identity import PartyAndReference
@@ -476,6 +478,7 @@ class CashSpendSource:
         from ..finance.cash import CASH_CONTRACT, CashIssue, CashState
 
         self._rng = random.Random(seed)
+        self._notary_party = notary_party
         bank = net.create_node(
             "FleetBank", scheme_id=schemes.ECDSA_SECP256R1_SHA256
         )
@@ -492,7 +495,7 @@ class CashSpendSource:
         n_cross = int(count * cross_shard_fraction) // 2
         count = count + n_cross
         for i in range(count):
-            ib = TransactionBuilder(notary_node.party)
+            ib = TransactionBuilder(self.notary_party)
             ib.add_output_state(
                 CashState(Amount(100 + i, token), owner.party.owning_key),
                 CASH_CONTRACT,
@@ -501,16 +504,27 @@ class CashSpendSource:
             issue = bank.services.sign_initial_transaction(ib)
             notary_node.services.record_transactions([issue])
             owner.services.record_transactions([issue])
+            for extra in extra_record_nodes:
+                # distributed flavour: every member validates, so the
+                # backchain must resolve on all of them
+                extra.services.record_transactions([issue])
             self._issues.append(issue)
         self._next = 0
         self._cross_budget = n_cross
+
+    @property
+    def notary_party(self) -> Party:
+        """The party transactions name as notary: the cluster service
+        identity when one was passed (distributed flavour), the notary
+        node's own otherwise."""
+        return self._notary_party or self.notary_node.party
 
     def _spend_of(self, issues: list):
         from ..core.contracts import Amount
         from ..core.transactions import TransactionBuilder
         from ..finance.cash import CASH_CONTRACT, CashMove, CashState
 
-        sb = TransactionBuilder(self.notary_node.party)
+        sb = TransactionBuilder(self.notary_party)
         total = 0
         for issue in issues:
             sb.add_input_state(
@@ -522,7 +536,7 @@ class CashSpendSource:
                 Amount(total, self._token), self.bank.party.owning_key
             ),
             CASH_CONTRACT,
-            self.notary_node.party,
+            self.notary_party,
         )
         sb.add_command(CashMove(), self.owner.party.owning_key)
         return self.owner.services.sign_initial_transaction(sb)
@@ -554,7 +568,7 @@ class CashSpendSource:
         from ..core.transactions import TransactionBuilder
         from ..finance.cash import CASH_CONTRACT, CashMove, CashState
 
-        sb = TransactionBuilder(self.notary_node.party)
+        sb = TransactionBuilder(self.notary_party)
         total = 0
         for ref in inputs:
             sar = self.owner.vault.state_and_ref(ref)
@@ -565,10 +579,128 @@ class CashSpendSource:
                 Amount(total, self._token), self.owner.party.owning_key
             ),
             CASH_CONTRACT,
-            self.notary_node.party,
+            self.notary_party,
         )
         sb.add_command(CashMove(), self.owner.party.owning_key)
         stx = self.owner.services.sign_initial_transaction(sb)
+        return stx, stx.wtx.inputs, stx.id
+
+
+class SyntheticSpendSource:
+    """Unsigned, command-less spends over an always-pass contract —
+    the ten-thousand-identity scale source. A command-less transaction
+    has no required signers, so the validating flush accepts it with
+    zero signatures; per-spend pure-python ECDSA (~10 ms each) would
+    otherwise dominate a 10k-request soak wall a hundred to one. The
+    uniqueness semantics under test — cross-shard routing, two-phase
+    reserve→commit, double-spend rivalry — depend only on the input
+    refs, which are as real as the cash source's."""
+
+    def __init__(
+        self,
+        members,
+        notary_party: Party,
+        count: int,
+        cross_shard_fraction: float = 0.0,
+        seed: int = 0,
+    ):
+        from ..core.contracts import UniqueIdentifier, register_contract
+        from ..core.contracts import StateAndRef
+        from ..core.transactions import (
+            SignedTransaction,
+            TransactionBuilder,
+        )
+        from .flows import (
+            DUMMY_LINEAR_CONTRACT,
+            DummyLinearState,
+            _DummyLinearContract,
+        )
+
+        register_contract(DUMMY_LINEAR_CONTRACT, _DummyLinearContract())
+        self._rng = random.Random(seed)
+        self.notary_party = notary_party
+        self._contract = DUMMY_LINEAR_CONTRACT
+        self._state_cls = DummyLinearState
+        self._uid_cls = UniqueIdentifier
+        self._sar_cls = StateAndRef
+        self._builder_cls = TransactionBuilder
+        self._stx_cls = SignedTransaction
+        # one well-known key as every synthetic state's owner: states
+        # carry participants but nothing signs, and nothing needs to
+        owner_kp = schemes.generate_keypair(
+            schemes.ECDSA_SECP256R1_SHA256, seed=seed * 31 + 5
+        )
+        self._owner_key = owner_kp.public
+        n_cross = int(count * cross_shard_fraction) // 2
+        total = count + n_cross
+        self._issues = []
+        batch = []
+        for i in range(total):
+            b = TransactionBuilder(notary_party)
+            b.add_output_state(
+                DummyLinearState(
+                    UniqueIdentifier(seed.to_bytes(8, "big")
+                                     + i.to_bytes(8, "big")),
+                    f"issue-{i}",
+                    self._owner_key,
+                ),
+                DUMMY_LINEAR_CONTRACT,
+            )
+            stx = SignedTransaction(b.to_wire_transaction(), ())
+            batch.append(stx)
+            self._issues.append(stx)
+        for m in members:
+            m.services.record_transactions(batch)
+        # rival() looks issues up by their output ref; build the index
+        # ONCE — at 10k+ issues a per-call rebuild would cost millions
+        # of dict inserts across a soak's injected double-spends
+        self._by_ref = {
+            StateRef(issue.id, 0): issue for issue in self._issues
+        }
+        self._next = 0
+        self._cross_budget = n_cross
+        self._seq = 0
+
+    def _spend_of(self, issues, info: str):
+        b = self._builder_cls(self.notary_party)
+        for issue in issues:
+            b.add_input_state(
+                self._sar_cls(
+                    issue.wtx.outputs[0], StateRef(issue.id, 0)
+                )
+            )
+        self._seq += 1
+        b.add_output_state(
+            self._state_cls(
+                self._uid_cls(b"synth-out" + self._seq.to_bytes(7, "big")),
+                info,
+                self._owner_key,
+            ),
+            self._contract,
+        )
+        return self._stx_cls(b.to_wire_transaction(), ())
+
+    def spend(self, client: FleetClient):
+        take = 2 if self._cross_budget > 0 and self._next + 1 < len(
+            self._issues
+        ) and self._rng.random() < 0.5 else 1
+        if self._next + take > len(self._issues):
+            raise RuntimeError(
+                "SyntheticSpendSource exhausted: size the fixture to "
+                "the scenario's total interactive offer"
+            )
+        issues = self._issues[self._next:self._next + take]
+        self._next += take
+        if take == 2:
+            self._cross_budget -= 1
+        stx = self._spend_of(issues, f"spend-by-{client.name}")
+        return stx, stx.wtx.inputs, stx.id
+
+    def rival(self, inputs: tuple):
+        """Contract-valid double spend: the same input refs, a
+        different output — a different id claiming the same states."""
+        issues = [self._by_ref[ref] for ref in inputs]
+        stx = self._spend_of(issues, "rival")
         return stx, stx.wtx.inputs, stx.id
 
 
@@ -611,6 +743,15 @@ class FleetReport:
     tracers: dict = field(default_factory=dict)
     cluster_traces: Any = None
     incidents: Any = None
+    # round-12 distributed uniqueness: the ownership map, the shared
+    # decision log (true serialisation order — the serial-replay
+    # reference), and end-of-run reservation/orphan depths per member
+    # (the reservation-ledger reconciliation inputs)
+    cluster_shards: int = 0
+    shard_map: dict = field(default_factory=dict)
+    xshard_decisions: list = field(default_factory=list)
+    reservations_live: dict = field(default_factory=dict)
+    xshard_orphans: dict = field(default_factory=dict)
 
     @property
     def sim_seconds(self) -> float:
@@ -643,6 +784,9 @@ class FleetSim:
         intent_wal: bool = False,
         tracing: bool = False,
         incident_dir: Optional[str] = None,
+        cluster_shards: int = 8,
+        batch_verifier=None,
+        spend_source: str = "cash",
     ):
         """`verifier_pool` (batching only): attach N out-of-process
         VerifierWorkers on the fabric and an
@@ -665,15 +809,21 @@ class FleetSim:
         reconciliations cite a bundle id."""
         if flavour not in FLAVOURS:
             raise ValueError(f"unknown fleet flavour {flavour!r}")
-        if (verifier_pool or intent_wal) and flavour != "batching":
+        if verifier_pool and flavour != "batching":
+            raise ValueError("verifier_pool is a batching-flavour seam")
+        if intent_wal and flavour not in ("batching", "distributed"):
             raise ValueError(
-                "verifier_pool / intent_wal are batching-flavour seams"
+                "intent_wal needs a batching-notary intake "
+                "(batching or distributed flavour)"
             )
         self.scenario = scenario
         self.flavour = flavour
         self.chaos = ChaosPlane(chaos)
         self.faults = FabricFaults(seed=scenario.seed)
-        self.net = MockNetwork(seed=scenario.seed, faults=self.faults)
+        self.net = MockNetwork(
+            seed=scenario.seed, faults=self.faults,
+            batch_verifier=batch_verifier,
+        )
         self.round_no = 0
         self._partitioned: Optional[str] = None
         self._rng = random.Random(scenario.seed ^ 0x5EED)
@@ -740,13 +890,64 @@ class FleetSim:
             self.qos = None
             self._drive_tick = None
             self.net.elect(self.members)
-        else:
+        elif flavour == "bft":
             self.service_party, self.members = (
                 self.net.create_bft_notary_cluster(
                     cluster_size or 4, scheme_id=scheme,
                     tracer_factory=self._tracer_for if tracing else None,
                 )
             )
+            self.qos = None
+            self._drive_tick = None
+        else:
+            # distributed sharded uniqueness (round 12): N members,
+            # each a batching notary over a
+            # DistributedUniquenessProvider — the state-ref space
+            # partitioned ACROSS the members, cross-member commits
+            # riding the fabric two-phase reserve→commit under the
+            # same FabricFaults plane the chaos events drive. Durable
+            # state (store, coordinator WAL, reservation journal,
+            # intent WAL) lives on a per-member NodeDatabase that
+            # SURVIVES kill/restart, exactly like a real process's
+            # sqlite file.
+            self.cluster_shards = max(1, int(cluster_shards))
+            self.xshard_decisions: list = []
+            self._xshard_dbs: dict = {}
+            self._xshard_providers: dict = {}
+            self._member_intents: dict = {}
+            n = cluster_size or 3
+            R = scenario.round_micros
+            from ..node.distributed_uniqueness import XShardPolicy
+
+            self._xshard_policy = XShardPolicy(
+                timeout_micros=4 * R,
+                backoff_base_micros=max(R // 4, 1),
+                backoff_cap_micros=2 * R,
+                reservation_ttl_micros=6 * R,
+            )
+            member_names = [f"DistNotary-{i}" for i in range(n)]
+            # one shared service identity, the raft-cluster discipline:
+            # every member holds the cluster key and answers (and
+            # signs) for the cluster party the clients name as notary
+            shared_kp = schemes.generate_keypair(
+                scheme, seed=self._rng.getrandbits(256)
+            )
+            self.service_party = Party("DistNotary", shared_kp.public)
+            self.members = []
+            for mname in member_names:
+                node = self.net.create_node(mname, scheme_id=scheme)
+                node.services.key_management.register_keypair(shared_kp)
+                from ..node.persistence import NodeDatabase
+
+                self._xshard_dbs[mname] = NodeDatabase(":memory:")
+                node.rebuild_cluster_member = (
+                    lambda _node=node, _names=member_names:
+                    self._build_distributed_member(
+                        _node, _names, wal=intent_wal
+                    )
+                )
+                node.rebuild_cluster_member()
+                self.members.append(node)
             self.qos = None
             self._drive_tick = None
         self.alive = {m.name: True for m in self.members}
@@ -769,7 +970,20 @@ class FleetSim:
         ]
 
         # -- traffic source -------------------------------------------------
-        if flavour == "batching":
+        if spend_source == "synthetic" and flavour == "distributed":
+            # the 10k-identity scale source: command-less unsigned
+            # spends (no per-spend ECDSA) with fully real input refs
+            self.source = SyntheticSpendSource(
+                self.members,
+                self.service_party,
+                self._interactive_budget(),
+                cross_shard_fraction=max(
+                    scenario.mix_of(p).cross_shard_fraction
+                    for p in scenario.phases
+                ),
+                seed=scenario.seed,
+            )
+        elif flavour in ("batching", "distributed"):
             self.source = CashSpendSource(
                 self.net,
                 self.members[0],
@@ -779,6 +993,16 @@ class FleetSim:
                     for p in scenario.phases
                 ),
                 seed=scenario.seed,
+                # every distributed member validates: the backchain
+                # must resolve wherever the gateway round-robin lands,
+                # and transactions name the shared cluster identity
+                extra_record_nodes=(
+                    self.members[1:] if flavour == "distributed" else ()
+                ),
+                notary_party=(
+                    self.service_party if flavour == "distributed"
+                    else None
+                ),
             )
         else:
             self.source = TearOffSource(self.service_party, scenario.seed)
@@ -818,6 +1042,18 @@ class FleetSim:
                         # flavour's consensus phase spans
                         trace_filter=self.flavour,
                     )
+                )
+        if flavour == "distributed":
+            # per-member serving heartbeat + the distributed-plane
+            # rules (shard.unreachable, reservation.orphaned) — so a
+            # partitioned owner and an orphaned reservation show in
+            # the same alert story the checker reconciles
+            for m in self.members:
+                m.services.notary_service.attach_health(
+                    self.monitors[m.name]
+                )
+                self._xshard_providers[m.name].attach_health(
+                    self.monitors[m.name]
                 )
         rollup_home = self.members[0].name
         self.cluster = ClusterHealth(
@@ -951,6 +1187,81 @@ class FleetSim:
 
     # -- plumbing ------------------------------------------------------------
 
+    def _build_distributed_member(self, node, member_names, wal=False):
+        """(Re)build one distributed-uniqueness member over its
+        surviving durable state: a fresh DistributedUniquenessProvider
+        + BatchingNotaryService on the member's own NodeDatabase (the
+        store, coordinator WAL, reservation journal and intent WAL all
+        live there, like a real process's sqlite file). The kill/
+        restart seam: recovery re-drives commit-marked intents,
+        presumed-aborts the rest, reloads journaled reservations, and
+        replays the intent WAL with futures re-attached to
+        still-waiting clients by transaction id."""
+        from ..node.distributed_uniqueness import (
+            DistributedUniquenessProvider,
+        )
+        from ..node.notary import BatchingNotaryService
+        from ..node.persistence import (
+            NotaryIntentJournal,
+            ShardedPersistentUniquenessProvider,
+            XShardCoordinatorJournal,
+            XShardReservationJournal,
+        )
+
+        db = self._xshard_dbs[node.name]
+        old = self._xshard_providers.get(node.name)
+        if old is not None:
+            old.stop()
+        provider = DistributedUniquenessProvider(
+            node.name,
+            member_names,
+            node.messaging,
+            self.net.clock,
+            n_partitions=self.cluster_shards,
+            store=ShardedPersistentUniquenessProvider(
+                db, self.cluster_shards
+            ),
+            journal=XShardCoordinatorJournal(db),
+            reservations=XShardReservationJournal(db),
+            policy=self._xshard_policy,
+            seed=(self.scenario.seed << 8) ^ (hash(node.name) & 0xFFFF),
+            decision_log=self.xshard_decisions,
+            tracer=self._tracer_for(node.name) if self._tracing else None,
+        )
+        self._xshard_providers[node.name] = provider
+        journal = self._member_intents.get(node.name)
+        if wal and journal is None:
+            journal = self._member_intents[node.name] = NotaryIntentJournal(
+                db
+            )
+        old_svc = getattr(node.services, "notary_service", None)
+        svc = BatchingNotaryService(
+            node.services, provider, intent_journal=journal,
+            service_identity=self.service_party,
+        )
+        node.services.notary_service = svc
+        node.ticks = [
+            t for t in node.ticks
+            if getattr(t, "__self__", None) not in (old_svc, old)
+        ]
+        node.ticks.append(svc.tick)
+        node.ticks.append(provider.tick)
+        monitor = getattr(self, "monitors", {}).get(node.name)
+        if monitor is not None:
+            svc.attach_health(monitor)
+            provider.attach_health(monitor)
+        provider.recover()
+        if journal is not None:
+            replayed = svc.replay_intents()
+            by_tx = {tx_id: fut for _seq, tx_id, fut in replayed}
+            for entry in getattr(self, "_live", []):
+                gen, _wait, rec = entry
+                if gen is None and rec.outcome is None:
+                    fut = by_tx.get(rec.tx_id)
+                    if fut is not None:
+                        entry[1] = fut
+        return svc
+
     def now(self) -> int:
         return self.net.clock.now_micros()
 
@@ -1025,13 +1336,31 @@ class FleetSim:
     def kill_member(self, idx: int) -> None:
         if self.flavour == "batching":
             raise ValueError(
-                "kill_restart needs a cluster flavour (raft/bft): the "
-                "batching sim is single-node — use freeze() for the "
-                "wedged-pump fault"
+                "kill_restart needs a cluster flavour (raft/bft/"
+                "distributed): the batching sim is single-node — use "
+                "freeze() for the wedged-pump fault"
             )
         node = self.members[idx]
         self.faults.kill(node.name)
         node.messaging.running = False
+        if self.flavour == "distributed":
+            # process death mid-serving: queued-but-unflushed requests
+            # die with the heap, in-flight coordinator state machines
+            # die (their WAL survives), unflushed intent-WAL
+            # resolutions die (those intents replay + dedupe), and the
+            # member stops ticking — the durable NodeDatabase is the
+            # only thing that survives, like a real sqlite file
+            svc = node.services.notary_service
+            svc._pending.clear()
+            journal = self._member_intents.get(node.name)
+            if journal is not None:
+                journal.lose_unflushed_resolutions()
+            provider = self._xshard_providers[node.name]
+            provider.stop()
+            node.ticks = [
+                t for t in node.ticks
+                if getattr(t, "__self__", None) not in (svc, provider)
+            ]
         if getattr(node, "raft", None) is not None:
             node.raft.stop()
         if getattr(node, "bft", None) is not None:
@@ -1041,13 +1370,14 @@ class FleetSim:
     def restart_member(self, idx: int) -> None:
         """Boot a replacement state machine over the same endpoint: the
         consensus layer restores it (AppendEntries/InstallSnapshot for
-        raft, checkpoint catch-up for BFT); the endpoint's dedupe set
-        absorbs frames redelivered across the outage."""
+        raft, checkpoint catch-up for BFT, WAL recovery + intent
+        replay for the distributed uniqueness plane); the endpoint's
+        dedupe set absorbs frames redelivered across the outage."""
         node = self.members[idx]
         rebuild = getattr(node, "rebuild_cluster_member", None)
         if rebuild is None:
             raise ValueError(
-                f"{node.name} is not a cluster member — only raft/bft "
+                f"{node.name} is not a cluster member — only cluster "
                 f"members carry a rebuild seam"
             )
         old = getattr(node, "raft", None) or getattr(node, "bft", None)
@@ -1056,9 +1386,11 @@ class FleetSim:
                 t for t in node.ticks
                 if getattr(t, "__self__", None) is not old
             ]
-        rebuild()
+        # revive the endpoint BEFORE recovery: the rebuild's WAL
+        # re-drives send protocol frames that must queue for delivery
         node.messaging.running = True
         self.faults.revive(node.name)
+        rebuild()
         self.alive[node.name] = True
         # a restarted process reports live from its first pump
         self._beats[node.name].beat()
@@ -1111,6 +1443,12 @@ class FleetSim:
         heap, the journal's unflushed resolution buffer is lost (those
         intents will REPLAY and dedupe), and the pump freezes — the
         watchdog flips healthz exactly as a real crash would."""
+        if self.flavour == "distributed":
+            # the distributed fleet's "kill the notary mid-flush" is a
+            # full member kill of the round-robin home member — the
+            # coordinator most in-flight cross-shard reserves ran on
+            self.kill_member(0)
+            return
         if self.flavour != "batching":
             raise ValueError("kill_notary is the batching-flavour crash")
         node = self.members[0]
@@ -1135,6 +1473,9 @@ class FleetSim:
         dead one admitted."""
         from ..node.notary import BatchingNotaryService
 
+        if self.flavour == "distributed":
+            self.restart_member(0)
+            return
         node = self.members[0]
         old = node.services.notary_service
         self._degraded_flushes_base += _metric_count(
@@ -1194,7 +1535,7 @@ class FleetSim:
         )
         self._next_rid += 1
         self.records.append(rec)
-        if self.flavour == "batching":
+        if self.flavour in ("batching", "distributed"):
             # the embedded-driver entry: enqueue without the flow
             # machinery (the flow-path entry gates are pinned by
             # tests/test_qos.py; here the round-rationed tick IS the
@@ -1467,6 +1808,35 @@ class FleetSim:
             self.intent_journal.flush_resolved()
             intent_unresolved = self.intent_journal.unresolved_count
             intent_replayed = self.intent_journal.replayed
+        has_member_wals = bool(getattr(self, "_member_intents", None))
+        if has_member_wals:
+            for j in self._member_intents.values():
+                j.flush_resolved()
+                intent_unresolved += j.unresolved_count
+                intent_replayed += j.replayed
+        xshard_extra = {}
+        if self.flavour == "distributed":
+            from ..node.distributed_uniqueness import ShardMap
+
+            sm = ShardMap(
+                [m.name for m in self.members], self.cluster_shards
+            )
+            xshard_extra = dict(
+                cluster_shards=self.cluster_shards,
+                shard_map={
+                    row["partition"]: row["owner"]
+                    for row in sm.snapshot()["partitions"]
+                },
+                xshard_decisions=list(self.xshard_decisions),
+                reservations_live={
+                    name: p.reservation_count()
+                    for name, p in self._xshard_providers.items()
+                },
+                xshard_orphans={
+                    name: p.orphan_count()
+                    for name, p in self._xshard_providers.items()
+                },
+            )
         pool = self.verify_pool
         svc = self.members[0].services.notary_service
         return FleetReport(
@@ -1487,7 +1857,7 @@ class FleetSim:
             distinct_clients=len(
                 {r.client for r in self.records}
             ),
-            intent_wal=self.intent_journal is not None,
+            intent_wal=self.intent_journal is not None or has_member_wals,
             intent_unresolved=intent_unresolved,
             intent_replayed=intent_replayed,
             verify_offered=len(self.verify_futures),
@@ -1513,6 +1883,7 @@ class FleetSim:
             tracers=dict(self.tracers),
             cluster_traces=self.cluster_traces,
             incidents=self.incidents,
+            **xshard_extra,
         )
 
     # -- reconciliation inputs ----------------------------------------------
@@ -1568,6 +1939,24 @@ class InvariantChecker:
                 )
 
     def _ledger(self) -> dict:
+        if self.report.flavour == "distributed":
+            # the cluster ledger is the UNION of the members' partition
+            # slices; a ref claimed by two members with different
+            # consumers is a partition-ownership breach, surfaced here
+            # before any downstream check trips on it confusingly
+            merged: dict = {}
+            claimed_by: dict = {}
+            for name in sorted(self.report.ledgers):
+                for ref, tx in self.report.ledgers[name].items():
+                    prior = merged.get(ref)
+                    assert prior is None or prior == tx, (
+                        f"{ref} committed to {prior} on "
+                        f"{claimed_by[ref]} but {tx} on {name} — two "
+                        f"members both think they own the ref"
+                    )
+                    merged[ref] = tx
+                    claimed_by[ref] = name
+            return merged
         names = sorted(self.report.ledgers)
         return self.report.ledgers[names[0]]
 
@@ -1753,9 +2142,21 @@ class InvariantChecker:
                 assert any(
                     not t["healthz"].get(victim, True) for t in during
                 ), f"{entry['name']}: victim {victim} never read unhealthy"
-                assert any(
-                    victim in t["cluster_stale"] for t in during
-                ), f"{entry['name']}: /cluster never marked {victim} stale"
+                if victim == self.report.members[0]:
+                    # the rollup is SERVED from the victim: a dead home
+                    # cannot mark itself stale — the outage shows as
+                    # everyone ELSE going stale in its view
+                    assert any(t["cluster_stale"] for t in during), (
+                        f"{entry['name']}: the dead rollup home's "
+                        f"/cluster never lost its peers"
+                    )
+                else:
+                    assert any(
+                        victim in t["cluster_stale"] for t in during
+                    ), (
+                        f"{entry['name']}: /cluster never marked "
+                        f"{victim} stale"
+                    )
             elif entry["kind"] == "freeze":
                 assert any(
                     not t["healthz"].get(victim, True) for t in during
@@ -1861,6 +2262,85 @@ class InvariantChecker:
             f"({frac:.1%} > {max_fraction:.1%})"
         )
 
+    def check_partition_ownership(self) -> None:
+        """Distributed flavour: every committed ref lives on the
+        member the ownership map says owns its partition (a replicated
+        copy elsewhere is legal IF it agrees — _ledger already rejects
+        disagreement)."""
+        from ..node.notary import shard_of_ref
+
+        rep = self.report
+        assert rep.flavour == "distributed" and rep.shard_map, (
+            "partition-ownership check needs the distributed flavour"
+        )
+        n = rep.cluster_shards
+        for name, ledger in rep.ledgers.items():
+            for ref in ledger:
+                owner = rep.shard_map[shard_of_ref(ref, n)]
+                owner_ledger = rep.ledgers.get(owner)
+                assert owner_ledger is None or ref in owner_ledger, (
+                    f"{ref} committed on {name} but MISSING on its "
+                    f"owner {owner} — a commit landed off-partition"
+                )
+
+    def check_reservation_ledger(self) -> None:
+        """The round-12 reservation-ledger reconciliation:
+
+        1. ZERO live reservations (and zero orphans) on every member
+           after the drain — every reserve the chaos window stranded
+           was driven to commit or release, nothing leaked.
+        2. The shared decision log replayed SERIALLY through a
+           reference uniqueness map reproduces the cluster ledger
+           bit-exact: accepts commit their inputs (same-tx re-commits
+           — WAL replays — are idempotent, like the provider), each
+           recorded conflict names a consumer the replay had already
+           committed, and the final replay map EQUALS the merged
+           ledger."""
+        rep = self.report
+        assert rep.flavour == "distributed", (
+            "reservation-ledger reconciliation is the distributed "
+            "flavour's check"
+        )
+        for name, count in rep.reservations_live.items():
+            assert count == 0, (
+                f"{name} still holds {count} reservation(s) after the "
+                f"drain — orphan recovery leaked"
+            )
+        for name, count in rep.xshard_orphans.items():
+            assert count == 0, f"{name} reports {count} orphan(s)"
+        inputs_of = {r.tx_id: r.inputs for r in rep.records}
+        replay: dict = {}
+        for tx_id, conflict in rep.xshard_decisions:
+            refs = inputs_of.get(tx_id, ())
+            if conflict is None:
+                for ref in refs:
+                    prior = replay.get(ref)
+                    assert prior is None or prior == tx_id, (
+                        f"decision log accepted {tx_id} but the serial "
+                        f"replay already committed {ref} to {prior} — "
+                        f"the log is out of serialisation order"
+                    )
+                    replay[ref] = tx_id
+            else:
+                for ref, consumer in conflict.items():
+                    got = replay.get(ref)
+                    assert got == consumer, (
+                        f"decision log rejected {tx_id} against "
+                        f"{consumer} on {ref}, but the serial replay "
+                        f"holds {got} — the loser saw a consumer that "
+                        f"was not serialised before it"
+                    )
+        ledger = self._ledger()
+        # replay may carry refs of canary-shaped input-less accepts
+        # (none in the fleet); the ledger must match the replay EXACTLY
+        assert replay == ledger, (
+            f"serial replay of the decision log diverges from the "
+            f"cluster ledger: {len(replay)} replayed vs {len(ledger)} "
+            f"committed; only-replay="
+            f"{list(set(replay) - set(ledger))[:3]!r} only-ledger="
+            f"{list(set(ledger) - set(replay))[:3]!r}"
+        )
+
     def check_exact_accounting(self) -> None:
         """The intent-WAL-era loss bound, tightened to an EQUALITY:
         every admitted request is committed, rejected or shed — never
@@ -1952,7 +2432,14 @@ class InvariantChecker:
         expect_conflicts: bool,
         expect_brownout: bool,
     ) -> None:
-        self.check_replica_agreement()
+        if self.report.flavour == "distributed":
+            # partition-disjoint slices, not replicas: ownership and
+            # the reservation-ledger reconciliation replace replica
+            # agreement
+            self.check_partition_ownership()
+            self.check_reservation_ledger()
+        else:
+            self.check_replica_agreement()
         self.check_ledger_vs_answers()
         if expect_conflicts:
             self.check_exactly_one_winner()

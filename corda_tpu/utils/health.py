@@ -687,6 +687,48 @@ class HealthMonitor:
             )
         )
 
+    def watch_distributed_uniqueness(self, provider) -> None:
+        """Install the distributed-uniqueness rules over a node/
+        distributed_uniqueness.DistributedUniquenessProvider:
+
+        `shard.unreachable` — a partition owner stopped answering the
+        cross-shard protocol (reserve-phase timeout fired, or a
+        decided commit is being re-driven into silence). Critical with
+        zero hold on both edges: the provider's own timeout already
+        encodes the duration, and the mark clears the moment any frame
+        from the owner arrives — so the alert auto-resolves on heal.
+
+        `reservation.orphaned` — this member holds reservations whose
+        TTL expired (their coordinator went quiet); the orphan query
+        machinery is driving them to resolution. Uses the policy holds
+        so a hold that resolves within one walk never pages."""
+        self.add_rule(
+            AlertRule(
+                "shard.unreachable",
+                lambda now: (
+                    bool(provider.unreachable_owners()),
+                    {"owners": sorted(provider.unreachable_owners())},
+                ),
+                severity=SEV_CRITICAL,
+                for_micros=0,
+                clear_for_micros=0,
+                trace_filter="xshard",
+            )
+        )
+        self.add_rule(
+            AlertRule(
+                "reservation.orphaned",
+                lambda now: (
+                    provider.orphan_count() > 0,
+                    {
+                        "orphans": provider.orphan_count(),
+                        "reservations": provider.reservation_count(),
+                    },
+                ),
+                trace_filter="xshard",
+            )
+        )
+
     def watch_perf(self, perf) -> None:
         """Install the performance-attribution rules over a
         utils/perf.PerfPlane: jit-retrace-after-warmup and per-shard

@@ -1,0 +1,1172 @@
+"""Distributed sharded uniqueness (round 12): fault-tolerant
+cross-shard reserve→commit across notary cluster members.
+
+Arcs pinned here:
+
+  * the ownership map (ShardMap) and the two-phase wire protocol —
+    deterministic ascending-partition acquisition, full-conflict-set
+    reporting, busy-retry under contention with exactly-one-winner
+    bit-exact against a serial replay of the decision log;
+  * presumed-abort robustness — coordinator killed before the durable
+    decision (participants release via the orphan status query),
+    coordinator killed after it (recovery re-drives ShardCommit to
+    completion), participant killed mid-reserve (the reservation
+    journal reloads and resolves);
+  * a partitioned owner answers `shard-unavailable` — typed, never a
+    hang — with `shard.unreachable` firing and auto-resolving on heal;
+  * the serving integration: BatchingNotaryService members over the
+    provider, config knobs, GET /shards, the QoS cross-shard lane,
+    per-partition raft replication groups;
+  * THE fleet acceptance arc at 10k+ client identities with injected
+    cross-shard double-spends while the ChaosPlane partitions one
+    owner and kill/restarts the coordinator-heavy member mid-reserve —
+    zero orphaned reservations, zero lost admitted requests, bit-exact
+    vs the serial decision-log replay;
+  * the real-process TCP soak: three member processes, one killed -9
+    mid-reserve, the ledger reconciled exactly-once after restart.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from corda_tpu.core.contracts import StateRef
+from corda_tpu.core.identity import Party
+from corda_tpu.crypto import schemes
+from corda_tpu.crypto.hashes import SecureHash
+from corda_tpu.node.distributed_uniqueness import (
+    DistributedUniquenessProvider,
+    ShardMap,
+    XShardPolicy,
+)
+from corda_tpu.node.messaging import FabricFaults, InMemoryMessagingNetwork
+from corda_tpu.node.notary import (
+    ShardUnavailableError,
+    UniquenessConflict,
+)
+from corda_tpu.node.persistence import (
+    NodeDatabase,
+    ShardedPersistentUniquenessProvider,
+    XShardCoordinatorJournal,
+    XShardReservationJournal,
+)
+from corda_tpu.node.services import TestClock
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _h(n: int) -> SecureHash:
+    return SecureHash(bytes([n % 251 + 1]) * 31 + bytes([n // 251]))
+
+
+def _ref(n: int) -> StateRef:
+    return StateRef(_h(n), 0)
+
+
+_KP = schemes.generate_keypair(schemes.ECDSA_SECP256R1_SHA256, seed=77)
+ALICE = Party("alice", _KP.public)
+
+
+class _Rig:
+    """N members over the in-memory fabric on one TestClock."""
+
+    def __init__(self, members=("A", "B"), n_partitions=4, durable=False,
+                 policy=None, decision_log=None, tracers=None, qos=None):
+        self.clock = TestClock()
+        self.faults = FabricFaults(clock=self.clock)
+        self.net = InMemoryMessagingNetwork(clock=self.clock,
+                                            faults=self.faults)
+        self.members = list(members)
+        self.policy = policy or XShardPolicy()
+        self.decisions = decision_log if decision_log is not None else []
+        self.dbs = {
+            name: NodeDatabase(":memory:") for name in self.members
+        }
+        self.durable = durable
+        self.n_partitions = n_partitions
+        self.tracers = tracers or {}
+        self.qos = qos
+        self.provs = {name: self.build(name) for name in self.members}
+
+    def build(self, name):
+        kw = {}
+        if self.durable:
+            db = self.dbs[name]
+            kw = dict(
+                store=ShardedPersistentUniquenessProvider(
+                    db, self.n_partitions
+                ),
+                journal=XShardCoordinatorJournal(db),
+                reservations=XShardReservationJournal(db),
+            )
+        return DistributedUniquenessProvider(
+            name, self.members, self.net.endpoint(name), self.clock,
+            n_partitions=self.n_partitions,
+            policy=self.policy,
+            seed=hash(name) & 0xFFFF,
+            decision_log=self.decisions,
+            tracer=self.tracers.get(name),
+            qos=self.qos,
+            **kw,
+        )
+
+    def restart(self, name):
+        """Kill -9 analogue: drop the live provider (in-flight state
+        machines die), rebuild over the surviving database, recover."""
+        self.provs[name].stop()
+        self.provs[name] = self.build(name)
+        return self.provs[name].recover()
+
+    def owned_refs(self, owner, count=8, start=1):
+        sm = self.provs[self.members[0]].shard_map
+        out = []
+        n = start
+        while len(out) < count:
+            if sm.owner_of(_ref(n)) == owner:
+                out.append(_ref(n))
+            n += 1
+        return out
+
+    def drive(self, rounds=10, advance=100_000):
+        for _ in range(rounds):
+            self.net.run()
+            for p in self.provs.values():
+                p.tick()
+            self.clock.advance(advance)
+
+
+# ---------------------------------------------------------------------------
+# ownership map
+
+
+def test_shard_map_deterministic_and_snapshot():
+    sm = ShardMap(["N0", "N1", "N2"], 6)
+    assert [sm.owner_of_partition(k) for k in range(6)] == [
+        "N0", "N1", "N2", "N0", "N1", "N2"
+    ]
+    assert sm.partitions_of("N1") == (1, 4)
+    # pure function of the ref bytes: stable across instances
+    sm2 = ShardMap(["N0", "N1", "N2"], 6)
+    for n in range(1, 64):
+        assert sm.owner_of(_ref(n)) == sm2.owner_of(_ref(n))
+    snap = sm.snapshot()
+    assert snap["n_partitions"] == 6
+    assert len(snap["partitions"]) == 6
+    assert snap["partitions"][4] == {"partition": 4, "owner": "N1"}
+
+
+# ---------------------------------------------------------------------------
+# the two-phase core
+
+
+def test_local_fast_path_and_conflict():
+    rig = _Rig(members=("A",), n_partitions=4)
+    p = rig.provs["A"]
+    refs = [_ref(1), _ref(2)]
+    p.commit(refs, _h(200), ALICE)   # all-local: resolves inline
+    assert p.store.committed[_ref(1)] == _h(200)
+    with pytest.raises(UniquenessConflict) as e:
+        p.commit([_ref(2), _ref(3)], _h(201), ALICE)
+    assert e.value.conflict == {_ref(2): _h(200)}
+    assert _ref(3) not in p.store.committed   # loser reserved nothing
+    assert p.reservation_count() == 0
+    # same-tx re-commit is idempotent success
+    p.commit(refs, _h(200), ALICE)
+    assert rig.decisions[0] == (_h(200), None)
+    assert rig.decisions[1] == (_h(201), {_ref(2): _h(200)})
+
+
+def test_cross_member_two_phase_wire_walkthrough():
+    rig = _Rig()
+    ra = rig.owned_refs("A", 1)[0]
+    rb = rig.owned_refs("B", 1)[0]
+    tx = _h(210)
+    fut = rig.provs["A"].commit_async([ra, rb], tx, ALICE)
+    # A reserved its own partition inline; B's reserve is on the wire
+    assert not fut.done
+    assert rig.provs["A"].in_flight_count() == 1
+    rig.net.pump(1)      # ShardReserve -> B
+    assert rig.provs["B"].reservation_count() == 1
+    rig.net.pump(1)      # ShardReserveAck -> A: decide, answer, commit
+    assert fut.done and fut.result() is None
+    rig.net.run()        # ShardCommit applies + acks
+    assert rig.provs["A"].store.committed[ra] == tx
+    assert rig.provs["B"].store.committed[rb] == tx
+    assert rig.provs["A"].reservation_count() == 0
+    assert rig.provs["B"].reservation_count() == 0
+    assert rig.provs["A"].in_flight_count() == 0
+    m = rig.provs["A"].metrics
+    assert m.counter("Notary.CrossShard.Commits").count == 1
+    assert m.counter("Notary.CrossShard.Reserves").count == 1
+    # same-tx re-commit over the fabric: idempotent signed-again path
+    fut2 = rig.provs["A"].commit_async([ra, rb], tx, ALICE)
+    rig.drive(4)
+    assert fut2.done and fut2.result() is None
+
+
+def test_cross_member_conflict_reports_full_set():
+    rig = _Rig(members=("A", "B", "C"), n_partitions=6)
+    ra, rb, rc = (rig.owned_refs(m, 1)[0] for m in ("A", "B", "C"))
+    win = _h(220)
+    fut = rig.provs["A"].commit_async([ra, rb], win, ALICE)
+    rig.drive(4)
+    assert fut.done
+    # the rival claims BOTH consumed refs plus a fresh one on C: the
+    # conflict set is complete and the fresh ref is released
+    loser = _h(221)
+    fut2 = rig.provs["C"].commit_async([ra, rb, rc], loser, ALICE)
+    rig.drive(6)
+    assert fut2.done
+    with pytest.raises(UniquenessConflict) as e:
+        fut2.result()
+    assert e.value.conflict == {ra: win, rb: win}
+    assert rc not in rig.provs["C"].store.committed
+    assert all(p.reservation_count() == 0 for p in rig.provs.values())
+    assert (loser, {ra: win, rb: win}) in rig.decisions
+
+
+def test_contention_exactly_one_winner_bit_exact_vs_replay():
+    """Two coordinators race the SAME two cross-member refs in
+    opposite submission order: ascending-partition acquisition +
+    busy-retry resolves it without deadlock, exactly one wins, and the
+    decision log replays serially to the exact store state."""
+    rig = _Rig()
+    ra = rig.owned_refs("A", 1)[0]
+    rb = rig.owned_refs("B", 1)[0]
+    t1, t2 = _h(230), _h(231)
+    f1 = rig.provs["A"].commit_async([ra, rb], t1, ALICE)
+    f2 = rig.provs["B"].commit_async([rb, ra], t2, ALICE)
+    rig.drive(30, advance=50_000)
+    assert f1.done and f2.done
+    outcomes = {}
+    for tx, fut in ((t1, f1), (t2, f2)):
+        try:
+            fut.result()
+            outcomes[tx] = None
+        except UniquenessConflict as e:
+            outcomes[tx] = e.conflict
+    winners = [tx for tx, out in outcomes.items() if out is None]
+    assert len(winners) == 1
+    win = winners[0]
+    lose = t2 if win == t1 else t1
+    assert outcomes[lose] == {ra: win, rb: win}
+    # serial replay of the shared decision log reproduces the stores
+    replay = {}
+    for tx, conflict in rig.decisions:
+        if conflict is None:
+            for ref in (ra, rb):
+                assert replay.get(ref) in (None, tx)
+                replay[ref] = tx
+        else:
+            for ref, consumer in conflict.items():
+                assert replay[ref] == consumer
+    merged = {}
+    merged.update(rig.provs["A"].store.committed)
+    merged.update(rig.provs["B"].store.committed)
+    assert replay == merged
+    assert all(p.reservation_count() == 0 for p in rig.provs.values())
+
+
+# ---------------------------------------------------------------------------
+# unavailable owner (typed degraded answer + health rule)
+
+
+def test_partitioned_owner_typed_unavailable_and_alert():
+    from corda_tpu.utils.health import HealthMonitor
+
+    rig = _Rig(policy=XShardPolicy(
+        timeout_micros=1_000_000, backoff_base_micros=50_000,
+        backoff_cap_micros=200_000, reservation_ttl_micros=1_000_000,
+    ))
+    # shard.unreachable carries its own duration (for/clear 0), so the
+    # default policy holds don't gate it
+    monitor = HealthMonitor(clock=rig.clock)
+    rig.provs["A"].attach_health(monitor)
+    ra = rig.owned_refs("A", 2)
+    rb = rig.owned_refs("B", 2)
+    rig.faults.partition({"A"}, {"B"})
+    fut = rig.provs["A"].commit_async([ra[0], rb[0]], _h(240), ALICE)
+    for _ in range(30):
+        rig.net.run()
+        for p in rig.provs.values():
+            p.tick()
+        monitor.tick()
+        rig.clock.advance(100_000)
+    assert fut.done, "a partitioned owner must answer, not hang"
+    with pytest.raises(ShardUnavailableError):
+        fut.result()
+    assert "B" in rig.provs["A"].unreachable_owners()
+    alert = monitor.snapshot()["alerts"]["shard.unreachable"]
+    assert alert["fire_count"] >= 1 and alert["state"] == "firing"
+    # the request holds NOTHING: its local reservation was released
+    assert rig.provs["A"].reservation_count() == 0
+    # heal: the next cross-member commit succeeds and the mark clears
+    rig.faults.heal()
+    fut2 = rig.provs["A"].commit_async([ra[1], rb[1]], _h(241), ALICE)
+    for _ in range(30):
+        rig.net.run()
+        for p in rig.provs.values():
+            p.tick()
+        monitor.tick()
+        rig.clock.advance(100_000)
+    assert fut2.done and fut2.result() is None
+    assert not rig.provs["A"].unreachable_owners()
+    alert = monitor.snapshot()["alerts"]["shard.unreachable"]
+    assert alert["state"] != "firing"
+    # B's stranded reservation resolved through the orphan query
+    assert rig.provs["B"].reservation_count() == 0
+    assert rb[0] not in rig.provs["B"].store.committed
+
+
+# ---------------------------------------------------------------------------
+# presumed-abort recovery (the WAL arcs)
+
+
+def test_coordinator_killed_mid_commit_re_drives_to_completion():
+    rig = _Rig(durable=True)
+    ra = rig.owned_refs("A", 1)[0]
+    rb = rig.owned_refs("B", 1)[0]
+    tx = _h(250)
+    fut = rig.provs["A"].commit_async([ra, rb], tx, ALICE)
+    rig.net.pump(1)   # reserve -> B
+    rig.net.pump(1)   # ack -> A: durable decision, answer, commit sent
+    assert fut.done
+    assert rig.provs["A"].journal.unresolved_count == 1  # commit unacked
+    recovered = rig.restart("A")
+    assert recovered == 1
+    assert rig.provs["A"].metrics.counter(
+        "Notary.CrossShard.Recovered"
+    ).count == 1
+    rig.drive(10)
+    assert rig.provs["B"].store.committed[rb] == tx
+    assert rig.provs["A"].store.committed[ra] == tx
+    assert rig.provs["A"].journal.unresolved_count == 0
+    assert all(p.reservation_count() == 0 for p in rig.provs.values())
+
+
+def test_coordinator_killed_pre_decision_presumed_abort():
+    from corda_tpu.utils.health import HealthMonitor, HealthPolicy
+
+    rig = _Rig(durable=True, policy=XShardPolicy(
+        reservation_ttl_micros=500_000,
+    ))
+    monitor = HealthMonitor(
+        clock=rig.clock,
+        policy=HealthPolicy(
+            alert_for_micros=200_000, alert_clear_for_micros=200_000,
+        ),
+    )
+    rig.provs["B"].attach_health(monitor)
+    ra = rig.owned_refs("A", 1)[0]
+    rb = rig.owned_refs("B", 1)[0]
+    tx = _h(251)
+    rig.provs["A"].commit_async([ra, rb], tx, ALICE)
+    rig.net.pump(1)   # reserve -> B: held + journaled
+    assert rig.provs["B"].reservation_count() == 1
+    assert rig.provs["B"].reservations.held_count == 1
+    assert rig.provs["A"].journal.unresolved_count == 1   # no decision
+    # the coordinator DIES (no restart yet): B's hold outlives its TTL
+    # and becomes an orphan — queries pile at the dead endpoint, the
+    # rule fires
+    rig.provs["A"].stop()
+    for _ in range(10):
+        for p in rig.provs.values():
+            p.tick()
+        monitor.tick()
+        rig.clock.advance(300_000)
+    assert rig.provs["B"].orphan_count() == 1
+    alert = monitor.snapshot()["alerts"]["reservation.orphaned"]
+    assert alert["fire_count"] >= 1 and alert["state"] == "firing"
+    # restart over the WAL: no commit mark -> presumed abort releases
+    rig.provs["A"] = rig.build("A")
+    assert rig.provs["A"].recover() == 0
+    assert rig.provs["A"].journal.unresolved_count == 0
+    for _ in range(10):
+        rig.net.run()
+        for p in rig.provs.values():
+            p.tick()
+        monitor.tick()
+        rig.clock.advance(300_000)
+    assert rig.provs["B"].reservation_count() == 0
+    assert rig.provs["B"].reservations.held_count == 0
+    assert rb not in rig.provs["B"].store.committed
+    alert = monitor.snapshot()["alerts"]["reservation.orphaned"]
+    assert alert["state"] != "firing"
+    # the refs are free again: a later transaction takes them
+    fut = rig.provs["A"].commit_async([ra, rb], _h(252), ALICE)
+    rig.drive(6)
+    assert fut.done and fut.result() is None
+
+
+def test_participant_killed_mid_reserve_reloads_and_resolves():
+    rig = _Rig(durable=True)
+    ra = rig.owned_refs("A", 1)[0]
+    rb = rig.owned_refs("B", 1)[0]
+    tx = _h(253)
+    fut = rig.provs["A"].commit_async([ra, rb], tx, ALICE)
+    rig.net.pump(1)   # reserve -> B (held + journaled); ack queued
+    assert rig.provs["B"].reservations.held_count == 1
+    rig.restart("B")
+    # the reload reconstructs the hold from the reservation journal
+    assert rig.provs["B"].reservation_count() == 1
+    rig.drive(30)
+    assert fut.done and fut.result() is None
+    assert rig.provs["B"].store.committed[rb] == tx
+    assert rig.provs["B"].reservation_count() == 0
+    assert rig.provs["B"].reservations.held_count == 0
+
+
+def test_same_tx_recommit_during_commit_phase_answers_immediately():
+    """Review pin: a same-tx re-commit arriving while the txn sits in
+    the COMMITTING phase (the intent-WAL replay window — the decision
+    is durable, an owner's ack is pending) must answer NOW, not park
+    on waiters that nothing drains after the decision resolved."""
+    rig = _Rig()
+    ra = rig.owned_refs("A", 1)[0]
+    rb = rig.owned_refs("B", 1)[0]
+    tx = _h(255)
+    fut = rig.provs["A"].commit_async([ra, rb], tx, ALICE)
+    rig.net.pump(1)   # reserve -> B
+    rig.net.pump(1)   # ack -> A: decided, ShardCommit queued, unacked
+    assert fut.done
+    assert rig.provs["A"].in_flight_count() == 1   # COMMITTING
+    replay_fut = rig.provs["A"].commit_async([ra, rb], tx, ALICE)
+    assert replay_fut.done and replay_fut.result() is None
+    rig.drive(4)
+    assert rig.provs["A"].in_flight_count() == 0
+    # and a waiter parked during RESERVING still resolves at decision
+    r2a, r2b = rig.owned_refs("A", 2, start=50)[1], rig.owned_refs(
+        "B", 2, start=50
+    )[1]
+    tx2 = _h(256)
+    f1 = rig.provs["A"].commit_async([r2a, r2b], tx2, ALICE)
+    f2 = rig.provs["A"].commit_async([r2a, r2b], tx2, ALICE)
+    rig.drive(4)
+    assert f1.done and f1.result() is None
+    assert f2.done and f2.result() is None
+
+
+def test_unreachable_mark_clears_on_any_inbound_frame():
+    """Review pin: after a reserve-phase timeout marked an owner
+    unreachable (and the request answered shard-unavailable, leaving
+    nothing to retry), ANY frame from the healed owner — including it
+    coordinating its OWN traffic at us — clears the mark, so
+    shard.unreachable auto-resolves without waiting for a later local
+    request to target that owner's partitions."""
+    rig = _Rig(policy=XShardPolicy(
+        timeout_micros=500_000, backoff_base_micros=50_000,
+        backoff_cap_micros=100_000,
+    ))
+    ra = rig.owned_refs("A", 1)[0]
+    rb = rig.owned_refs("B", 1)[0]
+    rig.faults.partition({"A"}, {"B"})
+    fut = rig.provs["A"].commit_async([ra, rb], _h(257), ALICE)
+    rig.drive(10, advance=200_000)
+    assert fut.done
+    assert "B" in rig.provs["A"].unreachable_owners()
+    rig.faults.heal()
+    # B coordinates ITS OWN transaction toward A — no local request
+    # ever re-targets B, yet the inbound reserve clears the mark
+    ra2 = rig.owned_refs("A", 2, start=60)[1]
+    rb2 = rig.owned_refs("B", 2, start=60)[1]
+    fut2 = rig.provs["B"].commit_async([ra2, rb2], _h(258), ALICE)
+    rig.drive(10)
+    assert fut2.done and fut2.result() is None
+    assert not rig.provs["A"].unreachable_owners()
+
+
+def test_orphan_against_empty_journal_coordinator_releases():
+    """A reservation whose coordinator vanished WITHOUT a WAL (or
+    whose WAL row is gone) resolves via the presumed-abort status
+    answer — never a permanent leak."""
+    rig = _Rig(durable=True, policy=XShardPolicy(
+        reservation_ttl_micros=300_000,
+    ))
+    rb = rig.owned_refs("B", 1)[0]
+    # forge a participant hold with no coordinator transaction at all
+    ok, _ = rig.provs["B"]._reserve_local(
+        rig.provs["B"].shard_map.partition_of(rb), [rb], _h(254), 99,
+        "A", ALICE,
+    )
+    assert ok == "ok"
+    assert rig.provs["B"].reservation_count() == 1
+    rig.drive(20, advance=200_000)
+    assert rig.provs["B"].reservation_count() == 0
+    assert rig.provs["B"].metrics.counter(
+        "Notary.CrossShard.OrphansResolved"
+    ).count == 1
+
+
+# ---------------------------------------------------------------------------
+# tracing + qos lanes
+
+
+def test_xshard_spans_join_the_request_trace():
+    from corda_tpu.utils import tracing as tracelib
+
+    tracers = {
+        name: tracelib.Tracer(enabled=True) for name in ("A", "B")
+    }
+    rig = _Rig(tracers=tracers)
+    ra = rig.owned_refs("A", 1)[0]
+    rb = rig.owned_refs("B", 1)[0]
+    root = tracers["A"].start_trace("notarise.request", tx_id="t")
+    fut = rig.provs["A"].commit_async(
+        [ra, rb], _h(260), ALICE, trace=tuple(root.context)
+    )
+    rig.drive(6)
+    assert fut.done
+    root.end()
+    spans_a = [
+        s.name
+        for t in tracers["A"].recorder.traces()
+        for s in t.spans
+    ]
+    assert "xshard.reserve" in spans_a and "xshard.commit" in spans_a
+    # the participant stamped hop spans into the SAME trace id on ITS
+    # recorder — the cross-node assembly surface
+    spans_b = [
+        s
+        for t in tracers["B"].recorder.traces()
+        for s in t.spans
+        if s.trace_id == root.trace_id
+    ]
+    assert any(s.name == "xshard.hop" for s in spans_b)
+
+
+def test_qos_cross_shard_latency_lane():
+    from corda_tpu.node.qos import NotaryQos, QosPolicy
+
+    clock = TestClock()
+    qos = NotaryQos(QosPolicy(), clock=clock)
+    rig = _Rig(qos=qos)
+    ra = rig.owned_refs("A", 1)[0]
+    rb = rig.owned_refs("B", 1)[0]
+    fut = rig.provs["A"].commit_async([ra, rb], _h(261), ALICE)
+    rig.drive(5)
+    assert fut.done
+    snap = qos.snapshot()["xshard"]
+    assert snap["count"] >= 1
+    assert snap["p99_micros"] is not None
+
+
+# ---------------------------------------------------------------------------
+# raft partition groups (replication seam)
+
+
+def test_partition_raft_groups_replicate_committed_rows():
+    from corda_tpu.node.raft import LEADER, partition_raft_groups
+
+    rig = _Rig(members=("A", "B"), n_partitions=2)
+    # one raft group per partition, every member in every group; the
+    # provider's partition_apply writes rows into each member's store
+    groups = {}
+    for name, prov in rig.provs.items():
+        groups[name] = partition_raft_groups(
+            name, rig.members, rig.net.endpoint(name), rig.clock,
+            prov.partition_apply, range(2),
+        )
+        prov.raft_groups = groups[name]
+
+    def drive(rounds):
+        for _ in range(rounds):
+            rig.net.run()
+            for name in rig.members:
+                for g in groups[name].values():
+                    g.tick()
+                rig.provs[name].tick()
+            rig.clock.advance(30_000)
+
+    drive(60)   # elections settle per group
+    for k in range(2):
+        assert sum(
+            1 for name in rig.members if groups[name][k].role == LEADER
+        ) == 1
+    ra = rig.owned_refs("A", 1)[0]
+    rb = rig.owned_refs("B", 1)[0]
+    tx = _h(270)
+    fut = rig.provs["A"].commit_async([ra, rb], tx, ALICE)
+    drive(60)
+    assert fut.done and fut.result() is None
+    # the OWNER holds its rows...
+    assert rig.provs["A"].store.committed[ra] == tx
+    assert rig.provs["B"].store.committed[rb] == tx
+    # ...and the raft groups replicated each row to the OTHER member
+    assert rig.provs["B"].store.committed.get(ra) == tx
+    assert rig.provs["A"].store.committed.get(rb) == tx
+
+
+# ---------------------------------------------------------------------------
+# serving integration: batching members, config, webserver
+
+
+def test_batching_members_serve_cross_member_spends():
+    """Two BatchingNotaryService members over one provider pair: a
+    cross-member spend submitted at either member flushes through the
+    async commit path and signs; with the other owner partitioned the
+    answer is the typed `shard-unavailable` NotaryError."""
+    from corda_tpu.testing import fleet as fl
+
+    R = 20_000
+    mix = fl.TrafficMix(
+        deadline_micros=100 * R, conflict_fraction=0.0,
+        cross_shard_fraction=1.0,
+    )
+    scenario = fl.FleetScenario(
+        clients=8, phases=(fl.Phase("steady", 4, 2, mix),),
+        round_micros=R, drain_rounds=30, seed=3,
+    )
+    sim = fl.FleetSim(scenario, "distributed", cluster_size=2)
+    rep = sim.run()
+    assert rep.outcomes().get(fl.OUT_SIGNED, 0) >= 6
+    # now a partitioned member: a cross-member spend at the surviving
+    # member answers shard-unavailable (typed), never hangs. The
+    # partition must OUTLIVE the reserve-phase timeout (4 rounds in
+    # the fleet policy), so it spans 12 of 20 offered rounds.
+    scenario2 = fl.FleetScenario(
+        clients=16, phases=(fl.Phase("steady", 20, 2, mix),),
+        round_micros=R, drain_rounds=30, seed=3,
+    )
+    sim2 = fl.FleetSim(scenario2, "distributed", cluster_size=2,
+                       chaos=(fl.partition(1, at=0.1, heal_at=0.7),))
+    rep2 = sim2.run()
+    unavailable = [
+        r for r in rep2.records
+        if r.outcome == fl.OUT_UNAVAILABLE
+        and r.shed_reason == "shard-unavailable"
+    ]
+    assert unavailable, (
+        "a partitioned owner must yield typed shard-unavailable answers"
+    )
+
+
+def test_config_knobs_validate_and_roundtrip(tmp_path):
+    from corda_tpu.node.config import (
+        ConfigError, NodeConfig, load_config, write_config,
+    )
+
+    cfg = NodeConfig(
+        name="N0", base_dir=str(tmp_path), notary="batching",
+        notary_cluster_shards=12, cluster_peers=("N0", "N1", "N2"),
+        notary_xshard_timeout_micros=3_000_000,
+        notary_xshard_backoff=25_000,
+    )
+    path = str(tmp_path / "node.toml")
+    write_config(cfg, path)
+    back = load_config(path)
+    assert back.notary_cluster_shards == 12
+    assert back.notary_xshard_timeout_micros == 3_000_000
+    assert back.notary_xshard_backoff == 25_000
+    assert back.cluster_peers == ("N0", "N1", "N2")
+    # defaults stay un-emitted (the write_config contract)
+    text = open(path).read()
+    assert "notary_xshard_timeout_micros = 3000000" in text
+    with pytest.raises(ConfigError, match="batching"):
+        NodeConfig(name="N0", base_dir=".", notary="simple",
+                   notary_cluster_shards=2, cluster_peers=("N0",))
+    with pytest.raises(ConfigError, match="cluster_peers"):
+        NodeConfig(name="N0", base_dir=".", notary="batching",
+                   notary_cluster_shards=2, cluster_peers=("N1",))
+    with pytest.raises(ConfigError, match="mutually exclusive"):
+        NodeConfig(name="N0", base_dir=".", notary="batching",
+                   notary_cluster_shards=2, notary_shards=4,
+                   cluster_peers=("N0",))
+    with pytest.raises(ConfigError, match="timeout"):
+        NodeConfig(name="N0", base_dir=".", notary="batching",
+                   notary_cluster_shards=2, cluster_peers=("N0",),
+                   notary_xshard_timeout_micros=0)
+
+
+def test_booted_node_serves_shards_endpoint(tmp_path):
+    """A real single-member cluster node boots with
+    notary_cluster_shards, serves GET /shards with the ownership map,
+    and the canary rides the distributed provider's all-local path."""
+    import urllib.request
+
+    from corda_tpu.node.config import NodeConfig, RpcUserConfig
+    from corda_tpu.node.node import Node
+
+    cfg = NodeConfig(
+        name="X0", base_dir=str(tmp_path / "X0"), notary="batching",
+        notary_cluster_shards=6, cluster_peers=("X0",),
+        verifier_backend="cpu", use_tls=False, scheme="secp256r1",
+        notary_intent_wal=True, web_port=0,
+        rpc_users=(RpcUserConfig("ops", "pw"),),
+    )
+    node = Node(cfg).start()
+    try:
+        for _ in range(5):
+            node.pump(0.05)
+        base = f"http://127.0.0.1:{node.web.port}"
+        with urllib.request.urlopen(f"{base}/shards", timeout=10) as r:
+            snap = json.loads(r.read())
+        assert snap["member"] == "X0"
+        assert snap["n_partitions"] == 6
+        assert all(row["owner"] == "X0" for row in snap["partitions"])
+        assert snap["reservation_depth"] == 0
+        # Notary.CrossShard.* series are on the scrape surface (the
+        # exposition sanitizes dots to underscores)
+        with urllib.request.urlopen(f"{base}/metrics", timeout=10) as r:
+            text = r.read().decode()
+        assert "Notary_CrossShard_InFlight" in text
+        # the endpoint index lists /shards as enabled
+        with urllib.request.urlopen(base + "/", timeout=10) as r:
+            index = json.loads(r.read())
+        row = next(
+            e for e in index["endpoints"] if e["path"] == "/shards"
+        )
+        assert row["enabled"] is True
+    finally:
+        node.stop()
+
+
+def test_shards_endpoint_404_when_unwired():
+    from corda_tpu.client.webserver import NodeWebServer
+
+    class _NoRpc:
+        def __getattr__(self, name):
+            raise AssertionError("no RPC in this rig")
+
+    ws = NodeWebServer(_NoRpc(), pump=lambda: None)
+    status, _ctype, payload = ws._serve_shards({})
+    assert status == 404
+    assert b"not wired" in payload
+
+
+# ---------------------------------------------------------------------------
+# fleet chaos regression + the acceptance arc
+
+
+def test_fleet_chaos_during_reserve_window_zero_orphans_zero_lost():
+    """Satellite regression: `partition` AND `kill_notary_mid_flush`
+    fired DURING a cross-shard reserve window leave zero orphaned
+    reservations and zero lost admitted requests (WAL-backed exact
+    accounting + the reservation-ledger reconciliation)."""
+    from corda_tpu.testing import fleet as fl
+
+    R = 20_000
+    mix = fl.TrafficMix(
+        deadline_micros=300 * R, conflict_fraction=0.08,
+        cross_shard_fraction=0.6,
+    )
+    scenario = fl.FleetScenario(
+        clients=96, phases=(fl.Phase("steady", 14, 8, mix),),
+        round_micros=R, drain_rounds=80, seed=41,
+    )
+    sim = fl.FleetSim(
+        scenario, "distributed", cluster_size=3, intent_wal=True,
+        chaos=(
+            fl.partition(2, at=0.15, heal_at=0.4),
+            fl.kill_notary_mid_flush(at=0.5, restart_at=0.65),
+        ),
+    )
+    rep = sim.run()
+    checker = fl.InvariantChecker(rep)
+    checker.check_all()
+    # the named guarantees, asserted directly too
+    assert all(v == 0 for v in rep.reservations_live.values())
+    assert all(v == 0 for v in rep.xshard_orphans.values())
+    checker.check_exact_accounting()
+    assert rep.intent_unresolved == 0
+    assert not any(
+        r.outcome in (None, fl.OUT_LOST) for r in rep.records
+    )
+
+
+@pytest.mark.slow
+def test_fleet_acceptance_10k_identities_chaos_bit_exact():
+    """THE round-12 acceptance arc: 10k+ client identities, injected
+    cross-shard double-spends, the ChaosPlane partitioning one owner
+    and kill/restarting the coordinator-heavy member mid-reserve —
+    exactly-one-winner bit-exact vs the serial decision-log replay,
+    zero orphaned reservations, zero lost admitted requests, and
+    `shard.unreachable` firing then auto-resolving on heal."""
+    from corda_tpu.testing import fleet as fl
+
+    R = 20_000
+    mix = fl.TrafficMix(
+        deadline_micros=300 * R, conflict_fraction=0.05,
+        cross_shard_fraction=0.5,
+    )
+    scenario = fl.FleetScenario(
+        clients=10_500,
+        phases=(fl.Phase("steady", 40, 260, mix),),
+        round_micros=R, drain_rounds=100, seed=29,
+    )
+    sim = fl.FleetSim(
+        scenario, "distributed", cluster_size=3, intent_wal=True,
+        spend_source="synthetic",
+        chaos=(
+            fl.partition(1, at=0.25, heal_at=0.5),
+            fl.kill_restart(0, at=0.6, restart_at=0.75),
+        ),
+    )
+    rep = sim.run()
+    assert rep.distinct_clients >= 10_000
+    checker = fl.InvariantChecker(rep)
+    # the full reconciliation: partition ownership, the serial-replay
+    # bit-exactness, exactly-one-winner, exact accounting, the health
+    # story for both chaos windows
+    checker.check_all()
+    assert rep.outcomes().get(fl.OUT_SIGNED, 0) >= 5_000
+    assert all(v == 0 for v in rep.reservations_live.values())
+    assert rep.intent_unresolved == 0
+    # shard.unreachable fired on a surviving member during the
+    # partition and is NOT firing at the end (auto-resolved on heal)
+    fired = 0
+    for name, mon in rep.monitors.items():
+        alert = mon.snapshot()["alerts"].get("shard.unreachable")
+        if alert and alert["fire_count"] >= 1:
+            fired += 1
+            assert alert["state"] != "firing", (
+                f"{name}: shard.unreachable stuck firing after heal"
+            )
+    assert fired >= 1, "no member ever flagged the partitioned owner"
+
+
+def test_fleet_small_acceptance_chaos_bit_exact():
+    """Tier-1-sized twin of the 10k arc (same chaos shape, same
+    checks, ~1.5k identities) so every CI run exercises the full
+    reconciliation even when slow tests are deselected."""
+    from corda_tpu.testing import fleet as fl
+
+    R = 20_000
+    mix = fl.TrafficMix(
+        deadline_micros=300 * R, conflict_fraction=0.05,
+        cross_shard_fraction=0.5,
+    )
+    scenario = fl.FleetScenario(
+        clients=1_500,
+        phases=(fl.Phase("steady", 15, 104, mix),),
+        round_micros=R, drain_rounds=100, seed=31,
+    )
+    sim = fl.FleetSim(
+        scenario, "distributed", cluster_size=3, intent_wal=True,
+        spend_source="synthetic",
+        chaos=(
+            fl.partition(1, at=0.25, heal_at=0.5),
+            fl.kill_restart(0, at=0.6, restart_at=0.75),
+        ),
+    )
+    rep = sim.run()
+    assert rep.distinct_clients >= 1_500
+    fl.InvariantChecker(rep).check_all()
+    fired = sum(
+        1 for mon in rep.monitors.values()
+        if (mon.snapshot()["alerts"].get("shard.unreachable") or {}).get(
+            "fire_count", 0
+        ) >= 1
+    )
+    assert fired >= 1
+
+
+# ---------------------------------------------------------------------------
+# bench smoke
+
+
+@pytest.mark.slow
+def test_bench_quick_distributed_smoke():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "bench.py"),
+         "--quick", "distributed"],
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "BENCH_DIST_CLIENTS": "48"},
+        capture_output=True, text=True, timeout=420,
+    )
+    assert out.returncode == 0, out.stderr + out.stdout
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == "distributed_commit"
+    assert rec["xshard_zero_orphans"] is True
+    assert rec["xshard_exactly_once"] is True
+    assert rec["gate_required_true"] == [
+        "xshard_zero_orphans", "xshard_exactly_once"
+    ]
+    assert rec["value"] > 0
+
+
+def test_bench_history_gates_xshard_verdicts(tmp_path):
+    """A distributed_commit record with a falsy required-true verdict
+    fails `bench_history --gate` no matter the headline."""
+    sys.path.insert(0, REPO_ROOT)
+    try:
+        from tools import bench_history
+    finally:
+        sys.path.remove(REPO_ROOT)
+    good = {
+        "metric": "distributed_commit", "value": 100.0,
+        "gate_required_true": ["xshard_zero_orphans",
+                               "xshard_exactly_once"],
+        "xshard_zero_orphans": True, "xshard_exactly_once": True,
+    }
+    bad = dict(good, value=200.0, xshard_zero_orphans=False)
+    old_path = tmp_path / "BENCH_r90.json"
+    new_path = tmp_path / "BENCH_r91.json"
+    old_path.write_text(json.dumps({"tail": json.dumps(good)}))
+    new_path.write_text(json.dumps({"tail": json.dumps(bad)}))
+    rows = bench_history.diff(
+        bench_history.parse_record(str(old_path)),
+        bench_history.parse_record(str(new_path)),
+    )
+    failures = bench_history.gate_failures(rows, 10.0)
+    assert any(
+        r["metric"].startswith("distributed_commit") for r in failures
+    ), failures
+    # both verdicts true -> no failure rows
+    new_path.write_text(
+        json.dumps({"tail": json.dumps(dict(good, value=90.0))})
+    )
+    rows_ok = bench_history.diff(
+        bench_history.parse_record(str(old_path)),
+        bench_history.parse_record(str(new_path)),
+    )
+    assert not [
+        r for r in bench_history.gate_failures(rows_ok, 50.0)
+        if r.get("better") == "required"
+    ]
+
+
+# ---------------------------------------------------------------------------
+# the real-process TCP soak
+
+
+_TCP_CHILD = r"""
+import json, sys, time
+from corda_tpu.crypto import schemes
+from corda_tpu.node.distributed_uniqueness import (
+    DistributedUniquenessProvider, XShardPolicy,
+)
+from corda_tpu.node.fabric import FabricEndpoint, PeerAddress
+from corda_tpu.node.persistence import (
+    NodeDatabase, ShardedPersistentUniquenessProvider,
+    XShardCoordinatorJournal, XShardReservationJournal,
+)
+from corda_tpu.node.services import Clock
+
+name, db_path, status_path, peers_json = sys.argv[1:5]
+peers = json.loads(peers_json)      # name -> [host, port] (parent only)
+SEEDS = {"A": 9001, "B": 9002, "C": 9003}
+db = NodeDatabase(db_path)
+ep = FabricEndpoint(
+    name,
+    schemes.generate_keypair(seed=SEEDS[name]),
+    db,
+    resolve=lambda peer: (
+        PeerAddress(peers[peer][0], peers[peer][1], None)
+        if peer in peers else None
+    ),
+)
+ep.expected_identity_key = lambda peer: (
+    schemes.generate_keypair(seed=SEEDS[peer]).public
+    if peer in SEEDS else None
+)
+prov = DistributedUniquenessProvider(
+    name, ["A", "B", "C"], ep, Clock(), n_partitions=3,
+    store=ShardedPersistentUniquenessProvider(db, 3),
+    journal=XShardCoordinatorJournal(db),
+    reservations=XShardReservationJournal(db),
+    policy=XShardPolicy(
+        timeout_micros=20_000_000, backoff_base_micros=100_000,
+        backoff_cap_micros=1_000_000, reservation_ttl_micros=3_000_000,
+    ),
+    seed=SEEDS[name],
+)
+ep.start()
+prov.recover()
+status = {"port": ep.listen_port}
+last = 0.0
+while True:
+    ep.pump(block=True, timeout=0.05)
+    prov.tick()
+    now = time.monotonic()
+    if now - last > 0.1:
+        last = now
+        status["committed"] = {
+            f"{ref.txhash}:{ref.index}": str(tx)
+            for ref, tx in prov.store.committed.items()
+        }
+        status["reservations"] = prov.reservation_count()
+        tmp = status_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(status, f)
+        import os as _os
+        _os.replace(tmp, status_path)
+"""
+
+
+def _read_status(path, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except Exception:
+            time.sleep(0.05)
+    raise AssertionError(f"no status at {path}")
+
+
+def _wait(cond, timeout=30.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def test_tcp_three_process_kill9_mid_reserve_exactly_once(tmp_path):
+    """The deferred PR-8 half, absorbed here: three member processes
+    over the REAL TCP fabric, participant B killed -9 mid-reserve
+    (after its reservation journaled, before the commit applied),
+    restarted over the same database — the fabric journal redelivers,
+    recovery re-drives, and the ledger reconciles exactly-once."""
+    from corda_tpu.node.fabric import FabricEndpoint, PeerAddress
+    from corda_tpu.node.services import Clock
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+
+    seeds = {"A": 9001, "B": 9002, "C": 9003}
+    kp = {m: schemes.generate_keypair(seed=s) for m, s in seeds.items()}
+    db_a = NodeDatabase(str(tmp_path / "A.db"))
+    addresses = {}
+    ep = FabricEndpoint(
+        "A", kp["A"], db_a,
+        resolve=lambda peer: addresses.get(peer),
+    )
+    ep.expected_identity_key = lambda peer: (
+        kp[peer].public if peer in kp else None
+    )
+    ep.start()
+    addresses["A"] = PeerAddress("127.0.0.1", ep.listen_port, None)
+
+    def spawn(member):
+        status = str(tmp_path / f"{member}.status.json")
+        try:
+            os.remove(status)
+        except FileNotFoundError:
+            pass
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _TCP_CHILD, member,
+             str(tmp_path / f"{member}.db"), status,
+             json.dumps({"A": ["127.0.0.1", ep.listen_port]})],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        )
+        st = _read_status(status)
+        addresses[member] = PeerAddress("127.0.0.1", st["port"], None)
+        return proc, status
+
+    proc_b, status_b = spawn("B")
+    proc_c, status_c = spawn("C")
+    prov = DistributedUniquenessProvider(
+        "A", ["A", "B", "C"], ep, Clock(), n_partitions=3,
+        store=ShardedPersistentUniquenessProvider(db_a, 3),
+        journal=XShardCoordinatorJournal(db_a),
+        reservations=XShardReservationJournal(db_a),
+        policy=XShardPolicy(
+            timeout_micros=30_000_000, backoff_base_micros=100_000,
+            backoff_cap_micros=1_000_000,
+        ),
+        seed=1,
+    )
+    try:
+        sm = prov.shard_map
+        # one ref per member's partition (3 partitions, 3 owners)
+        refs = {}
+        n = 1
+        while len(refs) < 3:
+            owner = sm.owner_of(_ref(n))
+            refs.setdefault(owner, _ref(n))
+            n += 1
+        tx = _h(99)
+        fut = prov.commit_async(
+            [refs["A"], refs["B"], refs["C"]], tx, ALICE
+        )
+        # drive until B's partition is reserved (the coordinator moved
+        # past B's ascending-order step) — THE mid-reserve moment
+        txn = prov._txns[tx]
+        b_step = next(
+            i for i, (_k, owner, _r) in enumerate(txn.parts)
+            if owner == "B"
+        )
+
+        def past_b():
+            ep.pump(block=True, timeout=0.05)
+            prov.tick()
+            t = prov._txns.get(tx)
+            return t is None or t.idx > b_step
+        assert _wait(past_b, timeout=60), "never reserved B's partition"
+        st_b = _read_status(status_b)
+        # kill -9, mid-protocol: B holds a journaled reservation
+        proc_b.send_signal(signal.SIGKILL)
+        proc_b.wait(timeout=10)
+
+        # the commit decision completes against C; the answer arrives
+        def answered():
+            ep.pump(block=True, timeout=0.05)
+            prov.tick()
+            return fut.done
+        assert _wait(answered, timeout=60), "commit never resolved"
+        assert fut.result() is None
+
+        # restart B over the SAME database: the reservation journal
+        # reloads, the fabric journal redelivers the ShardCommit, the
+        # coordinator WAL re-drives — the row lands exactly once
+        proc_b, status_b = spawn("B")
+
+        def converged():
+            ep.pump(block=True, timeout=0.05)
+            prov.tick()
+            try:
+                with open(status_b) as f:
+                    st = json.load(f)
+            except Exception:
+                return False
+            key = f"{refs['B'].txhash}:{refs['B'].index}"
+            return (
+                st.get("committed", {}).get(key) == str(tx)
+                and st.get("reservations") == 0
+                and prov.journal.unresolved_count == 0
+            )
+        assert _wait(converged, timeout=90), (
+            f"B never converged: {_read_status(status_b)} "
+            f"journal={prov.journal.unresolved_count}"
+        )
+        # exactly-once: a rival claiming B's ref loses with a conflict
+        rival = _h(98)
+        fut2 = prov.commit_async([refs["B"]], rival, ALICE)
+
+        def rival_answered():
+            ep.pump(block=True, timeout=0.05)
+            prov.tick()
+            return fut2.done
+        assert _wait(rival_answered, timeout=60)
+        with pytest.raises(UniquenessConflict) as e:
+            fut2.result()
+        assert e.value.conflict == {refs["B"]: tx}
+        # and the same-tx re-commit is idempotent success
+        fut3 = prov.commit_async(
+            [refs["A"], refs["B"], refs["C"]], tx, ALICE
+        )
+
+        def re_answered():
+            ep.pump(block=True, timeout=0.05)
+            prov.tick()
+            return fut3.done
+        assert _wait(re_answered, timeout=60)
+        assert fut3.result() is None
+        assert prov.reservation_count() == 0
+    finally:
+        for proc in (proc_b, proc_c):
+            try:
+                proc.kill()
+            except Exception:
+                pass
+        prov.stop()
+        ep.stop()
+        db_a.close()

@@ -417,7 +417,10 @@ def test_async_commit_defers_root_span_end_until_answered():
         def __init__(self):
             self.futs = []
 
-        def commit_async(self, states, tx_id, requester):
+        def commit_async(self, states, tx_id, requester, trace=None):
+            # trace= is the SPI contract (UniquenessProvider): the
+            # flush threads the frame's root span through it so
+            # distributed providers can stamp consensus/xshard spans
             fut = FlowFuture()
             self.futs.append(fut)
             return fut
