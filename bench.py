@@ -1436,6 +1436,101 @@ def _perf_metric(batch: int, iters: int) -> dict:
     }
 
 
+def _txstory_metric(batch: int, iters: int) -> dict:
+    """Transaction-provenance plane cost + population proof (the
+    round-13 tentpole's bench leg): the notary CPU rig serves `batch`
+    spends per flush with the lifecycle ledger DETACHED vs ATTACHED
+    (utils/txstory.TxStory — admit / flush-membership / verified /
+    terminal events per transaction, stage histograms and the slowest
+    leaderboard derived at close), interleaved min-of-reps A/B on the
+    same fixture through the REAL intake (submit -> enqueue_pending,
+    the path that emits). `value` is the fractional flush-wall
+    overhead; the acceptance line is <= 2%
+    (BENCH_TXSTORY_OVERHEAD_MAX), and `txstory_overhead_ok` rides the
+    bench_history --gate as a required-true verdict. The ON side uses
+    a FRESH ledger per rep — every rep pays full story creation, the
+    honest worst case."""
+    import gc
+    import time as _time
+
+    from corda_tpu.core import serialization as ser
+    from corda_tpu.node.notary import InMemoryUniquenessProvider
+    from corda_tpu.utils.txstory import TxStory
+
+    tile = max(1, int(os.environ.get("BENCH_TILE", "8")))
+    svc, requester, blobs = _trace_fixture(min(tile, batch), batch, cpu=True)
+    spends = [ser.decode(b) for b in blobs]
+    reps = max(2, iters)
+
+    def run_once(story) -> float:
+        svc.attach_txstory(story)   # None detaches (the OFF side)
+        svc.uniqueness = InMemoryUniquenessProvider()
+        futs = []
+        t0 = _time.perf_counter()
+        for stx in spends:
+            # the REAL intake path (enqueue_pending): admit + terminal
+            # hooks are exactly what production requests pay
+            futs.append(svc.submit(stx, requester))
+        svc.flush()
+        wall = _time.perf_counter() - t0
+        for fut in futs:
+            sig = fut.result()
+            if not hasattr(sig, "by"):
+                raise SystemExit(
+                    f"txstory metric notarisation failed: {sig}"
+                )
+        return wall
+
+    # population proof, untimed: one pass with a ledger attached must
+    # yield a complete admission->commit story per transaction
+    proof = TxStory()
+    run_once(proof)
+    sample = proof.story(str(spends[0].id))
+    if sample is None or sample["terminal"] != "committed":
+        raise SystemExit(
+            f"txstory metric: no committed story for the first spend "
+            f"({sample})"
+        )
+    if sample["event_count"] < 4 or "total" not in sample["stages_micros"]:
+        raise SystemExit(
+            f"txstory metric: incomplete story {sample}"
+        )
+    if not proof.slowest(1):
+        raise SystemExit("txstory metric: empty slowest leaderboard")
+
+    run_once(None)                   # warm-up both sides
+    walls_off, walls_on = [], []
+    for _ in range(reps):            # interleaved A/B: drift cancels
+        gc.collect()                 # equalise collector debt per rep
+        walls_off.append(run_once(None))
+        gc.collect()
+        walls_on.append(run_once(TxStory()))
+    svc.attach_txstory(None)
+    overhead = min(walls_on) / min(walls_off) - 1.0
+    max_overhead = float(
+        os.environ.get("BENCH_TXSTORY_OVERHEAD_MAX", "0.02")
+    )
+    return {
+        "metric": "txstory_plane_overhead",
+        "value": round(max(overhead, 0.0), 4),
+        "unit": "fractional flush-wall overhead of the lifecycle ledger",
+        # direction marker (see perf_plane_overhead): overhead gates
+        # when it grows, not when it improves
+        "lower_is_better": True,
+        "vs_baseline": round(max(overhead, 0.0), 4),
+        "overhead_raw": round(overhead, 4),
+        "overhead_max": max_overhead,
+        "txstory_overhead_ok": overhead <= max_overhead,
+        "gate_required_true": ["txstory_overhead_ok"],
+        "events_per_tx": round(
+            proof.recorded / max(1, len(spends)), 2
+        ),
+        "sample_stages_micros": sample["stages_micros"],
+        "batch": batch,
+        "reps": reps,
+    }
+
+
 def _montmul_metric(batch: int, iters: int) -> dict:
     """Interleaved device-resident A/B of the two variable x variable
     Montgomery-multiply formulations (round-3 MXU experiment, VERDICT
@@ -2120,6 +2215,11 @@ def _run_metric(metric: str, batch: int, iters: int) -> dict:
         if batch > 512:
             out["batch_requested"] = batch   # cap visible in the record
         return out
+    if metric == "txstory":
+        out = _txstory_metric(min(batch, 512), iters)
+        if batch > 512:
+            out["batch_requested"] = batch   # cap visible in the record
+        return out
     if metric == "fleet":
         out = _fleet_metric(min(batch, 16), iters)
         if batch > 16:
@@ -2301,6 +2401,37 @@ def _quick(metric: str) -> None:
                 "not counted"
             )
         return
+    if metric == "txstory":
+        batch = int(os.environ.get("BENCH_BATCH", "64"))
+        iters = int(os.environ.get("BENCH_ITERS", "3"))
+        out = _txstory_metric(batch, iters)
+        max_overhead = out["overhead_max"]
+        if not out["txstory_overhead_ok"]:
+            # one retry before failing (the quick-perf discipline): a
+            # co-scheduled process landing on the ON reps inflates
+            # min-of-reps A/B on a shared CI box
+            print(
+                f"bench: txstory overhead {out['value']:.4f} over the "
+                f"{max_overhead:.0%} gate — noisy box? retrying once",
+                file=sys.stderr,
+            )
+            retry = _txstory_metric(batch, iters)
+            if retry["value"] < out["value"]:
+                retry["first_attempt_overhead"] = out["value"]
+                out = retry
+        out["quick"] = True
+        print(json.dumps(out), flush=True)
+        if not out["txstory_overhead_ok"]:
+            raise SystemExit(
+                f"lifecycle-ledger overhead {out['value']:.4f} exceeds "
+                f"{max_overhead:.0%} of the flush wall"
+            )
+        if out["events_per_tx"] < 4:
+            raise SystemExit(
+                f"incomplete lifecycle stories: {out['events_per_tx']} "
+                f"events/tx (admit + flush + verified + terminal = 4)"
+            )
+        return
     if metric == "fleet":
         batch = int(os.environ.get("BENCH_BATCH", "8"))
         iters = int(os.environ.get("BENCH_ITERS", "1"))
@@ -2446,8 +2577,8 @@ def _quick(metric: str) -> None:
     if metric != "ingest":
         raise SystemExit(
             f"--quick supports 'ingest', 'trace', 'consensus', 'qos', "
-            f"'health', 'perf', 'fleet', 'faults', 'distributed' or "
-            f"'shards', not {metric!r}"
+            f"'health', 'perf', 'txstory', 'fleet', 'faults', "
+            f"'distributed' or 'shards', not {metric!r}"
         )
     batch = int(os.environ.get("BENCH_BATCH", "256"))
     iters = int(os.environ.get("BENCH_ITERS", "1"))
@@ -2468,7 +2599,7 @@ def main() -> None:
         raise SystemExit(
             f"unknown arguments {argv!r} "
             "(try --quick ingest|trace|consensus|qos|health|perf|"
-            "fleet|faults|shards)"
+            "txstory|fleet|faults|shards)"
         )
     t_start = time.perf_counter()
     # On a remote-attached TPU the host<->device link latency (~50-100
@@ -2481,7 +2612,8 @@ def main() -> None:
     known = (
         "all", "p256", "mixed", "merkle", "notary", "notary_commit_plane",
         "ingest", "ingest_pipelined", "trace", "consensus", "qos", "health",
-        "perf", "fleet", "faults", "distributed_commit", "montmul", "parity",
+        "perf", "txstory", "fleet", "faults", "distributed_commit",
+        "montmul", "parity",
     )
     if metric not in known:
         # a typo must not record a p256-only rate under another name
@@ -2520,8 +2652,8 @@ def main() -> None:
     # parity runs LAST of the optional work (cheapest to drop), but
     # before the headline so the headline stays the final stdout line
     for m in ("mixed", "merkle", "notary", "ingest", "ingest_pipelined",
-              "trace", "consensus", "qos", "health", "perf", "fleet",
-              "faults", "distributed_commit", "parity"):
+              "trace", "consensus", "qos", "health", "perf", "txstory",
+              "fleet", "faults", "distributed_commit", "parity"):
         avail = left() - reserve
         if avail < 60:
             print(
@@ -2533,8 +2665,8 @@ def main() -> None:
         env = dict(os.environ, BENCH_METRIC=m)
         if avail < 300 and m in (
             "mixed", "merkle", "notary", "ingest", "ingest_pipelined",
-            "trace", "consensus", "qos", "health", "perf", "fleet",
-            "faults", "distributed_commit",
+            "trace", "consensus", "qos", "health", "perf", "txstory",
+            "fleet", "faults", "distributed_commit",
         ):
             # trim before dropping: one timed rep at a shallower batch
             # still yields a usable point for the table

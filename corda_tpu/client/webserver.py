@@ -120,6 +120,8 @@ class NodeWebServer:
         cluster_traces=None,
         incidents=None,
         shards=None,
+        txstory=None,
+        cluster_tx=None,
     ):
         """`metrics`: an optional MetricRegistry served at GET /metrics
         in prometheus exposition format (the reference exports
@@ -172,6 +174,16 @@ class NodeWebServer:
         owners), the operator's routing-truth view of the distributed
         uniqueness plane.
 
+        `txstory`: an optional utils/txstory.TxStory — GET /tx/<id>
+        serves one transaction's lifecycle timeline (admission ->
+        flush membership -> per-attempt verify -> commit/terminal,
+        with the linked trace id) and GET /tx/slowest the completed-
+        transaction leaderboard. `cluster_tx`: an optional
+        ClusterTxStory — /tx/<id> then assembles the timeline
+        CLUSTER-WIDE (peer stories pulled over the network map,
+        clock-shifted onto one axis); `?local=1` serves this member's
+        story alone (the peer-pull form).
+
         Every operational endpoint honours `?ts=1`: the payload gains
         a shared process-monotonic `ts_micros` stamp (a trailing
         `# ts_micros` comment on /metrics text), so cross-endpoint
@@ -189,6 +201,8 @@ class NodeWebServer:
         self.cluster_traces = cluster_traces
         self.incidents = incidents
         self.shards = shards
+        self.txstory = txstory
+        self.cluster_tx = cluster_tx
         # serializes /profile on-demand captures and resets: without
         # it a second ?seconds=N request returns a partial table and
         # a concurrent ?reset=1 wipes an in-flight capture
@@ -359,8 +373,8 @@ class NodeWebServer:
             }
             for path, (desc, _) in self._ops.items()
         ]
-        # path-parameterized route (dispatched by prefix, not the _ops
-        # table — an exact-match entry for it could never be hit)
+        # path-parameterized routes (dispatched by prefix, not the
+        # _ops table — an exact-match entry could never be hit)
         rows.append({
             "path": "/cluster/trace/<trace_id>",
             "description": (
@@ -369,6 +383,25 @@ class NodeWebServer:
                 "adjusted, merged with a per-member phase summary"
             ),
             "enabled": self.cluster_traces is not None,
+        })
+        rows.append({
+            "path": "/tx/<tx_id>",
+            "description": (
+                "one transaction's lifecycle timeline, assembled "
+                "cluster-wide (admission, flush membership, "
+                "per-attempt verify, consensus commit, terminal — "
+                "with the linked trace id; ?local=1 for this member "
+                "alone)"
+            ),
+            "enabled": self.txstory is not None,
+        })
+        rows.append({
+            "path": "/tx/slowest",
+            "description": (
+                "slowest completed transactions: total latency + "
+                "per-stage breakdown (?limit=N)"
+            ),
+            "enabled": self.txstory is not None,
         })
         return self._json(200, {
             "endpoints": sorted(rows, key=lambda r: r["path"]),
@@ -521,6 +554,56 @@ class NodeWebServer:
         except Exception as e:   # noqa: BLE001 - defensive render
             return self._json(500, {"error": f"shards snapshot failed: {e}"})
 
+    def _serve_tx_slowest(self, query) -> tuple[int, str, bytes]:
+        # the completed-transaction leaderboard: total admission->
+        # terminal micros with the per-stage breakdown — the "which
+        # transactions were slow" entry point /metrics p99s can't give
+        try:
+            if self.txstory is None:
+                return self._json(
+                    404,
+                    {"error": "transaction provenance not wired on "
+                              "this gateway"},
+                )
+            limit_text = query.get("limit", [None])[0]
+            limit = None
+            if limit_text:
+                try:
+                    limit = max(0, int(limit_text))
+                except ValueError:
+                    return self._json(
+                        400, {"error": f"bad limit {limit_text!r}"}
+                    )
+            return self._json(200, {
+                "slowest": self.txstory.slowest(limit),
+                "summary": self.txstory.snapshot(),
+            })
+        except Exception as e:   # noqa: BLE001 - defensive render
+            return self._json(500, {"error": f"tx leaderboard failed: {e}"})
+
+    def _serve_tx(self, tx_id: str, query) -> tuple[int, str, bytes]:
+        # one transaction's lifecycle timeline. Default = cluster-wide
+        # assembly (events from every member on one clock-shifted
+        # axis); ?local=1 = this member's story + ClockSync evidence
+        # (the form peers pull, so assembly can't recurse)
+        try:
+            if self.txstory is None:
+                return self._json(
+                    404,
+                    {"error": "transaction provenance not wired on "
+                              "this gateway"},
+                )
+            if not tx_id:
+                return self._json(400, {"error": "empty tx id"})
+            local = query.get("local", ["0"])[0] not in ("", "0")
+            if local or self.cluster_tx is None:
+                out = self.txstory.local_payload(tx_id)
+            else:
+                out = self.cluster_tx.assemble(tx_id)
+            return self._json(200 if out.get("found") else 404, out)
+        except Exception as e:   # noqa: BLE001 - defensive render
+            return self._json(500, {"error": f"tx story failed: {e}"})
+
     def _serve_healthz(self, query) -> tuple[int, str, bytes]:
         # orchestrator liveness: judged LIVE against the watchdog (the
         # pump that would have ticked the monitor may be the very
@@ -636,6 +719,20 @@ class NodeWebServer:
             status, ctype, payload = self._serve_incident(
                 path[len("/incidents/"):]
             )
+            self._send(req, status, ctype, payload)
+            return
+        if method == "GET" and path.startswith("/tx/"):
+            # path-parameterized: /tx/slowest is the leaderboard,
+            # anything else is a transaction id (the str(SecureHash)
+            # form every answer, story and evidence row prints)
+            rest = path[len("/tx/"):]
+            query = parse_qs(url.query)
+            if rest == "slowest":
+                status, ctype, payload = self._serve_tx_slowest(query)
+            else:
+                status, ctype, payload = self._serve_tx(rest, query)
+            if query.get("ts", ["0"])[0] not in ("", "0"):
+                payload = self._stamp_ts(ctype, payload)
             self._send(req, status, ctype, payload)
             return
         if method == "GET" and path in self._ops:

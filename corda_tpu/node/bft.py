@@ -71,6 +71,28 @@ BFT_PHASES = (
 _TRACE_TABLE_CAP = 4096
 
 
+def _story_bft_commit(story, outcome, seq: int, member: str) -> None:
+    """Stamp `consensus.commit` on an executed notarisation's
+    lifecycle story (utils/txstory.py): the replica state machine's
+    success outcome `["ok", tx_id_bytes]` carries the id. Anything
+    else (errors, foreign state machines) is skipped — the ledger is
+    an observer, never a failure source."""
+    try:
+        if (
+            isinstance(outcome, (list, tuple))
+            and len(outcome) >= 2
+            and outcome[0] == "ok"
+        ):
+            from ..crypto.hashes import SecureHash
+
+            story.consensus_commit(
+                str(SecureHash(bytes(outcome[1]))),
+                index=seq, member=member,
+            )
+    except Exception:   # noqa: BLE001 - observer plane, never fatal
+        pass
+
+
 class BftUnavailable(Exception):
     pass
 
@@ -275,13 +297,17 @@ class BftReplica:
         config: BftConfig = BftConfig(),
         metrics=None,
         tracer=None,
+        txstory=None,
     ):
         """`metrics` / `tracer`: the consensus observability seam (see
         raft.RaftNode — same contract): Bft.Phase.* timers + lag/view
         gauges on the registry, per-member `bft.<phase>` spans joined
         to a submitted command's trace context, ClockSync feeding from
-        traced frames. Both None by default — the bare protocol pays
-        nothing."""
+        traced frames. `txstory`: an optional utils/txstory.TxStory —
+        every successfully-executed notarisation stamps a
+        `consensus.commit` lifecycle event (sequence + member) on its
+        transaction's story, on EVERY replica that executes it. All
+        None by default — the bare protocol pays nothing."""
         import random as _random
 
         assert name in peers
@@ -378,6 +404,7 @@ class BftReplica:
         # -- observability (PR 11): phase timers, gauges, spans --------
         self.metrics = metrics
         self.tracer = tracer
+        self.txstory = txstory
         self._phase_timers: dict[str, Any] = {}
         if metrics is not None:
             for phase in BFT_PHASES:
@@ -689,6 +716,8 @@ class BftReplica:
             outcome, signature = self.execute_fn(
                 _canon(command), timestamp,
             )
+            if self.txstory is not None:
+                _story_bft_commit(self.txstory, outcome, seq, self.name)
             self.executed[seq] = (cmd_id, origin, outcome, signature)
             self._watch.pop((origin, cmd_id), None)
             self.pending_requests.pop((origin, cmd_id), None)
@@ -1531,18 +1560,33 @@ class BFTNotaryService:
 
         if not isinstance(ftx, FilteredTransaction):
             return NotaryError("invalid-proof", "BFT notary takes a tear-off")
+        # lifecycle ledger: the BFT flavour's coordinator-side admit +
+        # terminal (replicas stamp their own consensus.commit events)
+        story = getattr(self.services, "txstory", None)
+        if story is not None:
+            story.admit(
+                str(ftx.id), requester=getattr(requester, "name", None)
+            )
         fut = self.replica.submit(["notarise", ser.encode(ftx)], trace=trace)
         try:
             outcome, sigs = yield from wait_future(fut)
         except BftUnavailable as e:
-            return NotaryError("unavailable", str(e))
+            err = NotaryError("unavailable", str(e))
+            if story is not None:
+                story.terminal_from(str(ftx.id), err)
+            return err
         outcome = list(outcome)
         if outcome[0] == "err":
             kind, detail = outcome[1], outcome[2]
             conflict = dict(detail) if kind == "conflict" else None
-            return NotaryError(
+            err = NotaryError(
                 kind,
                 str(detail) if conflict is None else "input states consumed",
                 conflict=conflict,
             )
+            if story is not None:
+                story.terminal_from(str(ftx.id), err)
+            return err
+        if story is not None:
+            story.close(str(ftx.id), "committed")
         return list(sigs)

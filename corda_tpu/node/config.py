@@ -144,6 +144,21 @@ class NodeConfig:
     # throughput history against ("notarisations/s regressed 12% vs
     # BENCH_r06" without an offline bench run); empty = no baseline
     perf_baseline: str = ""
+    # transaction provenance plane (utils/txstory.py): the per-tx
+    # lifecycle ledger behind GET /tx/<id> + /tx/slowest and the
+    # Tx.Stage.* histograms. On by default — bounded memory, one lock
+    # + append per lifecycle event (<2% of the flush wall, gated by
+    # the bench `txstory` metric).
+    txstory_enabled: bool = True
+    # spill the event stream to a sqlite index in the node database
+    # (same WAL discipline as the intent journal): ring-evicted
+    # transactions stay queryable at GET /tx/<id>
+    txstory_index: bool = False
+    # stage-SLO rule target, microseconds (0 = rule off): the
+    # `txstory.stage_slo` alert fires when any serving stage's
+    # (queue / verify / commit) recent p99 exceeds this, citing the
+    # offending tx ids in its detail
+    txstory_stage_slo_micros: int = 0
     verifier_type: str = "in_memory"
     # which BatchSignatureVerifier backs signature checks: "tpu" (the
     # production batch kernels) or "cpu" (the bit-exact reference —
@@ -264,6 +279,15 @@ class NodeConfig:
             )
         if self.perf_profile_hz < 0:
             raise ConfigError("perf_profile_hz must be >= 0")
+        if self.txstory_stage_slo_micros < 0:
+            raise ConfigError("txstory_stage_slo_micros must be >= 0")
+        if not self.txstory_enabled and (
+            self.txstory_index or self.txstory_stage_slo_micros > 0
+        ):
+            raise ConfigError(
+                "txstory_index / txstory_stage_slo_micros require "
+                "txstory_enabled (they configure the provenance plane)"
+            )
         if not self.perf_enabled and (
             self.perf_profile_hz > 0 or self.perf_baseline
         ):
@@ -448,6 +472,12 @@ def write_config(cfg: NodeConfig, path: str) -> None:
         emit("perf_profile_hz", cfg.perf_profile_hz)
     if cfg.perf_baseline:
         emit("perf_baseline", cfg.perf_baseline)
+    if not cfg.txstory_enabled:
+        emit("txstory_enabled", cfg.txstory_enabled)
+    if cfg.txstory_index:
+        emit("txstory_index", cfg.txstory_index)
+    if cfg.txstory_stage_slo_micros:
+        emit("txstory_stage_slo_micros", cfg.txstory_stage_slo_micros)
     emit("verifier_type", cfg.verifier_type)
     emit("verifier_backend", cfg.verifier_backend)
     emit("dev_mode", cfg.dev_mode)

@@ -380,6 +380,10 @@ class DistributedUniquenessProvider(UniquenessProvider):
         self.reservations = reservations
         self.tracer = tracer
         self.qos = qos
+        # transaction lifecycle ledger (utils/txstory.py): wired by
+        # node.py / rigs — coordinator-side reserve/commit/abort and
+        # participant-side orphan detection stamp per-tx events
+        self.txstory = None
         self.policy = policy or XShardPolicy()
         self.rng = random.Random(seed)
         self.decisions = decision_log
@@ -557,6 +561,11 @@ class DistributedUniquenessProvider(UniquenessProvider):
                 tx_id=str(tx_id), member=self.name,
                 partitions=len(parts),
             )
+        if self.txstory is not None:
+            self.txstory.record(
+                str(tx_id), "xshard.reserve",
+                partitions=len(parts), coordinator=self.name,
+            )
         remote = [p for p in parts if p[1] != self.name]
         if remote and self.journal is not None:
             # the WAL row lands BEFORE the first reserve leaves this
@@ -718,6 +727,13 @@ class DistributedUniquenessProvider(UniquenessProvider):
                 tx_id=str(txn.tx_id), member=self.name,
                 owners=len(txn.pending_owners),
             )
+        if self.txstory is not None:
+            # the 2PC commit point (the WAL mark is durable): every
+            # acquired partition will apply this commit
+            self.txstory.record(
+                str(txn.tx_id), "xshard.commit",
+                owners=len(txn.pending_owners), coordinator=self.name,
+            )
         self._resolve(txn, None)
         if not txn.pending_owners:
             self._finish(txn)
@@ -755,6 +771,11 @@ class DistributedUniquenessProvider(UniquenessProvider):
         self._record(txn.tx_id, conflict)
         self._c_aborts.inc()
         self._c_conflicts.inc()
+        if self.txstory is not None:
+            self.txstory.record(
+                str(txn.tx_id), "xshard.abort",
+                conflicts=len(conflict), coordinator=self.name,
+            )
         if txn.journaled:
             self.journal.finish(txn.xid)
         if txn.span is not None:
@@ -781,6 +802,11 @@ class DistributedUniquenessProvider(UniquenessProvider):
             self._unreachable.setdefault(owner, now)
         self._release_acquired(txn)
         self._c_unavailable.inc()
+        if self.txstory is not None:
+            self.txstory.record(
+                str(txn.tx_id), "xshard.unavailable",
+                owner=owner, partition=partition,
+            )
         if txn.journaled:
             self.journal.finish(txn.xid)
         if txn.span is not None:
@@ -1095,6 +1121,14 @@ class DistributedUniquenessProvider(UniquenessProvider):
                 )
         for r in due:
             self._c_orphan_queries.inc()
+            if self.txstory is not None and r.query_attempt == 1:
+                # first orphan detection only: a hold outlived its TTL
+                # and the recovery machinery is querying its
+                # coordinator (retries walk on backoff, not the story)
+                self.txstory.record(
+                    str(r.tx_id), "xshard.orphan",
+                    coordinator=r.coordinator, member=self.name,
+                )
             if r.coordinator == self.name and r.tx_id not in self._txns:
                 # our own dead coordination (pre-restart leftovers):
                 # answer from the journal directly

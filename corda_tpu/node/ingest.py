@@ -350,13 +350,20 @@ class IngestPipeline:
         stage: bool = True,
         tracer=None,
         perf=None,
+        txstory=None,
     ):
         """`perf`: an optional utils/perf.PerfPlane. Every finished
         batch reports its frame count and per-stage (decode /
         merkle-id / staging) host seconds, so GET /perf attributes the
         pre-flush host work — and the plane's
         `wire_ingest_pipelined_per_sec` history key (the same key
-        bench.py records) tracks the live ingest rate in-process."""
+        bench.py records) tracks the live ingest rate in-process.
+
+        `txstory`: an optional utils/txstory.TxStory. Every
+        successfully-ingested frame stamps `ingest.decode` +
+        `ingest.stage` lifecycle events (batch-shared stage seconds as
+        attributes) onto its transaction's story — the earliest
+        per-tx provenance a wire arrival gets."""
         self.pool = DecodePool(shards, decode)
         self.ring = IngestRing(ring_depth)
         self.leaf_cache = DigestCache(leaf_cache_size)
@@ -374,6 +381,7 @@ class IngestPipeline:
         # (None here so a later set_tracer()/env enable is honoured)
         self.tracer = tracer
         self.perf = perf
+        self.txstory = txstory
 
     def _tracer(self):
         return self.tracer if self.tracer is not None else tracing.get_tracer()
@@ -467,7 +475,10 @@ class IngestPipeline:
         results = handle.result() if handle is not None else []
         tracer = self._tracer()
         tracing_on = tracer.enabled
-        timing = tracing_on or self.perf is not None
+        timing = (
+            tracing_on or self.perf is not None
+            or self.txstory is not None
+        )
         t_decode = time.perf_counter() if timing else 0.0
         for i, obj in zip(miss_idx, results):
             blob = blobs[i]
@@ -524,6 +535,21 @@ class IngestPipeline:
                 max(0.0, t_id - t_decode),
                 max(0.0, t_stage - t_id),
             )
+        if self.txstory is not None:
+            # lifecycle ledger: decode+stage events for every frame
+            # whose tx id resolved (errors carry no id to key on) —
+            # one lock hold for the whole batch
+            ids = [
+                e.tx_id for e in entries
+                if e is not None and e.error is None
+                and e.tx_id is not None
+            ]
+            if ids:
+                self.txstory.ingest_batch(
+                    ids,
+                    max(0.0, t_decode - t0) if timing else 0.0,
+                    max(0.0, t_stage - t_id) if timing else 0.0,
+                )
         if tracing_on:
             self._emit_spans(
                 tracer, entries, hits, parents,

@@ -300,6 +300,41 @@ class Node:
         self.health.attach_incidents(
             self.incidents, node=config.name, background=True
         )
+        # transaction provenance plane (utils/txstory.py): the per-tx
+        # lifecycle ledger every serving-path seam emits into, served
+        # at GET /tx/<id> (cluster-assembled) + GET /tx/slowest with
+        # Tx.Stage.* histograms on /metrics. Created BEFORE the notary
+        # so every flavour can attach; `services.txstory` is the seam
+        # the flavour-shared commit_and_sign path reads.
+        self.txstory = None
+        self.cluster_tx = None
+        if config.txstory_enabled:
+            from ..utils.txstory import ClusterTxStory, TxStory
+
+            index = None
+            if config.txstory_index:
+                from .persistence import TxStoryIndex
+
+                index = TxStoryIndex(self.db)
+            self.txstory = TxStory(
+                metrics=self.metrics,
+                clock=self.services.clock,
+                tracer=self.tracer,
+                index=index,
+            )
+            self.services.txstory = self.txstory
+            self.cluster_tx = ClusterTxStory(
+                config.name,
+                self.txstory,
+                self._peer_web_urls,
+                tracer=self.tracer,
+            )
+            if config.txstory_stage_slo_micros > 0:
+                t = config.txstory_stage_slo_micros
+                self.health.watch_txstory(
+                    self.txstory,
+                    {"queue": t, "verify": t, "commit": t},
+                )
 
         # -- flows, notary, scheduler ----------------------------------
         # @corda_service instances from the imported cordapps, before
@@ -336,6 +371,8 @@ class Node:
             # pool-degraded alerting: a lost worker (or a starved
             # pool) pages before client timeouts do
             self.verifier_service.watch_health(self.health)
+            # per-attempt verify history on the lifecycle ledger
+            self.verifier_service.txstory = self.txstory
 
         # -- RPC --------------------------------------------------------
         users = [
@@ -546,6 +583,9 @@ class Node:
             clock=self.services.clock,
             metrics=self.metrics,
         )
+        # shed/admit events land on the lifecycle ledger with the tx
+        # id attached (qos.admit_tx / shed_tx)
+        self.qos.txstory = self.txstory
 
     def _install_distributed_uniqueness(self) -> None:
         """Round-12 horizontal scale-out: the batching notary over a
@@ -593,6 +633,7 @@ class Node:
             ),
             seed=self._dev_seed("xshard") or 0,
         )
+        provider.txstory = self.txstory
         # boot recovery BEFORE serving: commit-marked WAL intents
         # re-drive, unmarked ones presumed-abort, journaled
         # reservations reload as immediate orphans
@@ -611,6 +652,7 @@ class Node:
             degraded_fallback=cfg.notary_degraded_fallback,
             intent_journal=intent_journal,
         )
+        self.services.notary_service.attach_txstory(self.txstory)
         if intent_journal is not None:
             self.services.notary_service.replay_intents()
         self.services.notary_service.attach_health(self.health)
@@ -715,6 +757,7 @@ class Node:
                     degraded_fallback=self.config.notary_degraded_fallback,
                     intent_journal=intent_journal,
                 )
+                self.services.notary_service.attach_txstory(self.txstory)
                 if intent_journal is not None:
                     # boot replay: requests admitted-but-in-flight at
                     # the last crash re-enter the normal flush path;
@@ -760,9 +803,11 @@ class Node:
                     rng=random.Random(self._dev_seed("raft")),
                     # consensus observability: Raft.Phase.* timers +
                     # lag gauges on this node's scrape surface, phase
-                    # spans joined to propagated client traces
+                    # spans joined to propagated client traces, applied
+                    # commits stamped onto the lifecycle ledger
                     metrics=self.metrics,
                     tracer=self.tracer,
+                    txstory=self.txstory,
                     **raft_kw,
                 )
 
@@ -795,6 +840,7 @@ class Node:
                 rng=random.Random(self._dev_seed("bft")),
                 metrics=self.metrics,
                 tracer=self.tracer,
+                txstory=self.txstory,
             )
             self.bft = replica
             self.services.notary_service = BFTNotaryService(
@@ -923,6 +969,9 @@ class Node:
             # liveness heartbeat: periodic map re-registration keeps
             # the explorer's last-seen column meaningful
             self.network_map_client.tick()
+        if self.txstory is not None:
+            # lifecycle ledger: group-commit the sqlite index buffer
+            self.txstory.tick()
         # health plane last: the watchdog judges the beats this tick
         # just made, the canary launches, alert rules walk their states
         self.health.tick()
@@ -1037,6 +1086,8 @@ class Node:
             cluster_traces=self.cluster_traces,
             incidents=self.incidents,
             shards=getattr(self, "xshard", None),
+            txstory=self.txstory,
+            cluster_tx=self.cluster_tx,
         )
 
 

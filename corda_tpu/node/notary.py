@@ -659,11 +659,25 @@ class NotaryService:
         consensus-phase spans join the requester's trace."""
         from ..flows.api import wait_future
 
+        # lifecycle ledger (utils/txstory.py): the non-batching
+        # flavours (simple/validating, raft-backed included) admit and
+        # terminal here — commit_and_sign IS their serving path. The
+        # batching notary never reaches this method (enqueue_pending
+        # owns its intake), so no double-admit.
+        story = getattr(self.services, "txstory", None)
+        if story is not None:
+            story.admit(
+                str(tx_id),
+                requester=getattr(requester, "name", None),
+            )
         if not self.time_window_checker.is_valid(time_window):
-            return NotaryError(
+            err = NotaryError(
                 "time-window-invalid",
                 f"window {time_window} outside notary clock tolerance",
             )
+            if story is not None:
+                story.terminal_from(str(tx_id), err)
+            return err
         try:
             yield from wait_future(
                 self.uniqueness.commit_async(
@@ -671,22 +685,33 @@ class NotaryService:
                 )
             )
         except UniquenessConflict as e:
-            return NotaryError(
+            err = NotaryError(
                 "conflict",
                 str(e),
                 conflict={str(r): h for r, h in e.conflict.items()},
             )
+            if story is not None:
+                story.terminal_from(str(tx_id), err)
+            return err
         except ShardUnavailableError as e:
             # a partition owner is unreachable: a typed degraded answer
             # the client can retry against a healed cluster — distinct
             # from commit-unavailable so operators (and the fleet
             # checker) can tell a partitioned shard from a broken store
-            return NotaryError("shard-unavailable", str(e))
+            err = NotaryError("shard-unavailable", str(e))
+            if story is not None:
+                story.terminal_from(str(tx_id), err)
+            return err
         except Exception as e:
-            return NotaryError("commit-unavailable", str(e))
+            err = NotaryError("commit-unavailable", str(e))
+            if story is not None:
+                story.terminal_from(str(tx_id), err)
+            return err
         sig = self.services.key_management.sign(
             tx_id, self.identity.owning_key
         )
+        if story is not None:
+            story.close(str(tx_id), "committed")
         return sig
 
 
@@ -943,6 +968,7 @@ class BatchingNotaryService(NotaryService):
         self._oldest_arrival: Optional[int] = None
         self._health_heartbeat = None   # attach_health: flush-loop liveness
         self._perf = None               # attach_perf: attribution plane
+        self.txstory = None             # attach_txstory: lifecycle ledger
         # registry-backed metrics (scrapeable at /metrics, unlike the
         # bare ints they replace): dispatches vs requests IS the
         # batching ratio, exported as its own gauge
@@ -1095,8 +1121,13 @@ class BatchingNotaryService(NotaryService):
             arrival = self.services.clock.now_micros()
             if qoslib.expired(deadline, arrival):
                 # dead on arrival: answer without queuing — the flow
-                # entry's pre-decode-equivalent cheapest point
-                qos.count_shed(qoslib.SHED_EXPIRED_INGRESS)
+                # entry's pre-decode-equivalent cheapest point. These
+                # pre-queue sheds have no answer future, so shed_tx
+                # closes the lifecycle story directly (terminal=True).
+                qos.shed_tx(
+                    qoslib.SHED_EXPIRED_INGRESS, stx.id,
+                    terminal=True,
+                )
                 return NotaryError(
                     qoslib.SHED_KIND,
                     f"deadline {deadline} already expired at arrival",
@@ -1106,7 +1137,9 @@ class BatchingNotaryService(NotaryService):
             # fabrics): one flooding requester is rate-shaped here,
             # before any queue slot or verify work is spent on it
             if not qos.admission.admit(requester.name, arrival):
-                qos.count_shed(qoslib.SHED_ADMISSION)
+                qos.shed_tx(
+                    qoslib.SHED_ADMISSION, stx.id, terminal=True
+                )
                 return NotaryError(
                     qoslib.SHED_KIND,
                     f"admission rate exceeded for {requester.name}",
@@ -1115,12 +1148,15 @@ class BatchingNotaryService(NotaryService):
             # traffic sheds here too — with no SLO to serve it by, it
             # is the first load the degraded notary stops carrying
             if qos.brownout_level >= 2 and deadline is None:
-                qos.count_shed(qoslib.SHED_BROWNOUT_NO_DEADLINE)
+                qos.shed_tx(
+                    qoslib.SHED_BROWNOUT_NO_DEADLINE, stx.id,
+                    terminal=True,
+                )
                 return NotaryError(
                     qoslib.SHED_KIND,
                     "brownout: deadline-less requests are being shed",
                 )
-            qos.admitted.inc()
+            qos.admit_tx(stx.id)
         fut = FlowFuture()
         # flow-driven requests trace too: a root span per notarisation
         # (the wire-ingest path arrives with its span already attached
@@ -1184,7 +1220,8 @@ class BatchingNotaryService(NotaryService):
         router flushes a full shard itself, submit() never flushes
         (bench rigs fill the whole plane first)."""
         journal = self.intent_journal
-        if journal is not None and p.intent_seq is None:
+        fresh = p.intent_seq is None
+        if journal is not None and fresh:
             # durable intake: the intent row lands BEFORE the request
             # can enter any queue — from here on a crash replays it
             # instead of losing it. Resolution (any answer: signature,
@@ -1194,6 +1231,11 @@ class BatchingNotaryService(NotaryService):
             p.future.add_done_callback(
                 lambda f, j=journal, s=p.intent_seq: j.mark_resolved(s)
             )
+        # lifecycle ledger: admit (+ journal) events for a fresh
+        # arrival, `wal.replay` was already stamped by replay_intents
+        # for a re-enqueued intent — either way the future's answer
+        # records this transaction's one terminal event
+        self._story_intake(p, fresh)
         if self._shards is not None:
             self._enqueue_sharded(p)
             return
@@ -1227,6 +1269,11 @@ class BatchingNotaryService(NotaryService):
             fut.add_done_callback(
                 lambda f, j=journal, s=seq: j.mark_resolved(s)
             )
+            if self.txstory is not None:
+                # the replay marker doubles as the story's (re-)admit
+                # milestone — a tx whose pre-crash story died with the
+                # process still reconciles: replay -> one terminal
+                self.txstory.replay(str(stx.id), seq)
             p = _PendingNotarisation(
                 stx, requester, fut,
                 deadline=deadline, arrival_micros=now, intent_seq=seq,
@@ -1353,6 +1400,45 @@ class BatchingNotaryService(NotaryService):
             )
         )
 
+    def attach_txstory(self, story) -> None:
+        """Wire the transaction lifecycle ledger (utils/txstory.py):
+        every intake path emits `notary.admit` (+ `wal.journal` /
+        `wal.replay` under the intent WAL), every flush stamps
+        `notary.flush` membership with its batch id (+ shard), the
+        validate pass stamps `notary.verified`, degraded flushes and
+        quarantines carry their outcomes, and the answer future's
+        resolution records EXACTLY ONE terminal event per admitted
+        transaction. Pass None to detach (bench A/B rigs)."""
+        self.txstory = story
+
+    def _story_intake(self, p: _PendingNotarisation, fresh: bool) -> None:
+        """The shared lifecycle-intake hook (enqueue_pending AND the
+        ingest-ring drain): admit + journal events for fresh arrivals,
+        terminal hook on the answer future either way. The canary
+        (intent_seq == -1 sentinel) stays invisible — a synthetic
+        probe per tick would churn one story with endless re-answers."""
+        story = self.txstory
+        if story is None or p.intent_seq == -1:
+            return
+        tid = str(p.stx.id)
+        if fresh:
+            span = p.span
+            story.admit(
+                tid,
+                trace_id=(
+                    f"{span.trace_id:#x}"
+                    if span and not span.ended else None
+                ),
+                deadline=p.deadline,
+                requester=(
+                    p.requester.name
+                    if getattr(p.requester, "name", None) else None
+                ),
+            )
+            if p.intent_seq is not None:
+                story.journal(tid, p.intent_seq)
+        story.watch_future(tid, p.future)
+
     def attach_perf(self, plane) -> None:
         """Wire the performance-attribution plane (utils/perf.py):
         every flush feeds its phase marks in — per-shard flush wall +
@@ -1382,12 +1468,21 @@ class BatchingNotaryService(NotaryService):
         ring = self._ingest_ring
         if ring is None:
             return
+        story = self.txstory
         if self._shards is not None:
             for batch in ring.drain():
                 for p in batch:
+                    if story is not None:
+                        # ring arrivals bypass enqueue_pending (no
+                        # intent journal on the wire path) but still
+                        # admit into the lifecycle ledger
+                        self._story_intake(p, fresh=True)
                     self._enqueue_sharded(p)
             return
         for batch in ring.drain():
+            if story is not None:
+                for p in batch:
+                    self._story_intake(p, fresh=True)
             self._pending.extend(batch)
         if self._pending and self._oldest_arrival is None:
             self._oldest_arrival = self.services.clock.now_micros()
@@ -1777,7 +1872,9 @@ class BatchingNotaryService(NotaryService):
         live: list[_PendingNotarisation] = []
         for p in pending:
             if qoslib.expired(p.deadline, now):
-                qos.count_shed(qoslib.SHED_EXPIRED_FLUSH)
+                # the answer future below carries the story terminal;
+                # shed_tx only stamps the qos.shed event + counter
+                qos.shed_tx(qoslib.SHED_EXPIRED_FLUSH, p.stx.id)
                 if p.span:
                     # shed events are span events: the trace shows WHY
                     # this notarisation never reached the dispatch
@@ -1932,6 +2029,13 @@ class BatchingNotaryService(NotaryService):
         pending = live
         if not pending:
             return None
+        if self.txstory is not None:
+            # flush membership: batch id + owning shard on every
+            # member transaction's story, one lock hold for the batch
+            self.txstory.flush_membership(
+                [str(p.stx.id) for p in pending],
+                shard=shard.id if shard is not None else None,
+            )
         t = self._mark("stage", t, marks)
         verifier = (
             shard.verifier
@@ -2085,6 +2189,13 @@ class BatchingNotaryService(NotaryService):
         returned for quarantine and every other transaction still gets
         real results. Returns (results, poison_tx_indices)."""
         self._enter_degraded(error)
+        if self.txstory is not None:
+            # degraded outcome, attributed per member transaction: the
+            # flush that answers these was served by the CPU reference
+            self.txstory.degraded_flush(
+                [str(p.stx.id) for p in pending],
+                f"{type(error).__name__}: {error}",
+            )
         cpu = self._cpu_ref()
         try:
             return list(cpu.verify_batch(reqs)), set()
@@ -2538,6 +2649,10 @@ class BatchingNotaryService(NotaryService):
                 )
             )
             return False
+        if self.txstory is not None:
+            # the verify->commit stage boundary: signatures + contracts
+            # held, this transaction proceeds to the uniqueness commit
+            self.txstory.record(str(stx.id), "notary.verified")
         return True
 
 

@@ -868,6 +868,109 @@ class XShardReservationJournal:
         )[0][0]
 
 
+class TxStoryIndex:
+    """Sqlite spill for the transaction lifecycle ledger (round 13,
+    utils/txstory.py): every recorded event also lands here, so a
+    story the bounded in-memory ring evicted stays answerable at
+    GET /tx/<id>.
+
+    Same WAL discipline as the intent journal above: the table lives
+    in the node's WAL-mode database (synchronous=NORMAL — no per-row
+    fsync), appends buffer IN MEMORY on the emitting thread (one lock,
+    no sqlite on the hot path) and group-commit once per pump tick via
+    `flush()` — a crash loses at most one tick's worth of forensic
+    events, never serving-path answers (the ledger is an observer
+    plane; the intent WAL owns exactly-once)."""
+
+    _SCHEMA = """
+    CREATE TABLE IF NOT EXISTS tx_story_events (
+        seq       INTEGER PRIMARY KEY AUTOINCREMENT,
+        tx_id     TEXT NOT NULL,
+        name      TEXT NOT NULL,
+        at_micros INTEGER NOT NULL,
+        mono_us   INTEGER NOT NULL,
+        attrs     TEXT
+    );
+    CREATE INDEX IF NOT EXISTS tx_story_events_tx
+        ON tx_story_events (tx_id, seq);
+    """
+
+    def __init__(self, db: NodeDatabase, max_rows: int = 200_000):
+        self._db = db
+        db.execute_script(self._SCHEMA)
+        self._lock = threading.Lock()
+        self._buf: list[tuple] = []
+        self._max_rows = max(1_000, max_rows)
+        self.appended = 0
+        self.flushes = 0
+
+    def append(self, tx_id: str, name: str, at: int, mono: int, attrs) -> None:
+        """Buffer one event (called under the TxStory lock — memory
+        only, the sqlite write happens at flush())."""
+        with self._lock:
+            self._buf.append((tx_id, name, at, mono, attrs))
+
+    def flush(self) -> int:
+        """Group-commit the buffer in ONE transaction (the
+        flush_resolved discipline); returns rows written. Retention is
+        enforced here too: past `max_rows` the oldest rows fall off so
+        the spill stays bounded like everything else in the plane."""
+        import json as _json
+
+        with self._lock:
+            buf, self._buf = self._buf, []
+        if not buf:
+            return 0
+        rows = [
+            (
+                tx_id, name, at, mono,
+                _json.dumps(attrs) if attrs else None,
+            )
+            for tx_id, name, at, mono, attrs in buf
+        ]
+        with self._db.transaction() as conn:
+            conn.executemany(
+                "INSERT INTO tx_story_events"
+                " (tx_id, name, at_micros, mono_us, attrs)"
+                " VALUES (?,?,?,?,?)",
+                rows,
+            )
+            conn.execute(
+                "DELETE FROM tx_story_events WHERE seq <= ("
+                "SELECT COALESCE(MAX(seq), 0) - ? FROM tx_story_events)",
+                (self._max_rows,),
+            )
+        self.appended += len(rows)
+        self.flushes += 1
+        return len(rows)
+
+    def events_for(self, tx_id: str) -> list[dict]:
+        """One transaction's journaled events, oldest first, decoded to
+        the same row shape the in-memory story exports."""
+        import json as _json
+
+        out = []
+        for name, at, mono, attrs in self._db.query(
+            "SELECT name, at_micros, mono_us, attrs FROM tx_story_events"
+            " WHERE tx_id=? ORDER BY seq",
+            (tx_id,),
+        ):
+            row = {"name": name, "at_micros": at, "mono_us": mono}
+            if attrs:
+                try:
+                    row.update(_json.loads(attrs))
+                except ValueError:
+                    pass
+            out.append(row)
+        return out
+
+    @property
+    def row_count(self) -> int:
+        return self._db.query(
+            "SELECT COUNT(*) FROM tx_story_events"
+        )[0][0]
+
+
 class PersistentKeyManagementService(KeyManagementService):
     """PersistentKeyManagementService: fresh (anonymous) keys persist so
     confidential identities survive a node restart."""

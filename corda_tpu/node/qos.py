@@ -58,6 +58,7 @@ from ..utils.metrics import Histogram, MetricRegistry
 # shed reasons — ONE vocabulary for counters, NotaryError.kind payloads
 # and the /qos endpoint, so dashboards and clients never fork
 SHED_KIND = "shed"                    # NotaryError.kind for every shed
+
 SHED_EXPIRED_INGRESS = "ExpiredIngress"   # dead on arrival, pre-decode
 SHED_EXPIRED_FLUSH = "ExpiredFlush"       # died queued, pre-stage
 SHED_ADMISSION = "Admission"              # per-client token bucket
@@ -384,6 +385,42 @@ class NotaryQos:
         )
         self.metrics.gauge("Qos.BrownoutLevel", lambda: self._brownout_level)
         self.metrics.gauge("Qos.LaneDepth", self.lanes.depth)
+        # transaction lifecycle ledger (utils/txstory.py): wired by
+        # node.py when the provenance plane is on — admit/shed events
+        # with the tx id land in the per-tx story next to the counters
+        self.txstory = None
+
+    # -- lifecycle-ledger hooks (round 13) ------------------------------------
+
+    def admit_tx(self, tx_id) -> None:
+        """Count one admitted request AND stamp `qos.admit` on its
+        lifecycle story (when both the ledger and a tx id are known —
+        pre-decode lane traffic has no id yet and only counts).
+        `tx_id` may be the raw SecureHash: the str conversion is paid
+        only when a ledger is attached."""
+        self.admitted.inc()
+        if self.txstory is not None and tx_id is not None:
+            self.txstory.record(str(tx_id), "qos.admit")
+
+    def shed_tx(
+        self,
+        reason: str,
+        tx_id=None,
+        terminal: bool = False,
+    ) -> None:
+        """Count one shed AND stamp `qos.shed` (with the reason) on
+        the transaction's story. `terminal=True` additionally CLOSES
+        the story as shed — the pre-queue shed sites, where no answer
+        future exists to carry the terminal; flush-time sheds resolve
+        their future and terminal through it instead."""
+        self.count_shed(reason)
+        if self.txstory is not None and tx_id is not None:
+            from ..utils.txstory import shed_reason as _canonical
+
+            tid = str(tx_id)
+            self.txstory.record(tid, "qos.shed", reason=reason)
+            if terminal:
+                self.txstory.close(tid, "shed", reason=_canonical(reason))
 
     # -- clock ---------------------------------------------------------------
 

@@ -47,6 +47,33 @@ RAFT_PHASES = (
 _TRACE_TABLE_CAP = 4096
 
 
+def _story_consensus_commit(story, command, index, member, term) -> None:
+    """Stamp `consensus.commit` on a just-applied uniqueness command's
+    lifecycle story (utils/txstory.py). Only the notary's `["commit",
+    tx_id_bytes, refs]` command shape carries a tx id; anything else
+    (noops, foreign state machines) is silently skipped — the ledger
+    is an observer, never a failure source."""
+    try:
+        if not isinstance(command, (list, tuple)) or len(command) < 2:
+            return
+        if command[0] == "commit":
+            # notary cluster shape: tx id rides as raw hash bytes
+            from ..crypto.hashes import SecureHash
+
+            story.consensus_commit(
+                str(SecureHash(bytes(command[1]))),
+                index=index, member=member, term=term,
+            )
+        elif command[0] == "xcommit":
+            # partition-group replication shape (distributed
+            # uniqueness): tx id rides as the SecureHash itself
+            story.consensus_commit(
+                str(command[1]), index=index, member=member, term=term,
+            )
+    except Exception:   # noqa: BLE001 - observer plane, never fatal
+        pass
+
+
 class RaftUnavailable(Exception):
     """No leader reachable within the command deadline (the caller —
     e.g. a notary client — retries, NotaryFlow.kt:159-162)."""
@@ -226,6 +253,7 @@ class RaftNode:
         restore_fn: Optional[Callable[[Any], None]] = None,
         metrics=None,
         tracer=None,
+        txstory=None,
     ):
         """`metrics`: an optional MetricRegistry — Raft.Phase.* timers
         over every consensus phase plus quorum-lag gauges land on it
@@ -233,8 +261,12 @@ class RaftNode:
         optional utils/tracing.Tracer — commands submitted with a
         trace context get per-member `raft.<phase>` spans stamped into
         it, and traced protocol frames feed the tracer's ClockSync so
-        cross-node assembly can order spans honestly. Both default to
-        None: the bare protocol stays dependency- and overhead-free."""
+        cross-node assembly can order spans honestly. `txstory`: an
+        optional utils/txstory.TxStory — every applied uniqueness
+        commit command stamps a `consensus.commit` lifecycle event
+        (log index + member) on its transaction's story, on EVERY
+        member that applies it. All default to None: the bare protocol
+        stays dependency- and overhead-free."""
         import random as _random
 
         assert name in peers, "peers must include this member"
@@ -306,6 +338,7 @@ class RaftNode:
         # -- observability (PR 11): phase timers, lag gauges, spans ----
         self.metrics = metrics
         self.tracer = tracer
+        self.txstory = txstory
         self._phase_timers: dict[str, Any] = {}
         if metrics is not None:
             for phase in RAFT_PHASES:
@@ -990,6 +1023,10 @@ class RaftNode:
             )
             if observing:
                 self._stamp("apply", hdr, t_apply)
+            if self.txstory is not None:
+                _story_consensus_commit(
+                    self.txstory, command, idx, self.name, term
+                )
             self.applied_count += 1
             entry = self._index_futures.pop(self.last_applied, None)
             if entry is not None:
@@ -1335,6 +1372,7 @@ def partition_raft_groups(
     config: Optional[RaftConfig] = None,
     metrics=None,
     tracer=None,
+    txstory=None,
 ) -> dict:
     """One Raft group PER uniqueness partition (round 12, the
     distributed sharded uniqueness plane): group k rides the
@@ -1363,5 +1401,6 @@ def partition_raft_groups(
             config=config or RaftConfig(),
             metrics=metrics,
             tracer=tracer,
+            txstory=txstory,
         )
     return groups

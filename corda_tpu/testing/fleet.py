@@ -743,6 +743,10 @@ class FleetReport:
     tracers: dict = field(default_factory=dict)
     cluster_traces: Any = None
     incidents: Any = None
+    # round-13 provenance plane: the shared TxStory lifecycle ledger
+    # (None when FleetSim(txstory=True) was not requested) — the
+    # lifecycle-ledger reconciliation's input
+    txstory: Any = None
     # round-12 distributed uniqueness: the ownership map, the shared
     # decision log (true serialisation order — the serial-replay
     # reference), and end-of-run reservation/orphan depths per member
@@ -782,6 +786,7 @@ class FleetSim:
         lag_alert_threshold: int = 8,
         verifier_pool: int = 0,
         intent_wal: bool = False,
+        txstory: bool = False,
         tracing: bool = False,
         incident_dir: Optional[str] = None,
         cluster_shards: int = 8,
@@ -815,6 +820,11 @@ class FleetSim:
             raise ValueError(
                 "intent_wal needs a batching-notary intake "
                 "(batching or distributed flavour)"
+            )
+        if txstory and flavour != "batching":
+            raise ValueError(
+                "txstory is a batching-flavour seam (the lifecycle "
+                "ledger reconciliation rides the batching intake)"
             )
         self.scenario = scenario
         self.flavour = flavour
@@ -1172,6 +1182,29 @@ class FleetSim:
                     self._verify_worker_alive.append(True)
                 self.net.run()   # deliver the WorkerReady attaches
 
+        # -- round-13 provenance plane (lifecycle ledger) -------------------
+        self.txstory_plane = None
+        if txstory:
+            from ..utils.txstory import TxStory
+
+            notary = self.members[0]
+            svc = notary.services.notary_service
+            # the ledger is an OBSERVER that survives kill/restart
+            # (like the monitors): sized so a whole soak's stories
+            # stay resident for the end-of-run reconciliation
+            cap = max(4096, 2 * scenario.total_offered())
+            self.txstory_plane = TxStory(
+                metrics=svc.metrics,
+                clock=self.net.clock,
+                max_open=cap,
+                keep_done=cap,
+            )
+            svc.attach_txstory(self.txstory_plane)
+            if self.qos is not None:
+                self.qos.txstory = self.txstory_plane
+            if self.verify_pool is not None:
+                self.verify_pool.txstory = self.txstory_plane
+
         # -- bookkeeping ----------------------------------------------------
         self.records: list[RequestRecord] = []
         self.timeline: list[dict] = []
@@ -1500,6 +1533,10 @@ class FleetSim:
         node.services.notary_service = svc
         self._drive_tick = svc.tick
         svc.attach_health(self.monitors[node.name])
+        # the lifecycle ledger survives the restart (observer plane):
+        # attach BEFORE replay so every replayed intent stamps its
+        # wal.replay event onto the story the dead process admitted
+        svc.attach_txstory(self.txstory_plane)
         replayed = svc.replay_intents()
         by_tx = {tx_id: fut for _seq, tx_id, fut in replayed}
         for entry in self._live:
@@ -1684,13 +1721,13 @@ class FleetSim:
         if isinstance(value, NotaryError):
             if value.kind == qoslib.SHED_KIND:
                 rec.outcome = OUT_SHED
-                msg = value.message.lower()
-                if "brownout" in msg:
-                    rec.shed_reason = "brownout"
-                elif "admission" in msg:
-                    rec.shed_reason = "admission"
-                else:
-                    rec.shed_reason = "expired"
+                # ONE canonicalizer (utils/txstory.shed_reason): the
+                # model's attribution and the ledger's terminal reason
+                # derive from the same function, so a reworded shed
+                # message cannot fork the reconciliation
+                from ..utils.txstory import shed_reason
+
+                rec.shed_reason = shed_reason(value.message)
             elif value.kind == "conflict":
                 rec.outcome = OUT_CONFLICT
             else:
@@ -1883,6 +1920,7 @@ class FleetSim:
             tracers=dict(self.tracers),
             cluster_traces=self.cluster_traces,
             incidents=self.incidents,
+            txstory=self.txstory_plane,
             **xshard_extra,
         )
 
@@ -2366,6 +2404,95 @@ class InvariantChecker:
             f"unresolved in the WAL after the drain"
         )
 
+    def check_lifecycle_ledger(self) -> None:
+        """The round-13 lifecycle-ledger reconciliation — strictly
+        stronger than the counter-based accounting above, because it
+        replays PER-TRANSACTION stories against the model:
+
+        1. Every submitted request's transaction has a story, and a
+           story that reached a terminal reached EXACTLY ONE (the
+           intent-WAL replay window's re-answers record `tx.reanswer`,
+           never a second terminal).
+        2. The terminal kind AGREES with the model's outcome — signed
+           <-> committed, conflict <-> rejected, shed <-> shed with
+           the MATCHING reason, unavailable <-> unavailable or
+           quarantined — and every shed/quarantined/unavailable
+           terminal is attributed by a non-empty reason.
+        3. Every ADMITTED story (one carrying an admit/replay event)
+           reached a terminal; without the intent WAL the only excuse
+           is a request the model itself recorded LOST at a kill.
+        4. Nothing fell off the ledger (zero evictions): the soak's
+           accounting surface is complete, not sampled."""
+        from ..utils.txstory import ADMIT_EVENTS, TERMINALS
+
+        rep = self.report
+        assert rep.txstory is not None, (
+            "lifecycle reconciliation needs FleetSim(txstory=True)"
+        )
+        assert rep.txstory.evicted == 0, (
+            f"{rep.txstory.evicted} stories evicted mid-soak — the "
+            f"ledger was sized too small to reconcile against"
+        )
+        stories = {s["tx_id"]: s for s in rep.txstory.stories()}
+        terminal_names = set(TERMINALS.values())
+        for tid, s in stories.items():
+            terms = [
+                e["name"] for e in s["events"]
+                if e["name"] in terminal_names
+            ]
+            assert len(terms) <= 1, (
+                f"{tid} recorded {len(terms)} terminal events {terms} "
+                f"— exactly-once broken"
+            )
+        lost_ok = {
+            str(r.tx_id) for r in rep.records
+            if r.outcome in (None, OUT_LOST)
+        }
+        for tid, s in stories.items():
+            admitted = any(
+                e["name"] in ADMIT_EVENTS for e in s["events"]
+            )
+            if admitted and s["terminal"] is None:
+                assert not rep.intent_wal and tid in lost_ok, (
+                    f"admitted transaction {tid} never reached a "
+                    f"terminal event (events: "
+                    f"{[e['name'] for e in s['events']]})"
+                )
+        expected = {
+            OUT_SIGNED: ("committed",),
+            OUT_CONFLICT: ("rejected",),
+            OUT_SHED: ("shed",),
+            # the model folds EVERY non-shed/non-conflict NotaryError
+            # into OUT_UNAVAILABLE — typed rejections (invalid-
+            # transaction, time-window-invalid) included, which the
+            # ledger rightly closes as `rejected`
+            OUT_UNAVAILABLE: ("unavailable", "quarantined", "rejected"),
+        }
+        for r in rep.records:
+            tid = str(r.tx_id)
+            s = stories.get(tid)
+            assert s is not None, (
+                f"no lifecycle story for submitted {tid} "
+                f"(outcome {r.outcome})"
+            )
+            if r.outcome in (None, OUT_LOST):
+                continue   # rule 3 already bounded these
+            kinds = expected[r.outcome]
+            assert s["terminal"] in kinds, (
+                f"{tid}: model says {r.outcome} but the story closed "
+                f"{s['terminal']!r} (reason {s['reason']!r})"
+            )
+            if r.outcome in (OUT_SHED, OUT_UNAVAILABLE):
+                assert s["reason"], (
+                    f"{tid}: {s['terminal']} terminal carries no "
+                    f"reason attribution"
+                )
+            if r.outcome == OUT_SHED and r.shed_reason is not None:
+                assert s["reason"] == r.shed_reason, (
+                    f"{tid}: shed attributed {s['reason']!r} on the "
+                    f"ledger but {r.shed_reason!r} in the model"
+                )
+
     def check_verifier_pool(self) -> None:
         """Every verify shipped to the out-of-process pool resolved —
         worker kills included: the lease/redispatch machinery moved
@@ -2449,6 +2576,10 @@ class InvariantChecker:
             self.check_exact_accounting()
         else:
             self.check_lost_bounded()
+        if self.report.txstory is not None:
+            # per-transaction accounting, strictly stronger than the
+            # counter equality above
+            self.check_lifecycle_ledger()
         if self.report.verify_offered:
             self.check_verifier_pool()
         if slo_p99_micros is not None:
@@ -2507,6 +2638,10 @@ class InvariantChecker:
                 3,
             ),
             "faults": [e["name"] for e in self.report.chaos_log],
+            "lifecycle_ledger": (
+                self.report.txstory.snapshot()
+                if self.report.txstory is not None else None
+            ),
             "fault_plane": {
                 "intent_wal": self.report.intent_wal,
                 "intent_replayed": self.report.intent_replayed,

@@ -736,6 +736,16 @@ class HealthMonitor:
         telemetry, this monitor owns the alert walks + evidence)."""
         perf.install_rules(self)
 
+    def watch_txstory(
+        self, story, targets: dict, window_micros=None
+    ) -> None:
+        """Install the `txstory.stage_slo` rule over a
+        utils/txstory.TxStory: fires while any serving stage's recent
+        p99 breaches its target ({stage: micros}), the detail citing
+        the offending stage AND the worst tx ids — per-transaction
+        attribution for what a bare p99 regression hides."""
+        story.install_rules(self, targets, window_micros=window_micros)
+
     def watch_ring(
         self,
         name: str,

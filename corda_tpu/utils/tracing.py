@@ -599,6 +599,65 @@ def stage_summary(traces: Iterable[Trace]) -> dict:
 # -- cross-node trace assembly ------------------------------------------------
 
 
+def fan_out(
+    jobs: dict, workers: int = 8
+) -> tuple[dict, dict]:
+    """Run `jobs` ({key: zero-arg thunk}) concurrently on a bounded
+    batch of worker threads and return `(results, errors)` keyed like
+    the input (`errors` values are `"TypeName: message"` strings —
+    the unreachable-peer format every rollup surface already prints).
+
+    This is the peer-pull primitive the cluster surfaces share
+    (ClusterTraces.assemble, txstory.ClusterTxStory.assemble, incident
+    bundles via the former): a sequential pull costs N x timeout when
+    N peers are slow or partitioned — exactly the moment those
+    surfaces are being read — while the fan-out costs ~one timeout.
+    Threads are spawned per call (bounded by `workers`) and joined
+    before returning: no pool outlives the request, and a caller
+    processing `results` in sorted-key order stays deterministic."""
+    results: dict = {}
+    errors: dict = {}
+    if not jobs:
+        return results, errors
+    items = list(jobs.items())
+    if len(items) == 1:
+        key, thunk = items[0]
+        try:
+            results[key] = thunk()
+        except Exception as e:   # noqa: BLE001 - partial, not fatal
+            errors[key] = f"{type(e).__name__}: {e}"
+        return results, errors
+    lock = threading.Lock()
+    cursor = [0]
+
+    def worker() -> None:
+        while True:
+            with lock:
+                i = cursor[0]
+                if i >= len(items):
+                    return
+                cursor[0] = i + 1
+            key, thunk = items[i]
+            try:
+                value = thunk()
+            except Exception as e:   # noqa: BLE001 - partial, not fatal
+                with lock:
+                    errors[key] = f"{type(e).__name__}: {e}"
+            else:
+                with lock:
+                    results[key] = value
+
+    threads = [
+        threading.Thread(target=worker, daemon=True, name=f"fan-out-{k}")
+        for k in range(min(max(1, workers), len(items)))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return results, errors
+
+
 def parse_trace_id(text) -> Optional[int]:
     """Trace-id query decode: hex (`0x...` — the form every export and
     evidence row prints) or decimal; None on garbage."""
@@ -638,12 +697,18 @@ class ClusterTraces:
         peers_fn: Callable[[], dict],
         fetch: Optional[Callable[[str], dict]] = None,
         timeout: float = 1.5,
+        workers: int = 8,
     ):
         self.self_name = self_name
         self.tracer = tracer
         self._peers_fn = peers_fn
         self._fetch = fetch or self._http_fetch
         self.timeout = timeout
+        # peer pulls fan out on a bounded worker batch (fan_out): N
+        # slow peers cost ~one timeout per assembly, not N — the
+        # incident recorder assembles at exactly the moment peers are
+        # most likely to be unreachable
+        self.workers = workers
 
     def _http_fetch(self, url: str) -> dict:
         import json
@@ -705,15 +770,27 @@ class ClusterTraces:
                 })
 
         add(self.self_name, self._local_payload(trace_id), 0)
-        for name, base in sorted(self._peers_fn().items()):
-            if name == self.self_name:
-                continue
-            url = f"{base}/traces?trace_id={trace_id:#x}"
-            try:
-                payload = self._fetch(url)
-            except Exception as e:   # unreachable peer: partial, not fatal
-                errors[name] = f"{type(e).__name__}: {e}"
-                continue
+        peers = {
+            name: base
+            for name, base in self._peers_fn().items()
+            if name != self.self_name
+        }
+        # parallel peer pulls (fan_out): fetches overlap, then offsets
+        # and the merge run in sorted order so assembly stays
+        # deterministic; a failed fetch degrades to an `errors` entry
+        fetched, errors = fan_out(
+            {
+                name: (
+                    lambda b=base: self._fetch(
+                        f"{b}/traces?trace_id={trace_id:#x}"
+                    )
+                )
+                for name, base in peers.items()
+            },
+            workers=self.workers,
+        )
+        for name in sorted(fetched):
+            payload = fetched[name]
             offset_us, quality = self._offset_for(name, payload)
             offsets[name] = {"offset_us": offset_us, "quality": quality}
             add(name, payload, offset_us)

@@ -313,6 +313,48 @@ def test_nested_keys_explode_without_marker_for_old_records(tmp_path):
     )
 
 
+def test_json_output_emits_machine_readable_diff(rounds, capsys):
+    """`--json` (round-13 satellite): ONE JSON document on stdout —
+    the newest pair's rows, gate failures alongside, the text table
+    suppressed — so CI can archive the diff as an artifact without
+    scraping the human format. Exit-code contract unchanged."""
+    assert bh.main(["--dir", rounds, "--json"]) == 0
+    out = capsys.readouterr().out
+    doc = json.loads(out)
+    assert doc["old"] == "BENCH_r01.json"
+    assert doc["new"] == "BENCH_r02.json"
+    by_name = {r["metric"]: r for r in doc["rows"]}
+    notary = by_name["batching_notary_notarisations_per_sec"]
+    assert notary["delta_pct"] == -31.25
+    assert notary["better"] == "higher"
+    # the skipped metric diffs as missing-in-new, never a failure
+    assert by_name["wire_ingest_decode_id_stage_per_sec"]["new"] is None
+    assert doc["gate_pct"] is None and doc["gate_failures"] == []
+    assert "BENCH_r01.json ->" not in out   # no text table mixed in
+
+    # with --gate, failures land IN the document and the exit code
+    # still trips
+    assert bh.main(["--dir", rounds, "--json", "--gate", "10"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["gate_pct"] == 10.0
+    failed = {r["metric"] for r in doc["gate_failures"]}
+    assert failed == {"batching_notary_notarisations_per_sec"}
+
+
+def test_json_all_carries_every_pair(rounds, capsys):
+    _write_record(
+        rounds, "BENCH_r03.json", 3,
+        [_metric("ecdsa_p256_verifies_per_sec_via_spi", 86_000.0, 1.7)],
+    )
+    assert bh.main(["--dir", rounds, "--json", "--all"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert [p["old"] for p in doc["pairs"]] == [
+        "BENCH_r01.json", "BENCH_r02.json",
+    ]
+    # the top-level rows are the NEWEST pair's
+    assert doc["new"] == "BENCH_r03.json"
+
+
 def test_committed_trajectory_passes_regression_gate():
     """Round 6: `bench_history --gate` IS part of the tier-1 story.
     The newest two committed BENCH_r*.json records must not show a

@@ -607,6 +607,67 @@ def test_spans_pass_gates_committed_tree_clean(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# lifecycle events (round 13: txstory vocabulary)
+
+def test_lifecycle_convention_and_duplicate_spelling(tmp_path):
+    """Lifecycle-event literals off the dotted-lowercase
+    `component.event` form flag, one literal stamped from TWO sites
+    flags (timelines and the fleet reconciliation key on the string),
+    and unrelated `record` methods (flight recorder, incident
+    recorder, flows) stay INVISIBLE — only ledger-shaped receivers
+    are collected."""
+    _, findings = _scan(
+        tmp_path,
+        {
+            "s.py": """
+            def emit(story, recorder, flow):
+                story.record("T1", "NotaryAdmit")
+                story.record("T1", "notary.admit")
+                story.record("T1", f"verify.{'dispatch'}")
+                recorder.record(trace)
+                flow.record("T1", "NotAnEvent")
+
+            def emit_again(txstory):
+                txstory.record("T2", "notary.admit")
+            """
+        },
+        only=("lifecycle",),
+    )
+    by_rule = {f.rule: f for f in findings}
+    assert by_rule["lifecycle-name-convention"].detail == "NotaryAdmit"
+    dup = by_rule["lifecycle-duplicate-spelling"]
+    assert dup.detail == "notary.admit" and len(dup.evidence) == 2
+    # flow.record's bad literal never flagged (not a ledger receiver);
+    # the rendered-dynamic verify.<> stamp is clean
+    assert len(findings) == 2
+
+
+def test_lifecycle_pass_gates_committed_tree_clean(tmp_path):
+    """Every lifecycle literal in the committed tree passes: one
+    spelling site per event, dotted lowercase throughout — the
+    vocabulary the GET /tx timelines and the reconciliation key on
+    cannot drift."""
+    import os
+
+    from tools.lint.cli import DEFAULT_BASELINE, gate, load_baseline, run_passes
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    repo, findings = run_passes(root, only=("lifecycle",))
+    # the whole seam vocabulary was collected (a refactor that renames
+    # the emission method would silently blind the pass)
+    names = {r.name for r in repo.lifecycle_regs}
+    for expected in (
+        "notary.admit", "wal.journal", "wal.replay", "notary.flush",
+        "qos.admit", "qos.shed", "verify.dispatch", "verify.redispatch",
+        "verify.hedge", "xshard.reserve", "consensus.commit",
+    ):
+        assert expected in names, sorted(names)
+    rows = load_baseline(os.path.join(root, DEFAULT_BASELINE))
+    new, _stale, _unjust = gate(findings, rows, selected=("lifecycle",))
+    assert not new, [f.render() for f in new]
+
+
+# ---------------------------------------------------------------------------
 # contracts
 
 def test_contracts_pass_sweeps_installed_classes(tmp_path):

@@ -265,6 +265,14 @@ def main(argv: Optional[list[str]] = None) -> int:
         help="exit non-zero when any metric in the newest diff dropped "
         "more than PCT percent",
     )
+    p.add_argument(
+        "--json",
+        action="store_true",
+        help="emit the per-metric diff table as one machine-readable "
+        "JSON document on stdout (a CI artifact) instead of the text "
+        "table; gate failures land under `gate_failures` and the exit "
+        "code is unchanged",
+    )
     args = p.parse_args(argv)
 
     paths = discover(args.dir)
@@ -281,19 +289,42 @@ def main(argv: Optional[list[str]] = None) -> int:
         else [(records[-2], records[-1])]
     )
     newest_rows: list[dict] = []
+    json_pairs: list[dict] = []
     for (old_label, old), (new_label, new) in pairs:
         newest_rows = diff(old, new)
-        print(format_rows(newest_rows, old_label, new_label))
+        if args.json:
+            json_pairs.append({
+                "old": old_label,
+                "new": new_label,
+                "rows": newest_rows,
+            })
+        else:
+            print(format_rows(newest_rows, old_label, new_label))
+    bad: list[dict] = []
     if args.gate is not None:
         bad = gate_failures(newest_rows, args.gate)
-        if bad:
+        if not args.json:
             for r in bad:
                 print(
                     f"bench_history: GATE {r['metric']} regressed "
                     f"{r['delta_pct']}% (> {args.gate}% allowed)",
                     file=sys.stderr,
                 )
-            return 1
+    if args.json:
+        # ONE document: the newest pair's rows at the top level (what
+        # a CI artifact consumer almost always wants), every pair
+        # under `pairs` for --all trajectories, the gate verdict
+        # alongside — same exit-code contract as the text form
+        print(json.dumps({
+            "old": json_pairs[-1]["old"],
+            "new": json_pairs[-1]["new"],
+            "rows": json_pairs[-1]["rows"],
+            "pairs": json_pairs,
+            "gate_pct": args.gate,
+            "gate_failures": bad,
+        }, indent=2))
+    if bad:
+        return 1
     return 0
 
 

@@ -33,7 +33,10 @@ from . import blocking, conventions, jaxhazard, lockcheck
 from .facts import RepoFacts, extract_repo
 from .findings import Finding, sort_findings
 
-PASSES = ("lockcheck", "blocking", "jaxhazard", "metrics", "spans", "contracts")
+PASSES = (
+    "lockcheck", "blocking", "jaxhazard", "metrics", "spans",
+    "lifecycle", "contracts",
+)
 
 # rule-name prefix per pass: lets a --only run judge staleness (and
 # baseline merging) ONLY for rows its selected passes could have
@@ -44,6 +47,7 @@ _RULE_PREFIX = {
     "jaxhazard": "jax-",
     "metrics": "metric-",
     "spans": "span-",
+    "lifecycle": "lifecycle-",
     "contracts": "contract-",
 }
 
@@ -73,6 +77,8 @@ def run_passes(
         findings += conventions.run_metrics(repo)
     if "spans" in selected:
         findings += conventions.run_spans(repo)
+    if "lifecycle" in selected:
+        findings += conventions.run_lifecycle(repo)
     if "contracts" in selected:
         findings += conventions.run_contracts(repo)
     return repo, sort_findings(findings)

@@ -172,6 +172,69 @@ def run_spans(repo: RepoFacts) -> list[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# lifecycle events
+
+# the txstory vocabulary shares the span convention: dotted lowercase
+# `component.event`, at least two segments, `<>` for rendered-dynamic
+# pieces. One regex would do, but a separate binding keeps the two
+# passes free to diverge (spans allow phases like `raft.view_change`;
+# lifecycle literals are the reconciliation vocabulary and the fleet
+# checker string-matches them).
+_LIFECYCLE_RE = _SPAN_RE
+
+
+def run_lifecycle(repo: RepoFacts) -> list[Finding]:
+    """Lifecycle-event naming (utils/txstory.py): every collected
+    `<ledger>.record(tx_id, "...")` literal matches the dotted
+    lowercase `component.event` convention and is stamped from exactly
+    ONE site — GET /tx timelines, the stage-milestone mapping and the
+    fleet reconciliation all key on these strings, so a second
+    spelling forks the vocabulary silently. Non-renderable names are
+    skipped (the ledger's own typed helpers forward through variables;
+    their literals are collected at the helper's `self.record` site)."""
+    findings: list[Finding] = []
+    sites: dict[str, list] = {}
+    for reg in repo.lifecycle_regs:
+        if not _LIFECYCLE_RE.match(reg.name):
+            findings.append(
+                Finding(
+                    "lifecycle",
+                    "lifecycle-name-convention",
+                    P2,
+                    reg.file,
+                    reg.line,
+                    reg.scope,
+                    reg.name,
+                    f"lifecycle event {reg.name!r} does not match the "
+                    "dotted lowercase `component.event` convention",
+                )
+            )
+        if reg.literal:
+            sites.setdefault(reg.name, []).append(reg)
+    for name, regs in sorted(sites.items()):
+        locations = {(r.file, r.line) for r in regs}
+        if len(locations) <= 1:
+            continue
+        first = regs[0]
+        findings.append(
+            Finding(
+                "lifecycle",
+                "lifecycle-duplicate-spelling",
+                P2,
+                first.file,
+                first.line,
+                "",
+                name,
+                f"lifecycle event {name!r} is stamped from "
+                f"{len(locations)} sites — one event, several owners "
+                "(timelines and the reconciliation key on the literal)",
+                [f"{f}:{line}" for f, line in sorted(locations)],
+            )
+        )
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # contracts
 
 

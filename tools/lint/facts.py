@@ -53,6 +53,14 @@ METRIC_METHODS = frozenset(
     {"counter", "meter", "timer", "histogram", "gauge"}
 )
 
+# lifecycle-event stamp sites (utils/txstory.TxStory.record): the
+# receiver must LOOK like the ledger (a name ending in `story` /
+# `txstory`, or `self` inside utils/txstory.py itself) — `record` is
+# too common a method name to collect bare (FlightRecorder.record,
+# IncidentRecorder.record, flow.record are all 'record' calls that
+# stamp no lifecycle event)
+LIFECYCLE_RECEIVERS = ("story", "txstory", "_txstory", "txstory_plane")
+
 # span-stamping sites (utils/tracing.Tracer): the spans pass checks
 # their first-arg names the way the metrics pass checks registrations
 SPAN_METHODS = frozenset({"start_trace", "start_span", "span_at"})
@@ -201,6 +209,9 @@ class RepoFacts:
     # span-name stamp sites (same record shape as metric_regs; the
     # `method` field carries start_trace/start_span/span_at)
     span_regs: list[MetricReg] = field(default_factory=list)
+    # lifecycle-event stamp sites (utils/txstory.TxStory.record; the
+    # name is the SECOND positional arg — the first is the tx id)
+    lifecycle_regs: list[MetricReg] = field(default_factory=list)
     jit_roots: list[JitRoot] = field(default_factory=list)
     # attr -> {(class, kind)} across every scanned class
     lock_attr_index: dict[str, set] = field(default_factory=dict)
@@ -821,6 +832,33 @@ class _FunctionWalker:
                     self.facts.qualname,
                 )
             )
+        # lifecycle-event stamps (txstory.TxStory.record): the event
+        # name rides in the SECOND positional arg; collected only from
+        # ledger-shaped receivers (see LIFECYCLE_RECEIVERS) so the
+        # many unrelated `record` methods in the tree stay invisible
+        if (
+            attr in ("record", "_record_locked")
+            and len(node.args) >= 2
+            and (
+                receiver.rsplit(".", 1)[-1] in LIFECYCLE_RECEIVERS
+                or (
+                    receiver == "self"
+                    and self.facts.file.endswith("utils/txstory.py")
+                )
+            )
+        ):
+            name, literal = _metric_name(node.args[1], self.mod)
+            if name is not None:
+                self.repo.lifecycle_regs.append(
+                    MetricReg(
+                        attr,
+                        name,
+                        literal,
+                        self.facts.file,
+                        node.lineno,
+                        self.facts.qualname,
+                    )
+                )
         # span-name stamps (tracing.Tracer.start_trace/start_span/
         # span_at): same rendering as metric names, consumed by the
         # spans conventions pass
