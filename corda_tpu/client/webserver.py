@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import json
 import threading
+from ..utils import locks
 import time
 from dataclasses import dataclass
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -206,8 +207,10 @@ class NodeWebServer:
         # serializes /profile on-demand captures and resets: without
         # it a second ?seconds=N request returns a partial table and
         # a concurrent ?reset=1 wipes an in-flight capture
-        self._profile_lock = threading.Lock()
-        self._lock = threading.Lock()   # one RPC conversation at a time
+        self._profile_lock = locks.make_lock("NodeWebServer._profile_lock")
+        self._lock = locks.make_lock(
+            "NodeWebServer._lock"
+        )   # one RPC conversation at a time
         # the operational surface: path -> (description, handler(query)
         # -> (status, content_type, payload bytes)). ONE table drives
         # dispatch AND the GET / index, so the index can never drift

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import inspect
 import threading
+from ..utils import locks
 from dataclasses import dataclass
 from typing import Any, Callable, Optional, Type
 
@@ -109,7 +110,7 @@ class FlowFuture:
         self._value: Any = None
         self._exc: Optional[BaseException] = None
         self._callbacks: list[Callable[["FlowFuture"], None]] = []
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("FlowFuture._lock")
 
     def set_result(self, value: Any) -> None:
         with self._lock:

@@ -56,6 +56,7 @@ from __future__ import annotations
 
 import random
 import threading
+from ..utils import locks
 import time
 from dataclasses import dataclass
 from typing import Callable, Optional
@@ -389,7 +390,9 @@ class DistributedUniquenessProvider(UniquenessProvider):
         self.decisions = decision_log
         self.raft_groups = raft_groups or {}
         self.metrics = metrics if metrics is not None else MetricRegistry()
-        self._lock = threading.Lock()   # snapshot-vs-pump memory guard
+        self._lock = locks.make_lock(
+            "DistributedUniquenessProvider._lock"
+        )   # snapshot-vs-pump memory guard
         self._txns: dict[SecureHash, _XTxn] = {}        # coordinator
         self._res: dict[SecureHash, _Reservation] = {}  # participant
         self._ref_hold: dict[StateRef, SecureHash] = {}
@@ -1185,6 +1188,18 @@ class DistributedUniquenessProvider(UniquenessProvider):
                     # re-drive: the decision is durable, participants
                     # apply idempotently. No client future exists any
                     # more — the intent-WAL replay upstream re-asks.
+                    # The durable mark IS the accept decision: a crash
+                    # between the mark and the in-memory decision-log
+                    # append would otherwise leave the log missing an
+                    # accept that a later loser's conflict entry cites
+                    # (found by the crash-schedule explorer's
+                    # serial-replay invariant) — re-record it, before
+                    # any re-driven ShardCommit makes the rows visible
+                    # again, unless the original append did land
+                    if self.decisions is not None and (
+                        (tx_id, None) not in self.decisions
+                    ):
+                        self.decisions.append((tx_id, None))
                     txn = _XTxn(
                         xid, tx_id, list(refs), requester, None, None,
                         parts, now,

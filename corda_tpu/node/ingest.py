@@ -55,6 +55,7 @@ tests/test_ingest.py.
 from __future__ import annotations
 
 import threading
+from ..utils import locks
 import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
@@ -262,7 +263,7 @@ class IngestRing:
     def __init__(self, depth: int = 2):
         self.depth = max(1, depth)
         self._dq: deque = deque()
-        self._cond = threading.Condition()
+        self._cond = locks.make_condition("IngestRing._cond")
         self._closed = False
         # lifetime high-water mark: how close the consumer ever let the
         # ring get to its bound — a depth gauge samples, this remembers

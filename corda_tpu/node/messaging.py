@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import random
 import threading
+from ..utils import locks
 from collections import deque
 from dataclasses import dataclass
 from typing import Callable, Optional
@@ -139,7 +140,7 @@ class FabricFaults:
     def __init__(self, clock=None, seed: int = 0):
         self._clock = clock
         self._rng = random.Random(seed)
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("FabricFaults._lock")
         self._groups: tuple[frozenset, ...] = ()   # partition groups
         self._down: set[str] = set()               # killed nodes
         self._delay: dict[tuple[str, str], int] = {}    # directional us

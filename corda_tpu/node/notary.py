@@ -23,6 +23,7 @@ from __future__ import annotations
 import gc
 import os
 import threading
+from ..utils import locks
 import time
 from dataclasses import dataclass, field
 from typing import Any, Optional
@@ -239,7 +240,7 @@ class _UniquenessPartition:
         # cross-shard commit; holders resolve (commit or abort) within
         # one flush, so waiters never park long
         self.reserved: dict[StateRef, SecureHash] = {}
-        self.cond = threading.Condition()
+        self.cond = locks.make_condition("_UniquenessPartition.cond")
 
 
 class ShardReservation:
@@ -306,7 +307,9 @@ class ShardedUniquenessProvider(UniquenessProvider):
     def __init__(self, n_shards: int = 1, record_decisions: bool = False):
         self.n_shards = max(1, int(n_shards))
         self._parts = [_UniquenessPartition() for _ in range(self.n_shards)]
-        self._decision_lock = threading.Lock()
+        self._decision_lock = locks.make_lock(
+            "ShardedUniquenessProvider._decision_lock"
+        )
         self.decisions: Optional[list] = [] if record_decisions else None
 
     # -- routing -----------------------------------------------------------
@@ -839,7 +842,7 @@ class _NotaryShard:
         self.id = sid
         self.pending: list[_PendingNotarisation] = []
         self.oldest_arrival: Optional[int] = None
-        self.cond = threading.Condition()
+        self.cond = locks.make_condition("_NotaryShard.cond")
         self.verifier = verifier       # None = the hub's shared verifier
         self.heartbeat = None          # attach_health wires one per shard
         self.queue_bound = queue_bound
@@ -1026,7 +1029,7 @@ class BatchingNotaryService(NotaryService):
         self._completions = None       # worker mode: (future, outcome)
         self._workers: list[threading.Thread] = []
         self._stop_workers = False
-        self._gc_lock = threading.Lock()
+        self._gc_lock = locks.make_lock("BatchingNotaryService._gc_lock")
         self._gc_depth = 0
         self._gc_reenable = False
         if self.n_shards > 1:

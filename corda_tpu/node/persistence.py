@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import sqlite3
 import threading
+from ..utils import locks
 from typing import Optional
 
 from ..core import serialization as ser
@@ -110,7 +111,7 @@ class NodeDatabase:
     def __init__(self, path: str = ":memory:"):
         self.path = path
         self._conn = sqlite3.connect(path, check_same_thread=False)
-        self._lock = threading.RLock()
+        self._lock = locks.make_rlock("NodeDatabase._lock")
         self._tx_depth = 0
         with self._lock:
             if path != ":memory:":
@@ -573,7 +574,7 @@ class NotaryIntentJournal:
     def __init__(self, db: NodeDatabase):
         self._db = db
         db.execute_script(self._SCHEMA)
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("NotaryIntentJournal._lock")
         self._resolved_buf: list[int] = []
         self.appended = 0
         self.resolved = 0
@@ -898,7 +899,7 @@ class TxStoryIndex:
     def __init__(self, db: NodeDatabase, max_rows: int = 200_000):
         self._db = db
         db.execute_script(self._SCHEMA)
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("TxStoryIndex._lock")
         self._buf: list[tuple] = []
         self._max_rows = max(1_000, max_rows)
         self.appended = 0

@@ -50,6 +50,7 @@ notary's hot path pays a single attribute check.
 from __future__ import annotations
 
 import threading
+from ..utils import locks
 from dataclasses import dataclass
 from typing import Any, Callable, Optional
 
@@ -132,7 +133,7 @@ class TokenBucket:
     def __init__(self, rate_per_sec: float, burst: int):
         self.rate = float(rate_per_sec)
         self.burst = max(1, int(burst))
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("TokenBucket._lock")
         self._state: dict[str, tuple[float, int]] = {}  # name -> (tokens, t)
 
     def admit(self, client: str, now_micros: int, cost: int = 1) -> bool:
@@ -365,7 +366,7 @@ class NotaryQos:
         # spike" needs the transition times, not just the live level.
         # Bounded (an oscillation bug must not grow memory forever).
         self.brownout_transitions: list[tuple[int, int]] = []
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("NotaryQos._lock")
         # sharded commit plane (round 6): one AIMD controller + admitted
         # latency histogram PER SHARD, created by ensure_shards — a hot
         # shard (one partition's refs contended or deep) then collapses

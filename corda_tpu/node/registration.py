@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import json
 import threading
+from ..utils import locks
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from pathlib import Path
@@ -68,7 +69,7 @@ class Doorman:
         self.intermediate = intermediate
         self.auto_approve = auto_approve
         self._dir = Path(data_dir) if data_dir else None
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("Doorman._lock")
         # id -> {"csr": pem, "status": pending|approved|rejected,
         #        "reason": str}
         self._requests: dict[str, dict] = {}

@@ -23,6 +23,7 @@ import heapq
 import importlib
 import logging
 import threading
+from ..utils import locks
 from typing import Callable, Optional
 
 from ..core.contracts import ScheduledActivity, SchedulableState, StateRef
@@ -81,7 +82,7 @@ class NodeSchedulerService:
         self._services = services
         self._flow_starter = flow_starter
         self._flow_factory = flow_factory
-        self._lock = threading.RLock()
+        self._lock = locks.make_rlock("NodeSchedulerService._lock")
         self._scheduled: dict[StateRef, ScheduledActivity] = {}
         # min-heap of (scheduled_at, seq, ref); stale entries are lazily
         # discarded against _scheduled (the reference recomputes earliest

@@ -16,6 +16,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Iterable, Optional
 
 from ..core import serialization as ser
+from ..utils import locks
 from ..core.contracts import (
     Attachment,
     CommandWithParties,
@@ -772,9 +773,7 @@ class _Future:
     pump thread resolves it — no polling sleep in the await loop."""
 
     def __init__(self):
-        import threading
-
-        self._cond = threading.Condition()
+        self._cond = locks.make_condition("_Future._cond")
         self._done = False
         self._exc: Optional[BaseException] = None
 

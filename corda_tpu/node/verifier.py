@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import random
 import threading
+from ..utils import locks
 import time
 from dataclasses import dataclass, replace
 from typing import Optional
@@ -249,7 +250,9 @@ class OutOfProcessTransactionVerifierService(TransactionVerifierService):
         self._rng = random.Random(0xFA17)   # jitter: seeded, deterministic
         # guards the pool state below; never held across a fabric
         # send, a register_peer callback or a future resolution
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock(
+            "OutOfProcessTransactionVerifierService._lock"
+        )
         self._pending: dict[int, _PendingVerify] = {}
         self._workers: list[str] = []              # attach order (RR)
         self._leases: dict[str, int] = {}          # worker -> last-ready us

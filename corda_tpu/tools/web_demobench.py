@@ -33,6 +33,7 @@ import json
 import os
 import re
 import threading
+from ..utils import locks
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
@@ -59,8 +60,8 @@ class WebDemoBench:
         # serialises the slow node boots so DemoBench's port
         # allocation and node dict never race — status reads stay
         # unblocked while a node is starting
-        self._lock = threading.Lock()
-        self._spawn_lock = threading.Lock()
+        self._lock = locks.make_lock("WebDemoBench._lock")
+        self._spawn_lock = locks.make_lock("WebDemoBench._spawn_lock")
         self._starting: dict[str, Optional[str]] = {}  # name -> error|None
         self._web_ports: dict[str, int] = {}   # announced ports, cached
         self._closed = False

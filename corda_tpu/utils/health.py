@@ -57,6 +57,7 @@ from __future__ import annotations
 
 import json
 import threading
+from . import locks
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
@@ -141,7 +142,7 @@ class Heartbeat:
         self.deadline_micros = deadline_micros
         self.livelock_micros = livelock_micros
         self.queue_depth = queue_depth
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("Heartbeat._lock")
         # registration counts as the first beat: a loop that never runs
         # at all must show as stalled one deadline after it registered,
         # not crash the watchdog on a None timestamp
@@ -168,7 +169,7 @@ class Watchdog:
     live so the answer reflects NOW, not the last pump tick."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("Watchdog._lock")
         self._beats: dict[str, Heartbeat] = {}
         # livelock memory: name -> [progress value, micros it last moved]
         self._mem: dict[str, list] = {}
@@ -234,7 +235,7 @@ class HealthEventLog:
         path: Optional[str] = None,
         max_bytes: int = 4 << 20,
     ):
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("HealthEventLog._lock")
         self._tail: deque = deque(maxlen=max(8, capacity))
         self.path = path
         self.max_bytes = max(4096, int(max_bytes))
@@ -523,7 +524,7 @@ class CanaryProbe:
         self.interval_micros = interval_micros
         self.deadman_micros = deadman_micros
         self._hist = latency_hist
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("CanaryProbe._lock")
         self._last_launch: Optional[int] = None
         # grace from construction: the deadman arms `deadman_micros`
         # after the plane boots, not instantly on an idle node
@@ -611,7 +612,7 @@ class HealthMonitor:
         self.events = HealthEventLog(
             self.policy.event_log_capacity, event_log_path
         )
-        self._rules_lock = threading.Lock()
+        self._rules_lock = locks.make_lock("HealthMonitor._rules_lock")
         self._alerts: dict[str, _Alert] = {}
         self.canary: Optional[CanaryProbe] = None
         # incident forensics (attach_incidents): every firing
@@ -1107,7 +1108,7 @@ class ClusterHealth:
         )
         self.cache_ttl_micros = cache_ttl_micros
         self.timeout = timeout
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("ClusterHealth._lock")
         # name -> {"summary", "fetched_at_micros", "stale", "error"}
         self._cache: dict[str, dict] = {}
 
@@ -1242,7 +1243,7 @@ class IncidentRecorder:
         self.assemble = assemble
         self.chaos_log = chaos_log
         self.max_traces = max(0, int(max_traces))
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("IncidentRecorder._lock")
         self._seq = 0
         self.recorded = 0
         # GET /incidents headline cache: bundles embed whole assembled

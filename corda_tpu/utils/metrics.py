@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import math
 import threading
+from . import locks
 import time
 from typing import Any, Callable, Optional
 
@@ -29,7 +30,7 @@ class Counter:
     """Monotonic-or-not integer count."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("Counter._lock")
         self._count = 0
 
     def inc(self, n: int = 1) -> None:
@@ -49,7 +50,7 @@ class Meter:
     (dropwizard Meter's role; one EWMA instead of three)."""
 
     def __init__(self, clock: Callable[[], float] = time.monotonic):
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("Meter._lock")
         self._clock = clock
         self._count = 0
         self._start = clock()
@@ -97,7 +98,7 @@ class Histogram:
     RESERVOIR = 1024
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("Histogram._lock")
         self._count = 0
         self._sum = 0.0
         self._min = math.inf
@@ -186,18 +187,27 @@ class MetricRegistry:
     """Named metric registry (reference: com.codahale MetricRegistry)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("MetricRegistry._lock")
         self._metrics: dict[str, Any] = {}
 
     def _get_or_create(self, name: str, cls, factory=None):
-        with self._lock:
-            m = self._metrics.get(name)
-            if m is None:
-                m = (factory or cls)()
-                self._metrics[name] = m
-            elif not isinstance(m, cls):
-                raise TypeError(f"{name} already registered as {type(m)}")
-            return m
+        m = self._metrics.get(name)
+        if m is None:
+            # construct OUTSIDE the lock: `factory` is arbitrary user
+            # code (dynamic dispatch the static blocking pass cannot
+            # see through, and the runtime sanitizer measured on the
+            # pump-hot registry lock) — a losing race wastes one
+            # short-lived object, which is cheaper than serializing
+            # every registration behind a caller-supplied constructor
+            fresh = (factory or cls)()
+            with self._lock:
+                m = self._metrics.get(name)
+                if m is None:
+                    m = fresh
+                    self._metrics[name] = m
+        if not isinstance(m, cls):
+            raise TypeError(f"{name} already registered as {type(m)}")
+        return m
 
     def counter(self, name: str) -> Counter:
         return self._get_or_create(name, Counter)

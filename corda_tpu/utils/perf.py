@@ -58,6 +58,7 @@ from __future__ import annotations
 import json
 import sys
 import threading
+from . import locks
 import time
 from collections import deque
 from dataclasses import dataclass
@@ -124,7 +125,7 @@ class SamplingProfiler:
         self.max_stacks = max(1, int(max_stacks))
         self.depth = max(4, int(depth))
         self._prefixes: list[str] = []
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("SamplingProfiler._lock")
         self._stacks: dict[str, int] = {}
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -293,7 +294,7 @@ class KernelAccounting:
     and paying seconds of XLA compile inside the serving path."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("KernelAccounting._lock")
         self._keys: dict[tuple, dict] = {}
         self._warm = False
         self.compiles = 0
@@ -399,7 +400,7 @@ class KernelAccounting:
 # the process default (what TpuBatchVerifier records into when no
 # explicit accounting is injected) — mirrors tracing.get_tracer()
 _default_kernels: Optional[KernelAccounting] = None
-_default_kernels_lock = threading.Lock()
+_default_kernels_lock = locks.make_lock("perf._default_kernels_lock")
 
 
 def get_kernel_accounting() -> KernelAccounting:
@@ -431,7 +432,7 @@ class ShardSkew:
     def __init__(self, clock_fn: Callable[[], int], policy: PerfPolicy):
         self._clock_fn = clock_fn
         self._policy = policy
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("ShardSkew._lock")
         self.n_shards = 0
         self._requests: list[int] = []      # cumulative answered
         self._flushes: list[int] = []       # cumulative flush count
@@ -578,7 +579,7 @@ class WaveOverlap:
     exactly the regression the PR 6 re-measure is hunting."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("WaveOverlap._lock")
         self.waves = 0
         self.wall_s = 0.0
         self.blocked_s = 0.0
@@ -636,7 +637,7 @@ class PerfHistory:
     perf memory between offline bench rounds."""
 
     def __init__(self, capacity: int = 512):
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("PerfHistory._lock")
         self._series: dict[str, deque] = {}
         self.capacity = max(8, int(capacity))
 
@@ -848,7 +849,7 @@ class PerfPlane:
         # rate keys: name -> [count_fn, last_count, last_micros]
         self._rates: dict[str, list] = {}
         self._values: dict[str, Callable[[], float]] = {}
-        self._ingest_lock = threading.Lock()
+        self._ingest_lock = locks.make_lock("PerfPlane._ingest_lock")
         self.ingest_frames = 0
         self._ingest_stage_s = {"decode": 0.0, "merkle": 0.0, "stage": 0.0}
         self._last_tick: Optional[int] = None

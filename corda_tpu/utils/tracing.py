@@ -45,6 +45,7 @@ import heapq
 import os
 import random
 import threading
+from . import locks
 import time
 from typing import Any, Callable, Iterable, Optional
 
@@ -115,7 +116,7 @@ class ClockSync:
     `(fwd_min - bwd_min) / 2`, accurate to half the minimum RTT."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("ClockSync._lock")
         # peer -> [min skew micros, observation count]
         self._obs: dict[str, list] = {}
 
@@ -328,7 +329,7 @@ class FlightRecorder:
     def __init__(self, keep_recent: int = 64, keep_slowest: int = 16):
         self.keep_recent = max(1, keep_recent)
         self.keep_slowest = max(1, keep_slowest)
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("FlightRecorder._lock")
         self._recent: list[Trace] = []
         self._slow: list[tuple[float, int, Trace]] = []   # min-heap
         self._seq = 0
@@ -395,7 +396,7 @@ class Tracer:
         # per-peer clock-offset evidence (see ClockSync): consensus
         # layers feed it from traced fabric frames; /traces exports it
         self.clock_sync = ClockSync()
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("Tracer._lock")
         # trace AND span ids are salted per-tracer: two processes'
         # spans merge into one cross-node assembly (ClusterTraces), so
         # a bare per-tracer counter would collide span ids across
@@ -627,7 +628,7 @@ def fan_out(
         except Exception as e:   # noqa: BLE001 - partial, not fatal
             errors[key] = f"{type(e).__name__}: {e}"
         return results, errors
-    lock = threading.Lock()
+    lock = locks.make_lock("fan_out.<lock>")
     cursor = [0]
 
     def worker() -> None:
@@ -874,7 +875,7 @@ def annotate(name: str):
 # -- process default ----------------------------------------------------------
 
 _default_tracer: Optional[Tracer] = None
-_default_lock = threading.Lock()
+_default_lock = locks.make_lock("tracing._default_lock")
 
 
 def get_tracer() -> Tracer:

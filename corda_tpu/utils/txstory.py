@@ -55,6 +55,7 @@ from __future__ import annotations
 
 import heapq
 import threading
+from . import locks
 import time
 from collections import OrderedDict, deque
 from typing import Any, Callable, Optional
@@ -193,7 +194,7 @@ class TxStory:
             self._now = clock
         else:
             self._now = clock.now_micros
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("TxStory._lock")
         self._open: "OrderedDict[str, _Story]" = OrderedDict()
         self._done: "OrderedDict[str, _Story]" = OrderedDict()
         self._max_open = max(16, max_open)

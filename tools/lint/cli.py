@@ -17,7 +17,18 @@ writing down why.
     python -m tools.lint --format dot         # lock graph for graphviz
     python -m tools.lint --write-baseline     # (re)seed the baseline —
                                               # justifications stay ""
-                                              # until a human writes them
+                                              # until a human writes
+                                              # them; warns when a
+                                              # carried-over row's
+                                              # recorded severity no
+                                              # longer matches the live
+                                              # finding (drift)
+    python -m tools.lint --write-wiremsg-schema   # record a wire-
+                                              # schema evolution
+    python -m tools.lint --report split       # ARM the runtime
+                                              # sanitizer, soak, print
+                                              # the process-split
+                                              # feasibility report
 """
 
 from __future__ import annotations
@@ -29,13 +40,13 @@ import sys
 import time
 from typing import Optional
 
-from . import blocking, conventions, jaxhazard, lockcheck
+from . import blocking, conventions, jaxhazard, lockcheck, wiremsg
 from .facts import RepoFacts, extract_repo
 from .findings import Finding, sort_findings
 
 PASSES = (
     "lockcheck", "blocking", "jaxhazard", "metrics", "spans",
-    "lifecycle", "contracts",
+    "lifecycle", "contracts", "wiremsg",
 )
 
 # rule-name prefix per pass: lets a --only run judge staleness (and
@@ -49,6 +60,7 @@ _RULE_PREFIX = {
     "spans": "span-",
     "lifecycle": "lifecycle-",
     "contracts": "contract-",
+    "wiremsg": "wiremsg-",
 }
 
 DEFAULT_BASELINE = "LINT_BASELINE.json"
@@ -81,6 +93,8 @@ def run_passes(
         findings += conventions.run_lifecycle(repo)
     if "contracts" in selected:
         findings += conventions.run_contracts(repo)
+    if "wiremsg" in selected:
+        findings += wiremsg.run(repo)
     return repo, sort_findings(findings)
 
 
@@ -97,7 +111,7 @@ def write_baseline(
     path: str,
     findings: list[Finding],
     selected: tuple = PASSES,
-) -> None:
+) -> list[str]:
     """(Re)seed the baseline from the current findings, MERGING with
     what is already committed: an existing row's hand-written
     justification is preserved when its finding still fires, and rows
@@ -105,15 +119,36 @@ def write_baseline(
     re-seeding must never erase accepted history. Rows for a selected
     pass whose finding no longer fires are dropped (they would only go
     stale). New findings get an empty justification for a human to
-    fill in."""
+    fill in.
+
+    Returns justification-DRIFT warnings: a carried-over justification
+    was written against the finding as it then stood — when the live
+    finding's severity no longer matches what the row recorded, the
+    prose may argue about a finding that no longer exists in that
+    form, so the human is told to re-verify it."""
     existing = {r.get("fingerprint"): r for r in load_baseline(path)}
     rows = []
     seen = set()
+    drift: list[str] = []
     for f in findings:
         if f.fingerprint in seen:
             continue
         seen.add(f.fingerprint)
         prior = existing.get(f.fingerprint, {})
+        justification = str(prior.get("justification", ""))
+        if (
+            justification.strip()
+            and str(prior.get("severity", f.severity)) != f.severity
+        ):
+            # byte-identical twin in corda_tpu/testing/sanitizer.py's
+            # write_baseline — the static and dynamic planes share one
+            # baseline discipline; change both or neither
+            drift.append(
+                f"baseline row {f.fingerprint} ({f.rule} {f.file}): "
+                f"recorded severity {prior.get('severity')} but the "
+                f"live finding is {f.severity} — the carried-over "
+                "justification may no longer apply, re-verify it"
+            )
         rows.append(
             {
                 "fingerprint": f.fingerprint,
@@ -122,7 +157,7 @@ def write_baseline(
                 "file": f.file,
                 "scope": f.scope,
                 "detail": f.detail,
-                "justification": str(prior.get("justification", "")),
+                "justification": justification,
             }
         )
     for fp, row in existing.items():
@@ -131,6 +166,7 @@ def write_baseline(
     with open(path, "w", encoding="utf-8") as f:
         json.dump({"version": 1, "baselined": rows}, f, indent=2)
         f.write("\n")
+    return drift
 
 
 def gate(
@@ -210,6 +246,21 @@ def main(argv: Optional[list[str]] = None) -> int:
         default="text",
         help="dot prints the lock-acquisition graph instead of findings",
     )
+    p.add_argument(
+        "--write-wiremsg-schema",
+        action="store_true",
+        help="(re)generate WIREMSG_SCHEMA.json from the scanned tree "
+        "— the explicit act that records a wire-schema evolution",
+    )
+    p.add_argument(
+        "--report",
+        choices=("split",),
+        default=None,
+        help="'split' arms the runtime sanitizer, drives the standard "
+        "soak and prints the process-split feasibility report "
+        "(static sharing map x measured contention/hold times); "
+        "imports corda_tpu, unlike every other mode",
+    )
     args = p.parse_args(argv)
 
     only = None
@@ -226,6 +277,19 @@ def main(argv: Optional[list[str]] = None) -> int:
     subdirs = tuple(
         s.strip() for s in args.paths.split(",") if s.strip()
     )
+
+    if args.report == "split":
+        return _report_split(args.root)
+
+    if args.write_wiremsg_schema:
+        repo = extract_repo(args.root, subdirs)
+        path = wiremsg.write_schema(args.root, repo)
+        print(
+            f"lint: wrote {len(wiremsg.scoped_messages(repo))} wire "
+            f"message shape(s) to {path}"
+        )
+        return 0
+
     t0 = time.perf_counter()
     repo, findings = run_passes(args.root, only, subdirs)
     elapsed = time.perf_counter() - t0
@@ -238,7 +302,9 @@ def main(argv: Optional[list[str]] = None) -> int:
         args.root, DEFAULT_BASELINE
     )
     if args.write_baseline:
-        write_baseline(baseline_path, findings, only or PASSES)
+        drift = write_baseline(baseline_path, findings, only or PASSES)
+        for warning in drift:
+            print(f"lint: DRIFT {warning}", file=sys.stderr)
         print(
             f"lint: wrote {len(findings)} finding(s) to {baseline_path} "
             "— add justifications before committing"
@@ -308,6 +374,47 @@ def main(argv: Optional[list[str]] = None) -> int:
             f"baselined with justification "
             f"({len(repo.modules)} modules, {elapsed:.2f}s)"
         )
+    return 0
+
+
+def _report_split(root: str) -> int:
+    """`--report split`: the runtime half. Arms the sanitizer, drives
+    the standard soak (sharded batching notary, worker threads,
+    durable intake, concurrent readers) and prints the process-split
+    feasibility report plus the static<->dynamic reconciliation. The
+    one lint mode that imports corda_tpu (lazily — the static gate
+    stays dependency-free)."""
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from corda_tpu.testing import sanitizer as szr
+
+    view = szr.static_lock_view(root)
+    san = szr.ConcurrencySanitizer(
+        hot_locks=view.hot_locks, hold_budget_micros=2_000
+    )
+    t0 = time.perf_counter()
+    with san:
+        out = szr.standard_soak()
+    elapsed = time.perf_counter() - t0
+    diff = san.diff_static(view)
+    print(szr.render_split_report(san.split_report(view)))
+    print()
+    print(
+        f"static<->dynamic: {diff.observed_edge_count} observed "
+        f"edge(s), {len(diff.unseen_edges)} unseen, "
+        f"{len(diff.unexercised_edges)} statically-known never "
+        f"exercised (coverage {diff.coverage:.0%}), "
+        f"{len(diff.unknown_locks)} unknown runtime lock name(s)"
+    )
+    for f in diff.unseen_edges:
+        print(f.render())
+    for f in san.findings():
+        print(f.render())
+    print(
+        f"lint: split report over a {out['signed']}-signed/"
+        f"{out['rejected']}-rejected soak in {elapsed:.2f}s"
+    )
     return 0
 
 

@@ -45,6 +45,16 @@ LOCK_FACTORIES = {
     "BoundedSemaphore": "Semaphore",
 }
 
+# the instrumented factory (utils/locks.py): `locks.make_lock("X._l")`
+# constructs what `threading.Lock()` used to — the static analysis
+# sees through it so lock identities survive the adoption
+SANITIZER_FACTORIES = {
+    "make_lock": "Lock",
+    "make_rlock": "RLock",
+    "make_condition": "Condition",
+    "make_semaphore": "Semaphore",
+}
+
 # receiver-attr shapes worth treating as a lock even when no scanned
 # class defines them (third-party objects)
 _LOCKISH_ATTR = re.compile(r"(^|_)(lock|cond|condition|mutex|sem)s?$", re.I)
@@ -84,6 +94,7 @@ _STDLIB_METHOD_NOISE = frozenset(
         "send", "bind", "listen", "join", "start", "stop", "run", "wait",
         "acquire", "release", "notify", "notify_all", "set", "clear",
         "get", "put", "pop", "popleft", "append", "appendleft", "remove",
+        "wait_for",
         "insert", "extend", "add", "discard", "update", "copy", "items",
         "keys", "values", "sort", "index", "count", "result", "done",
         "cancel", "encode", "decode", "strip", "split", "format",
@@ -127,6 +138,22 @@ class MetricReg:
     file: str
     line: int
     scope: str
+
+
+@dataclass
+class WireMsg:
+    """A fabric message shape: a class decorated `@ser.serializable`
+    (the canonical-encoding registry — what actually crosses the
+    wire). The wiremsg pass checks the node/flows subset: frozen
+    dataclass, exactly one definition site, field list append-only vs
+    the committed WIREMSG_SCHEMA.json snapshot."""
+
+    name: str
+    file: str
+    line: int
+    is_dataclass: bool
+    frozen: bool
+    fields: tuple[str, ...]
 
 
 @dataclass
@@ -213,6 +240,7 @@ class RepoFacts:
     # name is the SECOND positional arg — the first is the tx id)
     lifecycle_regs: list[MetricReg] = field(default_factory=list)
     jit_roots: list[JitRoot] = field(default_factory=list)
+    wire_msgs: list[WireMsg] = field(default_factory=list)
     # attr -> {(class, kind)} across every scanned class
     lock_attr_index: dict[str, set] = field(default_factory=dict)
     # method name -> {funckey} across every scanned class
@@ -388,7 +416,8 @@ def _unparse(node: ast.AST) -> str:
 
 
 def _call_factory_kind(node: ast.expr) -> Optional[str]:
-    """'Lock' for threading.Lock() / Lock(), None otherwise."""
+    """'Lock' for threading.Lock() / Lock() / locks.make_lock(...),
+    None otherwise."""
     if not isinstance(node, ast.Call):
         return None
     fn = node.func
@@ -400,6 +429,14 @@ def _call_factory_kind(node: ast.expr) -> Optional[str]:
             return LOCK_FACTORIES[fn.attr]
     if isinstance(fn, ast.Name) and fn.id in LOCK_FACTORIES:
         return LOCK_FACTORIES[fn.id]
+    if isinstance(fn, ast.Attribute) and fn.attr in SANITIZER_FACTORIES:
+        if isinstance(fn.value, ast.Name) and fn.value.id in (
+            "locks",
+            "lockslib",
+        ):
+            return SANITIZER_FACTORIES[fn.attr]
+    if isinstance(fn, ast.Name) and fn.id in SANITIZER_FACTORIES:
+        return SANITIZER_FACTORIES[fn.id]
     return None
 
 
@@ -484,6 +521,7 @@ class _ModuleScanner(ast.NodeVisitor):
             b.id if isinstance(b, ast.Name) else getattr(b, "attr", "")
             for b in node.bases
         )
+        self._maybe_wire_msg(node)
         key = f"{self.mod.relpath}::{node.name}"
         info = self.repo.classes.get(key)
         if info is None:
@@ -493,6 +531,56 @@ class _ModuleScanner(ast.NodeVisitor):
         self._cls_stack.append(info)
         self.generic_visit(node)
         self._cls_stack.pop()
+
+    def _maybe_wire_msg(self, node: ast.ClassDef) -> None:
+        """Record `@ser.serializable` classes (any call/attribute/name
+        spelling) with their dataclass-ness, frozen flag and ordered
+        field list — the wiremsg pass's input."""
+
+        def _dec_name(dec: ast.expr) -> str:
+            if isinstance(dec, ast.Call):
+                dec = dec.func
+            if isinstance(dec, ast.Attribute):
+                return dec.attr
+            if isinstance(dec, ast.Name):
+                return dec.id
+            return ""
+
+        if not any(
+            _dec_name(d) == "serializable" for d in node.decorator_list
+        ):
+            return
+        is_dataclass = False
+        frozen = False
+        for dec in node.decorator_list:
+            if _dec_name(dec) != "dataclass":
+                continue
+            is_dataclass = True
+            if isinstance(dec, ast.Call):
+                for kw in dec.keywords:
+                    if (
+                        kw.arg == "frozen"
+                        and isinstance(kw.value, ast.Constant)
+                        and kw.value.value is True
+                    ):
+                        frozen = True
+        fields = tuple(
+            st.target.id
+            for st in node.body
+            if isinstance(st, ast.AnnAssign)
+            and isinstance(st.target, ast.Name)
+            and "ClassVar" not in _unparse(st.annotation)
+        )
+        self.repo.wire_msgs.append(
+            WireMsg(
+                node.name,
+                self.mod.relpath,
+                node.lineno,
+                is_dataclass,
+                frozen,
+                fields,
+            )
+        )
 
     def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
         self._scan_fn(node)
@@ -762,6 +850,19 @@ class _FunctionWalker:
         for sub in ast.walk(node):
             if isinstance(sub, ast.Call):
                 self._call(sub)
+            elif isinstance(sub, ast.NamedExpr):
+                # walrus targets bind like Assign targets: a lock (or
+                # thread) constructed in `if (l := make_lock(...))`
+                # must gain the same local identity a plain assignment
+                # would
+                kind = _call_factory_kind(sub.value)
+                if kind and isinstance(sub.target, ast.Name):
+                    self.local_locks[sub.target.id] = kind
+                elif _is_thread_ctor(sub.value) and isinstance(
+                    sub.target, ast.Name
+                ):
+                    self.local_threads.add(sub.target.id)
+                    self.facts.thread_locals.add(sub.target.id)
             elif isinstance(sub, ast.Lambda):
                 pass   # body visited by ast.walk; held context kept —
                 #        deferred-execution misattribution is accepted
@@ -794,18 +895,35 @@ class _FunctionWalker:
         # thread entry points
         if _is_thread_ctor(node):
             for kw in node.keywords:
-                if kw.arg == "target":
-                    for key in self._resolve_fn_expr(kw.value):
-                        self.repo.entries.append(
-                            Entry(
-                                f"thread:{key}",
-                                "thread",
-                                key,
-                                f"thread:{key}",
-                                self.facts.file,
-                                node.lineno,
-                            )
+                if kw.arg != "target":
+                    continue
+                if isinstance(kw.value, ast.Lambda):
+                    # a lambda target IS the thread body: walk it as a
+                    # synthetic function so its acquisitions/calls join
+                    # the fact table, and give it its own entry group
+                    key = self._walk_lambda_target(kw.value)
+                    self.repo.entries.append(
+                        Entry(
+                            f"thread:{key}",
+                            "thread",
+                            key,
+                            f"thread:{key}",
+                            self.facts.file,
+                            node.lineno,
                         )
+                    )
+                    continue
+                for key in self._resolve_fn_expr(kw.value):
+                    self.repo.entries.append(
+                        Entry(
+                            f"thread:{key}",
+                            "thread",
+                            key,
+                            f"thread:{key}",
+                            self.facts.file,
+                            node.lineno,
+                        )
+                    )
         # fabric handler registrations (pump-thread callbacks)
         if attr in ("add_handler",) and len(node.args) >= 2:
             for key in self._resolve_fn_expr(node.args[1]):
@@ -924,6 +1042,30 @@ class _FunctionWalker:
         if ref and ref[0] == "key":
             return (ref[1],)
         return self.repo.resolve_ref(ref, self.mod, self.facts.cls)
+
+    def _walk_lambda_target(self, lam: ast.Lambda) -> str:
+        """Synthesize function facts for a `Thread(target=lambda: ...)`
+        body. The key is scope-stable (a per-enclosing-function
+        counter, not a line number) so fingerprints survive shifts."""
+        n = sum(
+            1
+            for k in self.repo.functions
+            if k.startswith(f"{self.facts.key}.<lambda")
+        )
+        key = f"{self.facts.key}.<lambda{n}>"
+        qual = f"{self.facts.qualname}.<lambda{n}>"
+        facts = FunctionFacts(
+            key, qual, self.facts.file, lam.lineno, self.facts.cls,
+            tuple(a.arg for a in lam.args.args), lam,
+        )
+        self.repo.functions[key] = facts
+        walker = _FunctionWalker(self.repo, self.mod, facts)
+        # inherit the enclosing scope's local lock/thread identities —
+        # the lambda closes over them
+        walker.local_locks = dict(self.local_locks)
+        walker.local_funcs = dict(self.local_funcs)
+        walker._expr(lam.body)
+        return key
 
 
 def _const_strs(node: ast.expr) -> tuple[str, ...]:

@@ -1,0 +1,206 @@
+"""wiremsg — fabric message schema discipline.
+
+The mixed-version rule the fabrics document (PR 4: "6-element header
+frames need both ends upgraded") generalises to every wire message: a
+frame encoded by one node version must decode on another, so the
+dataclasses that cross the fabric (`Shard*`, `TxVerification*`,
+session frames — everything `@ser.serializable` under `node/` and
+`flows/`) follow three statically checkable rules:
+
+  P1 `wiremsg-duplicate-definition` — one message name, one class.
+      The codec registry keys on the class NAME; a second definition
+      site either collides at import (raises) or silently shadows,
+      and either way two modules now own one wire tag.
+  P1 `wiremsg-not-frozen` — every message is a frozen dataclass.
+      Handlers capture messages by reference (redispatch queues,
+      journals); a mutable message mutated after encode diverges from
+      what the wire carried.
+  P1 `wiremsg-schema-break` / P2 `wiremsg-schema-append` /
+  P2 `wiremsg-unsnapshotted` — the field list is APPEND-ONLY vs the
+      committed WIREMSG_SCHEMA.json snapshot. Renaming, removing or
+      reordering a field breaks decode of in-flight/journaled frames
+      (a break); appending is the compatible evolution path but must
+      be recorded (regenerate with --write-wiremsg-schema in the same
+      PR, so the next reorder diffs against the new truth); a message
+      class absent from the snapshot entirely is new and needs its
+      row. A snapshot row whose class vanished is a break too — the
+      old end still sends it.
+
+The snapshot lives at `<root>/WIREMSG_SCHEMA.json`:
+    {"version": 1, "messages": {"ShardReserve": ["xid", ...], ...}}
+A missing snapshot degrades to the structural checks only (fixture
+trees).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from .facts import RepoFacts, WireMsg
+from .findings import P1, P2, Finding
+
+SCHEMA_FILE = "WIREMSG_SCHEMA.json"
+
+
+def _in_scope(msg: WireMsg) -> bool:
+    parts = msg.file.split("/")
+    return "node" in parts or "flows" in parts
+
+
+def scoped_messages(repo: RepoFacts) -> list:
+    return [m for m in repo.wire_msgs if _in_scope(m)]
+
+
+def load_schema(root: str) -> dict:
+    path = os.path.join(root, SCHEMA_FILE)
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    msgs = doc.get("messages", {}) if isinstance(doc, dict) else {}
+    return {
+        str(name): [str(fld) for fld in fields]
+        for name, fields in msgs.items()
+        if isinstance(fields, list)
+    }
+
+
+def write_schema(root: str, repo: RepoFacts) -> str:
+    """(Re)generate the snapshot from the scanned tree — the explicit
+    act that records a schema evolution."""
+    path = os.path.join(root, SCHEMA_FILE)
+    msgs = {
+        m.name: list(m.fields)
+        for m in sorted(scoped_messages(repo), key=lambda m: m.name)
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": 1, "messages": msgs}, f, indent=2)
+        f.write("\n")
+    return path
+
+
+def run(repo: RepoFacts) -> list[Finding]:
+    findings: list[Finding] = []
+    msgs = scoped_messages(repo)
+
+    by_name: dict[str, list] = {}
+    for m in msgs:
+        by_name.setdefault(m.name, []).append(m)
+
+    for name, defs in sorted(by_name.items()):
+        sites = {(m.file, m.line) for m in defs}
+        if len(sites) > 1:
+            first = defs[0]
+            findings.append(
+                Finding(
+                    "wiremsg",
+                    "wiremsg-duplicate-definition",
+                    P1,
+                    first.file,
+                    first.line,
+                    "",
+                    name,
+                    f"wire message {name!r} is defined at "
+                    f"{len(sites)} sites — one wire tag, several "
+                    "owners (the codec registry keys on the name)",
+                    [f"{f}:{line}" for f, line in sorted(sites)],
+                )
+            )
+        for m in defs:
+            if not (m.is_dataclass and m.frozen):
+                what = (
+                    "not a dataclass"
+                    if not m.is_dataclass
+                    else "a mutable dataclass"
+                )
+                findings.append(
+                    Finding(
+                        "wiremsg",
+                        "wiremsg-not-frozen",
+                        P1,
+                        m.file,
+                        m.line,
+                        "",
+                        m.name,
+                        f"wire message {m.name!r} is {what} — fabric "
+                        "messages must be @dataclass(frozen=True) so "
+                        "a frame captured by reference can never "
+                        "diverge from what the wire carried",
+                    )
+                )
+
+    schema = load_schema(repo.root)
+    if schema:
+        for name, defs in sorted(by_name.items()):
+            m = defs[0]
+            snap = schema.get(name)
+            if snap is None:
+                findings.append(
+                    Finding(
+                        "wiremsg",
+                        "wiremsg-unsnapshotted",
+                        P2,
+                        m.file,
+                        m.line,
+                        "",
+                        name,
+                        f"wire message {name!r} has no "
+                        f"{SCHEMA_FILE} row — new message: record it "
+                        "with --write-wiremsg-schema in this PR",
+                    )
+                )
+                continue
+            live = list(m.fields)
+            if live[: len(snap)] != snap:
+                findings.append(
+                    Finding(
+                        "wiremsg",
+                        "wiremsg-schema-break",
+                        P1,
+                        m.file,
+                        m.line,
+                        "",
+                        name,
+                        f"wire message {name!r} field list "
+                        f"{live} is not an append-only extension of "
+                        f"the committed snapshot {snap} — renaming, "
+                        "removing or reordering fields breaks decode "
+                        "of in-flight and journaled frames",
+                    )
+                )
+            elif len(live) > len(snap):
+                added = live[len(snap):]
+                findings.append(
+                    Finding(
+                        "wiremsg",
+                        "wiremsg-schema-append",
+                        P2,
+                        m.file,
+                        m.line,
+                        "",
+                        f"{name}:+{','.join(added)}",
+                        f"wire message {name!r} appended "
+                        f"{added} — compatible, but regenerate "
+                        f"{SCHEMA_FILE} in this PR so the next diff "
+                        "runs against the new truth",
+                    )
+                )
+        for name in sorted(set(schema) - set(by_name)):
+            findings.append(
+                Finding(
+                    "wiremsg",
+                    "wiremsg-schema-break",
+                    P1,
+                    SCHEMA_FILE,
+                    0,
+                    "",
+                    name,
+                    f"wire message {name!r} is in the committed "
+                    "snapshot but no longer defined under "
+                    "node//flows/ — the old end still sends it; "
+                    "deletion is a wire-compat break (regenerate the "
+                    "snapshot only once no deployed end speaks it)",
+                )
+            )
+    return findings
