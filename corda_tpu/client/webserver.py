@@ -17,7 +17,9 @@ mapping), one gateway process (or thread) per node.
 Operational endpoints (wired per gateway): /metrics (Prometheus text),
 /traces (flight recorder), /qos (overload control plane), /healthz
 (orchestrator liveness, 200/503 from watchdog state), /health (full
-health-plane JSON), /cluster (fleet-wide health rollup). Every
+health-plane JSON), /cluster (fleet-wide health rollup), /device
+(per-device HBM/busy/queue/transfer telemetry) and /capacity (the
+roofline capacity model naming the binding constraint). Every
 response carries an explicit Content-Type — text/plain for /metrics,
 application/json everywhere else — and unknown paths (any method) get
 a JSON 404 body, never the http.server default stub.
@@ -123,6 +125,7 @@ class NodeWebServer:
         shards=None,
         txstory=None,
         cluster_tx=None,
+        device=None,
     ):
         """`metrics`: an optional MetricRegistry served at GET /metrics
         in prometheus exposition format (the reference exports
@@ -185,6 +188,17 @@ class NodeWebServer:
         clock-shifted onto one axis); `?local=1` serves this member's
         story alone (the peer-pull form).
 
+        `device`: an optional utils/device_telemetry.DevicePlane —
+        GET /device serves the per-device telemetry snapshot (HBM
+        occupancy + live-buffer census, windowed busy fraction,
+        dispatch-queue depth/wait, transfer bandwidth, the degraded-
+        fallback bridge) and GET /capacity the roofline capacity
+        model: per-resource ceilings + headroom for the notary line
+        with the binding constraint NAMED (host_pump |
+        device_compute | transfer | commit_plane);
+        `?what_if=shards:8,devices:4` substitutes model knobs for
+        planning the GIL escape and the next device round.
+
         Every operational endpoint honours `?ts=1`: the payload gains
         a shared process-monotonic `ts_micros` stamp (a trailing
         `# ts_micros` comment on /metrics text), so cross-endpoint
@@ -204,6 +218,7 @@ class NodeWebServer:
         self.shards = shards
         self.txstory = txstory
         self.cluster_tx = cluster_tx
+        self.device = device
         # serializes /profile on-demand captures and resets: without
         # it a second ?seconds=N request returns a partial table and
         # a concurrent ?reset=1 wipes an in-flight capture
@@ -248,6 +263,18 @@ class NodeWebServer:
             "/cluster": (
                 "fleet-wide health rollup over the network-map peers",
                 self._serve_cluster,
+            ),
+            "/device": (
+                "per-device telemetry: HBM occupancy + live buffers, "
+                "busy fraction, dispatch queue depth/wait, transfer "
+                "bandwidth, degraded-fallback bridge",
+                self._serve_device,
+            ),
+            "/capacity": (
+                "roofline capacity model: per-resource ceiling + "
+                "headroom for the notary line, binding constraint "
+                "named (?what_if=shards:8 substitutes knobs)",
+                self._serve_capacity,
             ),
             "/perf": (
                 "performance attribution: kernel compile/execute "
@@ -365,6 +392,7 @@ class NodeWebServer:
             "/health": self.health, "/cluster": self.cluster,
             "/perf": self.perf, "/profile": self.perf,
             "/incidents": self.incidents, "/shards": self.shards,
+            "/device": self.device, "/capacity": self.device,
         }
         rows = [
             {
@@ -642,6 +670,53 @@ class NodeWebServer:
             return self._json(200, self.cluster.snapshot())
         except Exception as e:   # noqa: BLE001 - defensive render
             return self._json(500, {"error": f"cluster rollup failed: {e}"})
+
+    def _serve_device(self, query) -> tuple[int, str, bytes]:
+        # per-device telemetry: HBM occupancy (absent-not-fatal on
+        # CPU backends — the hbm section reads null), windowed busy
+        # fraction and queue depth/wait per chip, transfer bandwidth,
+        # and the degraded-fallback bridge — the chips' side of the
+        # story every host-facing plane so far left invisible
+        try:
+            if self.device is None:
+                return self._json(
+                    404,
+                    {"error": "device telemetry not wired on this "
+                              "gateway"},
+                )
+            return self._json(200, self.device.snapshot())
+        except Exception as e:   # noqa: BLE001 - defensive render
+            return self._json(
+                500, {"error": f"device snapshot failed: {e}"}
+            )
+
+    def _serve_capacity(self, query) -> tuple[int, str, bytes]:
+        # the roofline answer: which resource binds the notary line
+        # next (host_pump | device_compute | transfer | commit_plane),
+        # per-resource ceilings + headroom, one operator-readable
+        # sentence. ?what_if=shards:8,devices:4 substitutes model
+        # knobs for planning the GIL escape / the next device round.
+        from ..utils import device_telemetry as devlib
+
+        try:
+            if self.device is None:
+                return self._json(
+                    404,
+                    {"error": "device telemetry not wired on this "
+                              "gateway"},
+                )
+            what_if_text = query.get("what_if", [None])[0]
+            what_if = None
+            if what_if_text:
+                try:
+                    what_if = devlib.parse_what_if(what_if_text)
+                except ValueError as e:
+                    return self._json(400, {"error": str(e)})
+            return self._json(200, self.device.capacity(what_if))
+        except Exception as e:   # noqa: BLE001 - defensive render
+            return self._json(
+                500, {"error": f"capacity model failed: {e}"}
+            )
 
     def _serve_perf(self, query) -> tuple[int, str, bytes]:
         # the attribution snapshot: /metrics tells you THAT serving

@@ -30,6 +30,7 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from ..parallel import mesh as meshlib
+from ..utils import device_telemetry as devlib
 from ..utils import perf as perflib
 from ..utils import tracing
 from . import encodings, schemes
@@ -183,6 +184,12 @@ class TpuBatchVerifier(BatchSignatureVerifier):
         # keyed on the shared ledger it would masquerade as a
         # multi-second "execute" and dodge the retrace counter
         self._warm_shapes: set = set()
+        # per-DEVICE attribution key (utils/device_telemetry): the
+        # pinned device's id, or the default device's, resolved lazily
+        # (jax.devices() initialises the backend); -1 marks a mesh
+        # dispatch — one data-parallel program over every mesh device,
+        # not attributable to a single chip
+        self._device_id: Optional[int] = None
         del donate  # reserved
         # the EC ladder kernels cost 20-350 s to compile per (scheme,
         # batch, backend); every process constructing this verifier
@@ -269,6 +276,19 @@ class TpuBatchVerifier(BatchSignatureVerifier):
                 return b
         return self.batch_sizes[-1]
 
+    def _dispatch_device_id(self) -> int:
+        if self._device_id is None:
+            if self.device is not None:
+                self._device_id = int(getattr(self.device, "id", 0))
+            elif self.mesh is not None:
+                self._device_id = -1
+            else:
+                try:
+                    self._device_id = int(jax.devices()[0].id)
+                except Exception:
+                    self._device_id = 0
+        return self._device_id
+
     def _dispatch(self, scheme_id: int, items: list, idxs) -> list:
         """Stage + launch one scheme bucket, chunking at the largest
         batch size. Returns [(device_result, idxs_slice, n)] WITHOUT
@@ -278,6 +298,9 @@ class TpuBatchVerifier(BatchSignatureVerifier):
         verify_batch."""
         max_b = self.batch_sizes[-1]
         pending = []
+        t_entry = time.perf_counter()
+        dev_id = self._dispatch_device_id()
+        devacct = devlib.get_device_accounting()
         for off in range(0, len(items), max_b):
             chunk = items[off : off + max_b]
             batch = self._pick_batch(len(chunk))
@@ -321,23 +344,26 @@ class TpuBatchVerifier(BatchSignatureVerifier):
                     )
                     for k, v in staged.items()
                 }
-            elif self.device is not None:
-                # per-device dispatch (sharded notary): commit the
-                # operands to THIS verifier's device so the jitted
-                # program executes there — N shard pipelines then keep
-                # N chips busy concurrently instead of queueing on the
-                # default device. The explicit transfer is timed into
-                # the accounting (device_put is where the link cost is
-                # visible to the host on this path).
+            else:
+                # commit the operands to the dispatch device — THIS
+                # verifier's pinned chip (sharded notary: N shard
+                # pipelines keep N chips busy concurrently instead of
+                # queueing on the default device), or the default
+                # device on an unpinned verifier. The explicit
+                # transfer is timed into the accounting EITHER way:
+                # device_put is where the link cost is visible to the
+                # host, and the old unpinned path (implicit transfer
+                # inside the jit call) recorded transfer bytes with
+                # zero transfer seconds, so single-device rigs
+                # reported a transfer_bytes_per_sec that lied.
                 t_put = time.perf_counter()
                 staged = {
                     k: jax.device_put(v, self.device)
                     for k, v in staged.items()
                 }
-                acct.record_transfer(
-                    scheme_id, batch, nbytes,
-                    time.perf_counter() - t_put,
-                )
+                put_s = time.perf_counter() - t_put
+                acct.record_transfer(scheme_id, batch, nbytes, put_s)
+                devacct.record_transfer(dev_id, nbytes, put_s)
                 nbytes = 0   # charged above, not again on the call row
             # TraceAnnotation (null context off-jax-profiler): names
             # this kernel launch in an XLA profiler capture so the
@@ -349,9 +375,19 @@ class TpuBatchVerifier(BatchSignatureVerifier):
             ):
                 res = self._kernel(scheme_id, batch)(**staged)
             self._warm_shapes.add((scheme_id, batch))
+            call_s = time.perf_counter() - t_call
             acct.record_call(
-                scheme_id, batch, time.perf_counter() - t_call,
+                scheme_id, batch, call_s,
                 first=first, transfer_bytes=nbytes,
+            )
+            # per-device attribution: the launch wall as device busy
+            # (the windowed busy-fraction feed) and the host-side
+            # dispatch-queue wait — wall from bucket entry to this
+            # chunk's launch, the serialization a chunk pays behind
+            # earlier chunks' staging + launches on the same device
+            devacct.record_dispatch(
+                dev_id, len(chunk), call_s,
+                queue_wait_seconds=t_call - t_entry,
             )
             pending.append((res, idxs[off : off + len(chunk)], len(chunk)))
         return pending

@@ -144,6 +144,15 @@ class NodeConfig:
     # throughput history against ("notarisations/s regressed 12% vs
     # BENCH_r06" without an offline bench run); empty = no baseline
     perf_baseline: str = ""
+    # device telemetry & capacity-attribution plane (utils/
+    # device_telemetry.py): per-device HBM/busy/queue/transfer
+    # telemetry at GET /device, the roofline capacity model naming the
+    # binding constraint at GET /capacity, Device.<k>.* gauges on
+    # /metrics and the device.hbm_pressure / device.fallback_active /
+    # device.utilization_collapse health rules. On by default —
+    # passive counters plus one sampler pass per pump second; on CPU
+    # backends memory stats degrade to null, never a failure.
+    device_telemetry_enabled: bool = True
     # transaction provenance plane (utils/txstory.py): the per-tx
     # lifecycle ledger behind GET /tx/<id> + /tx/slowest and the
     # Tx.Stage.* histograms. On by default — bounded memory, one lock
@@ -468,6 +477,8 @@ def write_config(cfg: NodeConfig, path: str) -> None:
             emit("qos_admission_burst", cfg.qos_admission_burst)
     if not cfg.perf_enabled:
         emit("perf_enabled", cfg.perf_enabled)
+    if not cfg.device_telemetry_enabled:
+        emit("device_telemetry_enabled", cfg.device_telemetry_enabled)
     if cfg.perf_profile_hz:
         emit("perf_profile_hz", cfg.perf_profile_hz)
     if cfg.perf_baseline:

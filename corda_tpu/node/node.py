@@ -347,6 +347,27 @@ class Node:
             rng=random.Random(self._dev_seed("smm", per_boot=True)),
         )
         self._install_notary()
+        # device telemetry & capacity attribution (utils/
+        # device_telemetry.py): per-device HBM/busy/queue/transfer
+        # sampling over jax.local_devices() fed by the process device
+        # accounting every TpuBatchVerifier records into, plus the
+        # roofline capacity model naming the binding constraint —
+        # served at GET /device + /capacity. Built AFTER the notary so
+        # attach_device can map shard queues onto pinned devices and
+        # bridge the degraded-mode flag.
+        self.device_plane = None
+        if config.device_telemetry_enabled:
+            from ..utils.device_telemetry import DevicePlane
+
+            self.device_plane = DevicePlane(
+                clock=self.services.clock,
+                metrics=self.metrics,
+                perf=self.perf,
+            )
+            notary = getattr(self.services, "notary_service", None)
+            if isinstance(notary, BatchingNotaryService):
+                notary.attach_device(self.device_plane)
+            self.health.watch_device(self.device_plane)
         self.scheduler = NodeSchedulerService(self.services, self.smm.start_flow)
 
         # -- verifier offload ------------------------------------------
@@ -979,6 +1000,11 @@ class Node:
             # history sampling rides the same cadence (self-throttled
             # to the perf policy's sample gap)
             self.perf.tick()
+        if self.device_plane is not None:
+            # device telemetry sampling too (self-throttled alike) —
+            # after health.tick so rules judge last-sample state and
+            # this tick's sample serves the NEXT walk
+            self.device_plane.tick()
 
     def run(self) -> None:
         """The pump loop — the single server thread (Node.kt:344)."""
@@ -1059,7 +1085,9 @@ class Node:
         /traces, the QoS plane (when enabled) at /qos, the health
         plane at /healthz + /health, the fleet rollup at /cluster,
         the perf-attribution plane at /perf (+ folded profiler stacks
-        at /profile), plus the ledger explorer UI at /web/explorer/. The node's pump
+        at /profile), the device-telemetry plane at /device + the
+        capacity model at /capacity, plus the ledger explorer UI at
+        /web/explorer/. The node's pump
         loop (run()) drives message delivery, so the gateway itself
         only polls futures (pass a real pump when embedding without
         run())."""
@@ -1088,6 +1116,7 @@ class Node:
             shards=getattr(self, "xshard", None),
             txstory=self.txstory,
             cluster_tx=self.cluster_tx,
+            device=self.device_plane,
         )
 
 

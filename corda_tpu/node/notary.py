@@ -1467,6 +1467,41 @@ class BatchingNotaryService(NotaryService):
             lambda: self._requests_counter.count,
         )
 
+    def backlog(self) -> int:
+        """Live pending depth across the commit plane (all shards, or
+        the single queue) — the device plane's starvation signal and
+        the fleet rigs' public depth read."""
+        if self._shards is not None:
+            return sum(shard.depth() for shard in self._shards)
+        return len(self._pending)
+
+    def attach_device(self, plane) -> None:
+        """Wire the device-telemetry plane (utils/device_telemetry):
+        per-shard pending-queue depths mapped onto the devices their
+        verifiers pin to (the per-device dispatch-queue feed), and the
+        round-9 degraded-mode flag bridged as `device.fallback_active`
+        evidence. The notary holds no reference back — the plane reads
+        THROUGH the registered lambdas — so None is simply a no-op
+        (re-attach a different notary to repoint a plane)."""
+        if plane is None:
+            return
+        if self._shards is not None:
+            plane.attach_queues(
+                [(lambda s=shard: s.depth()) for shard in self._shards],
+                [
+                    getattr(
+                        getattr(shard.verifier, "device", None),
+                        "id", None,
+                    )
+                    for shard in self._shards
+                ],
+            )
+        else:
+            plane.attach_queues([lambda: len(self._pending)], [None])
+        plane.watch_fallback(
+            lambda: self.degraded, lambda: self.degraded_evidence
+        )
+
     def _drain_ingest(self) -> None:
         ring = self._ingest_ring
         if ring is None:

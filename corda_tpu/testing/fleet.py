@@ -738,6 +738,10 @@ class FleetReport:
     verify_workers_lost: int = 0
     device_faults: int = 0
     degraded_flushes: int = 0
+    # round-15 device plane: the end-of-run GET /device-shaped
+    # snapshot from the member plane (None when the fault arc never
+    # built one) — the telemetry side of the device_fault story
+    device_telemetry: Any = None
     # round-11 tracing plane: per-member tracers, the cross-node
     # assembler and the incident recorder (None when not enabled)
     tracers: dict = field(default_factory=dict)
@@ -1114,6 +1118,7 @@ class FleetSim:
             for e in self.chaos.events
         )
         self.device_injector = None
+        self.device_plane = None
         self.intent_journal = None
         self.verify_pool = None
         self._verify_workers: list = []
@@ -1147,6 +1152,35 @@ class FleetSim:
             # member's monitor, so kill/device faults show in the same
             # healthz/alert story the checker reconciles
             svc.attach_health(self.monitors[notary.name])
+            # device-telemetry plane (round 15): the fleet reads the
+            # plane the production node serves at GET /device, so the
+            # device_fault chaos events assert the TELEMETRY story too
+            # — device.fallback_active fires with device evidence
+            # while the degraded flush serves off the CPU reference,
+            # and resolves when the recovery probe re-arms the chip.
+            # Lambdas read THROUGH to the current notary service: a
+            # kill/restart replaces the service object under the same
+            # plane.
+            from ..utils.device_telemetry import (
+                DevicePlane,
+                DevicePolicy,
+            )
+
+            self.device_plane = DevicePlane(
+                clock=self.net.clock,
+                policy=DevicePolicy(
+                    sample_gap_micros=0, live_buffer_census=False
+                ),
+                install_default_accounting=False,
+            )
+            self.device_plane.attach_queues(
+                [lambda: self._notary_service().backlog()], [None]
+            )
+            self.device_plane.watch_fallback(
+                lambda: self._notary_service().degraded,
+                lambda: self._notary_service().degraded_evidence,
+            )
+            self.monitors[notary.name].watch_device(self.device_plane)
             if verifier_pool:
                 from ..crypto.batch_verifier import CpuBatchVerifier
                 from ..node.verifier import (
@@ -1429,6 +1463,12 @@ class FleetSim:
         self._beats[node.name].beat()
 
     # -- round-9 fault-plane actions ------------------------------------------
+
+    def _notary_service(self):
+        """The CURRENT batching notary service — read through on every
+        call, so the device plane's fallback/backlog lambdas survive a
+        kill_notary/restart_notary swap of the service object."""
+        return self.members[0].services.notary_service
 
     def _worker_name(self, idx: int) -> str:
         return f"fleet-verifier-w{idx}"
@@ -1796,6 +1836,12 @@ class FleetSim:
         for name, hb in self._beats.items():
             if self.alive[name] and name not in self.frozen:
                 hb.beat(progress=1)
+        if self.device_plane is not None and (
+            self.alive[self.members[0].name] and not self._notary_down
+        ):
+            # sample BEFORE the monitor walk so the device rules judge
+            # this round's state (sample_gap 0: every round samples)
+            self.device_plane.tick()
         for name, mon in self.monitors.items():
             if self.alive[name]:
                 mon.tick()
@@ -1916,6 +1962,10 @@ class FleetSim:
                 self._degraded_flushes_base
                 + _metric_count(svc.metrics, "Notary.DegradedFlushes")
                 if self.flavour == "batching" else 0
+            ),
+            device_telemetry=(
+                self.device_plane.snapshot()
+                if self.device_plane is not None else None
             ),
             tracers=dict(self.tracers),
             cluster_traces=self.cluster_traces,
@@ -2266,6 +2316,33 @@ class InvariantChecker:
                     f"resolved (the recovery probe is not re-arming "
                     f"the device path)"
                 )
+                # round 15: the device-telemetry plane must tell the
+                # SAME story — device.fallback_active bridges the
+                # degraded gauge with device evidence, fires while the
+                # flushes serve off the CPU reference, and resolves
+                # with the probe
+                dev_alert = self._alert_of(
+                    victim, "device.fallback_active"
+                )
+                if dev_alert is not None:
+                    assert dev_alert["fire_count"] >= 1, (
+                        f"{entry['name']}: device.fallback_active "
+                        f"never fired while the notary served "
+                        f"degraded flushes"
+                    )
+                    assert dev_alert["state"] != "firing", (
+                        f"{entry['name']}: device.fallback_active "
+                        f"never resolved after the device path "
+                        f"recovered"
+                    )
+                if self.report.device_telemetry is not None:
+                    assert not self.report.device_telemetry[
+                        "fallback_active"
+                    ], (
+                        f"{entry['name']}: the device plane still "
+                        f"reports fallback_active at the end of the "
+                        f"soak"
+                    )
             elif entry["kind"] == "kill_verifier":
                 alert = self._alert_of(victim, "verifier.pool_degraded")
                 assert alert is not None and alert["fire_count"] >= 1, (

@@ -737,6 +737,17 @@ class HealthMonitor:
         telemetry, this monitor owns the alert walks + evidence)."""
         perf.install_rules(self)
 
+    def watch_device(self, plane) -> None:
+        """Install the device-telemetry rules over a
+        utils/device_telemetry.DevicePlane: `device.hbm_pressure`
+        (sustained HBM occupancy over threshold),
+        `device.fallback_active` (PR 9's degraded-mode gauge bridged
+        with device evidence) and `device.utilization_collapse` (busy
+        fraction dropping while the backlog grows — the pump starved
+        the chip). The plane owns the telemetry, this monitor the
+        alert walks + evidence (the watch_perf pattern)."""
+        plane.install_rules(self)
+
     def watch_txstory(
         self, story, targets: dict, window_micros=None
     ) -> None:

@@ -685,6 +685,31 @@ class PerfHistory:
         return out
 
 
+def flush_phase_seconds(metrics: MetricRegistry) -> dict[str, dict]:
+    """count / total_s / mean_s per `Notary.FlushPhase.*` timer on a
+    registry. ONE reader for the flush phase truth: PerfPlane's
+    host-stage attribution and the device plane's capacity model
+    (utils/device_telemetry) both consume this, so the roofline's
+    host-pump input can never drift from what GET /perf displays."""
+    from . import metrics as mlib
+
+    out: dict[str, dict] = {}
+    prefix = "Notary.FlushPhase."
+    for name in metrics.names():
+        if not name.startswith(prefix):
+            continue
+        m = metrics.get(name)
+        if not isinstance(m, mlib.Timer):
+            continue
+        h = m.histogram
+        out[name[len(prefix):]] = {
+            "count": h.count,
+            "total_s": h.sum,
+            "mean_s": h.mean,
+        }
+    return out
+
+
 def parse_bench_record(path: str) -> dict[str, dict]:
     """metric name -> record from one committed BENCH_r*.json (the
     driver capture shape: per-metric JSON lines inside the `tail`
@@ -1051,21 +1076,12 @@ class PerfPlane:
     def _host_stages(self) -> dict:
         """The host-side stage attribution: the notary's flush phase
         timers (shared registry) plus the ingest stage accumulators."""
-        from . import metrics as mlib
-
         out: dict[str, dict] = {}
-        prefix = "Notary.FlushPhase."
-        for name in self.metrics.names():
-            if not name.startswith(prefix):
-                continue
-            m = self.metrics.get(name)
-            if not isinstance(m, mlib.Timer):
-                continue
-            h = m.histogram
-            out[name[len(prefix):]] = {
-                "count": h.count,
-                "total_s": round(h.sum, 6),
-                "mean_s": round(h.mean, 6),
+        for stage, row in flush_phase_seconds(self.metrics).items():
+            out[stage] = {
+                "count": row["count"],
+                "total_s": round(row["total_s"], 6),
+                "mean_s": round(row["mean_s"], 6),
             }
         with self._ingest_lock:
             for stage, total in self._ingest_stage_s.items():

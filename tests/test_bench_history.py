@@ -372,3 +372,116 @@ def test_committed_trajectory_passes_regression_gate():
         "see the GATE lines above; either reclaim the metric or record "
         "why the regression is accepted"
     )
+
+
+def test_environment_change_waives_delta_gate_but_not_verdicts(
+    tmp_path, capsys
+):
+    """Round 15: bench stamps an `environment` block into every metric
+    line; when the newest two records' environments DIFFER (the CPU
+    container vs the coming device round), a throughput delta measures
+    the rig, not the code — the gate WARNS and annotates instead of
+    failing. Required-true verdict rows still gate: a soak that
+    stopped reconciling is broken on any backend."""
+    cpu_env = {"jax": "0.4.37", "backend": "cpu", "device_kind": "cpu",
+               "device_count": 1, "cpu_count": 8}
+    tpu_env = dict(cpu_env, backend="tpu", device_kind="TPU v5e",
+                   device_count=4)
+    _write_record(
+        tmp_path, "BENCH_r01.json", 1,
+        [
+            _metric("batching_notary_notarisations_per_sec", 41_500.0,
+                    0.83, environment=cpu_env),
+            _metric("fleet_soak_goodput_per_sec", 9_000.0, 1.0,
+                    environment=cpu_env, reconciled=True,
+                    gate_required_true=["reconciled"]),
+        ],
+    )
+    _write_record(
+        tmp_path, "BENCH_r02.json", 2,
+        [
+            # a 40% "regression" — but on a different backend
+            _metric("batching_notary_notarisations_per_sec", 25_000.0,
+                    0.5, environment=tpu_env),
+            _metric("fleet_soak_goodput_per_sec", 9_500.0, 1.0,
+                    environment=tpu_env, reconciled=True,
+                    gate_required_true=["reconciled"]),
+        ],
+    )
+    # the delta regression is WAIVED (warn + annotate), exit 0
+    assert bh.main(["--dir", str(tmp_path), "--gate", "10"]) == 0
+    err = capsys.readouterr().err
+    assert "WARN" in err and "environment changed" in err
+    assert "backend: cpu -> tpu" in err
+
+    # the same delta with IDENTICAL environments still gates
+    _write_record(
+        tmp_path, "BENCH_r02.json", 2,
+        [_metric("batching_notary_notarisations_per_sec", 25_000.0,
+                 0.5, environment=cpu_env)],
+    )
+    assert bh.main(["--dir", str(tmp_path), "--gate", "10"]) == 1
+    capsys.readouterr()
+
+    # a stamped round following an UNSTAMPED one (the committed
+    # r01-r06 trajectory predates the stamp) cannot claim same-rig
+    # either: the first cross-rig round after this PR must not
+    # hard-gate — the exact false failure the feature prevents
+    _write_record(
+        tmp_path, "BENCH_r01.json", 1,
+        [_metric("batching_notary_notarisations_per_sec", 41_500.0,
+                 0.83)],                       # no environment block
+    )
+    _write_record(
+        tmp_path, "BENCH_r02.json", 2,
+        [_metric("batching_notary_notarisations_per_sec", 25_000.0,
+                 0.5, environment=tpu_env)],
+    )
+    assert bh.main(["--dir", str(tmp_path), "--gate", "10"]) == 0
+    assert "WARN" in capsys.readouterr().err
+
+    # two unstamped records keep the plain gate (no rig evidence)
+    _write_record(
+        tmp_path, "BENCH_r02.json", 2,
+        [_metric("batching_notary_notarisations_per_sec", 25_000.0,
+                 0.5)],
+    )
+    assert bh.main(["--dir", str(tmp_path), "--gate", "10"]) == 1
+    capsys.readouterr()
+
+    # a falsy required-true verdict gates THROUGH an environment change
+    _write_record(
+        tmp_path, "BENCH_r02.json", 2,
+        [
+            _metric("fleet_soak_goodput_per_sec", 9_500.0, 1.0,
+                    environment=tpu_env, reconciled=False,
+                    gate_required_true=["reconciled"]),
+        ],
+    )
+    assert bh.main(["--dir", str(tmp_path), "--gate", "10"]) == 1
+
+
+def test_environment_annotation_lands_in_json_output(tmp_path, capsys):
+    env_a = {"backend": "cpu", "device_count": 1}
+    env_b = {"backend": "tpu", "device_count": 4}
+    _write_record(
+        tmp_path, "BENCH_r01.json", 1,
+        [_metric("m", 100.0, environment=env_a)],
+    )
+    _write_record(
+        tmp_path, "BENCH_r02.json", 2,
+        [_metric("m", 50.0, environment=env_b)],
+    )
+    assert bh.main(
+        ["--dir", str(tmp_path), "--gate", "10", "--json"]
+    ) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["environment_changed"] == {
+        "backend": "cpu -> tpu", "device_count": "1 -> 4",
+    }
+    assert doc["gate_failures"] == []
+    waived = doc["gate_waived_environment_change"]
+    assert len(waived) == 1 and waived[0]["metric"] == "m"
+    assert waived[0]["waived_environment_change"]["backend"] == (
+        "cpu -> tpu"
+    )

@@ -30,6 +30,15 @@ gate whenever the newest record carries it falsy — a soak that stops
 reconciling fails CI no matter what its goodput headline says. A
 metric missing from the newest round is reported but never gates (a
 trimmed or skipped secondary is a budget decision, not a regression).
+
+Environment awareness (round 15): bench.py stamps an `environment`
+block (jax version, backend platform, device kind + count, cpu count)
+into every metric line. When the newest two records' environments
+DIFFER — the CPU-container round vs a device round — a throughput
+delta measures the rig, not the code, so `--gate` downgrades
+delta-based regressions to WARN-and-annotate instead of failing.
+Required-true verdict rows still gate: a soak that stopped
+reconciling is broken on any backend.
 """
 
 from __future__ import annotations
@@ -90,6 +99,26 @@ def parse_record(path: str) -> dict[str, dict]:
     if isinstance(parsed, dict) and "metric" in parsed and "value" in parsed:
         metrics.setdefault(parsed["metric"], parsed)
     return metrics
+
+
+def environment_of(metrics: dict[str, dict]) -> Optional[dict]:
+    """The `environment` block bench.py stamps into each metric line
+    (identical within a round — the first one found wins); None on
+    records from rounds before the stamp existed."""
+    for rec in metrics.values():
+        env = rec.get("environment")
+        if isinstance(env, dict):
+            return env
+    return None
+
+
+def environment_delta(old: dict, new: dict) -> dict:
+    """{key: "old -> new"} for every environment key that differs."""
+    out = {}
+    for key in sorted(set(old) | set(new)):
+        if old.get(key) != new.get(key):
+            out[key] = f"{old.get(key)} -> {new.get(key)}"
+    return out
 
 
 # nested dict-valued record keys that diff per-entry in the
@@ -300,10 +329,44 @@ def main(argv: Optional[list[str]] = None) -> int:
             })
         else:
             print(format_rows(newest_rows, old_label, new_label))
+    # environment drift between the newest pair: a delta-based
+    # regression on a DIFFERENT rig (cpu container vs device round)
+    # is annotated, not gated — required-true verdicts still gate.
+    # A record from before the stamp existed (every round up to r06)
+    # compares as an EMPTY environment: the first stamped round after
+    # an unstamped one cannot claim same-rig either, so it waives too
+    # — hard-gating the first cross-rig round is the exact false
+    # failure this exists to prevent. Two unstamped records keep the
+    # plain gate (no evidence either way).
+    env_old = environment_of(records[-2][1])
+    env_new = environment_of(records[-1][1])
+    env_delta: dict = {}
+    if env_old is not None or env_new is not None:
+        env_delta = environment_delta(env_old or {}, env_new or {})
     bad: list[dict] = []
+    waived: list[dict] = []
     if args.gate is not None:
         bad = gate_failures(newest_rows, args.gate)
+        if env_delta:
+            waived = [r for r in bad if r.get("better") != "required"]
+            bad = [r for r in bad if r.get("better") == "required"]
+            for r in waived:
+                r["waived_environment_change"] = env_delta
         if not args.json:
+            for r in waived:
+                moved = (
+                    f"{r['delta_pct']}%" if r["delta_pct"] is not None
+                    # zero-growth-floor rows have no defined percent:
+                    # state the absolute move instead of "None%"
+                    else f"{r['old']:g} -> {r['new']:g}"
+                )
+                print(
+                    f"bench_history: WARN {r['metric']} moved "
+                    f"{moved} but the environment changed "
+                    f"({'; '.join(f'{k}: {v}' for k, v in env_delta.items())})"
+                    f" — not gating a cross-rig delta",
+                    file=sys.stderr,
+                )
             for r in bad:
                 print(
                     f"bench_history: GATE {r['metric']} regressed "
@@ -322,6 +385,8 @@ def main(argv: Optional[list[str]] = None) -> int:
             "pairs": json_pairs,
             "gate_pct": args.gate,
             "gate_failures": bad,
+            "environment_changed": env_delta or None,
+            "gate_waived_environment_change": waived,
         }, indent=2))
     if bad:
         return 1
