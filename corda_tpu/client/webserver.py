@@ -18,8 +18,10 @@ Operational endpoints (wired per gateway): /metrics (Prometheus text),
 /traces (flight recorder), /qos (overload control plane), /healthz
 (orchestrator liveness, 200/503 from watchdog state), /health (full
 health-plane JSON), /cluster (fleet-wide health rollup), /device
-(per-device HBM/busy/queue/transfer telemetry) and /capacity (the
-roofline capacity model naming the binding constraint). Every
+(per-device HBM/busy/queue/transfer telemetry), /capacity (the
+roofline capacity model naming the binding constraint) and /wire
+(per-link fabric accounting, codec cost attribution, gateway request
+accounting). Every
 response carries an explicit Content-Type — text/plain for /metrics,
 application/json everywhere else — and unknown paths (any method) get
 a JSON 404 body, never the http.server default stub.
@@ -28,6 +30,7 @@ a JSON 404 body, never the http.server default stub.
 from __future__ import annotations
 
 import json
+import logging
 import threading
 from ..utils import locks
 import time
@@ -126,6 +129,8 @@ class NodeWebServer:
         txstory=None,
         cluster_tx=None,
         device=None,
+        wire=None,
+        slow_request_micros: int = 50_000,
     ):
         """`metrics`: an optional MetricRegistry served at GET /metrics
         in prometheus exposition format (the reference exports
@@ -199,6 +204,22 @@ class NodeWebServer:
         `?what_if=shards:8,devices:4` substitutes model knobs for
         planning the GIL escape and the next device round.
 
+        `wire`: an optional utils/wire_telemetry.WirePlane — GET /wire
+        serves the wire-telemetry snapshot (per-link frame/byte rates
+        per peer and topic, CTS codec cost attribution split native
+        vs pure-Python, journal append/commit latency quantiles,
+        redelivery + dedupe-table depth, per-peer unacked backlog with
+        high-water marks, and per-endpoint gateway request
+        accounting). Every request through this gateway — whatever
+        the outcome — records its endpoint label, handler wall and
+        bytes served into the plane, which windows them into
+        requests/s and the measured pump-time-stolen fraction.
+
+        `slow_request_micros`: handlers slower than this log a
+        WARNING with endpoint + duration (0 disables) — gateway
+        requests that steal pump time are visible in the log before
+        the wire plane is even queried.
+
         Every operational endpoint honours `?ts=1`: the payload gains
         a shared process-monotonic `ts_micros` stamp (a trailing
         `# ts_micros` comment on /metrics text), so cross-endpoint
@@ -219,6 +240,8 @@ class NodeWebServer:
         self.txstory = txstory
         self.cluster_tx = cluster_tx
         self.device = device
+        self.wire = wire
+        self.slow_request_micros = int(slow_request_micros)
         # serializes /profile on-demand captures and resets: without
         # it a second ?seconds=N request returns a partial table and
         # a concurrent ?reset=1 wipes an in-flight capture
@@ -275,6 +298,13 @@ class NodeWebServer:
                 "headroom for the notary line, binding constraint "
                 "named (?what_if=shards:8 substitutes knobs)",
                 self._serve_capacity,
+            ),
+            "/wire": (
+                "wire & gateway telemetry: per-link frame/byte rates, "
+                "codec cost attribution (native vs python CTS), "
+                "journal latency quantiles, redelivery/dedupe/backlog, "
+                "per-endpoint gateway accounting",
+                self._serve_wire,
             ),
             "/perf": (
                 "performance attribution: kernel compile/execute "
@@ -343,6 +373,10 @@ class NodeWebServer:
 
     @staticmethod
     def _send(req, status: int, ctype: str, payload: bytes) -> None:
+        # bytes-served tally for the gateway accounting wrapper: every
+        # response path funnels through here, so the per-request stash
+        # on the handler object can never miss a body
+        req._bytes_served = getattr(req, "_bytes_served", 0) + len(payload)
         req.send_response(status)
         req.send_header("Content-Type", ctype)
         req.send_header("Content-Length", str(len(payload)))
@@ -393,6 +427,7 @@ class NodeWebServer:
             "/perf": self.perf, "/profile": self.perf,
             "/incidents": self.incidents, "/shards": self.shards,
             "/device": self.device, "/capacity": self.device,
+            "/wire": self.wire,
         }
         rows = [
             {
@@ -718,6 +753,22 @@ class NodeWebServer:
                 500, {"error": f"capacity model failed: {e}"}
             )
 
+    def _serve_wire(self, query) -> tuple[int, str, bytes]:
+        # the wire's side of the story: what the fabric's per-frame
+        # encode/decode + journal writes cost (split by codec path —
+        # the native rewrite's exact prize), which links carry the
+        # bytes, and what this gateway itself steals from the pump
+        try:
+            if self.wire is None:
+                return self._json(
+                    404,
+                    {"error": "wire telemetry not wired on this "
+                              "gateway"},
+                )
+            return self._json(200, self.wire.snapshot())
+        except Exception as e:   # noqa: BLE001 - defensive render
+            return self._json(500, {"error": f"wire snapshot failed: {e}"})
+
     def _serve_perf(self, query) -> tuple[int, str, bytes]:
         # the attribution snapshot: /metrics tells you THAT serving
         # slowed, /traces WHICH request was slow — this tells you WHY:
@@ -768,7 +819,54 @@ class NodeWebServer:
 
     # -- dispatch ------------------------------------------------------------
 
+    def _endpoint_label(self, path: str) -> str:
+        """Normalize a request path to a bounded endpoint label (the
+        gateway accounting's row key): path-parameterized routes
+        collapse onto one row each, so a scan of random tx ids cannot
+        grow the table without bound."""
+        if path in self._ops:
+            return path
+        if path.startswith("/web/"):
+            return "/web/<prefix>"
+        if path.startswith("/cluster/trace/"):
+            return "/cluster/trace/<trace_id>"
+        if path.startswith("/incidents/"):
+            return "/incidents/<id>"
+        if path == "/tx/slowest":
+            return "/tx/slowest"
+        if path.startswith("/tx/"):
+            return "/tx/<tx_id>"
+        parts = [p for p in path.split("/") if p]
+        if parts[:1] == ["api"]:
+            return "/api/" + parts[1] if len(parts) > 1 else "/api"
+        return "<other>"
+
     def _handle(self, req: BaseHTTPRequestHandler, method: str) -> None:
+        """Timed choke point over every request: dispatch, then record
+        endpoint label + handler wall + bytes served into the wire
+        plane (when wired) and log slow handlers — the gateway's cost
+        is measured exactly where it is paid."""
+        t0 = time.perf_counter()
+        try:
+            self._dispatch(req, method)
+        finally:
+            wall = time.perf_counter() - t0
+            endpoint = self._endpoint_label(urlparse(req.path).path)
+            slow = (
+                0 < self.slow_request_micros <= wall * 1e6
+            )
+            if slow:
+                logging.getLogger("corda_tpu.webserver").warning(
+                    "slow handler: %s %s took %.1fms",
+                    method, endpoint, wall * 1e3,
+                )
+            if self.wire is not None:
+                self.wire.gateway.record_request(
+                    endpoint, wall,
+                    getattr(req, "_bytes_served", 0), slow=slow,
+                )
+
+    def _dispatch(self, req: BaseHTTPRequestHandler, method: str) -> None:
         url = urlparse(req.path)
         path = url.path
         if method == "GET" and path.startswith("/web/"):
@@ -831,11 +929,7 @@ class NodeWebServer:
         except Exception as e:   # pragma: no cover - defensive
             status, body = 500, {"error": f"{type(e).__name__}: {e}"}
         payload = json.dumps(body, indent=2).encode()
-        req.send_response(status)
-        req.send_header("Content-Type", "application/json")
-        req.send_header("Content-Length", str(len(payload)))
-        req.end_headers()
-        req.wfile.write(payload)
+        self._send(req, status, "application/json", payload)
 
     def _route(self, req, method: str):
         url = urlparse(req.path)

@@ -153,6 +153,19 @@ class NodeConfig:
     # passive counters plus one sampler pass per pump second; on CPU
     # backends memory stats degrade to null, never a failure.
     device_telemetry_enabled: bool = True
+    # wire & gateway telemetry plane (utils/wire_telemetry.py):
+    # per-link fabric accounting + codec cost attribution at GET
+    # /wire, the `wire` resource in the GET /capacity roofline,
+    # Wire.*/Gateway.* gauges on /metrics and the wire.journal_growth
+    # / wire.backlog / gateway.saturated health rules. On by default —
+    # passive counters at the fabric seams plus a few COUNT queries
+    # per pump second (<2% of the fabric wall, gated by the bench
+    # `wire` metric).
+    wire_telemetry_enabled: bool = True
+    # the web gateway logs handlers slower than this (microseconds,
+    # 0 = off): requests that steal pump time are visible in the log
+    # before the wire plane is even queried
+    web_slow_request_micros: int = 50_000
     # transaction provenance plane (utils/txstory.py): the per-tx
     # lifecycle ledger behind GET /tx/<id> + /tx/slowest and the
     # Tx.Stage.* histograms. On by default — bounded memory, one lock
@@ -290,6 +303,8 @@ class NodeConfig:
             raise ConfigError("perf_profile_hz must be >= 0")
         if self.txstory_stage_slo_micros < 0:
             raise ConfigError("txstory_stage_slo_micros must be >= 0")
+        if self.web_slow_request_micros < 0:
+            raise ConfigError("web_slow_request_micros must be >= 0")
         if not self.txstory_enabled and (
             self.txstory_index or self.txstory_stage_slo_micros > 0
         ):
@@ -479,6 +494,10 @@ def write_config(cfg: NodeConfig, path: str) -> None:
         emit("perf_enabled", cfg.perf_enabled)
     if not cfg.device_telemetry_enabled:
         emit("device_telemetry_enabled", cfg.device_telemetry_enabled)
+    if not cfg.wire_telemetry_enabled:
+        emit("wire_telemetry_enabled", cfg.wire_telemetry_enabled)
+    if cfg.web_slow_request_micros != 50_000:
+        emit("web_slow_request_micros", cfg.web_slow_request_micros)
     if cfg.perf_profile_hz:
         emit("perf_profile_hz", cfg.perf_profile_hz)
     if cfg.perf_baseline:

@@ -35,13 +35,20 @@ import asyncio
 import hashlib
 import ssl
 import threading
+import time
 from collections import deque
 from dataclasses import dataclass
 from typing import Callable, Optional
 
 from ..core import serialization as ser
 from ..crypto import schemes
-from .messaging import FabricFaults, Handler, Message, MessagingService
+from .messaging import (
+    DEDUPE_KEEP,
+    FabricFaults,
+    Handler,
+    Message,
+    MessagingService,
+)
 
 _FABRIC_SCHEMA = """
 CREATE TABLE IF NOT EXISTS fabric_out (
@@ -117,11 +124,17 @@ def _from_db_uid(uid: int) -> int:
     return uid + 2**64 if uid < 0 else uid
 
 
+# processed fabric_in rows are the durable dedupe table; the prune in
+# `_prune_dedupe` bounds them to the newest messaging.DEDUPE_KEEP per
+# sender, checked once every this many ingests
+_DEDUPE_PRUNE_EVERY = 256
+
+
 # ---------------------------------------------------------------------------
 # framing
 
 
-async def _read_frame(reader: asyncio.StreamReader) -> list:
+async def _read_frame(reader: asyncio.StreamReader, telemetry=None) -> list:
     try:
         header = await reader.readexactly(4)
         length = int.from_bytes(header, "big")
@@ -130,17 +143,31 @@ async def _read_frame(reader: asyncio.StreamReader) -> list:
         body = await reader.readexactly(length)
     except asyncio.IncompleteReadError as e:
         raise ConnectionError("peer closed mid-frame") from e
+    t0 = time.perf_counter() if telemetry is not None else 0.0
     try:
         frame = ser.decode(body)
     except ser.SerializationError as e:
         raise ConnectionError(f"undecodable frame: {e}") from e
     if not isinstance(frame, list) or not frame:
         raise ConnectionError("malformed frame")
+    if telemetry is not None and frame[0] == "msg" and len(frame) >= 3:
+        telemetry.record_codec(
+            "decode", ser._native_codec() is not None, str(frame[2]),
+            time.perf_counter() - t0, len(body),
+        )
     return frame
 
 
-def _write_frame(writer: asyncio.StreamWriter, frame: list) -> None:
+def _write_frame(
+    writer: asyncio.StreamWriter, frame: list, telemetry=None
+) -> None:
+    t0 = time.perf_counter() if telemetry is not None else 0.0
     body = ser.encode(frame)
+    if telemetry is not None and frame[0] == "msg":
+        telemetry.record_codec(
+            "encode", ser._native_codec() is not None, str(frame[2]),
+            time.perf_counter() - t0, len(body),
+        )
     writer.write(len(body).to_bytes(4, "big") + body)
 
 
@@ -228,6 +255,8 @@ class FabricEndpoint(MessagingService):
         tls: Optional[TlsIdentity] = None,
         advertise_host: Optional[str] = None,
         faults: Optional[FabricFaults] = None,
+        telemetry=None,
+        dedupe_keep: int = DEDUPE_KEEP,
     ):
         self._name = name
         self._keypair = keypair
@@ -244,6 +273,20 @@ class FabricEndpoint(MessagingService):
         # exercise the SAME recovery paths a real outage would. None
         # (production default) costs one attribute check per frame.
         self.faults = faults
+        # wire-telemetry seam (utils.wire_telemetry.WireAccounting):
+        # mutable like `faults` — node.py attaches a WirePlane after
+        # construction; None (production default with the plane off)
+        # costs one attribute check per frame. Recorded at: send
+        # (journal append/commit wall), _write_frame/_read_frame
+        # (codec wall per topic), _drain_loop (frames out +
+        # redelivery), _ingest (frames in + dedupe hits).
+        self.telemetry = telemetry
+        # per-sender bound on retained processed dedupe rows
+        self.dedupe_keep = int(dedupe_keep)
+        self._ingests_since_prune = 0
+        # per-peer bridge high-water seq: a drained row at or below it
+        # is a redelivery (rows delete on ack, seqs never reuse)
+        self._sent_seq_hw: dict[str, int] = {}
         # the address peers should dial back (differs from the bind
         # host behind NAT or when bound to 0.0.0.0)
         self.advertise_host = advertise_host or host
@@ -310,6 +353,9 @@ class FabricEndpoint(MessagingService):
         senders attach headers (header-less sends keep the old
         5-element frame, so the upgrade order is receivers first)."""
         headers = _encode_headers(trace, deadline)
+        tel = self.telemetry
+        t0 = time.perf_counter() if tel is not None else 0.0
+        t1 = t0
         with self._db.transaction():
             if unique_id is None:
                 unique_id = self._next_uid()
@@ -318,6 +364,12 @@ class FabricEndpoint(MessagingService):
                 " VALUES (?,?,?,?,?)",
                 (target, topic, payload, _to_db_uid(unique_id), headers),
             )
+            if tel is not None:
+                t1 = time.perf_counter()
+        if tel is not None:
+            # append = the journaled INSERT, commit = the transaction
+            # exit (WAL-mode fsync lands there)
+            tel.record_journal(t1 - t0, time.perf_counter() - t1)
         if self._loop is not None:
             self._loop.call_soon_threadsafe(self._wake_bridge, target)
 
@@ -478,13 +530,24 @@ class FabricEndpoint(MessagingService):
                     continue
                 except asyncio.TimeoutError:
                     return   # idle: close connection, journal is empty
+            tel = self.telemetry
             for seq, topic, payload, uid, headers in rows:
                 frame = ["msg", seq, topic, bytes(payload), _from_db_uid(uid)]
                 if headers is not None:
                     # headers ride as a 6th element; pre-headers peers
                     # never see it (their journals carry NULL)
                     frame.append(bytes(headers))
-                _write_frame(writer, frame)
+                _write_frame(writer, frame, tel)
+                if seq <= self._sent_seq_hw.get(peer, 0):
+                    # this row already crossed the wire on an earlier
+                    # connection and was never acked — at-least-once
+                    # redelivery, counted per peer
+                    if tel is not None:
+                        tel.record_redelivery(peer)
+                else:
+                    self._sent_seq_hw[peer] = seq
+                if tel is not None:
+                    tel.record_frame("out", peer, topic, len(payload))
             await writer.drain()
             for _ in rows:
                 frame = await asyncio.wait_for(_read_frame(reader), timeout=30)
@@ -549,7 +612,7 @@ class FabricEndpoint(MessagingService):
                 # its journal holds the frames for redelivery on heal
                 raise ConnectionError("fault: partitioned")
             while True:
-                frame = await _read_frame(reader)
+                frame = await _read_frame(reader, self.telemetry)
                 if frame[0] != "msg":
                     raise ConnectionError(f"unexpected frame {frame[0]!r}")
                 if len(frame) not in (5, 6):
@@ -657,7 +720,7 @@ class FabricEndpoint(MessagingService):
         Headers land durably too — a frame redelivered after a crash
         keeps its trace link and (crucially) its deadline."""
         self._arrival_counter += 1
-        self._db.execute(
+        cur = self._db.execute(
             "INSERT OR IGNORE INTO fabric_in"
             " (sender, uid, arrival, topic, payload, headers)"
             " VALUES (?,?,?,?,?,?)",
@@ -666,7 +729,61 @@ class FabricEndpoint(MessagingService):
                 topic, payload, headers,
             ),
         )
+        tel = self.telemetry
+        if tel is not None:
+            if cur.rowcount == 0:
+                # IGNOREd: the (sender, uid) dedupe key swallowed it
+                tel.record_dedupe_hit(sender)
+            else:
+                tel.record_frame("in", sender, topic, len(payload))
+        self._ingests_since_prune += 1
+        if self._ingests_since_prune >= _DEDUPE_PRUNE_EVERY:
+            self._ingests_since_prune = 0
+            self._prune_dedupe()
         self._pump_wake.set()
+
+    def _prune_dedupe(self) -> None:
+        """Bound the durable dedupe table: keep the newest
+        `dedupe_keep` DISPATCHED rows per sender (by arrival
+        watermark), delete older ones. processed=0 rows are the live
+        inbound queue and processed=2 the dead-letter forensics —
+        neither is touched. Safe because the sender deletes acked
+        journal rows: only an explicit `unique_id=` replay could carry
+        a uid older than the watermark."""
+        for (sender,) in self._db.query(
+            "SELECT DISTINCT sender FROM fabric_in WHERE processed=1"
+        ):
+            row = self._db.query(
+                "SELECT arrival FROM fabric_in"
+                " WHERE sender=? AND processed=1"
+                " ORDER BY arrival DESC LIMIT 1 OFFSET ?",
+                (sender, self.dedupe_keep - 1),
+            )
+            if row:
+                self._db.execute(
+                    "DELETE FROM fabric_in"
+                    " WHERE sender=? AND processed=1 AND arrival<?",
+                    (sender, row[0][0]),
+                )
+
+    def wire_depths(self) -> dict:
+        """The WirePlane's per-tick depth pull (attach_fabric adopts
+        it): outbound journal depth total and per peer (the unacked
+        backlog) plus the retained dedupe-table depth — COUNT queries
+        paid once per tick, never on the send path."""
+        backlog = {
+            peer: n for peer, n in self._db.query(
+                "SELECT peer, COUNT(*) FROM fabric_out GROUP BY peer"
+            )
+        }
+        dedupe = self._db.query(
+            "SELECT COUNT(*) FROM fabric_in WHERE processed=1"
+        )[0][0]
+        return {
+            "journal_depth": sum(backlog.values()),
+            "dedupe_depth": dedupe,
+            "backlog": backlog,
+        }
 
     # -- dispatch (server thread) -------------------------------------------
 

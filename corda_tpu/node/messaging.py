@@ -36,6 +36,13 @@ TOPIC_VERIFIER_RES = "verifier.responses"
 # presumed-abort status queries — all ride this one topic
 TOPIC_XSHARD = "notary.xshard"
 
+# dedupe-table bound shared by BOTH fabrics: the newest DEDUPE_KEEP
+# dispatched (sender, uid) keys are retained per sender; older ones
+# prune away so a long soak's dedupe state stays bounded. Safe because
+# senders stop re-offering a frame once it acks — only an explicit
+# `unique_id=` replay could carry a key older than the watermark.
+DEDUPE_KEEP = 8192
+
 
 @dataclass(frozen=True)
 class Message:
@@ -476,9 +483,17 @@ class InMemoryMessaging(MessagingService):
         self._handlers: dict[str, list[Handler]] = {}
         self._rings: dict[str, object] = {}   # topic -> ingest ring
         self._next_id = 0
-        self._seen: set[tuple[str, int]] = set()
+        # insertion-ordered so the DEDUPE_KEEP bound evicts oldest-
+        # first (the in-memory analogue of the TCP fabric's arrival-
+        # watermark prune)
+        self._seen: dict[tuple[str, int], None] = {}
         self._undelivered: deque[Message] = deque()
         self.running = True
+        # wire-telemetry seam (utils.wire_telemetry.WireAccounting):
+        # mutable like FabricEndpoint.telemetry — None costs one
+        # attribute check per frame
+        self.telemetry = None
+        self.dedupe_keep = DEDUPE_KEEP
 
     @property
     def my_address(self) -> str:
@@ -501,6 +516,9 @@ class InMemoryMessaging(MessagingService):
             unique_id = self._next_id
             self._next_id += 1
         msg = Message(topic, payload, self._name, unique_id, trace, deadline)
+        tel = self.telemetry
+        if tel is not None:
+            tel.record_frame("out", target, topic, len(payload))
         self._network._enqueue(msg, target)
 
     def add_handler(self, topic: str, handler: Handler) -> None:
@@ -556,20 +574,52 @@ class InMemoryMessaging(MessagingService):
             if not ring.offer(m):
                 break   # still full: keep FIFO order, stop early
             self._undelivered.remove(m)
-            self._seen.add(key)
+            self._remember(key, m)
             moved += 1
         return moved
+
+    def _remember(self, key: tuple[str, int], msg: Message) -> None:
+        """Mark a frame delivered (dedupe) + record the inbound link —
+        ONE seam for all three delivery paths, so the telemetry and
+        the DEDUPE_KEEP eviction can never disagree."""
+        tel = self.telemetry
+        if tel is not None:
+            tel.record_frame(
+                "in", msg.sender, msg.topic, len(msg.payload)
+            )
+        self._seen[key] = None
+        if len(self._seen) > self.dedupe_keep:
+            self._seen.pop(next(iter(self._seen)))
+
+    def wire_depths(self) -> dict:
+        """The WirePlane's per-tick depth pull (the TCP fabric's
+        `wire_depths` shape): undelivered frames queued toward each
+        peer stand in for the unacked journal backlog."""
+        backlog = {
+            target: len(q)
+            for (sender, target), q in self._network._queues.items()
+            if sender == self._name and q
+        }
+        return {
+            "journal_depth": sum(backlog.values()),
+            "dedupe_depth": len(self._seen),
+            "backlog": backlog,
+        }
 
     def _deliver(self, msg: Message) -> None:
         key = (msg.sender, msg.unique_id)
         if key in self._seen:
-            return  # at-least-once upstream, exactly-once to handlers
+            # at-least-once upstream, exactly-once to handlers
+            tel = self.telemetry
+            if tel is not None:
+                tel.record_dedupe_hit(msg.sender)
+            return
         ring = self._rings.get(msg.topic)
         if ring is not None:
             # ring seam: enqueue the raw frame for the bulk decoder; a
             # full ring parks it (backpressure) for retry_parked
             if ring.offer(msg):
-                self._seen.add(key)
+                self._remember(key, msg)
             else:
                 self._undelivered.append(msg)
             return
@@ -577,6 +627,6 @@ class InMemoryMessaging(MessagingService):
         if not handlers:
             self._undelivered.append(msg)
             return
-        self._seen.add(key)
+        self._remember(key, msg)
         for h in list(handlers):
             h(msg)

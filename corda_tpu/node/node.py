@@ -368,6 +368,27 @@ class Node:
             if isinstance(notary, BatchingNotaryService):
                 notary.attach_device(self.device_plane)
             self.health.watch_device(self.device_plane)
+        # wire & gateway telemetry (utils/wire_telemetry.py): per-link
+        # fabric frame/byte accounting pushed by the messaging seams,
+        # codec cost attribution (native cts_hash vs pure-Python CTS),
+        # journal append/fsync latency, redelivery/dedupe/backlog
+        # depths pulled per tick, plus per-endpoint gateway request
+        # accounting recorded by the webserver dispatch wrapper —
+        # served at GET /wire and joined into GET /capacity as the
+        # "wire" resource via the device plane's wire feed.
+        self.wire_plane = None
+        if config.wire_telemetry_enabled:
+            from ..utils.wire_telemetry import WirePlane
+
+            self.wire_plane = WirePlane(
+                clock=self.services.clock,
+                metrics=self.metrics,
+            )
+            self.wire_plane.attach_fabric(self.messaging)
+            self.health.watch_wire(self.wire_plane)
+            if self.device_plane is not None:
+                self.device_plane.set_wire_feed(
+                    self.wire_plane.wire_host_seconds)
         self.scheduler = NodeSchedulerService(self.services, self.smm.start_flow)
 
         # -- verifier offload ------------------------------------------
@@ -1005,6 +1026,10 @@ class Node:
             # after health.tick so rules judge last-sample state and
             # this tick's sample serves the NEXT walk
             self.device_plane.tick()
+        if self.wire_plane is not None:
+            # wire telemetry pulls fabric depths (journal/dedupe/
+            # backlog) on the same self-throttled cadence
+            self.wire_plane.tick()
 
     def run(self) -> None:
         """The pump loop — the single server thread (Node.kt:344)."""
@@ -1087,7 +1112,8 @@ class Node:
         the perf-attribution plane at /perf (+ folded profiler stacks
         at /profile), the device-telemetry plane at /device + the
         capacity model at /capacity, plus the ledger explorer UI at
-        /web/explorer/. The node's pump
+        /web/explorer/, and the wire & gateway telemetry plane at
+        /wire. The node's pump
         loop (run()) drives message delivery, so the gateway itself
         only polls futures (pass a real pump when embedding without
         run())."""
@@ -1117,6 +1143,8 @@ class Node:
             txstory=self.txstory,
             cluster_tx=self.cluster_tx,
             device=self.device_plane,
+            wire=self.wire_plane,
+            slow_request_micros=self.config.web_slow_request_micros,
         )
 
 

@@ -742,6 +742,10 @@ class FleetReport:
     # snapshot from the member plane (None when the fault arc never
     # built one) — the telemetry side of the device_fault story
     device_telemetry: Any = None
+    # round-17 wire plane: the end-of-run GET /wire-shaped snapshot
+    # from the notary's fabric seam (None when the fault arc never
+    # built one) — per-link accounting under the same chaos schedule
+    wire_telemetry: Any = None
     # round-11 tracing plane: per-member tracers, the cross-node
     # assembler and the incident recorder (None when not enabled)
     tracers: dict = field(default_factory=dict)
@@ -1119,6 +1123,7 @@ class FleetSim:
         )
         self.device_injector = None
         self.device_plane = None
+        self.wire_plane = None
         self.intent_journal = None
         self.verify_pool = None
         self._verify_workers: list = []
@@ -1181,6 +1186,19 @@ class FleetSim:
                 lambda: self._notary_service().degraded_evidence,
             )
             self.monitors[notary.name].watch_device(self.device_plane)
+            # wire plane (round 17): the same accounting the node
+            # serves at GET /wire, attached to the notary's in-memory
+            # fabric seam — the chaos arcs exercise frame/dedupe/
+            # backlog bookkeeping under faults, and the wire alerts
+            # ride the member's monitor
+            from ..utils.wire_telemetry import WirePlane, WirePolicy
+
+            self.wire_plane = WirePlane(
+                clock=self.net.clock,
+                policy=WirePolicy(sample_gap_micros=0),
+            )
+            self.wire_plane.attach_fabric(notary.messaging)
+            self.monitors[notary.name].watch_wire(self.wire_plane)
             if verifier_pool:
                 from ..crypto.batch_verifier import CpuBatchVerifier
                 from ..node.verifier import (
@@ -1842,6 +1860,10 @@ class FleetSim:
             # sample BEFORE the monitor walk so the device rules judge
             # this round's state (sample_gap 0: every round samples)
             self.device_plane.tick()
+        if self.wire_plane is not None and (
+            self.alive[self.members[0].name] and not self._notary_down
+        ):
+            self.wire_plane.tick()
         for name, mon in self.monitors.items():
             if self.alive[name]:
                 mon.tick()
@@ -1966,6 +1988,10 @@ class FleetSim:
             device_telemetry=(
                 self.device_plane.snapshot()
                 if self.device_plane is not None else None
+            ),
+            wire_telemetry=(
+                self.wire_plane.snapshot()
+                if self.wire_plane is not None else None
             ),
             tracers=dict(self.tracers),
             cluster_traces=self.cluster_traces,

@@ -310,7 +310,9 @@ class DeviceSampler:
 # ---------------------------------------------------------------------------
 # capacity model (roofline over measured inputs)
 
-RESOURCES = ("host_pump", "device_compute", "transfer", "commit_plane")
+RESOURCES = (
+    "host_pump", "device_compute", "transfer", "commit_plane", "wire",
+)
 
 # what_if knobs GET /capacity?what_if= accepts (key:value, comma-
 # separated). Scale knobs model the planned restructures; *_us / *_per_*
@@ -324,6 +326,8 @@ WHAT_IF_KNOBS = (
     "device_us_per_tx",       # device busy seconds/tx override (micros)
     "transfer_bytes_per_tx",
     "transfer_bytes_per_sec",
+    "wire_us_per_tx",         # wire host cost override (micros) — e.g.
+    #                           price what the native codec would save
 )
 
 
@@ -371,6 +375,9 @@ def capacity_model(
       device_seconds_per_tx   device busy per request (DeviceAccounting)
       device_count            chips the dispatch path can spread over
       transfer_bytes_per_tx / transfer_bytes_per_sec
+      wire_seconds_per_tx     fabric host cost per notarisation
+                              (codec encode/decode + journal walls,
+                              the PR 17 WirePlane feed)
       current_per_sec         the sustained live rate (PerfHistory)
 
     `what_if` substitutes knobs (see WHAT_IF_KNOBS) — `shards:8`
@@ -385,6 +392,7 @@ def capacity_model(
     dev_n = inputs.get("device_count") or 1
     bytes_tx = inputs.get("transfer_bytes_per_tx")
     bw = inputs.get("transfer_bytes_per_sec")
+    wire_s = inputs.get("wire_seconds_per_tx")
     current = inputs.get("current_per_sec")
 
     if "pump_us_per_tx" in what_if:
@@ -397,6 +405,8 @@ def capacity_model(
         bytes_tx = what_if["transfer_bytes_per_tx"]
     if "transfer_bytes_per_sec" in what_if:
         bw = what_if["transfer_bytes_per_sec"]
+    if "wire_us_per_tx" in what_if:
+        wire_s = what_if["wire_us_per_tx"] / 1e6
     shards = what_if.get("shards", 1.0)
     devices = what_if.get("devices", float(dev_n))
     device_scale = devices / float(dev_n)
@@ -472,6 +482,19 @@ def capacity_model(
             + (f" across {shards:g} shards" if shards != 1.0 else "")
             if commit_eff else
             "no commit phase timings yet"
+        ),
+    )
+    resource(
+        "wire",
+        shards / wire_s if wire_s else None,
+        (
+            f"fabric wire work pays {wire_s * 1e6:.1f}us/tx on the "
+            f"host (codec encode/decode + journal append/fsync)"
+            + (f" across {shards:g} parallel pump planes"
+               if shards != 1.0 else "")
+            if wire_s else
+            "no wire telemetry feed (wire plane disabled, or no "
+            "fabric traffic yet)"
         ),
     )
 
@@ -665,6 +688,9 @@ class DevicePlane:
         # per served tx (armed sanitizer rigs wire it; production
         # leaves it None and the commit timer speaks alone)
         self._lock_hold_fn: Optional[Callable[[], Optional[float]]] = None
+        # the PR 17 wire feed: cumulative fabric host seconds (codec +
+        # journal walls) the capacity join divides by served txs
+        self._wire_fn: Optional[Callable[[], Optional[float]]] = None
         self.metrics.gauge(
             "Device.Count", lambda: len(self.sampler.devices())
         )
@@ -711,6 +737,16 @@ class DevicePlane:
         pump-hot lock hold seconds per served transaction (None when
         the sanitizer is disarmed — the normal production state)."""
         self._lock_hold_fn = fn
+
+    def set_wire_feed(
+        self, fn: Callable[[], Optional[float]]
+    ) -> None:
+        """Wire the PR 17 wire-telemetry feed: `fn()` answers
+        cumulative fabric host seconds (codec encode/decode + journal
+        append/fsync walls; None until any wire work is recorded) —
+        capacity_inputs divides by served transactions to price the
+        `wire` roofline resource."""
+        self._wire_fn = fn
 
     def install_rules(self, monitor) -> None:
         """Wire the hbm-pressure + fallback + collapse alerts onto a
@@ -978,6 +1014,14 @@ class DevicePlane:
                 hold_s = self._lock_hold_fn()
             except Exception:
                 hold_s = None
+        wire_s = None
+        if self._wire_fn is not None and served > 0:
+            try:
+                wire_total = self._wire_fn()
+            except Exception:
+                wire_total = None
+            if wire_total is not None and wire_total > 0:
+                wire_s = wire_total / served
         totals = self.accounting.snapshot()["totals"]
         dev_s = bytes_tx = bw = None
         if totals["requests"] > 0 and totals["busy_seconds"] > 0:
@@ -1002,6 +1046,7 @@ class DevicePlane:
             # the DeviceAccounting busy/transfer rows
             "device_wait_seconds_per_tx": wait_s,
             "lock_hold_seconds_per_tx": hold_s,
+            "wire_seconds_per_tx": wire_s,
             "device_seconds_per_tx": dev_s,
             "device_count": max(1, len(self.sampler.devices())),
             "transfer_bytes_per_tx": bytes_tx,

@@ -748,6 +748,19 @@ class HealthMonitor:
         alert walks + evidence (the watch_perf pattern)."""
         plane.install_rules(self)
 
+    def watch_wire(self, plane) -> None:
+        """Install the wire-telemetry rules over a
+        utils/wire_telemetry.WirePlane: `wire.journal_growth` (the
+        store-and-forward journal deep AND still growing across the
+        sample window — drains aren't keeping up), `wire.backlog`
+        (some peer's unacked backlog over threshold, detail naming
+        the peer and its high-water) and `gateway.saturated` (the
+        web gateway stealing more than the allowed fraction of pump
+        wall — handlers starving message delivery). Same ownership
+        split as watch_device: the plane owns the telemetry, this
+        monitor the alert walks + evidence."""
+        plane.install_rules(self)
+
     def watch_txstory(
         self, story, targets: dict, window_micros=None
     ) -> None:

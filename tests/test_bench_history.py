@@ -355,6 +355,46 @@ def test_json_all_carries_every_pair(rounds, capsys):
     assert doc["new"] == "BENCH_r03.json"
 
 
+def test_markdown_format_renders_github_table(rounds, capsys):
+    """`--format md` (round-17 satellite): the same per-metric diff as
+    the text table, rendered as a GitHub markdown table for PR
+    descriptions and CI job summaries. Direction markers get their own
+    column; missing values render as `-`."""
+    assert bh.main(["--dir", rounds, "--format", "md"]) == 0
+    out = capsys.readouterr().out
+    assert "### bench diff: `BENCH_r01.json` -> `BENCH_r02.json`" in out
+    assert "| metric | old | new | delta | vs_baseline | direction |" in out
+    lines = {
+        l.split("|")[1].strip(): l for l in out.splitlines()
+        if l.startswith("| `")
+    }
+    notary = lines["`batching_notary_notarisations_per_sec`"]
+    assert "-31.25%" in notary and "higher is better" in notary
+    # the metric the newest round skipped renders with `-` cells
+    missing = lines["`wire_ingest_decode_id_stage_per_sec`"]
+    assert missing.count(" - ") >= 2
+    # the text-table header never appears in md mode
+    assert "bench diff: BENCH_r01.json ->" not in out
+
+    # direction column distinguishes required-true and lower-is-better
+    rows = [
+        {"metric": "soak.reconciled", "old": 1.0, "new": 1.0,
+         "delta_pct": 0.0, "vs_baseline": None, "better": "required"},
+        {"metric": "plane_overhead", "old": 0.01, "new": 0.02,
+         "delta_pct": 100.0, "vs_baseline": None, "better": "lower"},
+    ]
+    md = bh.format_rows_md(rows, "a.json", "b.json")
+    assert "required true" in md and "lower is better" in md
+
+    # --format md composes with --gate: same exit-code contract
+    assert bh.main(
+        ["--dir", rounds, "--format", "md", "--gate", "10"]
+    ) == 1
+    captured = capsys.readouterr()
+    assert "| metric |" in captured.out
+    assert "GATE batching_notary_notarisations_per_sec" in captured.err
+
+
 def test_committed_trajectory_passes_regression_gate():
     """Round 6: `bench_history --gate` IS part of the tier-1 story.
     The newest two committed BENCH_r*.json records must not show a

@@ -1,0 +1,696 @@
+"""Wire & gateway telemetry plane (ISSUE 17).
+
+The acceptance arc: per-link fabric accounting recorded at both
+fabrics' send/recv seams, codec cost attribution split native vs
+pure-Python CTS, journal latency quantiles over an exact-sum + sampled
+reservoir feed, per-peer backlog with high-water marks, gateway request
+accounting at the webserver dispatch table with slow-handler logging,
+`wire.journal_growth` / `wire.backlog` / `gateway.saturated` health
+rules, the capacity roofline naming `wire` (with the
+`?what_if=wire_us_per_tx` native-codec pricing knob), and a booted node
+serving it all at GET /wire. The <=2% plane-overhead bound is gated by
+`bench.py --quick wire` (subprocess smoke at the bottom); the real
+two-process TCP redelivery reconciliation lives in
+test_wire_link_accounting.py.
+
+Simulated time (TestClock) everywhere the plane allows it; the booted
+node, the webserver and the bench smoke are real time.
+"""
+
+import json
+import logging
+import os
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from corda_tpu.client.webserver import NodeWebServer
+from corda_tpu.node.messaging import (
+    DEDUPE_KEEP,
+    InMemoryMessagingNetwork,
+)
+from corda_tpu.node.services import TestClock
+from corda_tpu.utils import device_telemetry as dlib
+from corda_tpu.utils import health as hlib
+from corda_tpu.utils import wire_telemetry as wlib
+from corda_tpu.utils.metrics import MetricRegistry
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, resp.headers["Content-Type"], resp.read()
+
+
+def _get_json(url, timeout=10):
+    status, _, body = _get(url, timeout)
+    return status, json.loads(body)
+
+
+class FakeFabric:
+    """The depth half of the fabric contract: a mutable `telemetry`
+    attribute plus a scripted `wire_depths()` feed (both real fabrics
+    implement exactly this shape)."""
+
+    def __init__(self):
+        self.telemetry = None
+        self.depths = {"journal_depth": 0, "dedupe_depth": 0, "backlog": {}}
+
+    def wire_depths(self):
+        return dict(self.depths)
+
+
+def _plane(clock=None, metrics=None, **policy):
+    policy.setdefault("sample_gap_micros", 0)
+    return wlib.WirePlane(
+        clock=clock, metrics=metrics, policy=wlib.WirePolicy(**policy)
+    )
+
+
+# ---------------------------------------------------------------------------
+# fabric accounting (pure recorder)
+
+
+def test_per_link_accounting_keys_on_direction_peer_topic():
+    acct = wlib.WireAccounting()
+    acct.record_frame("out", "B", "flow.session", 100)
+    acct.record_frame("out", "B", "flow.session", 50)
+    acct.record_frame("out", "C", "flow.session", 10)
+    acct.record_frame("in", "B", "rpc.reply", 7)
+    rows = acct.link_rows()
+    assert rows[("out", "B", "flow.session")] == {"frames": 2, "bytes": 150}
+    assert rows[("out", "C", "flow.session")] == {"frames": 1, "bytes": 10}
+    assert rows[("in", "B", "rpc.reply")] == {"frames": 1, "bytes": 7}
+    t = acct.totals()
+    assert t["frames_out"] == 3 and t["bytes_out"] == 160
+    assert t["frames_in"] == 1 and t["bytes_in"] == 7
+
+
+def test_codec_attribution_splits_native_from_python():
+    acct = wlib.WireAccounting()
+    acct.record_codec("encode", False, "flow.session", 40e-6, 256)
+    acct.record_codec("encode", False, "flow.session", 60e-6, 256)
+    acct.record_codec("decode", True, "flow.session", 5e-6, 256)
+    snap = acct.snapshot()
+    enc = snap["codec"]["flow.session"]["encode"]["python"]
+    dec = snap["codec"]["flow.session"]["decode"]["native"]
+    assert enc["calls"] == 2
+    assert enc["micros_per_frame"] == pytest.approx(50.0, rel=0.01)
+    assert dec["calls"] == 1
+    assert "python" not in snap["codec"]["flow.session"]["decode"]
+    t = acct.totals()
+    assert t["encode_seconds"] == pytest.approx(100e-6)
+    assert t["decode_seconds"] == pytest.approx(5e-6)
+    # host_seconds = codec + journal: the capacity roofline's input
+    assert acct.host_seconds() == pytest.approx(105e-6)
+
+
+def test_journal_exact_sums_with_sampled_reservoir_feed():
+    """record_journal keeps EXACT counts/sums (totals, host_seconds)
+    while feeding the latency reservoirs only 1-in-JOURNAL_SAMPLE_EVERY
+    sends — the quantile estimate rides a subsample, the accounting
+    never does."""
+    acct = wlib.WireAccounting()
+    n = wlib.WireAccounting.JOURNAL_SAMPLE_EVERY * 3
+    for _ in range(n):
+        acct.record_journal(10e-6, 5e-6)
+    t = acct.totals()
+    assert t["journal_appends"] == n
+    assert t["journal_seconds"] == pytest.approx(n * 15e-6)
+    assert acct._journal_append.count == 3
+    assert acct._journal_commit.count == 3
+    snap = acct.snapshot()["journal"]
+    assert snap["appends"] == n
+    assert snap["sampled_1_in"] == wlib.WireAccounting.JOURNAL_SAMPLE_EVERY
+    assert snap["append_micros"]["p50"] == pytest.approx(10.0, rel=0.05)
+    assert snap["commit_micros"]["p50"] == pytest.approx(5.0, rel=0.05)
+
+
+def test_redelivery_and_dedupe_counters():
+    acct = wlib.WireAccounting()
+    acct.record_redelivery("B", 3)
+    acct.record_redelivery("C")
+    acct.record_dedupe_hit("B")
+    t = acct.totals()
+    assert t["redelivered"] == 4 and t["dedupe_hits"] == 1
+    snap = acct.snapshot()
+    assert snap["redelivered"] == {"B": 3, "C": 1}
+    assert snap["dedupe_hits"] == {"B": 1}
+
+
+# ---------------------------------------------------------------------------
+# the plane: windows, depths, gauges, snapshot (simulated clock)
+
+
+def test_plane_windows_rates_and_pulls_depths():
+    clock = TestClock()
+    metrics = MetricRegistry()
+    plane = _plane(clock=clock, metrics=metrics)
+    fab = FakeFabric()
+    plane.attach_fabric(fab)
+    assert fab.telemetry is plane.fabric
+
+    fab.depths = {
+        "journal_depth": 10, "dedupe_depth": 40, "backlog": {"B": 10},
+    }
+    plane.tick()
+    for _ in range(3):
+        clock.advance(1_000_000)
+        for _ in range(50):
+            fab.telemetry.record_frame("out", "B", "t", 200)
+            fab.telemetry.record_frame("in", "B", "t", 100)
+            fab.telemetry.record_codec("encode", False, "t", 20e-6, 200)
+        plane.tick()
+
+    assert metrics.get("Wire.FramesOutPerSec").value() == pytest.approx(
+        50.0, rel=0.05
+    )
+    assert metrics.get("Wire.BytesInPerSec").value() == pytest.approx(
+        5_000.0, rel=0.05
+    )
+    assert metrics.get("Wire.EncodeMicrosPerFrame").value() == (
+        pytest.approx(20.0, rel=0.05)
+    )
+    assert metrics.get("Wire.JournalDepth").value() == 10
+    assert metrics.get("Wire.DedupeDepth").value() == 40
+    assert metrics.get("Wire.BacklogMax").value() == 10
+    # per-peer backlog gauges registered on first sight of the peer
+    assert metrics.get("Wire.Peer.B.Backlog").value() == 10
+
+    snap = plane.snapshot()
+    links = {
+        (r["direction"], r["peer"], r["topic"]): r for r in
+        snap["fabric"]["links"]
+    }
+    assert links[("out", "B", "t")]["frames"] == 150
+    assert links[("out", "B", "t")]["frames_per_sec"] == pytest.approx(
+        50.0, rel=0.05
+    )
+    assert snap["fabric"]["backlog"]["B"] == {
+        "current": 10, "high_water": 10,
+    }
+    assert snap["fabric"]["dedupe_depth"] == 40
+    assert snap["wire_host_seconds"] > 0
+
+
+def test_backlog_high_water_outlives_the_drain():
+    clock = TestClock()
+    plane = _plane(clock=clock)
+    fab = FakeFabric()
+    plane.attach_fabric(fab)
+    fab.depths["backlog"] = {"B": 700}
+    plane.tick()
+    clock.advance(1_000_000)
+    fab.depths["backlog"] = {"B": 0}
+    plane.tick()
+    peer, depth = plane.backlog_worst()
+    assert depth == 0
+    assert plane.backlog_high_water("B") == 700
+    assert plane.snapshot()["fabric"]["backlog"]["B"]["high_water"] == 700
+
+
+def test_sample_gap_throttles_the_tick():
+    clock = TestClock()
+    plane = _plane(clock=clock, sample_gap_micros=1_000_000)
+    fab = FakeFabric()
+    plane.attach_fabric(fab)
+    plane.tick()
+    fab.depths["journal_depth"] = 99
+    clock.advance(10)          # inside the gap: a no-op tick
+    plane.tick()
+    assert plane.journal_window()[0] == 0
+    clock.advance(1_000_000)   # past the gap: depths pulled
+    plane.tick()
+    assert plane.journal_window()[0] == 99
+
+
+def test_wire_host_seconds_none_until_traffic():
+    plane = _plane(clock=TestClock())
+    assert plane.wire_host_seconds() is None
+    plane.fabric.record_codec("encode", False, "t", 30e-6, 64)
+    assert plane.wire_host_seconds() == pytest.approx(30e-6)
+
+
+# ---------------------------------------------------------------------------
+# in-memory fabric integration (the seam the TCP fabric shares)
+
+
+def test_inmemory_fabric_records_links_dedupe_and_depths():
+    net = InMemoryMessagingNetwork()
+    a = net.endpoint("A")
+    b = net.endpoint("B")
+    clock = TestClock()
+    plane = _plane(clock=clock)
+    plane.attach_fabric(b)
+    # the sender side records "out" through ITS endpoint's seam
+    a.telemetry = plane.fabric
+    got = []
+    b.add_handler("t", got.append)
+    for i in range(5):
+        a.send("t", b"x" * 32, "B")
+    # a replayed uid: delivered once, the dedupe hit is counted
+    a.send("t", b"replay", "B", unique_id=2**63 | 9)
+    a.send("t", b"replay", "B", unique_id=2**63 | 9)
+    net.run()
+    assert len(got) == 6
+    t = plane.fabric.totals()
+    assert t["frames_out"] == 7 and t["frames_in"] == 6
+    assert t["dedupe_hits"] == 1
+    rows = plane.fabric.link_rows()
+    assert rows[("in", "A", "t")]["frames"] == 6
+    plane.tick()
+    assert plane.snapshot()["fabric"]["dedupe_depth"] == 6
+
+
+def test_inmemory_dedupe_table_bounded_under_churn():
+    """Satellite 1 (in-memory half): the (sender, uid) dedupe table
+    evicts oldest-first at `dedupe_keep`, so a long-lived endpoint's
+    memory stays pinned no matter how many frames churn through —
+    and the Wire.DedupeDepth gauge reads the pinned depth."""
+    net = InMemoryMessagingNetwork()
+    a = net.endpoint("A")
+    b = net.endpoint("B")
+    b.dedupe_keep = 64
+    metrics = MetricRegistry()
+    plane = _plane(clock=TestClock(), metrics=metrics)
+    plane.attach_fabric(b)
+    got = []
+    b.add_handler("t", got.append)
+    for i in range(600):
+        a.send("t", b"churn", "B")
+    net.run()
+    assert len(got) == 600
+    assert len(b._seen) == 64
+    assert b.wire_depths()["dedupe_depth"] == 64
+    plane.tick()
+    assert metrics.get("Wire.DedupeDepth").value() == 64
+    # the default bound is the shared DEDUPE_KEEP
+    assert net.endpoint("C").dedupe_keep == DEDUPE_KEEP
+
+
+# ---------------------------------------------------------------------------
+# health rules (simulated clock, via HealthMonitor.watch_wire)
+
+
+def _plane_with_monitor():
+    clock = TestClock()
+    metrics = MetricRegistry()
+    plane = _plane(clock=clock, metrics=metrics)
+    fab = FakeFabric()
+    plane.attach_fabric(fab)
+    monitor = hlib.HealthMonitor(clock=clock, metrics=metrics)
+    monitor.watch_wire(plane)
+    return clock, plane, fab, monitor
+
+
+def _walk(clock, plane, monitor, rounds=5, step=1_000_000):
+    for _ in range(rounds):
+        plane.tick()
+        monitor.tick()
+        clock.advance(step)
+
+
+def test_watch_wire_installs_the_three_rules():
+    _, _, _, monitor = _plane_with_monitor()
+    alerts = monitor.snapshot()["alerts"]
+    assert {"wire.journal_growth", "wire.backlog", "gateway.saturated"} <= (
+        set(alerts)
+    )
+
+
+def test_journal_growth_fires_on_deep_and_growing_then_resolves():
+    clock, plane, fab, monitor = _plane_with_monitor()
+    # deep but FLAT: store-and-forward holding steady, no alert
+    fab.depths["journal_depth"] = 400
+    _walk(clock, plane, monitor)
+    assert monitor.snapshot()["alerts"]["wire.journal_growth"]["state"] in (
+        "inactive", "resolved",
+    )
+    # deep AND growing: sends outrun the bridges
+    for _ in range(6):
+        fab.depths["journal_depth"] += 200
+        _walk(clock, plane, monitor, rounds=1)
+    alert = monitor.snapshot()["alerts"]["wire.journal_growth"]
+    assert alert["state"] == "firing"
+    assert alert["detail"]["growth_in_window"] > 0
+    # the drain: depth collapses, the alert resolves
+    fab.depths["journal_depth"] = 0
+    _walk(clock, plane, monitor, rounds=6)
+    assert monitor.snapshot()["alerts"]["wire.journal_growth"]["state"] == (
+        "resolved"
+    )
+
+
+def test_backlog_alert_names_the_stalled_peer():
+    clock, plane, fab, monitor = _plane_with_monitor()
+    fab.depths["backlog"] = {"B": 3, "C": 900}
+    _walk(clock, plane, monitor, rounds=6)
+    alert = monitor.snapshot()["alerts"]["wire.backlog"]
+    assert alert["state"] == "firing"
+    assert alert["detail"]["peer"] == "C"
+    assert alert["detail"]["backlog"] == 900
+    assert alert["detail"]["high_water"] == 900
+    fab.depths["backlog"] = {"B": 3, "C": 0}
+    _walk(clock, plane, monitor, rounds=6)
+    assert monitor.snapshot()["alerts"]["wire.backlog"]["state"] == (
+        "resolved"
+    )
+
+
+def test_gateway_saturated_fires_when_handlers_eat_the_wall():
+    clock, plane, _, monitor = _plane_with_monitor()
+    # handlers spending ~40% of wall clock, sustained
+    for _ in range(6):
+        plane.gateway.record_request("/wire", 0.4, 1000)
+        _walk(clock, plane, monitor, rounds=1)
+    alert = monitor.snapshot()["alerts"]["gateway.saturated"]
+    assert alert["state"] == "firing"
+    assert alert["detail"]["stolen_fraction"] >= 0.25
+    # the load stops: the windowed fraction decays and it resolves
+    _walk(clock, plane, monitor, rounds=40)
+    assert monitor.snapshot()["alerts"]["gateway.saturated"]["state"] == (
+        "resolved"
+    )
+
+
+# ---------------------------------------------------------------------------
+# capacity join: the roofline names `wire`
+
+
+WIRE_SYNTH = {
+    "pump_seconds_per_tx": 24e-6,
+    "commit_seconds_per_tx": 4e-6,
+    "device_seconds_per_tx": 2e-6,
+    "device_count": 1,
+    "transfer_bytes_per_tx": 160.0,
+    "transfer_bytes_per_sec": 50e6,
+    "current_per_sec": 30_000.0,
+    "wire_seconds_per_tx": 40e-6,
+}
+
+
+def test_capacity_model_names_wire_when_it_binds():
+    out = dlib.capacity_model(dict(WIRE_SYNTH))
+    assert out["binding_constraint"] == "wire"
+    rows = out["resources"]
+    assert rows["wire"]["ceiling_per_sec"] == pytest.approx(
+        1e6 / 40, rel=0.01
+    )
+    assert "codec" in rows["wire"]["evidence"]
+    # without the feed the resource reads unbounded, not zero
+    no_feed = dict(WIRE_SYNTH)
+    no_feed.pop("wire_seconds_per_tx")
+    out = dlib.capacity_model(no_feed)
+    assert out["binding_constraint"] == "host_pump"
+    assert out["resources"]["wire"]["ceiling_per_sec"] is None
+
+
+def test_what_if_wire_us_per_tx_prices_the_native_codec():
+    """The planning knob the native zero-copy rewrite is judged by:
+    substitute the measured wire cost with the target and the model
+    re-names the binding constraint."""
+    out = dlib.capacity_model(
+        dict(WIRE_SYNTH), what_if={"wire_us_per_tx": 2.0}
+    )
+    assert out["binding_constraint"] == "host_pump"
+    assert out["resources"]["wire"]["ceiling_per_sec"] == pytest.approx(
+        500_000.0, rel=0.01
+    )
+    assert dlib.parse_what_if("wire_us_per_tx:2.5") == {
+        "wire_us_per_tx": 2.5
+    }
+
+
+def test_device_plane_wire_feed_lands_in_capacity_inputs():
+    perf = None
+    plane = dlib.DevicePlane(
+        clock=TestClock(),
+        policy=dlib.DevicePolicy(
+            sample_gap_micros=0, live_buffer_census=False
+        ),
+        sampler=dlib.DeviceSampler(lambda: []),
+        perf=perf,
+        install_default_accounting=False,
+    )
+    wire = _plane(clock=TestClock())
+    wire.fabric.record_codec("encode", False, "t", 90e-6, 64)
+    plane.set_wire_feed(wire.wire_host_seconds)
+    # no served requests yet: the per-tx split stays undefined
+    assert plane.capacity_inputs()["wire_seconds_per_tx"] is None
+    plane._requests_served = lambda: 3
+    assert plane.capacity_inputs()["wire_seconds_per_tx"] == (
+        pytest.approx(30e-6)
+    )
+
+
+# ---------------------------------------------------------------------------
+# webserver: GET /wire, gateway accounting, slow-handler log
+
+
+def test_webserver_serves_wire_and_accounts_every_request(caplog):
+    metrics = MetricRegistry()
+    plane = _plane(clock=TestClock(), metrics=metrics)
+    plane.fabric.record_frame("in", "B", "t", 64)
+    plane.fabric.record_codec("decode", False, "t", 10e-6, 64)
+    web = NodeWebServer(
+        client=object(), pump=lambda: None, metrics=metrics, wire=plane,
+        slow_request_micros=1,   # everything is "slow": the log fires
+    ).start()
+    try:
+        base = f"http://127.0.0.1:{web.port}"
+        with caplog.at_level(
+            logging.WARNING, logger="corda_tpu.webserver"
+        ):
+            status, body = _get_json(base + "/wire")
+        assert status == 200
+        assert body["fabric"]["links"][0]["peer"] == "B"
+        assert body["fabric"]["codec"]["t"]["decode"]["python"]["calls"] == 1
+        assert body["wire_host_seconds"] > 0
+        assert "endpoints" in body["gateway"]
+
+        # satellite 2: the slow-handler warning names endpoint+duration
+        # (logged in the handler's finally, AFTER the response bytes —
+        # poll, like every other post-response assertion here)
+        deadline = time.monotonic() + 15
+        slow = []
+        while time.monotonic() < deadline:
+            slow = [
+                r for r in caplog.records if "slow handler" in r.message
+            ]
+            if slow:
+                break
+            time.sleep(0.02)
+        assert slow and "/wire" in slow[0].getMessage()
+
+        # every request lands in the gateway accounting — including
+        # 404s and the /wire request itself
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(base + "/nope", timeout=10)
+        _get_json(base + "/wire")
+        # the record lands just AFTER the response bytes: poll briefly
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if plane.gateway.totals()["requests"] >= 3:
+                break
+            time.sleep(0.02)
+        gw = plane.gateway.snapshot()
+        assert gw["endpoints"]["/wire"]["requests"] == 2
+        assert gw["endpoints"]["/wire"]["bytes"] > 0
+        assert gw["endpoints"]["<other>"]["requests"] == 1
+        assert gw["slow_requests"] >= 3
+        assert plane.gateway.totals()["requests"] == 3
+
+        # Wire.* / Gateway.* gauges on the scrape surface
+        _, _, text = _get(base + "/metrics")
+        assert b"Wire_FramesInPerSec" in text
+        assert b"Gateway_RequestsPerSec" in text
+        assert b"Gateway_SlowRequests" in text
+
+        # the shared ?ts=1 echo
+        _, ts_body = _get_json(base + "/wire?ts=1")
+        assert isinstance(ts_body["ts_micros"], int)
+    finally:
+        web.stop()
+
+
+def test_webserver_wire_404_when_not_wired():
+    web = NodeWebServer(
+        client=object(), pump=lambda: None, metrics=MetricRegistry()
+    ).start()
+    try:
+        base = f"http://127.0.0.1:{web.port}"
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(base + "/wire", timeout=10)
+        assert exc.value.code == 404
+        assert "error" in json.loads(exc.value.read())
+        _, index = _get_json(base + "/")
+        paths = {e["path"]: e for e in index["endpoints"]}
+        assert paths["/wire"]["enabled"] is False
+        assert "codec cost attribution" in paths["/wire"]["description"]
+    finally:
+        web.stop()
+
+
+def test_slow_request_micros_zero_disables_the_log(caplog):
+    plane = _plane(clock=TestClock())
+    web = NodeWebServer(
+        client=object(), pump=lambda: None, wire=plane,
+        slow_request_micros=0,
+    ).start()
+    try:
+        with caplog.at_level(
+            logging.WARNING, logger="corda_tpu.webserver"
+        ):
+            _get_json(f"http://127.0.0.1:{web.port}/wire")
+        assert not [
+            r for r in caplog.records if "slow handler" in r.message
+        ]
+        # accounted, but never counted slow
+        assert plane.gateway.totals()["slow_requests"] == 0
+    finally:
+        web.stop()
+
+
+# ---------------------------------------------------------------------------
+# config knobs
+
+
+def test_config_gates_the_plane_and_validates_slow_threshold(tmp_path):
+    from corda_tpu.node.config import (
+        ConfigError, NodeConfig, load_config, write_config,
+    )
+
+    cfg = NodeConfig(
+        name="A", base_dir=str(tmp_path),
+        wire_telemetry_enabled=False, web_slow_request_micros=75_000,
+    )
+    path = str(tmp_path / "node.toml")
+    write_config(cfg, path)
+    loaded = load_config(path)
+    assert loaded.wire_telemetry_enabled is False
+    assert loaded.web_slow_request_micros == 75_000
+    # defaults: both knobs omitted from the emitted file
+    write_config(NodeConfig(name="A", base_dir=str(tmp_path)), path)
+    text = open(path).read()
+    assert "wire_telemetry_enabled" not in text
+    assert "web_slow_request_micros" not in text
+    assert load_config(path).wire_telemetry_enabled is True
+    with pytest.raises(ConfigError):
+        NodeConfig(
+            name="A", base_dir=str(tmp_path), web_slow_request_micros=-1
+        )
+
+
+# ---------------------------------------------------------------------------
+# the booted node (acceptance: GET /wire with nonzero accounting)
+
+
+def test_booted_node_serves_wire_with_nonzero_accounting(tmp_path):
+    from corda_tpu.node.config import NodeConfig, RpcUserConfig
+    from corda_tpu.node.node import Node
+
+    node = Node(
+        NodeConfig(
+            name="WireNode", base_dir=str(tmp_path / "n"),
+            notary="batching", use_tls=False,
+            verifier_backend="cpu", web_port=0,
+            rpc_users=(RpcUserConfig("ops", "pw", ("ALL",)),),
+        )
+    ).start()
+    try:
+        assert node.wire_plane is not None
+        # the gateway polls RPC futures; the pump loop must be live
+        # (and it is the thing that ticks the plane)
+        import threading
+
+        threading.Thread(target=node.run, daemon=True).start()
+        base = f"http://127.0.0.1:{node.web.port}"
+        # /api/status rides the loopback RPC over the REAL fabric:
+        # frames journal, encode/decode, and land in the accounting
+        status, _ = _get_json(base + "/api/status")
+        assert status == 200
+        status, body = _get_json(base + "/wire")
+        assert status == 200
+        t = node.wire_plane.fabric.totals()
+        assert t["frames_out"] > 0 and t["frames_in"] > 0
+        assert t["journal_appends"] > 0
+        assert body["wire_host_seconds"] > 0
+        assert body["fabric"]["codec"]   # attribution rows present
+        assert any(
+            r["topic"].startswith("rpc.") for r in body["fabric"]["links"]
+        )
+        # the gateway accounted its own requests (the record lands
+        # just AFTER the response bytes, so poll briefly)
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            gw = node.wire_plane.gateway.snapshot()
+            if "/wire" in gw["endpoints"]:
+                break
+            time.sleep(0.02)
+        assert gw["endpoints"]["/api/status"]["requests"] >= 1
+        assert gw["endpoints"]["/wire"]["requests"] >= 1
+        # the capacity model knows the wire resource exists
+        status, cap = _get_json(base + "/capacity")
+        assert status == 200
+        assert "wire" in cap["resources"]
+    finally:
+        node.stop()
+
+
+def test_disabled_plane_serves_404_on_a_booted_node(tmp_path):
+    from corda_tpu.node.config import NodeConfig, RpcUserConfig
+    from corda_tpu.node.node import Node
+
+    node = Node(
+        NodeConfig(
+            name="NoWireNode", base_dir=str(tmp_path / "n"),
+            notary="batching", use_tls=False,
+            verifier_backend="cpu", web_port=0,
+            wire_telemetry_enabled=False,
+            rpc_users=(RpcUserConfig("ops", "pw", ("ALL",)),),
+        )
+    ).start()
+    try:
+        assert node.wire_plane is None
+        base = f"http://127.0.0.1:{node.web.port}"
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(base + "/wire", timeout=10)
+        assert exc.value.code == 404
+    finally:
+        node.stop()
+
+
+# ---------------------------------------------------------------------------
+# CI smoke: the bench plumbing itself (plane overhead + gateway proof)
+
+
+def test_bench_quick_wire_bounds_overhead_and_accounts_gateway():
+    """`bench.py --quick wire` must run under JAX_PLATFORMS=cpu: the
+    interleaved A/B overhead gate holds the plane at <=2% of the
+    served-transaction wall, the TCP headline moves real frames with
+    the plane attached, and the gateway leg accounts its requests."""
+    bench = os.path.join(os.path.dirname(__file__), "..", "bench.py")
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(bench), "--quick", "wire"],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        capture_output=True,
+        text=True,
+        timeout=540,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == "wire_fabric_ingest"
+    assert rec["quick"] is True
+    assert rec["value"] > 0
+    assert rec["wire_plane_overhead"] <= rec["overhead_max"]
+    assert rec["wire_plane_overhead_ok"] is True
+    assert rec["gateway_accounted_ok"] is True
+    assert set(rec["gate_required_true"]) == {
+        "wire_plane_overhead_ok", "gateway_accounted_ok",
+    }
+    assert rec["links_seen"] >= 2
+    assert rec["journal_appends"] >= 1
+    assert rec["gateway_requests"] >= 30

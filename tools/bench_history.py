@@ -11,6 +11,8 @@ This CLI is that comparison:
     python tools/bench_history.py --all           # full trajectory
     python tools/bench_history.py --gate 10       # exit 1 on any
                                                   # metric down >10%
+    python tools/bench_history.py --format md     # markdown table
+                                                  # (PR / CI summary)
 
 Per metric it prints old -> new value, the delta percent, and the
 newest vs_baseline; `--gate <pct>` turns a regression beyond the
@@ -231,6 +233,33 @@ def format_rows(rows: list[dict], old_label: str, new_label: str) -> str:
     return "\n".join(out)
 
 
+def format_rows_md(rows: list[dict], old_label: str, new_label: str) -> str:
+    """The same per-metric diff as `format_rows`, rendered as a GitHub
+    markdown table — pasteable into a PR description or CI summary.
+    Direction markers land in their own column so a reader scanning the
+    delta column isn't parsing bracketed suffixes."""
+    out = [
+        f"### bench diff: `{old_label}` -> `{new_label}`",
+        "",
+        "| metric | old | new | delta | vs_baseline | direction |",
+        "| --- | ---: | ---: | ---: | ---: | --- |",
+    ]
+    for r in rows:
+        o = "-" if r["old"] is None else f"{r['old']:g}"
+        n = "-" if r["new"] is None else f"{r['new']:g}"
+        d = "-" if r["delta_pct"] is None else f"{r['delta_pct']:+.2f}%"
+        vs = "-" if r["vs_baseline"] is None else f"{r['vs_baseline']:g}"
+        direction = (
+            "lower is better" if r.get("better") == "lower"
+            else "required true" if r.get("better") == "required"
+            else "higher is better"
+        )
+        out.append(
+            f"| `{r['metric']}` | {o} | {n} | {d} | {vs} | {direction} |"
+        )
+    return "\n".join(out)
+
+
 # growth-from-zero floor for lower-is-better rows: a 0.0 old value
 # (the overhead metrics clamp at 0.0 on a quiet box; a stage can round
 # to 0) makes delta_pct undefined, which must not wave a real
@@ -295,6 +324,14 @@ def main(argv: Optional[list[str]] = None) -> int:
         "more than PCT percent",
     )
     p.add_argument(
+        "--format",
+        choices=("text", "md"),
+        default="text",
+        help="table renderer: aligned text (default) or a GitHub "
+        "markdown table for PR descriptions / CI job summaries; "
+        "ignored under --json",
+    )
+    p.add_argument(
         "--json",
         action="store_true",
         help="emit the per-metric diff table as one machine-readable "
@@ -328,7 +365,8 @@ def main(argv: Optional[list[str]] = None) -> int:
                 "rows": newest_rows,
             })
         else:
-            print(format_rows(newest_rows, old_label, new_label))
+            render = format_rows_md if args.format == "md" else format_rows
+            print(render(newest_rows, old_label, new_label))
     # environment drift between the newest pair: a delta-based
     # regression on a DIFFERENT rig (cpu container vs device round)
     # is annotated, not gated — required-true verdicts still gate.
