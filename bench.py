@@ -73,6 +73,15 @@ BENCH_METRIC restricts to one measurement:
 records in one CPU-safe process (tier-1 smoke of the perf plumbing);
 `--quick trace` smokes the traced hot path, asserting the stage
 breakdown sums to ~the batch wall and tracing overhead stays under 5%.
+  statestore      — billion-state uniqueness store (node/
+                    statestore.py): sustained commit_many rate of the
+                    commit-log + mmap-index backend vs the sqlite
+                    backend at a pre-populated committed set
+                    (BENCH_STATESTORE_STATES, CI-scaled; =10000000 for
+                    the 10^7 acceptance record), probe p99 proven flat
+                    as the set grows 10x, and accept/reject bit-exact
+                    vs sqlite — three REQUIRED-TRUE verdicts ride
+                    bench_history --gate
   montmul         — device-resident A/B of the MXU (batched int8
                     Toeplitz matmul) vs VPU (shifted accumulate)
                     Montgomery-multiply formulations (experiment rig,
@@ -2005,6 +2014,211 @@ def _sanitizer_metric(batch: int, iters: int) -> dict:
     }
 
 
+def _statestore_metric(batch: int, iters: int) -> dict:
+    """Billion-state uniqueness store (round 19, node/statestore.py):
+    sustained `commit_many` rate of the commit-log + mmap-index
+    backend vs the sqlite backend over a pre-populated committed-state
+    set, batched-probe p99 flatness as the set grows 10x, and a
+    bit-exact accept/reject replay vs sqlite — the scale story the
+    registry was built for, CI-scaled.
+
+    The set size is BENCH_STATESTORE_STATES (default 50k: CI-safe in
+    seconds); the 10^7-state acceptance run is the same command with
+    BENCH_STATESTORE_STATES=10000000 — nothing in the layout changes
+    with n (probes touch O(1) mmap slots, commits append), which is
+    exactly what `statestore_p99_flat` pins: probe p99 at 10xS must
+    stay within BENCH_STATESTORE_P99_FACTOR (default 3.0, generous
+    for CI noise — the deterministic gate is tests/test_statestore.py)
+    of p99 at S. Durability parity for the rate A/B: the sqlite
+    backend runs file-backed with its production pragmas (WAL,
+    synchronous=NORMAL — no per-commit fsync), so the commit-log side
+    runs fsync=False (group-commit, same WAL discipline). Verdicts
+    `statestore_commit_rate_ok` (commit-log >= sqlite x
+    BENCH_STATESTORE_RATE_MARGIN), `statestore_p99_flat` and
+    `statestore_bitexact_vs_sqlite` ride bench_history --gate as
+    REQUIRED-TRUE."""
+    import shutil
+    import tempfile
+    import time as _time
+
+    from corda_tpu.core.contracts import StateRef
+    from corda_tpu.crypto.hashes import SecureHash
+    from corda_tpu.node.notary import UniquenessConflict
+    from corda_tpu.node.persistence import (
+        NodeDatabase, ShardedPersistentUniquenessProvider,
+    )
+    from corda_tpu.node.statestore import (
+        CommitLogStateStore, ShardedCommitLogUniquenessProvider,
+    )
+
+    rng = random.Random(19)
+    states = max(
+        int(os.environ.get("BENCH_STATESTORE_STATES", "50000")), 1000
+    )
+    rate_margin = float(
+        os.environ.get("BENCH_STATESTORE_RATE_MARGIN", "0.9")
+    )
+    p99_factor = float(
+        os.environ.get("BENCH_STATESTORE_P99_FACTOR", "3.0")
+    )
+    reps = max(2, iters)
+
+    class _P:
+        name = "O=Bench"
+
+    party = _P()
+
+    def mkrefs(n: int) -> list:
+        return [StateRef(SecureHash(rng.randbytes(32)), 0)
+                for _ in range(n)]
+
+    def entries_of(refs: list) -> list:
+        # multi-input transactions, 32 inputs each: the flush shape
+        return [(refs[i:i + 32], SecureHash(rng.randbytes(32)), party)
+                for i in range(0, len(refs), 32)]
+
+    root = tempfile.mkdtemp(prefix="bench_statestore_")
+    try:
+        # -- commit-rate A/B at depth --------------------------------
+        sq = ShardedPersistentUniquenessProvider(
+            NodeDatabase(os.path.join(root, "sq.db")), 2
+        )
+        cl = ShardedCommitLogUniquenessProvider(
+            os.path.join(root, "cl"), 2,
+            segment_max_records=1 << 20,
+            compact_min_segments=1 << 30, fsync=False,
+        )
+        for i in range(0, states, 4096):
+            chunk = entries_of(mkrefs(min(4096, states - i)))
+            sq.commit_many(chunk)
+            cl.commit_many(chunk)
+        cl.compact_all()   # probes below hit the mmap snapshot path
+
+        walls_sq, walls_cl = [], []
+        for _ in range(reps):   # interleaved A/B: drift cancels
+            fresh = entries_of(mkrefs(batch))
+            t0 = _time.perf_counter()
+            out_sq = sq.commit_many(fresh)
+            walls_sq.append(_time.perf_counter() - t0)
+            t0 = _time.perf_counter()
+            out_cl = cl.commit_many(fresh)
+            walls_cl.append(_time.perf_counter() - t0)
+            if any(r is not None for r in out_sq + out_cl):
+                raise SystemExit(
+                    "fresh-ref commit conflicted — the rate fixture "
+                    "is broken"
+                )
+        rate_sq = batch / min(walls_sq)
+        rate_cl = batch / min(walls_cl)
+        ratio = rate_cl / rate_sq
+        depth = cl.committed_count
+        cl.close()
+
+        # -- probe p99 flatness: grow ONE store S -> 10S -------------
+        store = CommitLogStateStore(
+            os.path.join(root, "p99"),
+            segment_max_records=1 << 20,
+            compact_min_segments=1 << 30, fsync=False,
+        )
+        kept: list = []   # every 16th ref: the probe sample pool
+        tx = SecureHash(rng.randbytes(32))
+
+        def grow(n: int) -> None:
+            for i in range(0, n, 8192):
+                refs = mkrefs(min(8192, n - i))
+                kept.extend(refs[::16])
+                store.commit_rows([(r, tx, "O=Bench") for r in refs])
+            store.compact(force=True)   # probes read the mmap index
+
+        def probe_p99_us() -> float:
+            probe = min(256, len(kept))
+            calls = 200
+            walls = []
+            for _ in range(calls):
+                sample = rng.sample(kept, probe)
+                t0 = _time.perf_counter()
+                got = store.prior_consumers_many(sample)
+                walls.append(_time.perf_counter() - t0)
+                if len(got) != probe:
+                    raise SystemExit(
+                        "a committed ref probed silent — the index "
+                        "is lying"
+                    )
+            walls.sort()
+            return walls[int(0.99 * (len(walls) - 1))] / probe * 1e6
+
+        grow(states)
+        p99_small = probe_p99_us()
+        grow(9 * states)
+        p99_big = probe_p99_us()
+        big_states = store.committed_count
+        store.close()
+        p99_ratio = p99_big / p99_small
+
+        # -- bit-exact accept/reject replay vs sqlite ----------------
+        pool = [StateRef(SecureHash(rng.randbytes(32)), rng.randrange(4))
+                for _ in range(240)]
+        workload = [
+            (rng.sample(pool, rng.randint(1, 4)),
+             SecureHash(rng.randbytes(32)), party)
+            for _ in range(160)
+        ]
+        sq2 = ShardedPersistentUniquenessProvider(
+            NodeDatabase(":memory:"), 4
+        )
+        cl2 = ShardedCommitLogUniquenessProvider(
+            os.path.join(root, "bitexact"), 4,
+            segment_max_records=32, compact_min_segments=2,
+            fsync=False,
+        )
+        got_sq = sq2.commit_many(workload)
+        got_cl = cl2.commit_many(workload)
+        bitexact = len(got_sq) == len(got_cl) and all(
+            (a is None and b is None)
+            or (isinstance(a, UniquenessConflict)
+                and isinstance(b, UniquenessConflict)
+                and a.conflict == b.conflict)
+            for a, b in zip(got_sq, got_cl)
+        ) and cl2.committed == sq2.committed
+        conflicts = sum(1 for r in got_sq if r is not None)
+        cl2.close()
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    return {
+        "metric": "statestore_commit_rate",
+        "value": round(rate_cl, 1),
+        "unit": "states/s through commit_many at a pre-populated set "
+                "(commit-log backend)",
+        "lower_is_better": False,
+        "vs_baseline": round(ratio, 3),
+        "sqlite_rate": round(rate_sq, 1),
+        "commit_rate_vs_sqlite": round(ratio, 3),
+        "rate_margin": rate_margin,
+        "statestore_commit_rate_ok": ratio >= rate_margin,
+        "prepopulated_states": states,
+        "grown_states": big_states,
+        "commit_depth": depth,
+        "probe_p99_us_per_ref_at_s": round(p99_small, 3),
+        "probe_p99_us_per_ref_at_10s": round(p99_big, 3),
+        "probe_p99_ratio": round(p99_ratio, 3),
+        "p99_factor_max": p99_factor,
+        "statestore_p99_flat": p99_ratio <= p99_factor,
+        "bitexact_conflicts": conflicts,
+        "statestore_bitexact_vs_sqlite": bitexact,
+        "gate_required_true": [
+            "statestore_commit_rate_ok", "statestore_p99_flat",
+            "statestore_bitexact_vs_sqlite",
+        ],
+        "extrapolation": "probes touch O(1) mmap slots and commits "
+                         "append; rerun with "
+                         "BENCH_STATESTORE_STATES=10000000 for the "
+                         "10^7-state acceptance record",
+        "batch": batch,
+        "reps": reps,
+    }
+
+
 def _montmul_metric(batch: int, iters: int) -> dict:
     """Interleaved device-resident A/B of the two variable x variable
     Montgomery-multiply formulations (round-3 MXU experiment, VERDICT
@@ -2742,6 +2956,11 @@ def _run_metric_inner(metric: str, batch: int, iters: int) -> dict:
         if batch > 512:
             out["batch_requested"] = batch   # cap visible in the record
         return out
+    if metric == "statestore":
+        out = _statestore_metric(min(batch, 8192), iters)
+        if batch > 8192:
+            out["batch_requested"] = batch   # cap visible in the record
+        return out
     if metric == "fleet":
         out = _fleet_metric(min(batch, 16), iters)
         if batch > 16:
@@ -2793,6 +3012,31 @@ def _run_child(m: str, env: dict, timeout: float) -> bool:
             )
         print(f"bench metric {m!r} failed: {e}", file=sys.stderr)
         return False
+
+
+def _retry_gate(out, rerun, value_key, ok, label, max_overhead):
+    """Re-measure a flush-wall overhead gate up to BENCH_GATE_RETRIES
+    times (default 2) before letting it fail: one co-scheduled process
+    landing on the ON reps inflates min-of-reps A/B on a shared CI box,
+    and mid-suite on a single-vCPU runner one retry is demonstrably not
+    enough. Keeps the best attempt and stops as soon as the gate
+    passes; the first attempt's value rides along in the record."""
+    tries = int(os.environ.get("BENCH_GATE_RETRIES", "2"))
+    for i in range(tries):
+        if ok(out):
+            break
+        print(
+            f"bench: {label} {out[value_key]:.4f} over the "
+            f"{max_overhead:.0%} gate — noisy box? retry {i + 1}/{tries}",
+            file=sys.stderr,
+        )
+        retry = rerun()
+        if retry[value_key] < out[value_key]:
+            retry["first_attempt_overhead"] = out.get(
+                "first_attempt_overhead", out[value_key]
+            )
+            out = retry
+    return out
 
 
 def _quick(metric: str) -> None:
@@ -2850,6 +3094,13 @@ def _quick(metric: str) -> None:
                every HTTP request it served under concurrent
                notarisation load, and that per-link + journal
                accounting is nonempty.
+      statestore — the billion-state uniqueness store (round 19): a
+               tiny pre-populated set, asserting the commit-log
+               backend's accept/reject stayed bit-exact vs sqlite on
+               a conflict-heavy workload, probe p99 held flat across
+               a 10x set growth, and the sustained commit_many rate
+               held the vs-sqlite margin — the deterministic gate is
+               tests/test_statestore.py.
     """
     if metric == "shards":
         # force the smoke's sweep shape: the assertions below pin
@@ -2899,21 +3150,11 @@ def _quick(metric: str) -> None:
         max_overhead = float(
             os.environ.get("BENCH_PERF_OVERHEAD_MAX", "0.02")
         )
-        if out["value"] > max_overhead:
-            # one retry before failing (the _attempt_with_retry
-            # discipline): a co-scheduled process landing on the ON
-            # reps inflates min-of-reps A/B on a shared CI box, and
-            # the real signal — the profiler's measured self-overhead
-            # — sits an order of magnitude under the gate
-            print(
-                f"bench: perf overhead {out['value']:.4f} over the "
-                f"{max_overhead:.0%} gate — noisy box? retrying once",
-                file=sys.stderr,
-            )
-            retry = _perf_metric(batch, iters)
-            if retry["value"] < out["value"]:
-                retry["first_attempt_overhead"] = out["value"]
-                out = retry
+        out = _retry_gate(
+            out, lambda: _perf_metric(batch, iters), "value",
+            lambda o: o["value"] <= max_overhead,
+            "perf overhead", max_overhead,
+        )
         out["quick"] = True
         print(json.dumps(out), flush=True)
         if out["value"] > max_overhead:
@@ -2941,19 +3182,11 @@ def _quick(metric: str) -> None:
         iters = int(os.environ.get("BENCH_ITERS", "3"))
         out = _txstory_metric(batch, iters)
         max_overhead = out["overhead_max"]
-        if not out["txstory_overhead_ok"]:
-            # one retry before failing (the quick-perf discipline): a
-            # co-scheduled process landing on the ON reps inflates
-            # min-of-reps A/B on a shared CI box
-            print(
-                f"bench: txstory overhead {out['value']:.4f} over the "
-                f"{max_overhead:.0%} gate — noisy box? retrying once",
-                file=sys.stderr,
-            )
-            retry = _txstory_metric(batch, iters)
-            if retry["value"] < out["value"]:
-                retry["first_attempt_overhead"] = out["value"]
-                out = retry
+        out = _retry_gate(
+            out, lambda: _txstory_metric(batch, iters), "value",
+            lambda o: o["txstory_overhead_ok"],
+            "txstory overhead", max_overhead,
+        )
         out["quick"] = True
         print(json.dumps(out), flush=True)
         if not out["txstory_overhead_ok"]:
@@ -2972,19 +3205,11 @@ def _quick(metric: str) -> None:
         iters = int(os.environ.get("BENCH_ITERS", "3"))
         out = _device_metric(batch, iters)
         max_overhead = out["overhead_max"]
-        if not out["device_plane_overhead_ok"]:
-            # one retry before failing (the quick-perf discipline): a
-            # co-scheduled process landing on the ON reps inflates
-            # min-of-reps A/B on a shared CI box
-            print(
-                f"bench: device overhead {out['value']:.4f} over the "
-                f"{max_overhead:.0%} gate — noisy box? retrying once",
-                file=sys.stderr,
-            )
-            retry = _device_metric(batch, iters)
-            if retry["value"] < out["value"]:
-                retry["first_attempt_overhead"] = out["value"]
-                out = retry
+        out = _retry_gate(
+            out, lambda: _device_metric(batch, iters), "value",
+            lambda o: o["device_plane_overhead_ok"],
+            "device overhead", max_overhead,
+        )
         out["quick"] = True
         print(json.dumps(out), flush=True)
         if not out["device_plane_overhead_ok"]:
@@ -3005,20 +3230,12 @@ def _quick(metric: str) -> None:
         iters = int(os.environ.get("BENCH_ITERS", "3"))
         out = _wire_metric(batch, iters)
         max_overhead = out["overhead_max"]
-        if not out["wire_plane_overhead_ok"]:
-            # one retry before failing (the quick-perf discipline): a
-            # co-scheduled process landing on the ON reps inflates
-            # min-of-reps A/B on a shared CI box
-            print(
-                f"bench: wire overhead {out['wire_plane_overhead']:.4f} "
-                f"over the {max_overhead:.0%} gate — noisy box? "
-                "retrying once",
-                file=sys.stderr,
-            )
-            retry = _wire_metric(batch, iters)
-            if retry["wire_plane_overhead"] < out["wire_plane_overhead"]:
-                retry["first_attempt_overhead"] = out["wire_plane_overhead"]
-                out = retry
+        out = _retry_gate(
+            out, lambda: _wire_metric(batch, iters),
+            "wire_plane_overhead",
+            lambda o: o["wire_plane_overhead_ok"],
+            "wire overhead", max_overhead,
+        )
         out["quick"] = True
         print(json.dumps(out), flush=True)
         if not out["wire_plane_overhead_ok"]:
@@ -3044,20 +3261,11 @@ def _quick(metric: str) -> None:
         iters = int(os.environ.get("BENCH_ITERS", "3"))
         out = _sanitizer_metric(batch, iters)
         max_overhead = out["overhead_max"]
-        if not out["sanitizer_overhead_ok"]:
-            # one retry before failing (the quick-perf discipline): a
-            # co-scheduled process landing on the ON reps inflates
-            # min-of-reps A/B on a shared CI box
-            print(
-                f"bench: sanitizer factory overhead {out['value']:.4f} "
-                f"over the {max_overhead:.0%} gate — noisy box? "
-                "retrying once",
-                file=sys.stderr,
-            )
-            retry = _sanitizer_metric(batch, iters)
-            if retry["value"] < out["value"]:
-                retry["first_attempt_overhead"] = out["value"]
-                out = retry
+        out = _retry_gate(
+            out, lambda: _sanitizer_metric(batch, iters), "value",
+            lambda o: o["sanitizer_overhead_ok"],
+            "sanitizer factory overhead", max_overhead,
+        )
         out["quick"] = True
         print(json.dumps(out), flush=True)
         if not out["sanitizer_overhead_ok"]:
@@ -3070,6 +3278,44 @@ def _quick(metric: str) -> None:
                 "the armed rep observed no locks — the factory is not "
                 "routing constructions through the monitor"
             )
+        return
+    if metric == "statestore":
+        # tiny set: tier-1 smokes the record shape and the three
+        # REQUIRED-TRUE verdicts; the at-scale numbers come from the
+        # default run (and BENCH_STATESTORE_STATES=10000000 for the
+        # 10^7 acceptance record)
+        os.environ.setdefault("BENCH_STATESTORE_STATES", "4000")
+        batch = int(os.environ.get("BENCH_BATCH", "2048"))
+        iters = int(os.environ.get("BENCH_ITERS", "2"))
+        out = _statestore_metric(batch, iters)
+        out["quick"] = True
+        print(json.dumps(out), flush=True)
+        if not out["statestore_bitexact_vs_sqlite"]:
+            raise SystemExit(
+                "commit-log accept/reject diverged from the sqlite "
+                "backend on the same workload — the one thing the "
+                "store must never do"
+            )
+        if out["bitexact_conflicts"] < 1:
+            raise SystemExit(
+                "the bit-exact workload produced no conflicts — the "
+                "replay proved nothing"
+            )
+        if not out["statestore_p99_flat"]:
+            raise SystemExit(
+                f"probe p99 grew {out['probe_p99_ratio']:.2f}x when "
+                "the committed set grew 10x — the O(1) index story "
+                "is broken"
+            )
+        if not out["statestore_commit_rate_ok"]:
+            raise SystemExit(
+                f"commit-log sustained rate fell to "
+                f"{out['commit_rate_vs_sqlite']:.2f} of sqlite's "
+                f"(gate {out['rate_margin']:.2f}) at depth "
+                f"{out['commit_depth']}"
+            )
+        if out["value"] <= 0:
+            raise SystemExit("zero sustained commit rate")
         return
     if metric == "fleet":
         batch = int(os.environ.get("BENCH_BATCH", "8"))
@@ -3217,8 +3463,8 @@ def _quick(metric: str) -> None:
         raise SystemExit(
             f"--quick supports 'ingest', 'trace', 'consensus', 'qos', "
             f"'health', 'perf', 'txstory', 'device', 'wire', "
-            f"'sanitizer', 'fleet', 'faults', 'distributed' or "
-            f"'shards', not {metric!r}"
+            f"'sanitizer', 'statestore', 'fleet', 'faults', "
+            f"'distributed' or 'shards', not {metric!r}"
         )
     batch = int(os.environ.get("BENCH_BATCH", "256"))
     iters = int(os.environ.get("BENCH_ITERS", "1"))
@@ -3239,8 +3485,8 @@ def main() -> None:
         raise SystemExit(
             f"unknown arguments {argv!r} "
             "(try --quick ingest|trace|consensus|qos|health|perf|"
-            "txstory|device|wire|sanitizer|fleet|faults|distributed|"
-            "shards)"
+            "txstory|device|wire|sanitizer|statestore|fleet|faults|"
+            "distributed|shards)"
         )
     t_start = time.perf_counter()
     # On a remote-attached TPU the host<->device link latency (~50-100
@@ -3253,8 +3499,8 @@ def main() -> None:
     known = (
         "all", "p256", "mixed", "merkle", "notary", "notary_commit_plane",
         "ingest", "ingest_pipelined", "trace", "consensus", "qos", "health",
-        "perf", "txstory", "device", "wire", "sanitizer", "fleet",
-        "faults", "distributed_commit", "montmul", "parity",
+        "perf", "txstory", "device", "wire", "sanitizer", "statestore",
+        "fleet", "faults", "distributed_commit", "montmul", "parity",
     )
     if metric not in known:
         # a typo must not record a p256-only rate under another name
@@ -3294,8 +3540,8 @@ def main() -> None:
     # before the headline so the headline stays the final stdout line
     for m in ("mixed", "merkle", "notary", "ingest", "ingest_pipelined",
               "trace", "consensus", "qos", "health", "perf", "txstory",
-              "device", "wire", "sanitizer", "fleet", "faults",
-              "distributed_commit", "parity"):
+              "device", "wire", "sanitizer", "statestore", "fleet",
+              "faults", "distributed_commit", "parity"):
         avail = left() - reserve
         if avail < 60:
             print(
@@ -3308,8 +3554,8 @@ def main() -> None:
         if avail < 300 and m in (
             "mixed", "merkle", "notary", "ingest", "ingest_pipelined",
             "trace", "consensus", "qos", "health", "perf", "txstory",
-            "device", "wire", "sanitizer", "fleet", "faults",
-            "distributed_commit",
+            "device", "wire", "sanitizer", "statestore", "fleet",
+            "faults", "distributed_commit",
         ):
             # trim before dropping: one timed rep at a shallower batch
             # still yields a usable point for the table

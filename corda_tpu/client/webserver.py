@@ -130,6 +130,7 @@ class NodeWebServer:
         cluster_tx=None,
         device=None,
         wire=None,
+        statestore=None,
         slow_request_micros: int = 50_000,
     ):
         """`metrics`: an optional MetricRegistry served at GET /metrics
@@ -241,6 +242,7 @@ class NodeWebServer:
         self.cluster_tx = cluster_tx
         self.device = device
         self.wire = wire
+        self.statestore = statestore
         self.slow_request_micros = int(slow_request_micros)
         # serializes /profile on-demand captures and resets: without
         # it a second ?seconds=N request returns a partial table and
@@ -305,6 +307,12 @@ class NodeWebServer:
                 "journal latency quantiles, redelivery/dedupe/backlog, "
                 "per-endpoint gateway accounting",
                 self._serve_wire,
+            ),
+            "/statestore": (
+                "billion-state committed-state registry: per-shard "
+                "segment/snapshot depth, memtable size, compaction "
+                "and probe counters for the commit-log backend",
+                self._serve_statestore,
             ),
             "/perf": (
                 "performance attribution: kernel compile/execute "
@@ -428,6 +436,7 @@ class NodeWebServer:
             "/incidents": self.incidents, "/shards": self.shards,
             "/device": self.device, "/capacity": self.device,
             "/wire": self.wire,
+            "/statestore": self.statestore,
         }
         rows = [
             {
@@ -768,6 +777,25 @@ class NodeWebServer:
             return self._json(200, self.wire.snapshot())
         except Exception as e:   # noqa: BLE001 - defensive render
             return self._json(500, {"error": f"wire snapshot failed: {e}"})
+
+    def _serve_statestore(self, query) -> tuple[int, str, bytes]:
+        # the committed-state registry's shape: how deep the snapshot
+        # is, how much unfolded tail the memtable carries, how often
+        # compaction folds — the reading guide lives in
+        # docs/node-administration.md ("Billion-state store")
+        try:
+            if self.statestore is None:
+                return self._json(
+                    404,
+                    {"error": "commit-log state store not wired on "
+                              "this gateway (notary_state_store = "
+                              "sqlite?)"},
+                )
+            return self._json(200, self.statestore.stats())
+        except Exception as e:   # noqa: BLE001 - defensive render
+            return self._json(
+                500, {"error": f"statestore snapshot failed: {e}"}
+            )
 
     def _serve_perf(self, query) -> tuple[int, str, bytes]:
         # the attribution snapshot: /metrics tells you THAT serving

@@ -75,6 +75,13 @@ class NodeConfig:
     # a safe boot-time migration (rows re-route into the new partition
     # tables).
     notary_shards: int = 0
+    # committed-state registry backend: "sqlite" (the per-shard
+    # `notary_commits_s<k>` tables) or "commitlog" (the billion-state
+    # segmented commit log + mmap hash index under
+    # <base_dir>/statestore, node/statestore.py). Switching to
+    # commitlog runs a ONE-WAY boot migration out of the sqlite
+    # tables; accept/reject decisions are bit-exact across backends.
+    notary_state_store: str = "sqlite"
     # give every shard a dedicated flush worker thread (the pump then
     # only routes and resolves answers); False flushes shards from the
     # pump tick as a dispatch-all-then-consume wave
@@ -260,6 +267,19 @@ class NodeConfig:
         if self.notary_shard_workers and self.notary_shards <= 1:
             raise ConfigError(
                 "notary_shard_workers requires notary_shards > 1"
+            )
+        if self.notary_state_store not in ("sqlite", "commitlog"):
+            raise ConfigError(
+                "notary_state_store must be 'sqlite' or 'commitlog'"
+            )
+        if (
+            self.notary_state_store == "commitlog"
+            and self.notary in ("raft", "raft-validating", "bft")
+        ):
+            raise ConfigError(
+                "notary_state_store = 'commitlog' serves the batching/"
+                "simple/validating and distributed planes — the raft "
+                "and bft notaries replicate their own store"
             )
         if self.notary_intent_wal and self.notary != "batching":
             raise ConfigError(
@@ -472,6 +492,8 @@ def write_config(cfg: NodeConfig, path: str) -> None:
             emit("notary_shard_workers", cfg.notary_shard_workers)
     if cfg.notary_intent_wal:
         emit("notary_intent_wal", cfg.notary_intent_wal)
+    if cfg.notary_state_store != "sqlite":
+        emit("notary_state_store", cfg.notary_state_store)
     if cfg.notary_cluster_shards:
         emit("notary_cluster_shards", cfg.notary_cluster_shards)
     if cfg.notary_xshard_timeout_micros != 2_000_000:

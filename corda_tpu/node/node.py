@@ -653,9 +653,13 @@ class Node:
         self.services.key_management.register_keypair(self._cluster_keypair)
         if cfg.qos_enabled:
             self._build_qos()
-        store = ShardedPersistentUniquenessProvider(
-            self.db, cfg.notary_cluster_shards
-        )
+        if cfg.notary_state_store == "commitlog":
+            store = self._build_state_store(cfg.notary_cluster_shards)
+        else:
+            store = ShardedPersistentUniquenessProvider(
+                self.db, cfg.notary_cluster_shards
+            )
+        self._gauge_committed_states(store)
         provider = DistributedUniquenessProvider(
             cfg.name,
             list(cfg.cluster_peers),
@@ -706,11 +710,55 @@ class Node:
             self.services.notary_service.attach_perf(self.perf)
             self.health.watch_perf(self.perf)
 
+    def _build_state_store(self, n_shards: int):
+        """Mount the billion-state committed-state registry (round 19,
+        node/statestore.py) under <base_dir>/statestore, drain the
+        sqlite tables into it (ONE-WAY boot migration — commit-log
+        appends are idempotent, and the sqlite clear runs last, so a
+        crash mid-migration simply re-migrates on next boot), and
+        export the Statestore.* gauges the GET /statestore plane
+        reads alongside."""
+        from .statestore import (
+            ShardedCommitLogUniquenessProvider,
+            migrate_sqlite_state,
+        )
+
+        store = ShardedCommitLogUniquenessProvider(
+            os.path.join(self.config.base_dir, "statestore"), n_shards
+        )
+        migrate_sqlite_state(self.db, store)
+        self.statestore = store
+
+        def stat(key):
+            return lambda s=store, k=key: s.stats()[k]
+
+        self.metrics.gauge(
+            "Statestore.CommittedStates", stat("committed_states")
+        )
+        self.metrics.gauge("Statestore.Segments", stat("segments"))
+        self.metrics.gauge(
+            "Statestore.SnapshotStates", stat("snapshot_states")
+        )
+        self.metrics.gauge(
+            "Statestore.MemtableStates", stat("memtable_states")
+        )
+        self.metrics.gauge("Statestore.Compactions", stat("compactions"))
+        return store
+
+    def _gauge_committed_states(self, uniqueness) -> None:
+        # set-growth without a scan: every backend maintains the count
+        # O(1), so health/capacity can watch it for free
+        self.metrics.gauge(
+            "Notary.CommittedStates",
+            lambda u=uniqueness: u.committed_count,
+        )
+
     def _install_notary(self) -> None:
         kind = self.config.notary
         self.raft = None
         self.bft = None
         self.xshard = None
+        self.statestore = None
         if kind == "":
             return
         if kind == "batching" and self.config.notary_cluster_shards > 0:
@@ -747,12 +795,20 @@ class Node:
                     shards = max(int.from_bytes(stored, "big"), 1)
             else:
                 shards = 0                     # classic legacy layout
-            if shards:
+            if self.config.notary_state_store == "commitlog":
+                # billion-state plane (round 19): the segmented commit
+                # log + mmap hash index replaces the sqlite tables; a
+                # one-way boot migration drains whichever layout they
+                # held (legacy or partitioned)
+                shards = max(self.config.notary_shards, 1)
+                uniqueness = self._build_state_store(shards)
+            elif shards:
                 uniqueness = ShardedPersistentUniquenessProvider(
                     self.db, shards
                 )
             else:
                 uniqueness = PersistentUniquenessProvider(self.db)
+            self._gauge_committed_states(uniqueness)
             if kind == "batching":
                 shard_verifiers = None
                 if (
@@ -997,6 +1053,11 @@ class Node:
             # timeouts, commit re-drives and orphan queries all walk
             # on the pump cadence too
             self.xshard.tick()
+        if getattr(self, "statestore", None) is not None:
+            # commit-log compaction walks on the pump cadence:
+            # fold piled-up sealed segments into the next snapshot
+            # generation off the serving path
+            self.statestore.maintain()
         if self.raft is not None:
             if self._hb_raft is None:
                 self._hb_raft = self.health.heartbeat("raft.driver")
@@ -1144,6 +1205,7 @@ class Node:
             cluster_tx=self.cluster_tx,
             device=self.device_plane,
             wire=self.wire_plane,
+            statestore=getattr(self, "statestore", None),
             slow_request_micros=self.config.web_slow_request_micros,
         )
 

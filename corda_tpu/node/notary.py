@@ -346,6 +346,21 @@ class ShardedUniquenessProvider(UniquenessProvider):
         under the partition condition."""
         return self._parts[shard].committed.get(ref)
 
+    def _prior_consumers_many(self, shard: int, refs) -> dict:
+        """Batched membership probe: {ref: committed consumer} for the
+        subset of `refs` already committed on `shard` (absent = free).
+        Called under the partition condition. The default is per-ref
+        point probes; backends with a real batched sweep (the commit-
+        log store's sorted mmap-index walk, the sqlite layer's one
+        `IN (...)` query) override this — commit_many issues exactly
+        ONE of these per flush run."""
+        out = {}
+        for ref in refs:
+            prior = self._prior_consumer(shard, ref)
+            if prior is not None:
+                out[ref] = prior
+        return out
+
     def _write_shard(self, shard: int, refs, tx_id, requester) -> None:
         """Durably commit `refs` -> tx_id on `shard`. Called under the
         partition condition."""
@@ -521,6 +536,19 @@ class ShardedUniquenessProvider(UniquenessProvider):
                 # staged state); it re-enters below via the per-entry
                 # two-phase path, which parks on the reservation
                 # correctly.
+                # ONE batched membership probe for the whole run: the
+                # backing store never changes under the held condition
+                # (the run's own rows write at the end), so the
+                # persisted view is fixed — only the staged view
+                # evolves entry to entry
+                run_refs: list = []
+                seen: set = set()
+                for k in range(i, j):
+                    for ref in entries[k][0]:
+                        if ref not in seen:
+                            seen.add(ref)
+                            run_refs.append(ref)
+                persisted = self._prior_consumers_many(home, run_refs)
                 for k in range(i, j):
                     states_k, tx_k, req_k = entries[k]
                     if any(
@@ -532,7 +560,7 @@ class ShardedUniquenessProvider(UniquenessProvider):
                     for ref in states_k:
                         prior = staged.get(ref)
                         if prior is None:
-                            prior = self._prior_consumer(home, ref)
+                            prior = persisted.get(ref)
                         if prior is not None and prior != tx_k:
                             conflict[ref] = prior
                     if conflict:

@@ -309,47 +309,93 @@ class PersistentUniquenessProvider(UniquenessProvider):
 
     batch_synchronous = True
 
+    # sqlite's default parameter ceiling is 999; two params per ref
+    # pair keeps a healthy margin under it
+    _PROBE_CHUNK = 400
+
     def __init__(self, db: NodeDatabase):
         self._db = db
+        # O(1) committed count: scanned ONCE at boot, maintained by
+        # actual-new-row deltas from the inserts (INSERT OR IGNORE
+        # absorbs idempotent re-commits without double-counting)
+        self._count = db.query(
+            "SELECT COUNT(*) FROM notary_commits"
+        )[0][0]
+
+    @classmethod
+    def _probe_in(cls, conn, table: str, refs) -> dict:
+        """The batched conflict probe: ONE `IN (VALUES ...)` row-value
+        query per chunk of refs instead of a point SELECT per ref in a
+        Python loop — the same one-sweep-per-flush shape the commit-log
+        store's `prior_consumers_many` serves from its mmap index."""
+        out: dict = {}
+        refs = list(refs)
+        for i in range(0, len(refs), cls._PROBE_CHUNK):
+            chunk = refs[i:i + cls._PROBE_CHUNK]
+            marks = ",".join("(?,?)" for _ in chunk)
+            params: list = []
+            for ref in chunk:
+                params += [ref.txhash.bytes_, ref.index]
+            for ref_tx, ref_index, consumer in conn.execute(
+                f"SELECT ref_tx, ref_index, consumer FROM {table}"
+                f" WHERE (ref_tx, ref_index) IN (VALUES {marks})",
+                params,
+            ):
+                out[StateRef(SecureHash(bytes(ref_tx)), ref_index)] = (
+                    SecureHash(bytes(consumer))
+                )
+        return out
 
     def commit(
         self, states: list[StateRef], tx_id: SecureHash, requester: Party
     ) -> None:
         with self._db.transaction() as conn:
-            conflict = {}
-            for ref in states:
-                row = conn.execute(
-                    "SELECT consumer FROM notary_commits"
-                    " WHERE ref_tx=? AND ref_index=?",
-                    (ref.txhash.bytes_, ref.index),
-                ).fetchone()
-                if row is not None and bytes(row[0]) != tx_id.bytes_:
-                    conflict[ref] = SecureHash(bytes(row[0]))
+            prior_map = self._probe_in(conn, "notary_commits", states)
+            conflict = {
+                ref: prior
+                for ref, prior in prior_map.items()
+                if prior != tx_id
+            }
             if conflict:
                 raise UniquenessConflict(conflict)
-            for ref in states:
-                conn.execute(
-                    "INSERT OR IGNORE INTO notary_commits"
-                    " (ref_tx, ref_index, consumer, requester)"
-                    " VALUES (?,?,?,?)",
+            before = conn.total_changes
+            conn.executemany(
+                "INSERT OR IGNORE INTO notary_commits"
+                " (ref_tx, ref_index, consumer, requester)"
+                " VALUES (?,?,?,?)",
+                [
                     (
                         ref.txhash.bytes_,
                         ref.index,
                         tx_id.bytes_,
                         requester.name,
-                    ),
-                )
+                    )
+                    for ref in states
+                ],
+            )
+            self._count += conn.total_changes - before
 
     def commit_many(self, entries) -> list:
         """A whole notary flush in ONE DB transaction (the reference
         batches JDBC work per CommitRequest the same way): sequential
-        first-wins semantics per entry, one executemany for all the
-        surviving inserts instead of a statement per StateRef."""
+        first-wins semantics per entry, ONE batched `IN (...)` probe
+        for every distinct ref in the flush (the persisted view is
+        fixed for the whole transaction — only the staged view evolves
+        entry to entry), and one executemany for all the surviving
+        inserts."""
         from .notary import UniquenessConflict
 
         out = []
         rows = []
         with self._db.transaction() as conn:
+            distinct: list = []
+            seen: set = set()
+            for states, _tx, _req in entries:
+                for ref in states:
+                    if ref not in seen:
+                        seen.add(ref)
+                        distinct.append(ref)
+            persisted = self._probe_in(conn, "notary_commits", distinct)
             # staged view: refs committed by EARLIER entries in this
             # batch must conflict later ones exactly as sequential
             # commits would
@@ -359,13 +405,7 @@ class PersistentUniquenessProvider(UniquenessProvider):
                 for ref in states:
                     prior = staged.get(ref)
                     if prior is None:
-                        row = conn.execute(
-                            "SELECT consumer FROM notary_commits"
-                            " WHERE ref_tx=? AND ref_index=?",
-                            (ref.txhash.bytes_, ref.index),
-                        ).fetchone()
-                        if row is not None:
-                            prior = SecureHash(bytes(row[0]))
+                        prior = persisted.get(ref)
                     if prior is not None and prior != tx_id:
                         conflict[ref] = prior
                 if conflict:
@@ -383,17 +423,19 @@ class PersistentUniquenessProvider(UniquenessProvider):
                     )
                 out.append(None)
             if rows:
+                before = conn.total_changes
                 conn.executemany(
                     "INSERT OR IGNORE INTO notary_commits"
                     " (ref_tx, ref_index, consumer, requester)"
                     " VALUES (?,?,?,?)",
                     rows,
                 )
+                self._count += conn.total_changes - before
         return out
 
     @property
     def committed_count(self) -> int:
-        return self._db.query("SELECT COUNT(*) FROM notary_commits")[0][0]
+        return self._count
 
 
 class ShardedPersistentUniquenessProvider(ShardedUniquenessProvider):
@@ -424,6 +466,14 @@ class ShardedPersistentUniquenessProvider(ShardedUniquenessProvider):
         super().__init__(n_shards, record_decisions)
         self._db = db
         self._ensure_layout()
+        # O(1) committed counts: one COUNT(*) per partition at boot,
+        # maintained by actual-new-row insert deltas from there on
+        self._counts = [
+            self._db.query(
+                f"SELECT COUNT(*) FROM {self._table(k)}"
+            )[0][0]
+            for k in range(self.n_shards)
+        ]
 
     def _table(self, shard: int) -> str:
         return f"notary_commits_s{shard}"
@@ -495,11 +545,18 @@ class ShardedPersistentUniquenessProvider(ShardedUniquenessProvider):
         )
         return SecureHash(bytes(row[0][0])) if row else None
 
+    def _prior_consumers_many(self, shard: int, refs) -> dict:
+        with self._db.transaction() as conn:
+            return PersistentUniquenessProvider._probe_in(
+                conn, self._table(shard), refs
+            )
+
     def _write_shard(self, shard: int, refs, tx_id, requester) -> None:
         self._write_rows(shard, [(ref, tx_id, requester) for ref in refs])
 
     def _write_rows(self, shard: int, rows) -> None:
         with self._db.transaction() as conn:
+            before = conn.total_changes
             conn.executemany(
                 f"INSERT OR IGNORE INTO {self._table(shard)}"
                 " (ref_tx, ref_index, consumer, requester)"
@@ -510,13 +567,11 @@ class ShardedPersistentUniquenessProvider(ShardedUniquenessProvider):
                     for ref, tx_id, requester in rows
                 ],
             )
+            self._counts[shard] += conn.total_changes - before
 
     @property
     def committed_count(self) -> int:
-        return sum(
-            self._db.query(f"SELECT COUNT(*) FROM {self._table(k)}")[0][0]
-            for k in range(self.n_shards)
-        )
+        return sum(self._counts)
 
     @property
     def committed(self) -> dict:
@@ -534,9 +589,7 @@ class ShardedPersistentUniquenessProvider(ShardedUniquenessProvider):
         return out
 
     def partition_depth(self, shard: int) -> int:
-        return self._db.query(
-            f"SELECT COUNT(*) FROM {self._table(shard)}"
-        )[0][0]
+        return self._counts[shard]
 
 
 class NotaryIntentJournal:

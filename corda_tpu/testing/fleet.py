@@ -800,6 +800,8 @@ class FleetSim:
         cluster_shards: int = 8,
         batch_verifier=None,
         spend_source: str = "cash",
+        statestore: str = "sqlite",
+        statestore_dir: Optional[str] = None,
     ):
         """`verifier_pool` (batching only): attach N out-of-process
         VerifierWorkers on the fabric and an
@@ -834,6 +836,34 @@ class FleetSim:
                 "txstory is a batching-flavour seam (the lifecycle "
                 "ledger reconciliation rides the batching intake)"
             )
+        # round 19: the distributed flavour can swap its members'
+        # committed-state registry from the sqlite tables to the
+        # commit-log store (node/statestore.py) — per-member store
+        # DIRECTORIES play the role the per-member NodeDatabase plays
+        # for sqlite (durable state surviving kill/restart), so
+        # restart_member() becomes a real boot replay over segments +
+        # snapshot and a joiner can install a member's snapshot file
+        # set
+        if statestore not in ("sqlite", "commitlog"):
+            raise ValueError(
+                f"unknown statestore backend {statestore!r} "
+                "(sqlite | commitlog)"
+            )
+        if statestore == "commitlog":
+            if flavour != "distributed":
+                raise ValueError(
+                    "statestore='commitlog' is a distributed-flavour "
+                    "seam"
+                )
+            if not statestore_dir:
+                raise ValueError(
+                    "statestore='commitlog' needs statestore_dir: the "
+                    "per-member store directories must survive "
+                    "kill/restart"
+                )
+        self.statestore = statestore
+        self._statestore_dir = statestore_dir
+        self._member_stores: dict = {}
         self.scenario = scenario
         self.flavour = flavour
         self.chaos = ChaosPlane(chaos)
@@ -1297,15 +1327,42 @@ class FleetSim:
         old = self._xshard_providers.get(node.name)
         if old is not None:
             old.stop()
+        if self.statestore == "commitlog":
+            # close the dead incarnation's handles, then reopen the
+            # SAME directory: recovery replays manifest + snapshot +
+            # segment tail — the boot-replay path, under fleet chaos.
+            # Tiny segments so a soak actually seals, compacts and
+            # replays multi-segment logs; fsync off matches the
+            # simulated-time discipline (writes survive like the
+            # per-member NodeDatabase does).
+            import os as _os
+
+            from ..node.statestore import (
+                ShardedCommitLogUniquenessProvider,
+            )
+
+            old_store = self._member_stores.pop(node.name, None)
+            if old_store is not None:
+                old_store.close()
+            store = ShardedCommitLogUniquenessProvider(
+                _os.path.join(self._statestore_dir, node.name),
+                self.cluster_shards,
+                segment_max_records=16,
+                compact_min_segments=4,
+                fsync=False,
+            )
+            self._member_stores[node.name] = store
+        else:
+            store = ShardedPersistentUniquenessProvider(
+                db, self.cluster_shards
+            )
         provider = DistributedUniquenessProvider(
             node.name,
             member_names,
             node.messaging,
             self.net.clock,
             n_partitions=self.cluster_shards,
-            store=ShardedPersistentUniquenessProvider(
-                db, self.cluster_shards
-            ),
+            store=store,
             journal=XShardCoordinatorJournal(db),
             reservations=XShardReservationJournal(db),
             policy=self._xshard_policy,

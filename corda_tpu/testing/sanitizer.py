@@ -1064,6 +1064,7 @@ class CrashScheduleExplorer:
         n_partitions: int = 6,
         provider_cls=None,
         seed: int = 0,
+        store_factory=None,
     ):
         from ..node.distributed_uniqueness import (
             DistributedUniquenessProvider,
@@ -1074,6 +1075,16 @@ class CrashScheduleExplorer:
         self.n_partitions = n_partitions
         self.provider_cls = provider_cls or DistributedUniquenessProvider
         self.seed = seed
+        # pluggable committed-state backend (round 19): called as
+        # store_factory(world_id, member) for every (re)build — a
+        # restart within one world MUST reopen the same durable state
+        # (the commit-log store's directory), a new world must get a
+        # fresh one. When the store exposes durability boundaries
+        # (set_boundary), they enter the kill-schedule enumeration as
+        # `store.<op>` crossings: segment append/seal, snapshot write,
+        # index publish, compaction swap.
+        self.store_factory = store_factory
+        self._world_seq = 0
         # generous silence bound: every kill heals within a few steps,
         # so `shard-unavailable` must never be the answer — any
         # unavailability IS a violation in this rig
@@ -1147,6 +1158,9 @@ class CrashScheduleExplorer:
         w.requester = Party("explorer", kp.public)
         w.intents = {}
         w.provs = {}
+        w.stores = {}
+        w.world_id = self._world_seq
+        self._world_seq += 1
         for m in self.members:
             db = w.dbs[m]
             w.intents[m] = _JournalTap(
@@ -1166,15 +1180,32 @@ class CrashScheduleExplorer:
         )
 
         db = w.dbs[m]
+        if self.store_factory is not None:
+            # reopening the member's surviving store directory IS the
+            # boot replay under test; the old incarnation's handles
+            # close first (the process died, its fds died with it)
+            old = w.stores.get(m)
+            if old is not None and hasattr(old, "close"):
+                old.close()
+            store = self.store_factory(w.world_id, m)
+            if hasattr(store, "set_boundary"):
+                store.set_boundary(
+                    lambda op, when, _m=m: self._boundary(
+                        _m, f"store.{op}", when
+                    )
+                )
+            w.stores[m] = store
+        else:
+            store = ShardedPersistentUniquenessProvider(
+                db, self.n_partitions
+            )
         return self.provider_cls(
             m,
             self.members,
             w.net.endpoint(m),
             w.clock,
             n_partitions=self.n_partitions,
-            store=ShardedPersistentUniquenessProvider(
-                db, self.n_partitions
-            ),
+            store=store,
             journal=_JournalTap(
                 XShardCoordinatorJournal(db), m, "coord", self
             ),
@@ -1445,6 +1476,9 @@ class CrashScheduleExplorer:
                 f"schedule did not converge in {self.MAX_STEPS} steps"
             )
         violations.extend(self._invariants(w, subs))
+        for store in w.stores.values():
+            if hasattr(store, "close"):
+                store.close()
         sig = hashlib.sha256(
             (
                 f"{sched.kind}|{sched.kill_index}|{sched.kill_phase}|"

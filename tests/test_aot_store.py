@@ -43,6 +43,7 @@ def _reqs(n=6, seed=3):
     return out
 
 
+@pytest.mark.slow
 def test_artifact_roundtrip_and_reuse(store):
     reqs = _reqs()
     want = CpuBatchVerifier().verify_batch(reqs)
@@ -57,6 +58,7 @@ def test_artifact_roundtrip_and_reuse(store):
     assert len(os.listdir(store)) == 1   # reused, not rebuilt
 
 
+@pytest.mark.slow
 def test_corrupt_artifact_falls_back_and_is_dropped(store):
     reqs = _reqs()
     want = CpuBatchVerifier().verify_batch(reqs)
@@ -93,6 +95,7 @@ def test_key_tracks_code_and_knobs(store, monkeypatch):
     assert p4 != p1
 
 
+@pytest.mark.slow
 def test_kill_switch(store, monkeypatch):
     monkeypatch.setenv("CORDA_TPU_AOT", "0")
     reqs = _reqs()

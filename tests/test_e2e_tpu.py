@@ -102,6 +102,7 @@ def test_tampered_signature_rejected_on_tpu_verifier(net):
     assert "invalid" in str(exc.value).lower()
 
 
+@pytest.mark.slow
 def test_dvp_arc_on_mesh_sharded_verifier():
     """The SAME full-pipeline arc with the mesh-sharded SPI branch
     (TpuBatchVerifier(mesh=...) over the conftest 8-virtual-CPU mesh):

@@ -176,6 +176,7 @@ def test_ed_double_scalar_mul():
         assert (gx[i], gy[i]) == want, f"case {i}"
 
 
+@pytest.mark.slow
 def test_windowed_double_scalar_mul_matches_plain():
     """w=4 fixed-window Shamir (ec.wei_double_scalar_mul_windowed) must
     agree with the plain ladder for full-width scalars on both curves —
@@ -216,6 +217,7 @@ def test_windowed_double_scalar_mul_matches_plain():
             assert aff_w == aff_p
 
 
+@pytest.mark.slow
 def test_ed_windowed_double_scalar_mul_matches_plain():
     import random
 

@@ -381,6 +381,7 @@ def test_raft_soak_cluster_story_tracks_injected_reality(raft_report):
     assert all(final["healthz"].values())
 
 
+@pytest.mark.slow
 def test_bft_soak_survives_slow_peer_and_replica_restart():
     """Acceptance on the 4-replica BFT cluster (same ≥1024-identity
     fleet, round-robin sample): a slow replica and a killed+restarted

@@ -57,7 +57,14 @@ def test_make_mesh_shapes():
     assert mesh.axis_names == (meshlib.BATCH_AXIS,)
 
 
-@pytest.mark.parametrize("scheme_id", MESH_SCHEMES)
+@pytest.mark.parametrize(
+    "scheme_id",
+    [
+        MESH_SCHEMES[0],
+        pytest.param(MESH_SCHEMES[1], marks=pytest.mark.slow),
+        pytest.param(MESH_SCHEMES[2], marks=pytest.mark.slow),
+    ],
+)
 def test_mesh_matches_cpu_single_scheme(mesh, scheme_id):
     rng = random.Random(scheme_id)
     reqs = _requests(scheme_id, rng, 9)  # forces padding: 9 -> 16
@@ -88,6 +95,7 @@ def test_mesh_mixed_schemes_and_cpu_fallback(mesh):
     assert got == want
 
 
+@pytest.mark.slow
 def test_mesh_chunking_over_largest_batch(mesh):
     """More requests than the largest batch size: chunked dispatch over
     the mesh must still preserve order."""
@@ -99,6 +107,7 @@ def test_mesh_chunking_over_largest_batch(mesh):
     assert got == want
 
 
+@pytest.mark.slow
 def test_mesh_2d_dcn_ici_matches_cpu():
     """The multi-host mesh shape: batch sharded over BOTH axes of a
     2x4 (dcn x ici) mesh, bit-exact vs the CPU reference including

@@ -124,6 +124,7 @@ def test_oracle_refuses_wrong_rate_and_extra_reveals():
         oracle.sign(ftx_leaky)
 
 
+@pytest.mark.slow
 def test_simm_demo():
     """Two-node agreement on a MIXED multi-risk-class portfolio:
     3 swaps + 2 swaptions + 2 FX forwards + 2 CDS + 2 equity options +

@@ -502,9 +502,11 @@ def test_bench_quick_sanitizer_smoke():
         # few ms and scheduler noise alone exceeds 1% — the smoke
         # proves the record shape and the passthrough, at a
         # noise-floor gate; the default-table run keeps the honest 1%
+        # (6% not 3%: on a single-vCPU CI box the mid-suite scheduler
+        # jitter alone reaches ~4% of a few-ms wall)
         env={**os.environ, "JAX_PLATFORMS": "cpu", "BENCH_BATCH": "48",
              "BENCH_ITERS": "3",
-             "BENCH_SANITIZER_OVERHEAD_MAX": "0.03"},
+             "BENCH_SANITIZER_OVERHEAD_MAX": "0.06"},
         capture_output=True, text=True, timeout=420,
     )
     assert out.returncode == 0, out.stderr + out.stdout

@@ -89,6 +89,7 @@ def test_batch_matches_reference_and_openssl(scheme_id):
                 assert ossl == expected
 
 
+@pytest.mark.slow
 def test_mixed_scheme_batch():
     """One batch spanning all three EC schemes, order preserved."""
     rng = random.Random(99)
